@@ -1,0 +1,52 @@
+"""distributed_eigenspaces_tpu — a TPU-native online distributed PCA framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capabilities of the reference
+``TimeEscaper/distributed_eigenspaces`` (online distributed principal eigenspace
+estimation):
+
+- the reference's per-worker covariance + top-k eigensolve
+  (``distributed.py:59-70``, ``distributed.py:22-29``) becomes XLA matmul +
+  ``jnp.linalg.eigh`` / streaming subspace iteration (:mod:`.ops.linalg`);
+- the RabbitMQ master/worker topology (``distributed.py:82-143``) becomes a
+  :class:`~distributed_eigenspaces_tpu.parallel.WorkerPool` over a
+  ``jax.sharding.Mesh``, with the projector merge as a ``lax.pmean`` allreduce
+  over ICI (:mod:`.parallel`);
+- the notebook's online outer loop (cell 16) becomes
+  :func:`~distributed_eigenspaces_tpu.algo.online_distributed_pca`, implementing
+  the pseudocode exactly (:mod:`.algo`);
+- the CIFAR pickle loader (``load_data.py:1-76``) is reproduced with a
+  grayscale/RGB toggle plus synthetic and streaming sources (:mod:`.data`).
+"""
+
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.ops.linalg import (
+    gram,
+    top_k_eigvecs,
+    principal_angles,
+    principal_angles_degrees,
+    projector,
+    subspace_iteration,
+)
+from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
+from distributed_eigenspaces_tpu.algo.online import (
+    online_distributed_pca,
+    one_shot_round,
+)
+from distributed_eigenspaces_tpu.api.estimator import OnlineDistributedPCA
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "PCAConfig",
+    "gram",
+    "top_k_eigvecs",
+    "principal_angles",
+    "principal_angles_degrees",
+    "projector",
+    "subspace_iteration",
+    "WorkerPool",
+    "online_distributed_pca",
+    "one_shot_round",
+    "OnlineDistributedPCA",
+    "__version__",
+]

@@ -1,0 +1,15 @@
+"""Algorithm layer: the online distributed PCA outer loop and one-shot round.
+
+Implements the pseudocode at reference ``assets/algorithm.png`` (notebook cell
+12) exactly — unlike the reference, which diverges in the AMQP path (single
+round, result discarded — SURVEY.md §2.2-B4) and the notebook (static data,
+wrong discount — §2.2-B6).
+"""
+
+from distributed_eigenspaces_tpu.algo.online import (
+    online_distributed_pca,
+    one_shot_round,
+    OnlineState,
+)
+
+__all__ = ["online_distributed_pca", "one_shot_round", "OnlineState"]

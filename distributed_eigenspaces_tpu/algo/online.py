@@ -1,0 +1,170 @@
+"""Online distributed PCA — the outer time loop over a worker pool.
+
+The algorithm (reference pseudocode, ``assets/algorithm.png`` / notebook cell
+12; executed prototype at notebook cell 16):
+
+    sigma_tilde(0) = 0
+    for t = 1..T:
+        per worker l: V_hat_l = top-k eigvecs of (1/n) X_l^T X_l
+        sigma_bar = (1/m) sum_l V_hat_l V_hat_l^T       # one pmean on TPU
+        v_bar = top-k eigvecs of sigma_bar
+        sigma_tilde += discount * v_bar v_bar^T
+    output: top-k eigvecs of sigma_tilde
+
+Deliberate fixes over the reference (SURVEY.md §2.2):
+  - B4: the final ``top_k(sigma_tilde)`` is actually computed and returned
+    (the reference master discards the merge and never exits).
+  - B6: the data stream *advances* every step (the notebook re-read the same
+    first m batches forever), and the discount follows the pseudocode
+    (``1/T``) or a true running mean (``1/t``); the notebook's buggy
+    ``1/(t+1)``/T-1-step variant survives only behind ``discount="notebook"``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.ops.linalg import projector, top_k_eigvecs
+from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
+
+
+class OnlineState(NamedTuple):
+    """Checkpointable algorithm state (SURVEY.md §5.4): tiny and complete.
+
+    ``sigma_tilde`` is the (d, d) running projector average; ``step`` is the
+    1-based count of merge rounds already folded in. Together with the data
+    stream's cursor this is everything needed to resume.
+    """
+
+    sigma_tilde: jax.Array
+    step: jax.Array  # int32 scalar
+
+    @classmethod
+    def initial(cls, dim: int, dtype=jnp.float32) -> "OnlineState":
+        return cls(
+            sigma_tilde=jnp.zeros((dim, dim), dtype=dtype),
+            step=jnp.zeros((), dtype=jnp.int32),
+        )
+
+
+def _discount(rule: str, step: jax.Array, num_steps: int) -> jax.Array:
+    """Per-step weight applied to the new projector. ``step`` is 1-based."""
+    if rule == "1/T":
+        return jnp.asarray(1.0 / num_steps, jnp.float32)
+    if rule == "1/t":
+        # running mean: sigma_tilde <- (1 - 1/t) sigma_tilde + (1/t) P
+        return 1.0 / step.astype(jnp.float32)
+    if rule == "notebook":
+        # bug-compatible 1/(t+1) additive weight (notebook cell 16, B6)
+        return 1.0 / (step.astype(jnp.float32) + 1.0)
+    raise ValueError(rule)
+
+
+def update_state(
+    state: OnlineState,
+    v_bar: jax.Array,
+    *,
+    discount: str,
+    num_steps: int,
+) -> OnlineState:
+    """Fold one merged eigenspace into the online running average (jittable)."""
+    step = state.step + 1
+    w = _discount(discount, step, num_steps)
+    p = projector(v_bar).astype(state.sigma_tilde.dtype)
+    if discount == "1/t":
+        sigma = state.sigma_tilde * (1.0 - w) + p * w
+    else:
+        sigma = state.sigma_tilde + p * w
+    return OnlineState(sigma_tilde=sigma, step=step)
+
+
+def online_distributed_pca(
+    stream: Iterable[jax.Array],
+    cfg: PCAConfig,
+    *,
+    pool: WorkerPool | None = None,
+    state: OnlineState | None = None,
+    on_step: Callable[[int, OnlineState, jax.Array], None] | None = None,
+    worker_masks: Iterator[jax.Array] | None = None,
+    max_steps: int | None | str = "auto",
+):
+    """Run the full online algorithm over a stream of ``(m, n, d)`` blocks.
+
+    Args:
+      stream: iterable yielding per-step worker blocks, shape
+        ``(num_workers, rows_per_worker, dim)``. The stream *advances* —
+        each step consumes fresh data (fixes B6).
+      cfg: algorithm configuration. ``cfg.num_steps`` caps the loop; a
+        shorter stream ends it early (true online behavior).
+      pool: optional pre-built WorkerPool (else built from cfg).
+      state: optional resume state (checkpoint restart, SURVEY.md §5.4).
+      on_step: optional callback ``(t, state, v_bar)`` after each fold —
+        metrics/checkpoint hook.
+      worker_masks: optional iterator of ``(m,)`` {0,1} masks for fault
+        injection (SURVEY.md §5.3).
+      max_steps: ``"auto"`` caps the *total* step count (including resumed
+        state) at ``cfg.num_steps``; ``None`` consumes the whole stream
+        (``partial_fit`` semantics — fold extra rounds past T); an int is an
+        explicit total cap.
+
+    Returns:
+      ``(w, state)`` — ``w`` the final (dim, k) principal subspace estimate
+      (descending order, canonical signs), ``state`` the final online state.
+    """
+    if pool is None:
+        pool = WorkerPool(
+            cfg.num_workers,
+            backend="local" if cfg.backend == "auto" and len(jax.devices()) == 1
+            else ("shard_map" if cfg.backend == "auto" else cfg.backend),
+            solver=cfg.solver,
+            subspace_iters=cfg.subspace_iters,
+        )
+    if state is None:
+        state = OnlineState.initial(cfg.dim, cfg.state_dtype)
+
+    update = jax.jit(
+        lambda s, v: update_state(
+            s, v, discount=cfg.discount, num_steps=cfg.num_steps
+        )
+    )
+
+    cap = cfg.num_steps if max_steps == "auto" else max_steps
+    steps_done = int(state.step)
+    for x_blocks in stream:
+        if cap is not None and steps_done >= cap and cfg.discount != "1/t":
+            break
+        mask = next(worker_masks) if worker_masks is not None else None
+        x_blocks = pool.shard(x_blocks)
+        _, v_bar = pool.round(x_blocks, cfg.k, worker_mask=mask)
+        state = update(state, v_bar)
+        steps_done += 1
+        if on_step is not None:
+            on_step(steps_done, state, v_bar)
+
+    w = top_k_eigvecs(state.sigma_tilde, cfg.k)
+    return w, state
+
+
+def one_shot_round(
+    x_blocks: jax.Array,
+    k: int,
+    *,
+    pool: WorkerPool | None = None,
+    backend: str = "auto",
+):
+    """Single distributed round — parity with ``python distributed.py``.
+
+    The reference AMQP path runs exactly one merge round and then drops the
+    result on the floor (``distributed.py:126-131``, B4). This returns both
+    the merged projector average ``sigma_bar`` (what the master computed) and
+    its top-k eigenspace (what it should have produced).
+    """
+    m = x_blocks.shape[0]
+    if pool is None:
+        pool = WorkerPool(m, backend=backend)
+    sigma_bar, v_bar = pool.round(pool.shard(x_blocks), k)
+    return sigma_bar, v_bar

@@ -1,0 +1,5 @@
+"""Public estimator API — the notebook-compatible surface (SURVEY.md §7.5)."""
+
+from distributed_eigenspaces_tpu.api.estimator import OnlineDistributedPCA
+
+__all__ = ["OnlineDistributedPCA"]

@@ -1,0 +1,124 @@
+"""Estimator-style wrapper: fit / transform / components, sklearn-shaped.
+
+The reference validates its result by eyeballing a scatter of ``data @ W``
+against ``sklearn.decomposition.PCA(2)`` (notebook cells 17-22). This class
+packages the same workflow — ``W = fit(data)``, ``transform(x) = x @ W`` —
+as a real API, with the worker pool and online loop behind it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.algo.online import (
+    OnlineState,
+    online_distributed_pca,
+)
+from distributed_eigenspaces_tpu.data.stream import block_stream
+from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
+
+
+class OnlineDistributedPCA:
+    """Online distributed PCA estimator.
+
+    Example (the notebook cell 16-20 workflow, one call)::
+
+        pca = OnlineDistributedPCA(PCAConfig(dim=1024, k=2, num_workers=10,
+                                             rows_per_worker=8, num_steps=10))
+        pca.fit(data)                  # data: (N, 1024)
+        z = pca.transform(data)        # (N, 2)
+        W = pca.components_            # (1024, 2), descending, canonical signs
+    """
+
+    def __init__(self, cfg: PCAConfig, *, pool: WorkerPool | None = None):
+        self.cfg = cfg
+        self.pool = pool
+        self.state: OnlineState | None = None
+        self._w: jax.Array | None = None
+
+    # -- fitting ------------------------------------------------------------
+
+    def fit(self, data, *, on_step=None, worker_masks=None) -> "OnlineDistributedPCA":
+        """Fit on a (N, dim) array, streaming it as ``num_steps`` blocks of
+        ``num_workers x rows_per_worker`` rows (advancing cursor — B6 fix).
+
+        ``fit`` starts fresh (sklearn semantics — prior state is discarded);
+        use :meth:`fit_stream`/:meth:`partial_fit` to continue a run.
+        """
+        self.state = None
+        self._w = None
+        cfg = self.cfg
+        stream = block_stream(
+            data,
+            num_workers=cfg.num_workers,
+            rows_per_worker=cfg.rows_per_worker,
+            num_steps=cfg.num_steps,
+            remainder=cfg.remainder,
+            dtype=cfg.dtype,
+        )
+        return self.fit_stream(stream, on_step=on_step, worker_masks=worker_masks)
+
+    def fit_stream(self, stream, *, on_step=None, worker_masks=None,
+                   max_steps="auto"):
+        """Fit on an iterable of pre-blocked ``(m, n, dim)`` arrays."""
+        w, state = online_distributed_pca(
+            stream,
+            self.cfg,
+            pool=self.pool,
+            state=self.state,
+            on_step=on_step,
+            worker_masks=worker_masks,
+            max_steps=max_steps,
+        )
+        self._w, self.state = w, state
+        return self
+
+    def partial_fit(self, x_blocks) -> "OnlineDistributedPCA":
+        """Fold one more ``(m, n, dim)`` step into the running estimate
+        (no step cap — extra online rounds past T keep refining)."""
+        return self.fit_stream([jnp.asarray(x_blocks)], max_steps=None)
+
+    # -- results ------------------------------------------------------------
+
+    @property
+    def components_(self) -> jax.Array:
+        """(dim, k) estimated principal directions (descending order)."""
+        if self._w is None:
+            raise RuntimeError("call fit() first")
+        return self._w
+
+    # The reference calls this "matrix_w" (notebook cell 17-18).
+    matrix_w = components_
+
+    def transform(self, x) -> jax.Array:
+        """Project ``(N, dim) -> (N, k)`` (notebook cells 19-20: ``data @ W``)."""
+        x = jnp.asarray(x, dtype=self.cfg.dtype)
+        prec = jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
+        return jnp.matmul(x, self.components_.astype(x.dtype), precision=prec)
+
+    def fit_transform(self, data, **kw) -> jax.Array:
+        return self.fit(data, **kw).transform(data)
+
+    def inverse_transform(self, z) -> jax.Array:
+        """Back-project ``(N, k) -> (N, dim)`` (reconstruction)."""
+        return jnp.asarray(z) @ self.components_.T
+
+    def score(self, x, exact_w=None) -> dict:
+        """Diagnostics: explained variance ratio on ``x``; if ``exact_w`` is
+        given, worst principal angle (degrees) vs that subspace."""
+        from distributed_eigenspaces_tpu.ops.linalg import (
+            principal_angles_degrees,
+        )
+
+        x = jnp.asarray(x, dtype=self.cfg.dtype)
+        z = x @ self.components_
+        total = jnp.sum(jnp.var(x, axis=0))
+        explained = jnp.sum(jnp.var(z, axis=0))
+        out = {"explained_variance_ratio": float(explained / total)}
+        if exact_w is not None:
+            ang = principal_angles_degrees(self.components_, jnp.asarray(exact_w))
+            out["max_principal_angle_deg"] = float(jnp.max(ang))
+        return out

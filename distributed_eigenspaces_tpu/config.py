@@ -1,0 +1,81 @@
+"""Single configuration dataclass for the framework (SURVEY.md §5.6).
+
+Replaces the reference's scattered knobs: the 5 argparse flags
+(``distributed.py:157-162``) and the notebook constants ``m=10, T=10, k=2,
+batch_size=8`` (cells 9, 16), plus everything the reference hardcoded
+(5-deep prefetch at ``distributed.py:108``, silent remainder drop at
+``distributed.py:99-104``, grayscale at ``distributed.py:170-173``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PCAConfig:
+    """Configuration for online distributed PCA.
+
+    Attributes:
+      dim: feature dimension d (reference: 1024 grayscale / 3072 RGB, B7).
+      k: subspace rank ("--rank" in the reference CLI, ``distributed.py:160``).
+      num_workers: m, the worker count (notebook ``m=10``; becomes the size of
+        the ``workers`` mesh axis on TPU).
+      rows_per_worker: n, rows each worker consumes per outer step (notebook
+        ``batch_size=8``).
+      num_steps: T, outer online steps (notebook ``T=10``).
+      discount: online averaging rule for ``sigma_tilde``:
+        ``"1/T"`` — the pseudocode (``assets/algorithm.png``);
+        ``"1/t"``  — running mean, 1/t at step t (what an online estimator wants);
+        ``"notebook"`` — bug-compatible ``1/(t+1)``, t in 1..T-1 (SURVEY §2.2-B6),
+        for parity experiments only.
+      backend: worker-pool backend: ``"auto"`` | ``"local"`` (vmap, single
+        device) | ``"shard_map"`` (mesh DP over ICI) | ``"feature_sharded"``
+        (2-D mesh, d sharded too — the large-d path).
+      solver: local top-k eigensolver: ``"eigh"`` (exact, d<=~4096) or
+        ``"subspace"`` (block power iteration; never materializes d x d in the
+        streaming path).
+      subspace_iters: power-iteration steps when ``solver="subspace"``.
+      dtype: storage/compute dtype for data blocks (bfloat16 keeps the MXU
+        saturated; accumulation is always fp32 inside the kernels).
+      state_dtype: dtype of the running ``sigma_tilde`` state.
+      remainder: batcher remainder policy: ``"drop"`` (reference CLI behavior,
+        ``distributed.py:99-104``), ``"pad"`` (zero-pad final block, weighted
+        correctly), or ``"error"``.
+      mesh_shape: optional explicit mesh layout, e.g. ``{"workers": 4,
+        "features": 2}``; ``None`` = one ``workers`` axis over all devices.
+      seed: PRNG seed for initialization (subspace solver, synthetic data).
+    """
+
+    dim: int
+    k: int
+    num_workers: int = 8
+    rows_per_worker: int = 128
+    num_steps: int = 10
+    discount: str = "1/T"
+    backend: str = "auto"
+    solver: str = "eigh"
+    subspace_iters: int = 16
+    dtype: Any = jnp.float32
+    state_dtype: Any = jnp.float32
+    remainder: str = "drop"
+    mesh_shape: dict[str, int] | None = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.discount not in ("1/T", "1/t", "notebook"):
+            raise ValueError(f"unknown discount rule: {self.discount!r}")
+        if self.backend not in ("auto", "local", "shard_map", "feature_sharded"):
+            raise ValueError(f"unknown backend: {self.backend!r}")
+        if self.solver not in ("eigh", "subspace"):
+            raise ValueError(f"unknown solver: {self.solver!r}")
+        if self.remainder not in ("drop", "pad", "error"):
+            raise ValueError(f"unknown remainder policy: {self.remainder!r}")
+        if not (0 < self.k <= self.dim):
+            raise ValueError(f"need 0 < k <= dim, got k={self.k}, dim={self.dim}")
+
+    def replace(self, **kw) -> "PCAConfig":
+        return dataclasses.replace(self, **kw)

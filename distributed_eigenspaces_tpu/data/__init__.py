@@ -1,0 +1,36 @@
+"""Data layer (L1 of the reference layer map): loaders + sharded streaming.
+
+Feature parity with reference ``load_data.py:1-76`` (CIFAR pickle loading)
+plus what the reference lacked: a synthetic planted-spectrum generator (the
+correctness config in BASELINE.md), MNIST-like streaming, and an explicit
+batcher remainder policy (the reference silently dropped the tail,
+``distributed.py:99-104`` — SURVEY.md §2.2-B5).
+"""
+
+from distributed_eigenspaces_tpu.data.cifar import (
+    unpickle,
+    load_cifar10,
+    load_CIFAR_10_data,
+    preprocess,
+)
+from distributed_eigenspaces_tpu.data.synthetic import (
+    planted_spectrum,
+    PlantedSpectrum,
+)
+from distributed_eigenspaces_tpu.data.stream import (
+    block_stream,
+    make_batches,
+    synthetic_stream,
+)
+
+__all__ = [
+    "unpickle",
+    "load_cifar10",
+    "load_CIFAR_10_data",
+    "preprocess",
+    "planted_spectrum",
+    "PlantedSpectrum",
+    "block_stream",
+    "make_batches",
+    "synthetic_stream",
+]

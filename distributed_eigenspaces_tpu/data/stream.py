@@ -1,0 +1,100 @@
+"""Streaming batcher: host rows -> per-step (m, n, d) worker blocks.
+
+Replaces both reference batchers (C6, SURVEY.md §2): the notebook's
+``make_batches`` (cell 8, ragged tail kept) and the CLI's contiguous split
+that silently drops the remainder (``distributed.py:99-104``). The remainder
+policy here is explicit, and the stream **advances** its cursor every step —
+the notebook re-read the same first m batches forever (B6).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_batches(n_rows: int, batch_size: int, *, keep_tail: bool = True):
+    """Contiguous index ranges [(lo, hi), ...] — reference cell 8 semantics
+    (``keep_tail=True``) or the CLI's drop behavior (``False``)."""
+    ranges = [
+        (lo, min(lo + batch_size, n_rows))
+        for lo in range(0, n_rows, batch_size)
+    ]
+    if not keep_tail and ranges and ranges[-1][1] - ranges[-1][0] < batch_size:
+        ranges.pop()
+    return ranges
+
+
+def block_stream(
+    data,
+    *,
+    num_workers: int,
+    rows_per_worker: int,
+    num_steps: int | None = None,
+    remainder: str = "drop",
+    dtype=jnp.float32,
+    wrap: bool = False,
+) -> Iterator[jax.Array]:
+    """Yield (num_workers, rows_per_worker, d) blocks from (N, d) host data.
+
+    Each step consumes ``num_workers * rows_per_worker`` fresh rows (advancing
+    cursor — the B6 fix). Remainder policy for the final partial step:
+    ``"drop"`` (reference behavior), ``"pad"`` (zero rows; the Gram kernel
+    normalizes by the *unpadded* count upstream, so pad only when callers
+    handle weighting), or ``"error"``. ``wrap=True`` restarts from row 0
+    instead of stopping (infinite epochs for throughput benchmarking).
+    """
+    data = np.asarray(data)
+    n_total, d = data.shape
+    step_rows = num_workers * rows_per_worker
+    if step_rows > n_total:
+        raise ValueError(
+            f"one step needs {step_rows} rows, dataset has {n_total}"
+        )
+    cursor, steps = 0, 0
+    while num_steps is None or steps < num_steps:
+        if cursor + step_rows > n_total:
+            if wrap:
+                cursor = 0
+            else:
+                tail = n_total - cursor
+                if tail and remainder == "error":
+                    raise ValueError(
+                        f"{tail} remainder rows (step={step_rows}); set "
+                        "remainder='drop'/'pad' or adjust sizes"
+                    )
+                if tail and remainder == "pad":
+                    block = np.zeros((step_rows, d), dtype=data.dtype)
+                    block[:tail] = data[cursor:]
+                    yield jnp.asarray(
+                        block.reshape(num_workers, rows_per_worker, d),
+                        dtype=dtype,
+                    )
+                break
+        block = data[cursor : cursor + step_rows]
+        cursor += step_rows
+        steps += 1
+        yield jnp.asarray(
+            block.reshape(num_workers, rows_per_worker, d), dtype=dtype
+        )
+
+
+def synthetic_stream(
+    spectrum,
+    *,
+    num_workers: int,
+    rows_per_worker: int,
+    num_steps: int,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> Iterator[jax.Array]:
+    """Infinite-data analogue of :func:`block_stream`: fresh planted-spectrum
+    draws each step (true online setting; also the benchmark feed)."""
+    key = jax.random.PRNGKey(seed)
+    for _ in range(num_steps):
+        key, sub = jax.random.split(key)
+        x = spectrum.sample(sub, num_workers * rows_per_worker, dtype=dtype)
+        yield x.reshape(num_workers, rows_per_worker, -1)

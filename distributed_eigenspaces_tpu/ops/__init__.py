@@ -1,0 +1,30 @@
+"""Numeric kernels (L0/L2 of the reference layer map, SURVEY.md §1).
+
+Pure-JAX replacements for the reference's NumPy/SciPy BLAS/LAPACK layer:
+``np.dot(x.T, x)`` (``distributed.py:68``) and
+``scipy.linalg.eigh(..., eigvals=...)`` (``distributed.py:29``).
+"""
+
+from distributed_eigenspaces_tpu.ops.linalg import (
+    gram,
+    top_k_eigvecs,
+    canonicalize_signs,
+    principal_angles,
+    principal_angles_degrees,
+    projector,
+    merge_projectors,
+    subspace_iteration,
+    top_k_eigvecs_streaming,
+)
+
+__all__ = [
+    "gram",
+    "top_k_eigvecs",
+    "canonicalize_signs",
+    "principal_angles",
+    "principal_angles_degrees",
+    "projector",
+    "merge_projectors",
+    "subspace_iteration",
+    "top_k_eigvecs_streaming",
+]

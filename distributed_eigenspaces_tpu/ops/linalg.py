@@ -1,0 +1,248 @@
+"""Core linear-algebra kernels for online distributed PCA, TPU-first.
+
+These are the XLA-native replacements for the reference's numeric layer:
+
+- :func:`gram` replaces ``SlaveNode.compute_sigma_hat_``
+  (reference ``distributed.py:59-70``): the local d x d sample covariance
+  ``(1/n) X^T X``. On TPU this is a single MXU matmul with fp32 accumulation.
+- :func:`top_k_eigvecs` replaces ``Node.top_k_eigenvectors``
+  (reference ``distributed.py:22-29``, which used the removed
+  ``scipy.linalg.eigh(eigvals=...)`` API and returned columns in *ascending*
+  eigenvalue order — SURVEY.md §2.2-B2/B3). Ours returns **descending** order
+  with deterministically canonicalized column signs.
+- :func:`principal_angles` is the correctness oracle the reference only
+  gestured at with a scatter-plot A/B against sklearn (notebook cells 21-22):
+  the angles between recovered and exact subspaces.
+- :func:`subspace_iteration` is the large-d solver: block power iteration that
+  needs only ``A @ V`` products, so the d x d matrix never has to be
+  materialized for the streaming/feature-sharded configs (SURVEY.md §7.7).
+
+All functions are jit-compatible, shape-polymorphic only in the usual traced
+sense (static shapes per compile), and avoid data-dependent Python control
+flow.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _precision(*arrays):
+    """HIGHEST precision for fp32 inputs (full fp32 matmul — without this,
+    XLA's default decomposes fp32 matmuls into bf16 passes and covariance
+    accuracy collapses); default (MXU-native) for bf16 inputs, which is the
+    intended fast path."""
+    if any(a.dtype == jnp.float32 for a in arrays):
+        return lax.Precision.HIGHEST
+    return None
+
+
+def gram(x: jax.Array, *, normalize: bool = True) -> jax.Array:
+    """Sample second-moment matrix ``(1/n) X^T X`` of a row-block ``X (n, d)``.
+
+    The local covariance kernel of the algorithm (pseudocode line
+    ``sigma_hat = (1/n) sum_i x_i x_i^T``; executed-truth form at reference
+    ``distributed.py:67-69``). Accumulates in float32 regardless of input
+    dtype so bfloat16 inputs keep MXU throughput without losing the merge's
+    numerical fidelity.
+    """
+    n = x.shape[0]
+    g = jnp.einsum(
+        "ni,nj->ij",
+        x,
+        x,
+        preferred_element_type=jnp.float32,
+        precision=_precision(x),
+    )
+    if normalize:
+        g = g / jnp.asarray(n, dtype=g.dtype)
+    return g
+
+
+def canonicalize_signs(v: jax.Array) -> jax.Array:
+    """Flip column signs so each column's largest-|entry| element is positive.
+
+    Eigenvectors are only defined up to sign; LAPACK/XLA may return either.
+    The algorithm itself is sign-invariant (it only ever uses projectors
+    ``V V^T``), but a deterministic sign makes the public API stable and makes
+    test assertions exact (SURVEY.md §2.2-B3).
+    """
+    idx = jnp.argmax(jnp.abs(v), axis=0)
+    pivot = jnp.take_along_axis(v, idx[None, :], axis=0)[0]
+    signs = jnp.where(pivot >= 0, 1.0, -1.0).astype(v.dtype)
+    return v * signs[None, :]
+
+
+@partial(jax.jit, static_argnames=("k",))
+def top_k_eigvecs(m: jax.Array, k: int) -> jax.Array:
+    """Top-k eigenvectors of a symmetric matrix, descending eigenvalue order.
+
+    Replaces reference ``distributed.py:22-29``. ``jnp.linalg.eigh`` returns
+    ascending eigenvalues; we take the trailing k columns and reverse them so
+    column 0 is the leading eigenvector, then canonicalize signs. Shape:
+    ``(d, d) -> (d, k)``.
+    """
+    m = 0.5 * (m + m.T)  # guard symmetry against accumulated round-off
+    with jax.default_matmul_precision("highest"):
+        # TPU eigh/qr lower to matmuls; without this they run in bf16 passes
+        _, v = jnp.linalg.eigh(m)
+    topk = v[:, -k:][:, ::-1]
+    return canonicalize_signs(topk)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def top_k_eig(m: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Top-k (eigenvalues, eigenvectors), both in descending eigenvalue order."""
+    m = 0.5 * (m + m.T)
+    with jax.default_matmul_precision("highest"):
+        w, v = jnp.linalg.eigh(m)
+    wk = w[-k:][::-1]
+    vk = canonicalize_signs(v[:, -k:][:, ::-1])
+    return wk, vk
+
+
+def projector(v: jax.Array) -> jax.Array:
+    """Orthogonal projector ``V V^T`` onto the column space of ``V (d, k)``.
+
+    The merge currency of the whole algorithm: workers exchange projectors,
+    not eigenvectors, which is what makes the merge sign/order-invariant
+    (reference merge at ``distributed.py:126-131``).
+    """
+    return jnp.einsum(
+        "ik,jk->ij",
+        v,
+        v,
+        preferred_element_type=jnp.float32,
+        precision=_precision(v),
+    ).astype(v.dtype)
+
+
+def merge_projectors(v_stack: jax.Array) -> jax.Array:
+    """``(m, d, k) -> (d, d)`` mean of per-worker projectors.
+
+    The reference computes this serially on the master
+    (``distributed.py:126-131``); here it is one batched einsum, and under
+    ``shard_map`` the mean lowers to a ``pmean`` allreduce over ICI.
+    """
+    m = v_stack.shape[0]
+    p = jnp.einsum(
+        "mik,mjk->ij",
+        v_stack,
+        v_stack,
+        preferred_element_type=jnp.float32,
+        precision=_precision(v_stack),
+    )
+    return (p / m).astype(v_stack.dtype)
+
+
+def principal_angles(u: jax.Array, v: jax.Array) -> jax.Array:
+    """Principal angles (radians, ascending) between ``span(u)`` and ``span(v)``.
+
+    ``u, v`` must have orthonormal columns, shapes ``(d, k)``. This is the
+    BASELINE.json correctness metric ("principal angle vs exact SVD") —
+    the quantitative version of the reference's visual sklearn A/B check
+    (notebook cells 21-22).
+    """
+    with jax.default_matmul_precision("highest"):
+        s = jnp.linalg.svd(
+            jnp.matmul(u.T, v, precision=lax.Precision.HIGHEST),
+            compute_uv=False,
+        )
+    s = jnp.clip(s, 0.0, 1.0)
+    return jnp.sort(jnp.arccos(s))
+
+
+def principal_angles_degrees(u: jax.Array, v: jax.Array) -> jax.Array:
+    """:func:`principal_angles` in degrees (the ≤1° target unit)."""
+    return jnp.degrees(principal_angles(u, v))
+
+
+def grassmann_distance(u: jax.Array, v: jax.Array) -> jax.Array:
+    """Grassmann (geodesic) distance: l2 norm of the principal angles."""
+    return jnp.linalg.norm(principal_angles(u, v))
+
+
+def _orthonormalize(v: jax.Array) -> jax.Array:
+    """Thin-QR orthonormalization of the columns of ``v (d, k)``."""
+    with jax.default_matmul_precision("highest"):
+        q, _ = jnp.linalg.qr(v)
+    return q
+
+
+def subspace_iteration(
+    matvec,
+    d: int,
+    k: int,
+    *,
+    iters: int = 16,
+    key: jax.Array | None = None,
+    v0: jax.Array | None = None,
+) -> jax.Array:
+    """Top-k invariant subspace of a symmetric PSD operator by block power iteration.
+
+    ``matvec(V) -> A @ V`` is the only access to ``A``; for the streaming /
+    feature-sharded configs ``A = (1/n) X^T X`` is applied as
+    ``X^T (X V) / n`` per block so the d x d matrix never materializes
+    (SURVEY.md §7 "hard parts" (a)). Deterministic given ``key``/``v0``.
+
+    Not jitted itself (``matvec`` may close over traced arrays); it traces
+    cleanly inside any caller's ``jit``. For fp32 operands ``matvec`` should
+    use ``precision=lax.Precision.HIGHEST`` internally — XLA's default
+    decomposes fp32 matmuls into bf16 passes, which caps subspace accuracy
+    around a degree.
+
+    Convergence is geometric in the eigengap ratio ``(lambda_{k+1}/lambda_k)^iters``;
+    callers with tight accuracy targets should oversample (pass a larger k and
+    truncate) or raise ``iters``.
+    """
+    if v0 is None:
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        v0 = jax.random.normal(key, (d, k), dtype=jnp.float32)
+    v = _orthonormalize(v0)
+
+    def body(_, v):
+        return _orthonormalize(matvec(v))
+
+    v = jax.lax.fori_loop(0, iters, body, v)
+    # Rayleigh–Ritz: rotate the converged basis to eigenvector coordinates so
+    # columns come out in descending-eigenvalue order like top_k_eigvecs.
+    av = matvec(v)
+    small = jnp.matmul(v.T, av, precision=lax.Precision.HIGHEST)  # (k, k) sym
+    with jax.default_matmul_precision("highest"):
+        _, r = jnp.linalg.eigh(0.5 * (small + small.T))
+    v = jnp.matmul(v, r[:, ::-1], precision=lax.Precision.HIGHEST)
+    return canonicalize_signs(v)
+
+
+def top_k_eigvecs_streaming(
+    x_blocks: jax.Array,
+    k: int,
+    *,
+    iters: int = 16,
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Top-k eigenvectors of ``(1/N) X^T X`` for ``x_blocks (b, n, d)`` without
+    ever forming the d x d Gram matrix.
+
+    Each power step is two tall matmuls per block (``X V`` then ``X^T (X V)``),
+    scanned over blocks — the MXU-friendly path for d=12288-scale configs.
+    """
+    b, n, d = x_blocks.shape
+
+    prec = _precision(x_blocks)
+
+    def matvec(v):
+        def body(acc, xb):
+            xv = jnp.matmul(xb, v, precision=prec)
+            return acc + jnp.matmul(xb.T, xv, precision=prec), None
+
+        acc0 = jnp.zeros((d, v.shape[1]), dtype=jnp.float32)
+        acc, _ = jax.lax.scan(body, acc0, x_blocks)
+        return acc / (b * n)
+
+    return subspace_iteration(matvec, d, k, iters=iters, key=key)

@@ -1,0 +1,22 @@
+"""Distribution layer: mesh construction + the WorkerPool abstraction.
+
+This package replaces the reference's entire communication/runtime stack —
+AMQP transport (``distributed.py:14-20``), JSON wire protocol
+(``distributed.py:43-52,109-112``), worker consume loop
+(``distributed.py:32-57``) and master scheduler (``distributed.py:82-143``) —
+with ``jax.sharding.Mesh`` + ``shard_map`` and XLA collectives over ICI.
+"""
+
+from distributed_eigenspaces_tpu.parallel.mesh import (
+    make_mesh,
+    worker_sharding,
+    replicated_sharding,
+)
+from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
+
+__all__ = [
+    "make_mesh",
+    "worker_sharding",
+    "replicated_sharding",
+    "WorkerPool",
+]

@@ -1,0 +1,90 @@
+"""Mesh construction and sharding helpers.
+
+The reference's topology is "one master + N slave processes connected to a
+RabbitMQ broker at ``--broker IP``" (``distributed.py:157-167``). Here the
+topology is a ``jax.sharding.Mesh``: the ``workers`` axis carries data
+parallelism (one reference worker == one mesh slot), and an optional
+``features`` axis shards the d dimension for large-d configs (SURVEY.md §5.7).
+
+Multi-host: on a multi-host TPU slice, ``jax.distributed.initialize()`` (see
+:func:`initialize_multihost`) makes ``jax.devices()`` span all hosts, and the
+same mesh code scales from one chip to a pod — the DCN/ICI split is XLA's
+problem, not ours. There is no broker, no JSON, no queue.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "workers"
+FEATURE_AXIS = "features"
+
+
+def make_mesh(
+    num_workers: int | None = None,
+    num_feature_shards: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """Build a ``(workers, features)`` mesh over the available devices.
+
+    ``num_workers=None`` uses every device on the workers axis. The product
+    ``num_workers * num_feature_shards`` must divide into the device count
+    evenly (it uses exactly that many devices, allowing oversubscribed
+    layouts to be rejected loudly rather than silently wrapped — contrast the
+    reference's hardcoded 5-deep seed that crashes when ``--batches < 5``,
+    SURVEY.md §2.2-B5).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n_dev = len(devices)
+    if num_workers is None:
+        if n_dev % num_feature_shards:
+            raise ValueError(
+                f"{n_dev} devices not divisible by features={num_feature_shards}"
+            )
+        num_workers = n_dev // num_feature_shards
+    need = num_workers * num_feature_shards
+    if need > n_dev:
+        raise ValueError(
+            f"mesh {num_workers}x{num_feature_shards} needs {need} devices, "
+            f"have {n_dev}"
+        )
+    grid = np.asarray(devices[:need]).reshape(num_workers, num_feature_shards)
+    return Mesh(grid, (WORKER_AXIS, FEATURE_AXIS))
+
+
+def worker_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for per-worker data blocks ``(m, n, d)``: split axis 0 over
+    ``workers``, features replicated (1-D DP layout)."""
+    return NamedSharding(mesh, P(WORKER_AXIS))
+
+
+def feature_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for ``(m, n, d)`` blocks in the 2-D layout: rows over
+    ``workers`` and the trailing feature dim over ``features``."""
+    return NamedSharding(mesh, P(WORKER_AXIS, None, FEATURE_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (for the small ``(d, k)`` results/state)."""
+    return NamedSharding(mesh, P())
+
+
+def initialize_multihost(**kw) -> None:
+    """Initialize multi-host JAX (DCN coordination).
+
+    The TPU-native replacement for pointing every process at a broker IP
+    (``--broker``, reference ``distributed.py:159,166-167``): after this,
+    ``jax.devices()`` spans the slice and the normal mesh path handles
+    cross-host collectives. No-op if already initialized or single-process.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    try:
+        jax.distributed.initialize(**kw)
+    except (ValueError, RuntimeError):
+        # Single-process environment (no coordinator configured) — fine.
+        pass

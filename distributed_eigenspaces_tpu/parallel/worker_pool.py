@@ -1,0 +1,236 @@
+"""WorkerPool — the m-worker abstraction, backed by vmap or a device mesh.
+
+This single class replaces the reference's C10-C13 (SURVEY.md §2): AMQP
+transport, JSON protocol, the slave consume loop (``distributed.py:32-57``)
+and the master's dynamic work queue (``distributed.py:82-143``). One algorithm
+"round" — every worker computes a local covariance + top-k eigenspace, the
+projectors are averaged, the merged top-k is extracted — is a single jitted
+function; on the ``shard_map`` backend the average is a ``lax.pmean``
+allreduce over ICI instead of d x k floats serialized as JSON text
+(``distributed.py:51``).
+
+Scheduling note: the reference assigns batches to workers dynamically (LIFO
+work queue, ``distributed.py:132-137``). The merge is a permutation-invariant
+average, so *which* worker computes which batch cannot affect the result
+(tested in tests/test_worker_pool.py); static assignment is therefore
+semantically identical and lets the whole round live inside one XLA program.
+
+Fault tolerance: the reference's only mechanism is AMQP at-least-once
+redelivery (``distributed.py:53``). Here a ``worker_mask`` argument reweights
+the merge over surviving workers — a dropped shard's contribution is excluded
+exactly, and the mask is where fault-injection tests hook in (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_eigenspaces_tpu.ops.linalg import (
+    gram,
+    top_k_eigvecs,
+    subspace_iteration,
+)
+from distributed_eigenspaces_tpu.parallel.mesh import (
+    WORKER_AXIS,
+    make_mesh,
+    worker_sharding,
+)
+
+
+def _local_eigenspaces(x_blocks: jax.Array, k: int, solver: str, iters: int):
+    """Per-worker ``V_hat``: ``(m, n, d) -> (m, d, k)`` (vmapped C8 -> C7)."""
+
+    def one(xb):
+        g = gram(xb)
+        if solver == "subspace":
+            return subspace_iteration(
+                lambda v: jnp.matmul(
+                    g, v, precision=jax.lax.Precision.HIGHEST
+                ),
+                g.shape[0],
+                k,
+                iters=iters,
+            )
+        return top_k_eigvecs(g, k)
+
+    return jax.vmap(one)(x_blocks)
+
+
+def _masked_projector_mean(v_stack: jax.Array, mask: jax.Array) -> jax.Array:
+    """Weighted mean of projectors ``V V^T`` over workers with mask (m,) in {0,1}.
+
+    Returns the *sum* of masked projectors and the mask count; callers divide
+    after any cross-device reduction so the global mean is exact even when
+    shards carry different numbers of surviving workers.
+    """
+    w = mask.astype(jnp.float32)
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if v_stack.dtype == jnp.float32
+        else None
+    )
+    p = jnp.einsum(
+        "mik,mjk,m->ij",
+        v_stack,
+        v_stack,
+        w,
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )
+    return p, jnp.sum(w)
+
+
+class WorkerPool:
+    """Pool of ``m`` logical PCA workers.
+
+    Backends:
+      - ``"local"``: single-device, workers vmapped over a leading axis — the
+        TPU equivalent of the notebook's ``for l in range(m)`` loop (cell 16).
+      - ``"shard_map"``: workers spread over the ``workers`` mesh axis; the
+        projector merge is a ``pmean`` over ICI. ``m`` must be a multiple of
+        the mesh's worker-axis size (each device carries ``m / axis_size``
+        workers, vmapped).
+      - ``"auto"``: ``shard_map`` when >1 device is visible, else ``local``.
+
+    The per-round math is identical across backends (tested); the backend is
+    purely a placement/communication choice — the ``backend="tpu"``-flag idea
+    from BASELINE.json's north star.
+    """
+
+    def __init__(
+        self,
+        num_workers: int,
+        *,
+        backend: str = "auto",
+        mesh: Mesh | None = None,
+        solver: str = "eigh",
+        subspace_iters: int = 16,
+    ):
+        if backend == "auto":
+            backend = "shard_map" if len(jax.devices()) > 1 else "local"
+        if backend not in ("local", "shard_map"):
+            raise ValueError(f"unknown WorkerPool backend: {backend!r}")
+        self.num_workers = num_workers
+        self.backend = backend
+        self.solver = solver
+        self.subspace_iters = subspace_iters
+        if backend == "shard_map":
+            if mesh is None:
+                n_dev = len(jax.devices())
+                shards = _largest_divisor_leq(num_workers, n_dev)
+                mesh = make_mesh(num_workers=shards)
+            axis = mesh.shape[WORKER_AXIS]
+            if num_workers % axis:
+                raise ValueError(
+                    f"num_workers={num_workers} not divisible by mesh "
+                    f"workers axis {axis}"
+                )
+        self.mesh = mesh
+        self._round_fn = self._build_round()
+
+    # -- public API ---------------------------------------------------------
+
+    def round(self, x_blocks: jax.Array, k: int, worker_mask=None):
+        """One merge round: ``(m, n, d) -> (sigma_bar (d, d), v_bar (d, k))``.
+
+        ``sigma_bar`` is the mean projector (what the reference master
+        computes and then discards, ``distributed.py:126-131`` / B4);
+        ``v_bar`` is its top-k eigenspace (what the pseudocode actually
+        needs). ``worker_mask`` (m,) of {0,1} excludes failed workers from
+        the merge.
+        """
+        m = x_blocks.shape[0]
+        if m != self.num_workers:
+            raise ValueError(
+                f"x_blocks has {m} workers, pool was built for "
+                f"{self.num_workers}"
+            )
+        if worker_mask is None:
+            worker_mask = jnp.ones((m,), dtype=jnp.float32)
+        return self._round_fn(x_blocks, worker_mask, k)
+
+    def shard(self, x_blocks: jax.Array) -> jax.Array:
+        """Place ``(m, n, d)`` host data onto the pool's devices with the
+        worker sharding (the input-pipeline half of the reference's batch
+        dispatch, ``distributed.py:108-112``)."""
+        if self.backend == "local" or self.mesh is None:
+            return jnp.asarray(x_blocks)
+        return jax.device_put(x_blocks, worker_sharding(self.mesh))
+
+    def local_eigenspaces(self, x_blocks: jax.Array, k: int) -> jax.Array:
+        """Per-worker eigenspaces ``(m, d, k)`` without the merge (the
+        slave-side half, reference ``distributed.py:46-48``)."""
+        return jax.jit(
+            partial(
+                _local_eigenspaces,
+                solver=self.solver,
+                iters=self.subspace_iters,
+            ),
+            static_argnames=("k",),
+        )(x_blocks, k=k)
+
+    # -- round construction -------------------------------------------------
+
+    def _build_round(self):
+        solver, iters = self.solver, self.subspace_iters
+
+        def merged_top_k(p, k):
+            if solver == "subspace":
+                return subspace_iteration(
+                    lambda v: jnp.matmul(
+                        p, v, precision=jax.lax.Precision.HIGHEST
+                    ),
+                    p.shape[0],
+                    k,
+                    iters=iters,
+                )
+            return top_k_eigvecs(p, k)
+
+        if self.backend == "local":
+
+            @partial(jax.jit, static_argnames=("k",))
+            def round_local(x_blocks, mask, k):
+                vs = _local_eigenspaces(x_blocks, k, solver, iters)
+                psum, cnt = _masked_projector_mean(vs, mask)
+                sigma_bar = psum / jnp.maximum(cnt, 1.0)
+                return sigma_bar, merged_top_k(sigma_bar, k)
+
+            return round_local
+
+        mesh = self.mesh
+        in_spec = P(WORKER_AXIS)
+
+        @partial(jax.jit, static_argnames=("k",))
+        def round_sharded(x_blocks, mask, k):
+            def shard_fn(xs, mask_s):
+                # xs: (m_local, n, d) on this device's worker slot(s)
+                vs = _local_eigenspaces(xs, k, solver, iters)
+                psum, cnt = _masked_projector_mean(vs, mask_s)
+                # ICI allreduce — the entire reference wire protocol (C11)
+                # collapses to these two lines.
+                psum = jax.lax.psum(psum, axis_name=WORKER_AXIS)
+                cnt = jax.lax.psum(cnt, axis_name=WORKER_AXIS)
+                sigma_bar = psum / jnp.maximum(cnt, 1.0)
+                return sigma_bar, merged_top_k(sigma_bar, k)
+
+            return jax.shard_map(
+                partial(shard_fn),
+                mesh=mesh,
+                in_specs=(in_spec, in_spec),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )(x_blocks, mask)
+
+        return round_sharded
+
+
+def _largest_divisor_leq(m: int, cap: int) -> int:
+    """Largest divisor of ``m`` that is <= ``cap`` (worker-axis size)."""
+    for s in range(min(m, cap), 0, -1):
+        if m % s == 0:
+            return s
+    return 1

@@ -1,0 +1,39 @@
+"""Test harness: run everything on CPU with 8 virtual devices.
+
+This is the TPU-native replacement for the reference's only multi-node test
+story ("run RabbitMQ in Docker plus master+slave processes by hand" —
+SURVEY.md §4): JAX fakes an 8-device platform on one CPU process, so the
+shard_map DP path, the pmean merge, and the feature-sharded path all run in
+plain pytest. Must set env vars before the first jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# A sitecustomize may have pre-registered an accelerator backend at
+# interpreter boot (before this conftest ran), making the env var above
+# ineffective — force the platform at the config level too.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,144 @@
+"""Data-layer tests: CIFAR pickle parity (round-trip through fabricated
+pickles in the reference's exact on-disk format), preprocessing (B7 toggle),
+batcher remainder policies (B5 fix), and the planted-spectrum generator."""
+
+import os
+import pickle
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.data.cifar import (
+    load_CIFAR_10_data,
+    load_cifar10,
+    preprocess,
+    unpickle,
+)
+from distributed_eigenspaces_tpu.data.stream import block_stream, make_batches
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+
+
+@pytest.fixture()
+def cifar_dir(tmp_path, rng):
+    """Fabricate a CIFAR-10 dir in the reference's exact pickle format
+    (load_data.py:8-15 reads dicts with b'data' (N,3072) uint8 rows,
+    b'filenames', b'labels')."""
+    n_per = 20
+    for b in range(2):
+        d = {
+            b"data": rng.integers(0, 256, (n_per, 3072), dtype=np.uint8),
+            b"filenames": [f"img_{b}_{i}.png".encode() for i in range(n_per)],
+            b"labels": [int(i % 10) for i in range(n_per)],
+        }
+        with open(tmp_path / f"data_batch_{b + 1}", "wb") as f:
+            pickle.dump(d, f)
+    # the two files the reference skips (UNUSED_FILES, load_data.py:5)
+    (tmp_path / "readme.html").write_text("<html></html>")
+    with open(tmp_path / "batches.meta", "wb") as f:
+        pickle.dump({b"label_names": [b"airplane"]}, f)
+    return str(tmp_path)
+
+
+def test_load_cifar_shapes_and_skips_metadata(cifar_dir):
+    data, filenames, labels = load_CIFAR_10_data(cifar_dir)
+    assert data.shape == (40, 32, 32, 3)
+    assert filenames.shape == (40,)
+    assert labels.shape == (40,)
+    assert set(labels.tolist()) <= set(range(10))
+
+
+def test_load_cifar_negatives_float(cifar_dir):
+    data, _, _ = load_CIFAR_10_data(cifar_dir, negatives=True)
+    assert data.dtype == np.float32
+    data_u8, _, _ = load_CIFAR_10_data(cifar_dir, negatives=False)
+    assert data_u8.dtype == np.uint8
+    # both paths express the same pixels
+    np.testing.assert_allclose(data, data_u8.astype(np.float32))
+
+
+def test_preprocess_grayscale_matches_reference(cifar_dir):
+    """grayscale path == the reference's inline channel-mean + flatten
+    (distributed.py:170-173)."""
+    data, _, _ = load_CIFAR_10_data(cifar_dir)
+    got = preprocess(data, grayscale=True)
+    want = data.astype(np.float32).mean(axis=3).reshape(len(data), -1)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert got.shape == (40, 1024)
+
+
+def test_preprocess_rgb_3072(cifar_dir):
+    """B7: the full-RGB 3072-d path BASELINE.md requires."""
+    x, labels = load_cifar10(cifar_dir, grayscale=False)
+    assert x.shape == (40, 3072)
+    assert labels.shape == (40,)
+
+
+def test_unpickle_missing_file():
+    with pytest.raises(FileNotFoundError):
+        unpickle("/nonexistent/batch")
+    with pytest.raises(FileNotFoundError):
+        load_CIFAR_10_data("/nonexistent/dir")
+
+
+def test_make_batches_tail_policies():
+    # notebook cell 8 semantics: ragged tail kept
+    assert make_batches(10, 4) == [(0, 4), (4, 8), (8, 10)]
+    # reference CLI semantics: tail dropped (distributed.py:99-104)
+    assert make_batches(10, 4, keep_tail=False) == [(0, 4), (4, 8)]
+    assert make_batches(8, 4) == [(0, 4), (4, 8)]
+
+
+def test_block_stream_advances_and_shapes(rng):
+    data = rng.standard_normal((100, 6)).astype(np.float32)
+    blocks = list(
+        block_stream(data, num_workers=2, rows_per_worker=10, num_steps=None)
+    )
+    assert len(blocks) == 5  # 100 // 20
+    assert blocks[0].shape == (2, 10, 6)
+    np.testing.assert_allclose(
+        np.asarray(blocks[1]).reshape(-1, 6), data[20:40], rtol=1e-6
+    )
+
+
+def test_block_stream_remainder_policies(rng):
+    data = rng.standard_normal((50, 4)).astype(np.float32)
+    # drop: 2 full steps of 20 rows, 10 dropped
+    assert len(list(block_stream(data, num_workers=2, rows_per_worker=10))) == 2
+    # pad: a third, zero-padded step
+    padded = list(
+        block_stream(data, num_workers=2, rows_per_worker=10, remainder="pad")
+    )
+    assert len(padded) == 3
+    tail = np.asarray(padded[-1]).reshape(-1, 4)
+    np.testing.assert_allclose(tail[:10], data[40:], rtol=1e-6)
+    np.testing.assert_allclose(tail[10:], 0.0)
+    with pytest.raises(ValueError):
+        list(block_stream(data, num_workers=2, rows_per_worker=10, remainder="error"))
+
+
+def test_block_stream_wrap(rng):
+    data = rng.standard_normal((40, 4)).astype(np.float32)
+    blocks = list(
+        block_stream(data, num_workers=2, rows_per_worker=10, num_steps=5, wrap=True)
+    )
+    assert len(blocks) == 5  # wrapped past the end
+    np.testing.assert_allclose(np.asarray(blocks[2]), np.asarray(blocks[0]))
+
+
+def test_block_stream_too_small():
+    with pytest.raises(ValueError):
+        next(block_stream(np.zeros((5, 3)), num_workers=2, rows_per_worker=10))
+
+
+def test_planted_spectrum_properties():
+    spec = planted_spectrum(32, k_planted=4, seed=1)
+    q = np.asarray(spec.basis)
+    np.testing.assert_allclose(q.T @ q, np.eye(32), atol=1e-4)
+    lam = np.asarray(spec.eigenvalues)
+    assert np.all(np.diff(lam) <= 1e-7)  # descending
+    # empirical covariance of a big sample approximates Q diag(lam) Q^T
+    x = np.asarray(spec.sample(jax.random.PRNGKey(0), 20000))
+    emp = x.T @ x / len(x)
+    want = (q * lam) @ q.T
+    assert np.abs(emp - want).max() < 0.5

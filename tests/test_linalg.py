@@ -1,0 +1,160 @@
+"""Unit tests for ops/linalg vs NumPy ground truth (SURVEY.md §4 obligations:
+Gram vs X.T@X, top-k eigh vs numpy.linalg.eigh, projector invariances)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.ops.linalg import (
+    canonicalize_signs,
+    gram,
+    grassmann_distance,
+    merge_projectors,
+    principal_angles,
+    principal_angles_degrees,
+    projector,
+    subspace_iteration,
+    top_k_eig,
+    top_k_eigvecs,
+    top_k_eigvecs_streaming,
+)
+
+
+def _sym(rng, d):
+    a = rng.standard_normal((d, d)).astype(np.float32)
+    return (a + a.T) / 2
+
+
+def test_gram_matches_numpy(rng):
+    x = rng.standard_normal((37, 16)).astype(np.float32)
+    got = np.asarray(gram(jnp.asarray(x)))
+    want = x.T @ x / 37
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_gram_unnormalized(rng):
+    x = rng.standard_normal((10, 8)).astype(np.float32)
+    got = np.asarray(gram(jnp.asarray(x), normalize=False))
+    np.testing.assert_allclose(got, x.T @ x, rtol=1e-5, atol=1e-5)
+
+
+def test_gram_bf16_input_fp32_accumulation(rng):
+    x = rng.standard_normal((64, 32)).astype(np.float32)
+    got = gram(jnp.asarray(x, jnp.bfloat16))
+    assert got.dtype == jnp.float32
+    want = x.T @ x / 64
+    # bf16 inputs: loose elementwise tolerance, but structure must hold
+    np.testing.assert_allclose(np.asarray(got), want, rtol=0.05, atol=0.05)
+
+
+def test_top_k_eigvecs_matches_numpy(rng):
+    m = _sym(rng, 24)
+    k = 5
+    v = np.asarray(top_k_eigvecs(jnp.asarray(m), k))
+    w_np, v_np = np.linalg.eigh(m)
+    want = v_np[:, ::-1][:, :k]  # descending
+    # compare as subspaces per column (sign-free)
+    for j in range(k):
+        dot = abs(v[:, j] @ want[:, j])
+        assert dot > 1 - 1e-4, f"column {j} mismatch, |dot|={dot}"
+
+
+def test_top_k_descending_order(rng):
+    m = _sym(rng, 16)
+    w, v = top_k_eig(jnp.asarray(m), 4)
+    w = np.asarray(w)
+    assert np.all(np.diff(w) <= 1e-6), f"not descending: {w}"
+    # Rayleigh quotients match returned eigenvalues
+    for j in range(4):
+        rq = v[:, j] @ jnp.asarray(m) @ v[:, j]
+        np.testing.assert_allclose(float(rq), w[j], rtol=1e-4, atol=1e-4)
+
+
+def test_canonicalize_signs_deterministic(rng):
+    v = rng.standard_normal((12, 3)).astype(np.float32)
+    c1 = np.asarray(canonicalize_signs(jnp.asarray(v)))
+    c2 = np.asarray(canonicalize_signs(jnp.asarray(-v)))
+    np.testing.assert_allclose(c1, c2, rtol=0, atol=0)
+    # pivot element positive
+    idx = np.argmax(np.abs(c1), axis=0)
+    assert np.all(c1[idx, np.arange(3)] > 0)
+
+
+def test_projector_sign_and_order_invariant(rng):
+    """The merge currency V V^T must not care about column sign or order
+    (SURVEY.md §2.2-B3 — the property that makes the reference's ascending
+    eigh ordering harmless)."""
+    q, _ = np.linalg.qr(rng.standard_normal((10, 3)))
+    q = q.astype(np.float32)
+    p1 = np.asarray(projector(jnp.asarray(q)))
+    flipped = q[:, ::-1] * np.array([1, -1, 1], np.float32)[None, :]
+    p2 = np.asarray(projector(jnp.asarray(flipped)))
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-5)
+
+
+def test_merge_projectors_is_mean(rng):
+    vs = np.stack(
+        [np.linalg.qr(rng.standard_normal((8, 2)))[0] for _ in range(5)]
+    ).astype(np.float32)
+    got = np.asarray(merge_projectors(jnp.asarray(vs)))
+    want = np.mean([v @ v.T for v in vs], axis=0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_principal_angles_identical_subspace(rng):
+    q, _ = np.linalg.qr(rng.standard_normal((20, 4)))
+    q = q.astype(np.float32)
+    ang = np.asarray(principal_angles(jnp.asarray(q), jnp.asarray(q)))
+    np.testing.assert_allclose(ang, 0.0, atol=1e-3)
+    # rotated basis of the same subspace -> still zero angles
+    r, _ = np.linalg.qr(rng.standard_normal((4, 4)))
+    ang2 = np.asarray(
+        principal_angles(jnp.asarray(q), jnp.asarray(q @ r.astype(np.float32)))
+    )
+    np.testing.assert_allclose(ang2, 0.0, atol=1e-3)
+
+
+def test_principal_angles_orthogonal_subspaces():
+    u = jnp.eye(6)[:, :2]
+    v = jnp.eye(6)[:, 2:4]
+    ang = np.asarray(principal_angles_degrees(u, v))
+    np.testing.assert_allclose(ang, 90.0, atol=1e-3)
+    assert float(grassmann_distance(u, v)) > 2.0
+
+
+def test_subspace_iteration_matches_eigh(rng):
+    d, k = 48, 4
+    # well-separated spectrum so 30 iterations converge far past 1e-3
+    q, _ = np.linalg.qr(rng.standard_normal((d, d)))
+    lam = np.concatenate([np.array([10, 6, 3.5, 2.0]), 0.1 * np.ones(d - k)])
+    a = (q * lam) @ q.T
+    a = jnp.asarray((a + a.T) / 2, jnp.float32)
+    v_exact = top_k_eigvecs(a, k)
+    mv = lambda v: jnp.matmul(a, v, precision=jax.lax.Precision.HIGHEST)
+    v_iter = subspace_iteration(mv, d, k, iters=40, key=jax.random.PRNGKey(7))
+    ang = np.asarray(principal_angles_degrees(v_exact, v_iter))
+    assert ang.max() < 0.1, f"angles: {ang}"
+
+
+def test_top_k_eigvecs_streaming_never_materializes(rng):
+    b, n, d, k = 6, 32, 20, 3
+    # planted decaying spectrum so the k-th eigengap is real (power-iteration
+    # convergence is geometric in lambda_{k+1}/lambda_k)
+    scales = np.concatenate([[8.0, 4.0, 2.0], 0.2 * np.ones(d - k)])
+    x = (rng.standard_normal((b, n, d)) * scales[None, None, :]).astype(
+        np.float32
+    )
+    v_stream = top_k_eigvecs_streaming(jnp.asarray(x), k, iters=60)
+    flat = x.reshape(-1, d)
+    v_exact = top_k_eigvecs(jnp.asarray(flat.T @ flat / (b * n)), k)
+    ang = np.asarray(principal_angles_degrees(v_exact, v_stream))
+    assert ang.max() < 0.5, f"angles: {ang}"
+
+
+def test_top_k_eigvecs_jit_cache():
+    """Static-k jit: two calls same shape hit the cache (no tracing error)."""
+    m = jnp.eye(8)
+    v1 = top_k_eigvecs(m, 2)
+    v2 = top_k_eigvecs(m + 0.1, 2)
+    assert v1.shape == v2.shape == (8, 2)

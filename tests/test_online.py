@@ -1,0 +1,157 @@
+"""End-to-end algorithm tests: the online loop recovers the planted subspace
+(the quantitative version of the reference's sklearn scatter A/B, notebook
+cells 21-22), discount rules, resume, and one-shot parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_eigenspaces_tpu.algo.online import (
+    OnlineState,
+    one_shot_round,
+    online_distributed_pca,
+)
+from distributed_eigenspaces_tpu.api.estimator import OnlineDistributedPCA
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.stream import block_stream, synthetic_stream
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.ops.linalg import (
+    principal_angles_degrees,
+    top_k_eigvecs,
+)
+
+
+D, K = 64, 3
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=D, k=K, num_workers=8, rows_per_worker=64, num_steps=6,
+        backend="local",
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+def test_recovers_planted_subspace():
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=3)
+    cfg = _cfg()
+    stream = synthetic_stream(
+        spec, num_workers=8, rows_per_worker=64, num_steps=6, seed=5
+    )
+    w, state = online_distributed_pca(stream, cfg)
+    assert w.shape == (D, K)
+    assert int(state.step) == 6
+    ang = np.asarray(principal_angles_degrees(w, spec.top_k(K)))
+    assert ang.max() < 2.0, f"planted-subspace angles: {ang}"
+
+
+def test_matches_exact_svd_on_static_data(rng):
+    """On a fixed dataset, the estimate lands near the exact top-k SVD
+    subspace — the BASELINE.json metric."""
+    spec = planted_spectrum(D, k_planted=K, gap=30.0, noise=0.005, seed=9)
+    x = np.asarray(spec.sample(jax.random.PRNGKey(0), 4096))
+    cfg = _cfg(num_steps=8, rows_per_worker=64)
+    est = OnlineDistributedPCA(cfg).fit(x)
+    exact = top_k_eigvecs(jnp.asarray(x.T @ x / len(x)), K)
+    ang = np.asarray(principal_angles_degrees(est.components_, exact))
+    assert ang.max() < 1.0, f"vs exact SVD: {ang}"  # the <=1 degree target
+
+
+def test_shard_map_end_to_end(devices):
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=3)
+    cfg = _cfg(backend="shard_map")
+    stream = synthetic_stream(
+        spec, num_workers=8, rows_per_worker=64, num_steps=6, seed=5
+    )
+    w, _ = online_distributed_pca(stream, cfg)
+    ang = np.asarray(principal_angles_degrees(w, spec.top_k(K)))
+    assert ang.max() < 2.0
+
+
+def test_discount_rules_differ_but_converge():
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=3)
+    for rule in ("1/T", "1/t", "notebook"):
+        stream = synthetic_stream(
+            spec, num_workers=8, rows_per_worker=64, num_steps=6, seed=5
+        )
+        w, _ = online_distributed_pca(stream, _cfg(discount=rule))
+        ang = np.asarray(principal_angles_degrees(w, spec.top_k(K)))
+        assert ang.max() < 3.0, f"{rule}: {ang}"
+
+
+def test_resume_equals_straight_run():
+    """Checkpoint semantics: run 3+3 steps with a state handoff == run 6
+    (SURVEY.md §5.4 — sigma_tilde + step is the whole checkpoint)."""
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=3)
+    blocks = list(
+        synthetic_stream(spec, num_workers=8, rows_per_worker=64, num_steps=6, seed=5)
+    )
+    cfg = _cfg()
+    w_full, state_full = online_distributed_pca(iter(blocks), cfg)
+    # same cfg both halves (the 1/T weight depends on num_steps); the loop
+    # simply ends early when the stream runs dry
+    _, state_half = online_distributed_pca(iter(blocks[:3]), cfg)
+    w_res, state_res = online_distributed_pca(
+        iter(blocks[3:]), cfg, state=state_half
+    )
+    assert int(state_res.step) == int(state_full.step) == 6
+    np.testing.assert_allclose(
+        np.asarray(state_res.sigma_tilde),
+        np.asarray(state_full.sigma_tilde),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(w_res), np.asarray(w_full), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_stream_advances():
+    """Each step must consume fresh rows (the B6 fix): feeding T copies of
+    the same block vs an advancing stream must differ."""
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((8 * 64 * 4, D)).astype(np.float32)
+    cfg = _cfg(num_steps=4)
+    advancing = block_stream(
+        data, num_workers=8, rows_per_worker=64, num_steps=4
+    )
+    _, st_adv = online_distributed_pca(advancing, cfg)
+    first = next(
+        block_stream(data, num_workers=8, rows_per_worker=64, num_steps=1)
+    )
+    _, st_rep = online_distributed_pca([first] * 4, cfg)
+    assert not np.allclose(
+        np.asarray(st_adv.sigma_tilde), np.asarray(st_rep.sigma_tilde)
+    )
+
+
+def test_one_shot_round_returns_result():
+    """B4 fix: the one-shot mode actually returns sigma_bar AND its top-k."""
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((8, 32, 16)).astype(np.float32)
+    sigma_bar, v_bar = one_shot_round(jnp.asarray(x), k=2, backend="local")
+    assert sigma_bar.shape == (16, 16)
+    assert v_bar.shape == (16, 2)
+    v_want = top_k_eigvecs(sigma_bar, 2)
+    np.testing.assert_allclose(
+        np.asarray(v_bar), np.asarray(v_want), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_estimator_api(rng):
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=3)
+    x = np.asarray(spec.sample(jax.random.PRNGKey(1), 4096))
+    est = OnlineDistributedPCA(_cfg(num_steps=8))
+    z = est.fit_transform(x)
+    assert z.shape == (4096, K)
+    assert est.components_.shape == (D, K)
+    back = est.inverse_transform(z)
+    assert back.shape == x.shape
+    scores = est.score(x, exact_w=spec.top_k(K))
+    assert scores["explained_variance_ratio"] > 0.5
+    assert scores["max_principal_angle_deg"] < 2.0
+    # partial_fit advances the state
+    step_before = int(est.state.step)
+    est.partial_fit(np.asarray(spec.sample(jax.random.PRNGKey(2), 8 * 64)).reshape(8, 64, D))
+    assert int(est.state.step) == step_before + 1

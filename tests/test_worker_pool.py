@@ -1,0 +1,131 @@
+"""WorkerPool tests: backend equivalence (local vmap vs shard_map over 8
+virtual devices), permutation invariance of the merge (SURVEY.md §7 hard part
+(d)), and fault-mask reweighting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.ops.linalg import (
+    principal_angles_degrees,
+    top_k_eigvecs,
+)
+from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
+
+
+def _blocks(rng, m=8, n=64, d=24):
+    return rng.standard_normal((m, n, d)).astype(np.float32)
+
+
+def _reference_round(x, k):
+    """NumPy ground truth of one round (the notebook cell-16 inner loop plus
+    the merge the reference master computes at distributed.py:126-131)."""
+    m, n, d = x.shape
+    sigma_bar = np.zeros((d, d), np.float32)
+    for l in range(m):
+        g = x[l].T @ x[l] / n
+        w, v = np.linalg.eigh(g)
+        vk = v[:, -k:]
+        sigma_bar += vk @ vk.T
+    return sigma_bar / m
+
+
+def test_local_backend_matches_numpy(rng):
+    x = _blocks(rng)
+    pool = WorkerPool(8, backend="local")
+    sigma_bar, v_bar = pool.round(jnp.asarray(x), k=3)
+    want = _reference_round(x, 3)
+    np.testing.assert_allclose(np.asarray(sigma_bar), want, rtol=1e-4, atol=1e-4)
+    # v_bar is top-3 of sigma_bar
+    v_want = top_k_eigvecs(jnp.asarray(want), 3)
+    ang = np.asarray(principal_angles_degrees(v_bar, v_want))
+    assert ang.max() < 0.1
+
+
+def test_shard_map_matches_local(rng, devices):
+    x = jnp.asarray(_blocks(rng))
+    local = WorkerPool(8, backend="local")
+    sharded = WorkerPool(8, backend="shard_map")
+    s1, v1 = local.round(x, k=4)
+    s2, v2 = sharded.round(sharded.shard(x), k=4)
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(s2), rtol=1e-4, atol=1e-4
+    )
+    ang = np.asarray(principal_angles_degrees(v1, v2))
+    assert ang.max() < 0.1
+
+
+def test_more_workers_than_devices(rng, devices):
+    """m=16 workers on 8 devices: two vmapped workers per shard."""
+    x = jnp.asarray(_blocks(rng, m=16))
+    local = WorkerPool(16, backend="local")
+    sharded = WorkerPool(16, backend="shard_map")
+    s1, _ = local.round(x, k=2)
+    s2, _ = sharded.round(sharded.shard(x), k=2)
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(s2), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_merge_permutation_invariant(rng):
+    """Static assignment == the reference's dynamic LIFO queue, because the
+    merge is an average (SURVEY.md §7 hard part (d))."""
+    x = _blocks(rng)
+    pool = WorkerPool(8, backend="local")
+    s1, _ = pool.round(jnp.asarray(x), k=3)
+    perm = rng.permutation(8)
+    s2, _ = pool.round(jnp.asarray(x[perm]), k=3)
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(s2), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_worker_mask_excludes_failed(rng):
+    """Masked merge == merge over the surviving subset only (the fault
+    injection hook, SURVEY.md §5.3)."""
+    x = _blocks(rng)
+    pool = WorkerPool(8, backend="local")
+    mask = jnp.asarray([1, 1, 0, 1, 1, 0, 1, 1], jnp.float32)
+    s_masked, _ = pool.round(jnp.asarray(x), k=3, worker_mask=mask)
+    survivors = x[np.asarray(mask) > 0]
+    want = _reference_round(survivors, 3)
+    np.testing.assert_allclose(
+        np.asarray(s_masked), want, rtol=1e-4, atol=1e-4
+    )
+
+
+def test_worker_mask_sharded(rng, devices):
+    x = jnp.asarray(_blocks(rng))
+    mask = jnp.asarray([1, 0, 1, 1, 1, 1, 0, 1], jnp.float32)
+    local = WorkerPool(8, backend="local")
+    sharded = WorkerPool(8, backend="shard_map")
+    s1, _ = local.round(x, k=2, worker_mask=mask)
+    s2, _ = sharded.round(sharded.shard(x), k=2, worker_mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(s2), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_subspace_solver_backend(rng):
+    """solver='subspace' approximates the eigh path (large-d mode)."""
+    # planted per-feature scales so the k-th eigengap is real (power
+    # iteration needs lambda_{k+1}/lambda_k < 1 to converge)
+    x = _blocks(rng, d=32)
+    scales = np.concatenate([[6.0, 3.0], 0.3 * np.ones(30)]).astype(np.float32)
+    x = x * scales[None, None, :]
+    exact = WorkerPool(8, backend="local", solver="eigh")
+    approx = WorkerPool(8, backend="local", solver="subspace", subspace_iters=50)
+    _, v1 = exact.round(jnp.asarray(x), k=2)
+    _, v2 = approx.round(jnp.asarray(x), k=2)
+    ang = np.asarray(principal_angles_degrees(v1, v2))
+    assert ang.max() < 1.0, f"angles {ang}"
+
+
+def test_mesh_validation(devices):
+    with pytest.raises(ValueError):
+        make_mesh(num_workers=5, num_feature_shards=3)  # 15 > 8 devices
+    pool = WorkerPool(8, backend="shard_map")
+    with pytest.raises(ValueError):
+        pool.round(jnp.zeros((4, 8, 8)), k=2)  # wrong worker count
