@@ -105,6 +105,21 @@ def top_k_eig(m: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     return wk, vk
 
 
+def merged_top_k(p: jax.Array, k: int, solver: str = "eigh",
+                 iters: int = 16) -> jax.Array:
+    """Top-k of a (replicated) symmetric matrix by the configured solver —
+    the shared dispatch used by both the WorkerPool round and the fused
+    train step (keeps their numerics identical by construction)."""
+    if solver == "subspace":
+        return subspace_iteration(
+            lambda v: jnp.matmul(p, v, precision=lax.Precision.HIGHEST),
+            p.shape[0],
+            k,
+            iters=iters,
+        )
+    return top_k_eigvecs(p, k)
+
+
 def projector(v: jax.Array) -> jax.Array:
     """Orthogonal projector ``V V^T`` onto the column space of ``V (d, k)``.
 

@@ -1,0 +1,275 @@
+"""Feature-dimension sharded online PCA — the large-d scale-out path.
+
+The reference's memory wall: every node materializes the full d x d
+covariance (``distributed.py:67``), which at the ImageNet config
+(d=12288, SURVEY.md §5.7) is 600 MB fp32 per worker before the O(d^3)
+eigensolve. This module is the SP/TP slot of the new design: the feature
+dimension is sharded over a second mesh axis and **no d x d matrix ever
+exists** — not the per-worker covariance, not the merged projector, not the
+online state.
+
+Machinery (all inside one ``shard_map`` over a ``(workers, features)`` mesh):
+
+- per-worker top-k eigenspaces by block power iteration whose matvec is
+  ``X^T (X V) / n`` with ``X`` column-sharded: the inner product reduces over
+  ``features`` with a ``psum`` (k-width, so the wire cost is d*k, like the
+  reference's JSON eigenspace messages — but over ICI, not AMQP);
+- orthonormalization by CholeskyQR2 (two rounds of Gram + Cholesky + solve
+  — MXU-friendly tall-skinny QR; the Gram is a k x k ``psum``);
+- the worker merge as subspace iteration on the implicit operator
+  ``P U = (1/m) sum_l V_l (V_l^T U)`` — a ``psum`` over ``workers``;
+- the online state as a rank-r eigendecomposition ``sigma_tilde ~= U S U^T``
+  updated incrementally (append the new projector's columns, re-eigensolve
+  an (r+k) x (r+k) Gram, truncate) — O(d r^2 / f) per device per step.
+
+Everything lowers to tall-skinny matmuls + tiny replicated eigensolves, which
+is exactly the shape the MXU and ICI want.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.parallel.mesh import FEATURE_AXIS, WORKER_AXIS
+
+HP = jax.lax.Precision.HIGHEST
+
+
+class LowRankState(NamedTuple):
+    """Rank-r factorization of the running average: sigma_tilde ~= U S U^T.
+
+    ``u`` is (d, r) with orthonormal columns (row-sharded over ``features``
+    in the distributed step), ``s`` the (r,) eigenvalues (descending,
+    replicated), ``step`` the 1-based round count. The checkpointable state
+    of the large-d path (SURVEY.md §5.4) — d*r floats instead of d*d.
+    """
+
+    u: jax.Array
+    s: jax.Array
+    step: jax.Array
+
+    @classmethod
+    def initial(cls, dim: int, rank: int, dtype=jnp.float32) -> "LowRankState":
+        return cls(
+            u=jnp.zeros((dim, rank), dtype=dtype),
+            s=jnp.zeros((rank,), dtype=dtype),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+
+def _psum_if(x, axis_name):
+    return jax.lax.psum(x, axis_name) if axis_name else x
+
+
+def _chol_qr(v, axis_name, eps=1e-7):
+    """One CholeskyQR pass on row-sharded ``v (..., d_local, k)``."""
+    g = jnp.einsum("...dk,...dl->...kl", v, v, precision=HP)
+    g = _psum_if(g, axis_name)
+    k = g.shape[-1]
+    g = g + eps * jnp.trace(g, axis1=-2, axis2=-1)[..., None, None] * jnp.eye(
+        k, dtype=g.dtype
+    )
+    r = jnp.linalg.cholesky(g)  # lower
+    # v <- v @ R^{-T}  (columns of v against lower-tri solve)
+    return jax.lax.linalg.triangular_solve(
+        r, v, left_side=False, lower=True, transpose_a=True
+    )
+
+
+def chol_qr2(v, axis_name=None):
+    """CholeskyQR2: numerically solid orthonormalization from tall-skinny
+    Grams only (no Householder QR, which XLA serializes column-by-column)."""
+    return _chol_qr(_chol_qr(v, axis_name), axis_name)
+
+
+def _small_eigh_desc(g):
+    """eigh of a tiny replicated matrix, descending order."""
+    with jax.default_matmul_precision("highest"):
+        w, q = jnp.linalg.eigh(0.5 * (g + jnp.swapaxes(g, -1, -2)))
+    return w[..., ::-1], q[..., ::-1]
+
+
+def worker_subspace_sharded(x, k, iters, n_total_rows, key):
+    """Per-worker top-k eigenspaces with the feature dim sharded.
+
+    ``x``: (m_local, n, d_local) — this device's row-block columns for its
+    local workers. Returns (m_local, d_local, k) orthonormal (globally, over
+    the features axis) eigenvector shards.
+    """
+    m_local, n, d_local = x.shape
+
+    def matvec(v):
+        # v: (m_local, d_local, k). X V reduces over the sharded d axis.
+        xv = jnp.einsum("mnd,mdk->mnk", x, v, precision=HP)
+        xv = jax.lax.psum(xv, FEATURE_AXIS)
+        return (
+            jnp.einsum("mnd,mnk->mdk", x, xv, precision=HP) / n_total_rows
+        )
+
+    # deterministic, feature-shard-distinct init: fold in the shard index
+    fidx = jax.lax.axis_index(FEATURE_AXIS)
+    v = jax.random.normal(
+        jax.random.fold_in(key, fidx), (m_local, d_local, k), jnp.float32
+    )
+    v = chol_qr2(v, FEATURE_AXIS)
+
+    def body(_, v):
+        return chol_qr2(matvec(v), FEATURE_AXIS)
+
+    v = jax.lax.fori_loop(0, iters, body, v)
+    # Rayleigh-Ritz within each worker for descending-order columns
+    av = matvec(v)
+    small = jnp.einsum("mdk,mdl->mkl", v, av, precision=HP)
+    small = jax.lax.psum(small, FEATURE_AXIS)
+    _, q = _small_eigh_desc(small)
+    return jnp.einsum("mdk,mkl->mdl", v, q, precision=HP)
+
+
+def merged_subspace_sharded(v_workers, k, iters, key):
+    """Top-k of the mean projector ``(1/m) sum_l V_l V_l^T`` without forming
+    it: subspace iteration on the implicit operator.
+
+    ``v_workers``: (m_local, d_local, k) shards. Returns (d_local, k) shard
+    of the merged eigenspace (replicated over ``workers``).
+    """
+    m_local, d_local, _ = v_workers.shape
+    m_total = jax.lax.psum(
+        jnp.asarray(m_local, jnp.float32), WORKER_AXIS
+    )
+
+    def matvec(u):
+        # u: (d_local, k) replicated over workers.
+        w = jnp.einsum("mdk,dj->mkj", v_workers, u, precision=HP)
+        w = jax.lax.psum(w, FEATURE_AXIS)  # full V_l^T U, per local worker
+        y = jnp.einsum("mdk,mkj->dj", v_workers, w, precision=HP)
+        return jax.lax.psum(y, WORKER_AXIS) / m_total
+
+    fidx = jax.lax.axis_index(FEATURE_AXIS)
+    u = jax.random.normal(
+        jax.random.fold_in(key, fidx), (d_local, k), jnp.float32
+    )
+    u = chol_qr2(u, FEATURE_AXIS)
+
+    def body(_, u):
+        return chol_qr2(matvec(u), FEATURE_AXIS)
+
+    u = jax.lax.fori_loop(0, iters, body, u)
+    au = matvec(u)
+    small = jnp.einsum("dk,dl->kl", u, au, precision=HP)
+    small = jax.lax.psum(small, FEATURE_AXIS)
+    _, q = _small_eigh_desc(small)
+    return jnp.matmul(u, q, precision=HP)
+
+
+def lowrank_update(state: LowRankState, v_bar, weight, keep=1.0):
+    """Fold ``keep * sigma_tilde + weight * v_bar v_bar^T`` into the rank-r
+    factorization.
+
+    ``v_bar`` (d_local, k) and ``state.u`` (d_local, r) are row shards over
+    ``features`` (or full arrays when called un-sharded). Pure tall-skinny +
+    (r+k)-sized math: build C = [U sqrt(keep*S), sqrt(w) V], eigendecompose
+    C^T C, truncate. ``keep`` < 1 implements running-mean (1/t) discounts.
+    """
+    return _lowrank_update(state, v_bar, weight, keep, axis_name=None)
+
+
+def _lowrank_update(state, v_bar, weight, keep, axis_name):
+    u, s, step = state
+    r = u.shape[1]
+    c = jnp.concatenate(
+        [u * jnp.sqrt(jnp.maximum(keep * s, 0.0))[None, :],
+         jnp.sqrt(weight) * v_bar],
+        axis=1,
+    )  # (d_local, r+k)
+    g = jnp.einsum("di,dj->ij", c, c, precision=HP)
+    g = _psum_if(g, axis_name)
+    w, q = _small_eigh_desc(g)  # (r+k,), (r+k, r+k)
+    w = jnp.maximum(w, 0.0)
+    # eigenvectors of C C^T: C q / sqrt(w) — guard zero eigenvalues
+    inv = jnp.where(w > 1e-12, jax.lax.rsqrt(jnp.maximum(w, 1e-30)), 0.0)
+    u_new = jnp.einsum("dc,ck,k->dk", c, q[:, :r], inv[:r], precision=HP)
+    return LowRankState(u=u_new, s=w[:r], step=step + 1)
+
+
+def make_feature_sharded_step(
+    cfg: PCAConfig,
+    mesh: Mesh,
+    *,
+    rank: int | None = None,
+    seed: int = 0,
+):
+    """Build the fully-sharded training step for the ``(workers, features)``
+    mesh: ``step(state, x_blocks) -> (state, v_bar)``.
+
+    ``x_blocks`` (m, n, d) is sharded ``P(workers, None, features)``;
+    ``state.u`` (d, r) is sharded ``P(features, None)``; ``v_bar`` (d, k)
+    comes back sharded ``P(features, None)``. One jit, zero host hops.
+    """
+    k, iters = cfg.k, cfg.subspace_iters
+    r = rank if rank is not None else min(cfg.dim, 2 * k + 8)
+    m, n = cfg.num_workers, cfg.rows_per_worker
+    key = jax.random.PRNGKey(seed)
+
+    # (add_weight, keep_scale) per 1-based step t = state.step + 1, matching
+    # algo.online._discount semantics for each rule
+    if cfg.discount == "1/T":
+        def weights(step):
+            return jnp.asarray(1.0 / cfg.num_steps, jnp.float32), 1.0
+    elif cfg.discount == "1/t":
+        def weights(step):
+            t = step.astype(jnp.float32) + 1.0
+            return 1.0 / t, (t - 1.0) / t
+    else:  # "notebook": additive 1/(t+1) (SURVEY.md §2.2-B6)
+        def weights(step):
+            return 1.0 / (step.astype(jnp.float32) + 2.0), 1.0
+
+    def sharded(state, x):
+        # x: (m_local, n, d_local); state.u: (d_local_f, r)
+        vws = worker_subspace_sharded(x, k, iters, n, key)
+        v_bar = merged_subspace_sharded(vws, k, iters, jax.random.fold_in(key, 1))
+        w, keep = weights(state.step)
+        new_state = _lowrank_update(state, v_bar, w, keep, axis_name=FEATURE_AXIS)
+        return new_state, v_bar
+
+    x_spec = P(WORKER_AXIS, None, FEATURE_AXIS)
+    u_spec = P(FEATURE_AXIS, None)
+    state_specs = LowRankState(u=u_spec, s=P(), step=P())
+
+    inner = jax.shard_map(
+        sharded,
+        mesh=mesh,
+        in_specs=(state_specs, x_spec),
+        out_specs=(state_specs, u_spec),
+        check_vma=False,
+    )
+
+    x_sharding = NamedSharding(mesh, x_spec)
+    state_shardings = LowRankState(
+        u=NamedSharding(mesh, u_spec),
+        s=NamedSharding(mesh, P()),
+        step=NamedSharding(mesh, P()),
+    )
+    v_sharding = NamedSharding(mesh, u_spec)
+
+    @partial(
+        jax.jit,
+        in_shardings=(state_shardings, x_sharding),
+        out_shardings=(state_shardings, v_sharding),
+    )
+    def step(state, x_blocks):
+        return inner(state, x_blocks)
+
+    def init_state():
+        return jax.device_put(
+            LowRankState.initial(cfg.dim, r), state_shardings
+        )
+
+    step.init_state = init_state
+    step.rank = r
+    return step

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_eigenspaces_tpu.ops.linalg import (
     gram,
+    merged_top_k,
     top_k_eigvecs,
     subspace_iteration,
 )
@@ -178,17 +179,8 @@ class WorkerPool:
     def _build_round(self):
         solver, iters = self.solver, self.subspace_iters
 
-        def merged_top_k(p, k):
-            if solver == "subspace":
-                return subspace_iteration(
-                    lambda v: jnp.matmul(
-                        p, v, precision=jax.lax.Precision.HIGHEST
-                    ),
-                    p.shape[0],
-                    k,
-                    iters=iters,
-                )
-            return top_k_eigvecs(p, k)
+        def merged(p, k):
+            return merged_top_k(p, k, solver, iters)
 
         if self.backend == "local":
 
@@ -197,7 +189,7 @@ class WorkerPool:
                 vs = _local_eigenspaces(x_blocks, k, solver, iters)
                 psum, cnt = _masked_projector_mean(vs, mask)
                 sigma_bar = psum / jnp.maximum(cnt, 1.0)
-                return sigma_bar, merged_top_k(sigma_bar, k)
+                return sigma_bar, merged(sigma_bar, k)
 
             return round_local
 
@@ -215,7 +207,7 @@ class WorkerPool:
                 psum = jax.lax.psum(psum, axis_name=WORKER_AXIS)
                 cnt = jax.lax.psum(cnt, axis_name=WORKER_AXIS)
                 sigma_bar = psum / jnp.maximum(cnt, 1.0)
-                return sigma_bar, merged_top_k(sigma_bar, k)
+                return sigma_bar, merged(sigma_bar, k)
 
             return jax.shard_map(
                 partial(shard_fn),

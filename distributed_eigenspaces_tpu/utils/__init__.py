@@ -1,0 +1,24 @@
+"""Auxiliary subsystems (SURVEY.md §5): checkpointing, metrics, tracing,
+fault injection. The reference had none of these — its only observability was
+print statements and one wall-clock span (``distributed.py:93,131``), and its
+only fault story was AMQP at-least-once redelivery (``distributed.py:53``).
+"""
+
+from distributed_eigenspaces_tpu.utils.checkpoint import (
+    save_checkpoint,
+    restore_checkpoint,
+    Checkpointer,
+)
+from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+from distributed_eigenspaces_tpu.utils.faults import FaultInjector
+from distributed_eigenspaces_tpu.utils.tracing import named_scope, profile_to
+
+__all__ = [
+    "save_checkpoint",
+    "restore_checkpoint",
+    "Checkpointer",
+    "MetricsLogger",
+    "FaultInjector",
+    "named_scope",
+    "profile_to",
+]

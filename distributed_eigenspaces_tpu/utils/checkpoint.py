@@ -1,0 +1,150 @@
+"""Checkpoint / resume of the online PCA state (SURVEY.md §5.4).
+
+The reference keeps everything in process memory — ``sigma_tilde``,
+``computed_eigens`` and the remaining batch list all die with the master
+process (``distributed.py:88-91``; notebook cell 16 locals). Here the
+complete resumable state is tiny and explicit:
+
+  - dense path:    ``OnlineState``  = sigma_tilde (d, d) + step
+  - low-rank path: ``LowRankState`` = U (d, r) + S (r,) + step
+  - plus the data-stream cursor (an integer row offset)
+
+Storage is a plain ``state.npz`` plus an atomically-renamed ``meta.json``
+commit marker (a crash mid-write leaves no meta.json, so the checkpoint is
+simply not found). The payload is gathered to host on save, so restore works
+on any topology — state saved from an 8-device mesh restores onto 1 device
+or 64. States are a few d*r floats; orbax's async machinery buys nothing at
+this size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+from distributed_eigenspaces_tpu.algo.online import OnlineState
+from distributed_eigenspaces_tpu.parallel.feature_sharded import LowRankState
+
+_STATE_TYPES = {"online": OnlineState, "lowrank": LowRankState}
+
+
+def _to_host(tree):
+    """Fully materialize on host (gathers sharded leaves)."""
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def save_checkpoint(
+    path: str,
+    state: OnlineState | LowRankState,
+    *,
+    cursor: int = 0,
+    extra: dict[str, Any] | None = None,
+) -> None:
+    """Write a self-describing checkpoint directory at ``path``."""
+    os.makedirs(path, exist_ok=True)
+    kind = "online" if isinstance(state, OnlineState) else "lowrank"
+    host = _to_host(state)
+    # Invalidate any previous commit marker BEFORE touching state.npz, and
+    # write the payload via tmp+rename: a crash at any point leaves either
+    # the old complete checkpoint (marker still present, payload untouched)
+    # or no committed checkpoint — never a committed-but-corrupt one.
+    meta_final = os.path.join(path, "meta.json")
+    if os.path.exists(meta_final):
+        os.remove(meta_final)
+    # tmp name must keep the .npz suffix (np.savez appends it otherwise)
+    state_tmp = os.path.join(path, "state.tmp.npz")
+    np.savez(state_tmp, **{f: getattr(host, f) for f in host._fields})
+    os.replace(state_tmp, os.path.join(path, "state.npz"))
+    meta = {
+        "state_type": kind,
+        "cursor": int(cursor),
+        "step": int(host.step),
+        "format_version": 1,
+    }
+    if extra:
+        meta["extra"] = extra
+    tmp = os.path.join(path, "meta.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=2)
+    os.replace(tmp, meta_final)  # atomic commit marker
+
+
+def restore_checkpoint(path: str):
+    """Load ``(state, cursor)`` from a checkpoint directory.
+
+    Raises FileNotFoundError on a missing/uncommitted checkpoint (a crash
+    between state.npz and meta.json leaves no meta.json — the write is
+    treated as never having happened).
+    """
+    meta_path = os.path.join(path, "meta.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"no committed checkpoint at {path!r}")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    cls = _STATE_TYPES[meta["state_type"]]
+    with np.load(os.path.join(path, "state.npz")) as z:
+        import jax.numpy as jnp
+
+        state = cls(**{f: jnp.asarray(z[f]) for f in cls._fields})
+    return state, meta["cursor"]
+
+
+@dataclasses.dataclass
+class Checkpointer:
+    """Periodic checkpoint hook for the online loop.
+
+    Use as the ``on_step`` callback::
+
+        ckpt = Checkpointer("/path/ckpt", every=5)
+        online_distributed_pca(stream, cfg, on_step=ckpt.on_step)
+
+    Keeps the latest ``keep`` checkpoints as ``step_{t:08d}`` subdirs.
+    """
+
+    directory: str
+    every: int = 1
+    keep: int = 2
+    rows_per_step: int = 0  # rows consumed per step -> saved stream cursor
+
+    def on_step(self, t: int, state, v_bar=None) -> None:
+        if t % self.every:
+            return
+        path = os.path.join(self.directory, f"step_{t:08d}")
+        save_checkpoint(path, state, cursor=t * self.rows_per_step)
+        self._gc()
+
+    def latest(self):
+        """Restore the newest committed checkpoint, or None."""
+        steps = self._steps()
+        if not steps:
+            return None
+        return restore_checkpoint(
+            os.path.join(self.directory, f"step_{steps[-1]:08d}")
+        )
+
+    def _steps(self):
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_"):
+                if os.path.exists(
+                    os.path.join(self.directory, name, "meta.json")
+                ):
+                    out.append(int(name[5:]))
+        return sorted(out)
+
+    def _gc(self):
+        steps = self._steps()
+        for s in steps[: max(0, len(steps) - self.keep)]:
+            import shutil
+
+            shutil.rmtree(
+                os.path.join(self.directory, f"step_{s:08d}"),
+                ignore_errors=True,
+            )

@@ -1,0 +1,62 @@
+"""Fault injection (SURVEY.md §5.3).
+
+The reference's failure story: a slave acks only after replying
+(``distributed.py:53``), so AMQP redelivers a crashed worker's batch —
+at-least-once, with no timeout, liveness, or master redundancy. The
+TPU-native equivalent of "kill a slave process" is a worker mask: a dropped
+worker's projector is excluded from the merge and the mean reweights over
+survivors exactly (see ``WorkerPool.round(worker_mask=...)``).
+
+This module generates deterministic fault schedules for tests and chaos
+runs.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+class FaultInjector:
+    """Deterministic per-step worker-failure masks.
+
+    ``drop_prob`` is the independent per-worker failure probability per
+    step; at least one worker always survives (an all-dead round would make
+    the merge undefined — the masked mean guards with max(count, 1) but the
+    algorithm should see >= 1 contribution).
+
+    Iterate it alongside the stream and pass to ``worker_masks=``::
+
+        faults = FaultInjector(num_workers=8, drop_prob=0.2, seed=3)
+        online_distributed_pca(stream, cfg, worker_masks=iter(faults))
+    """
+
+    def __init__(self, num_workers: int, drop_prob: float, seed: int = 0):
+        if not 0.0 <= drop_prob < 1.0:
+            raise ValueError(f"drop_prob must be in [0, 1), got {drop_prob}")
+        self.num_workers = num_workers
+        self.drop_prob = drop_prob
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next_mask()
+
+    def next_mask(self) -> np.ndarray:
+        mask = (
+            self._rng.random(self.num_workers) >= self.drop_prob
+        ).astype(np.float32)
+        if mask.sum() == 0:  # resurrect one survivor
+            mask[self._rng.integers(self.num_workers)] = 1.0
+        return mask
+
+
+def kill_workers(num_workers: int, dead: list[int]) -> np.ndarray:
+    """Explicit mask with the listed worker indices dead (scenario tests)."""
+    mask = np.ones(num_workers, np.float32)
+    for i in dead:
+        mask[i] = 0.0
+    if mask.sum() == 0:
+        raise ValueError("cannot kill every worker")
+    return mask

@@ -1,0 +1,91 @@
+"""Structured metrics & logging (SURVEY.md §5.5).
+
+Replaces the reference's observability — a dozen ``print`` calls
+(``distributed.py:35,44,56,92,98,107,114,120,131,137,142``) and one
+wall-clock span (``distributed.py:93,131``) — with per-step structured
+records: throughput (the BASELINE.json samples/sec metric), step latency,
+and optional accuracy (principal angle vs a reference subspace).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO
+
+
+class MetricsLogger:
+    """Collects per-step metrics; optionally streams them as JSON lines.
+
+    Use as an ``on_step`` callback factory::
+
+        metrics = MetricsLogger(samples_per_step=m * n)
+        online_distributed_pca(stream, cfg, on_step=metrics.on_step)
+        print(metrics.summary())
+    """
+
+    def __init__(
+        self,
+        *,
+        samples_per_step: int = 0,
+        stream: IO | None = None,
+        reference_subspace=None,
+    ):
+        self.samples_per_step = samples_per_step
+        self.stream = stream
+        self.reference_subspace = reference_subspace
+        self.records: list[dict] = []
+        self._last_time = None
+
+    def start(self) -> "MetricsLogger":
+        self._last_time = time.perf_counter()
+        return self
+
+    def on_step(self, t: int, state, v_bar=None) -> None:
+        now = time.perf_counter()
+        rec: dict = {"step": int(t)}
+        if self._last_time is not None:
+            dt = now - self._last_time
+            rec["step_seconds"] = round(dt, 6)
+            if self.samples_per_step:
+                rec["samples_per_sec"] = round(self.samples_per_step / dt, 1)
+        if self.reference_subspace is not None and v_bar is not None:
+            from distributed_eigenspaces_tpu.ops.linalg import (
+                principal_angles_degrees,
+            )
+
+            rec["principal_angle_deg"] = round(
+                float(
+                    principal_angles_degrees(
+                        v_bar, self.reference_subspace
+                    ).max()
+                ),
+                4,
+            )
+        self._last_time = now
+        self.records.append(rec)
+        if self.stream is not None:
+            print(json.dumps(rec), file=self.stream, flush=True)
+
+    def summary(self) -> dict:
+        """Aggregate: total steps, mean/max throughput, final accuracy."""
+        out: dict = {"steps": len(self.records)}
+        sps = [r["samples_per_sec"] for r in self.records if "samples_per_sec" in r]
+        if sps:
+            out["mean_samples_per_sec"] = round(sum(sps) / len(sps), 1)
+            out["max_samples_per_sec"] = round(max(sps), 1)
+        angles = [
+            r["principal_angle_deg"]
+            for r in self.records
+            if "principal_angle_deg" in r
+        ]
+        if angles:
+            out["final_principal_angle_deg"] = angles[-1]
+        return out
+
+
+def log_line(msg: str, **fields) -> None:
+    """One structured log line to stderr (replaces the reference's prints)."""
+    rec = {"msg": msg, "time": time.time(), **fields}
+    print(json.dumps(rec), file=sys.stderr, flush=True)

@@ -1,0 +1,43 @@
+"""Tracing / profiling (SURVEY.md §5.1).
+
+The reference's tracing is print statements at protocol steps plus tqdm in
+the notebook. TPU-native: ``jax.named_scope`` annotations (show up in XLA/
+profiler timelines around shard compute and the merge) and ``jax.profiler``
+trace capture for TensorBoard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def named_scope(name: str):
+    """Annotate a region of traced computation (visible in profiles)."""
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: str | None):
+    """Capture a jax.profiler trace into ``log_dir`` (no-op when None)::
+
+        with profile_to("/tmp/trace"):
+            state, _ = step(state, x)
+            jax.block_until_ready(state)
+    """
+    if log_dir is None:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate_step(t: int):
+    """Name one online step in the profile timeline."""
+    with jax.profiler.StepTraceAnnotation("pca_step", step_num=t):
+        yield

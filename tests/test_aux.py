@@ -1,0 +1,281 @@
+"""Aux subsystems: checkpoint round-trip + crash-consistency, metrics,
+fault injection schedules, CLI end-to-end (SURVEY.md §5)."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.algo.online import (
+    OnlineState,
+    online_distributed_pca,
+)
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.data.stream import synthetic_stream
+from distributed_eigenspaces_tpu.parallel.feature_sharded import LowRankState
+from distributed_eigenspaces_tpu.utils.checkpoint import (
+    Checkpointer,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from distributed_eigenspaces_tpu.utils.faults import FaultInjector, kill_workers
+from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+
+def test_checkpoint_roundtrip_online(tmp_path):
+    state = OnlineState(
+        sigma_tilde=jnp.eye(8) * 0.5, step=jnp.asarray(3, jnp.int32)
+    )
+    save_checkpoint(str(tmp_path / "ck"), state, cursor=1234)
+    restored, cursor = restore_checkpoint(str(tmp_path / "ck"))
+    assert isinstance(restored, OnlineState)
+    assert cursor == 1234
+    np.testing.assert_allclose(
+        np.asarray(restored.sigma_tilde), np.eye(8) * 0.5
+    )
+    assert int(restored.step) == 3
+
+
+def test_checkpoint_roundtrip_lowrank(tmp_path):
+    state = LowRankState(
+        u=jnp.ones((16, 4)), s=jnp.arange(4.0), step=jnp.asarray(7, jnp.int32)
+    )
+    save_checkpoint(str(tmp_path / "ck"), state)
+    restored, _ = restore_checkpoint(str(tmp_path / "ck"))
+    assert isinstance(restored, LowRankState)
+    assert restored.u.shape == (16, 4)
+    assert int(restored.step) == 7
+
+
+def test_checkpoint_uncommitted_invisible(tmp_path):
+    """A crash between state.npz and meta.json == no checkpoint."""
+    state = OnlineState.initial(4)
+    path = tmp_path / "ck"
+    save_checkpoint(str(path), state)
+    os.remove(path / "meta.json")  # simulate crash before commit marker
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(str(path))
+
+
+def test_checkpointer_keeps_latest(tmp_path):
+    ck = Checkpointer(str(tmp_path), every=2, keep=2)
+    for t in range(1, 9):
+        state = OnlineState(
+            sigma_tilde=jnp.eye(4) * t, step=jnp.asarray(t, jnp.int32)
+        )
+        ck.on_step(t, state)
+    restored, _ = ck.latest()
+    assert int(restored.step) == 8
+    assert len(ck._steps()) == 2  # gc kept only the newest two
+
+
+def test_resume_through_checkpoint_matches(tmp_path):
+    """Full run == run-3-steps, crash, restore, run-3-more."""
+    D, K = 32, 2
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, seed=0)
+    cfg = PCAConfig(dim=D, k=K, num_workers=4, rows_per_worker=64,
+                    num_steps=6, backend="local")
+    blocks = list(synthetic_stream(spec, num_workers=4, rows_per_worker=64,
+                                   num_steps=6, seed=2))
+    w_full, st_full = online_distributed_pca(iter(blocks), cfg)
+
+    _, st3 = online_distributed_pca(iter(blocks[:3]), cfg)
+    save_checkpoint(str(tmp_path / "ck"), st3)
+    restored, _ = restore_checkpoint(str(tmp_path / "ck"))
+    w_res, st_res = online_distributed_pca(iter(blocks[3:]), cfg,
+                                           state=restored)
+    np.testing.assert_allclose(
+        np.asarray(st_res.sigma_tilde), np.asarray(st_full.sigma_tilde),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_fault_injector_deterministic():
+    f1 = list(FaultInjector(8, 0.3, seed=5).next_mask() for _ in range(4))
+    f2 = list(FaultInjector(8, 0.3, seed=5).next_mask() for _ in range(4))
+    for a, b in zip(f1, f2):
+        np.testing.assert_array_equal(a, b)
+    # always at least one survivor even at extreme drop rates
+    hard = FaultInjector(4, 0.99, seed=1)
+    for _ in range(50):
+        assert hard.next_mask().sum() >= 1
+
+
+def test_fault_injector_validates():
+    with pytest.raises(ValueError):
+        FaultInjector(4, 1.0)
+    with pytest.raises(ValueError):
+        kill_workers(3, [0, 1, 2])
+    mask = kill_workers(4, [1, 3])
+    np.testing.assert_array_equal(mask, [1, 0, 1, 0])
+
+
+def test_online_loop_survives_faults():
+    """Accuracy degrades gracefully, not catastrophically, under 25% worker
+    loss per step — the elastic-recovery claim (SURVEY.md §5.3)."""
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+
+    D, K = 48, 3
+    spec = planted_spectrum(D, k_planted=K, gap=25.0, noise=0.01, seed=4)
+    cfg = PCAConfig(dim=D, k=K, num_workers=8, rows_per_worker=64,
+                    num_steps=6, backend="local")
+    stream = synthetic_stream(spec, num_workers=8, rows_per_worker=64,
+                              num_steps=6, seed=6)
+    faults = iter(FaultInjector(8, 0.25, seed=9))
+    w, state = online_distributed_pca(stream, cfg, worker_masks=faults)
+    ang = np.asarray(principal_angles_degrees(w, spec.top_k(K)))
+    assert ang.max() < 3.0, f"under faults: {ang}"
+
+
+def test_metrics_logger():
+    buf = io.StringIO()
+    ml = MetricsLogger(samples_per_step=100, stream=buf).start()
+    state = OnlineState.initial(4)
+    ml.on_step(1, state)
+    ml.on_step(2, state)
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert [l["step"] for l in lines] == [1, 2]
+    assert all("samples_per_sec" in l for l in lines)
+    s = ml.summary()
+    assert s["steps"] == 2 and "mean_samples_per_sec" in s
+
+
+CLI_ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    XLA_FLAGS="--xla_force_host_platform_device_count=8",
+)
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "distributed_eigenspaces_tpu.cli", *argv],
+        capture_output=True,
+        text=True,
+        env=CLI_ENV,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        timeout=600,
+    )
+
+
+def test_cli_fit_synthetic(tmp_path):
+    out = tmp_path / "w.npy"
+    r = _run_cli(
+        "--mode", "fit", "--data", "synthetic", "--dim", "64",
+        "--rank", "3", "--workers", "4", "--steps", "3",
+        "--rows-per-worker", "32", "--backend", "local",
+        "--save", str(out), "--metrics",
+    )
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["mode"] == "fit" and rec["steps"] == 3
+    w = np.load(out)
+    assert w.shape == (64, 3)
+
+
+def test_cli_oneshot_master_alias(tmp_path):
+    r = _run_cli(
+        "--mode", "master", "--broker", "10.0.0.1", "--data", "synthetic",
+        "--dim", "32", "--rank", "2", "--batches", "4", "--steps", "1",
+        "--rows-per-worker", "16", "--backend", "local",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "--broker 10.0.0.1 ignored" in r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["mode"] == "oneshot" and rec["workers"] == 4
+
+
+def test_cli_slave_explains():
+    r = _run_cli("--mode", "slave")
+    assert r.returncode == 2
+    assert "device shard" in r.stderr
+
+
+def test_cli_checkpoint_resume(tmp_path):
+    ckdir = tmp_path / "ck"
+    common = [
+        "--mode", "fit", "--data", "synthetic", "--dim", "48",
+        "--rank", "2", "--workers", "4", "--steps", "4",
+        "--rows-per-worker", "32", "--backend", "local",
+        "--checkpoint-dir", str(ckdir), "--checkpoint-every", "2",
+    ]
+    r1 = _run_cli(*common)
+    assert r1.returncode == 0, r1.stderr
+    assert (ckdir / "step_00000004" / "meta.json").exists()
+    # the saved cursor tracks consumed rows (4 steps * 4 workers * 32 rows)
+    meta = json.loads(
+        (ckdir / "step_00000004" / "meta.json").read_text()
+    )
+    assert meta["cursor"] == 4 * 4 * 32
+    r2 = _run_cli(*common, "--resume")
+    assert r2.returncode == 0, r2.stderr
+    assert '"resumed_step": 4' in r2.stderr
+    assert '"cursor": 512' in r2.stderr
+    # fully-resumed run has no remaining budget -> 0 extra steps
+    assert json.loads(r2.stdout.strip().splitlines()[-1])["steps"] == 0
+
+
+def test_cli_partial_resume_continues_stream(tmp_path):
+    """Resume from step 2/4 consumes only UNSEEN rows (no B6-style replay)."""
+    ckdir = tmp_path / "ck"
+    common = [
+        "--mode", "fit", "--data", "synthetic", "--dim", "48",
+        "--rank", "2", "--workers", "4", "--steps", "2",
+        "--rows-per-worker", "32", "--backend", "local",
+        "--checkpoint-dir", str(ckdir), "--checkpoint-every", "1",
+    ]
+    r1 = _run_cli(*common)
+    assert r1.returncode == 0, r1.stderr
+    # resume with a larger budget: picks up at cursor=256, runs 2 more
+    more = list(common)
+    more[more.index("--steps") + 1] = "4"
+    r2 = _run_cli(*more, "--resume")
+    assert r2.returncode == 0, r2.stderr
+    assert '"cursor": 256' in r2.stderr
+    assert json.loads(r2.stdout.strip().splitlines()[-1])["steps"] == 2
+
+
+def test_cli_one_over_t_bounded_by_steps(tmp_path):
+    """--discount 1/t must still respect --steps (stream-level bound)."""
+    r = _run_cli(
+        "--mode", "fit", "--data", "synthetic", "--dim", "32",
+        "--rank", "2", "--workers", "2", "--steps", "3",
+        "--rows-per-worker", "16", "--backend", "local",
+        "--discount", "1/t",
+    )
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout.strip().splitlines()[-1])["steps"] == 3
+
+
+def test_checkpoint_rewrite_crash_leaves_no_committed_corruption(tmp_path):
+    """Overwriting an existing checkpoint invalidates the commit marker
+    first — a crash mid-rewrite must not leave meta.json + corrupt npz."""
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, OnlineState.initial(4))
+    # simulate the crash window: marker removed, payload half-written
+    import distributed_eigenspaces_tpu.utils.checkpoint as ckpt_mod
+
+    real_savez = np.savez
+
+    def crashing_savez(file, **kw):
+        with open(file, "wb") as f:
+            f.write(b"partial")
+        raise RuntimeError("simulated crash mid-write")
+
+    np.savez = crashing_savez
+    try:
+        with pytest.raises(RuntimeError):
+            save_checkpoint(path, OnlineState.initial(4))
+    finally:
+        np.savez = real_savez
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(path)
