@@ -65,9 +65,20 @@ def preprocess(
     ``grayscale=True`` reproduces the reference CLI path
     (``distributed.py:170-173``): channel mean then flatten to H*W (1024-d
     for CIFAR). ``grayscale=False`` flattens all channels (3072-d), the
-    BASELINE.md CIFAR config.
+    BASELINE.md CIFAR config. uint8 input takes the native C++ conversion
+    kernels (``native/loader.cc``); anything else the numpy path.
     """
-    x = np.asarray(images, dtype=dtype)
+    images = np.asarray(images)
+    if images.dtype == np.uint8 and dtype == np.float32 and images.ndim == 4:
+        from distributed_eigenspaces_tpu.runtime.native import (
+            to_f32,
+            to_gray_f32,
+        )
+
+        if grayscale:
+            return to_gray_f32(images)
+        return to_f32(images).reshape(images.shape[0], -1)
+    x = images.astype(dtype)
     if grayscale:
         x = x.mean(axis=3)
     return x.reshape(x.shape[0], -1)

@@ -1,0 +1,130 @@
+"""Pallas TPU kernel: streaming Gram accumulation ``X^T X`` with fp32
+accumulate.
+
+The hot op of the whole framework (reference ``distributed.py:67-69``,
+``np.dot(x.T, x)`` under OpenBLAS) as a hand-tiled MXU kernel: the row
+dimension ``n`` streams through VMEM in blocks while a (bd_i, bd_j) fp32
+accumulator tile stays resident, so arbitrarily many rows pass through
+without ever re-reading the output from HBM — the d x d result is written
+exactly once. bfloat16 inputs hit the MXU at full rate; accumulation is
+always fp32.
+
+The XLA einsum in :func:`..linalg.gram` is the default (and what the
+framework uses on CPU / in interpret-free tests); ``gram_pallas`` is the
+TPU fast path, selected by :func:`gram_auto` for fp32/bf16 inputs with
+MXU-aligned shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _gram_kernel(xi_ref, xj_ref, out_ref):
+    """Grid (gi, gj, gn): accumulate xi_block^T @ xj_block over the n axis.
+
+    The n axis is the innermost grid dimension, so for each (i, j) output
+    tile the accumulator stays in VMEM across all n-blocks.
+    """
+
+    @pl.when(pl.program_id(2) == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += jax.lax.dot_general(
+        xi_ref[:],
+        xj_ref[:],
+        dimension_numbers=(((0,), (0,)), ((), ())),  # contract rows: X^T X
+        preferred_element_type=jnp.float32,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("block_n", "block_d", "normalize", "interpret"),
+)
+def gram_pallas(
+    x: jax.Array,
+    *,
+    block_n: int = 512,
+    block_d: int = 256,
+    normalize: bool = True,
+    interpret: bool = False,
+) -> jax.Array:
+    """``(n, d) -> (d, d)`` sample second-moment matrix via Pallas.
+
+    Requires ``n % block_n == 0`` and ``d % block_d == 0`` (callers pad or
+    fall back to the XLA path — :func:`gram_auto`). ``interpret=True`` runs
+    the kernel on CPU for tests.
+    """
+    n, d = x.shape
+    if n % block_n or d % block_d:
+        raise ValueError(
+            f"shape ({n}, {d}) not divisible by blocks "
+            f"({block_n}, {block_d})"
+        )
+    grid = (d // block_d, d // block_d, n // block_n)
+    out = pl.pallas_call(
+        _gram_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (block_n, block_d),
+                lambda i, j, nb: (nb, i),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_n, block_d),
+                lambda i, j, nb: (nb, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_d, block_d),
+            lambda i, j, nb: (i, j),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((d, d), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x, x)
+    if normalize:
+        out = out / jnp.asarray(n, jnp.float32)
+    return out
+
+
+def _pick_block(total: int, target: int) -> int:
+    """Largest divisor of ``total`` that is <= target and a multiple of 128
+    (falls back to the largest divisor, then to ``total`` itself)."""
+    best = None
+    for b in range(min(target, total), 0, -1):
+        if total % b == 0:
+            if b % 128 == 0:
+                return b
+            if best is None:
+                best = b
+    return best or total
+
+
+def gram_auto(x: jax.Array, *, normalize: bool = True) -> jax.Array:
+    """Use the Pallas kernel when on TPU with aligned shapes, else the XLA
+    einsum (identical math; tested against each other)."""
+    from distributed_eigenspaces_tpu.ops.linalg import gram
+
+    n, d = x.shape
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if not on_tpu or d % 128 or n % 8:
+        return gram(x, normalize=normalize)
+    return gram_pallas(
+        x,
+        block_n=_pick_block(n, 512),
+        block_d=_pick_block(d, 256),
+        normalize=normalize,
+    )

@@ -43,10 +43,20 @@ from distributed_eigenspaces_tpu.parallel.mesh import (
 
 
 def _local_eigenspaces(x_blocks: jax.Array, k: int, solver: str, iters: int):
-    """Per-worker ``V_hat``: ``(m, n, d) -> (m, d, k)`` (vmapped C8 -> C7)."""
+    """Per-worker ``V_hat``: ``(m, n, d) -> (m, d, k)`` (vmapped C8 -> C7).
+
+    The Gram uses the Pallas streaming kernel on TPU for MXU-aligned shapes
+    (``ops.pallas_gram``), falling back to the XLA einsum elsewhere — same
+    math, tested against each other.
+    """
+    import os
+
+    from distributed_eigenspaces_tpu.ops.pallas_gram import gram_auto
+
+    use_pallas = os.environ.get("DET_NO_PALLAS", "0") != "1"
 
     def one(xb):
-        g = gram(xb)
+        g = gram_auto(xb) if use_pallas else gram(xb)
         if solver == "subspace":
             return subspace_iteration(
                 lambda v: jnp.matmul(

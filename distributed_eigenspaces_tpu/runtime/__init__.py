@@ -1,0 +1,22 @@
+"""Native host-side runtime: C++ conversion kernels + prefetching IO.
+
+See ``native/loader.cc`` for the implementation and
+:mod:`.native` for the ctypes bindings (numpy fallback when the toolchain
+is unavailable or ``DET_NO_NATIVE=1``).
+"""
+
+from distributed_eigenspaces_tpu.runtime.native import (
+    native_available,
+    to_gray_f32,
+    to_f32,
+    ChunkReader,
+)
+from distributed_eigenspaces_tpu.runtime.prefetch import prefetch_stream
+
+__all__ = [
+    "native_available",
+    "to_gray_f32",
+    "to_f32",
+    "ChunkReader",
+    "prefetch_stream",
+]

@@ -1,0 +1,187 @@
+"""ctypes bindings for the native loader (``native/loader.cc``).
+
+The shared library is built on first use with plain ``g++ -O3 -shared`` into
+a cache directory and memoized; every entry point has a numpy fallback so
+the framework is fully functional without a toolchain (or with
+``DET_NO_NATIVE=1``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_LIB_FAILED = False
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "loader.cc",
+)
+
+
+def _build_dir() -> str:
+    d = os.environ.get(
+        "DET_NATIVE_CACHE",
+        os.path.join(tempfile.gettempdir(), "det_native_cache"),
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _LIB_FAILED
+    if _LIB is not None or _LIB_FAILED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        if os.environ.get("DET_NO_NATIVE") == "1" or not os.path.exists(_SRC):
+            _LIB_FAILED = True
+            return None
+        so_path = os.path.join(_build_dir(), "det_loader.so")
+        try:
+            if not os.path.exists(so_path) or (
+                os.path.getmtime(so_path) < os.path.getmtime(_SRC)
+            ):
+                tmp = so_path + ".tmp"
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                        "-pthread", _SRC, "-o", tmp,
+                    ],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+                os.replace(tmp, so_path)
+            lib = ctypes.CDLL(so_path)
+            lib.u8_nhwc_to_gray_f32.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int32,
+            ]
+            lib.u8_to_f32.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int32,
+            ]
+            lib.reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+            lib.reader_open.restype = ctypes.c_void_p
+            lib.reader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.reader_next.restype = ctypes.c_int64
+            lib.reader_close.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+        except Exception:
+            _LIB_FAILED = True
+        return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _nthreads() -> int:
+    return min(8, os.cpu_count() or 1)
+
+
+def to_gray_f32(images: np.ndarray) -> np.ndarray:
+    """(N, H, W, C) uint8 -> (N, H*W) float32 channel-mean grayscale — the
+    reference's preprocessing (``distributed.py:170-173``) as a native
+    kernel; numpy fallback otherwise."""
+    images = np.ascontiguousarray(images)
+    n, h, w, c = images.shape
+    lib = _load()
+    if lib is None or images.dtype != np.uint8:
+        return (
+            images.astype(np.float32).mean(axis=3).reshape(n, h * w)
+        )
+    out = np.empty((n, h * w), np.float32)
+    lib.u8_nhwc_to_gray_f32(
+        images.ctypes.data, out.ctypes.data, n, h, w, c, _nthreads()
+    )
+    return out
+
+
+def to_f32(flat: np.ndarray) -> np.ndarray:
+    """uint8 array -> float32 (same shape) via the native widen kernel."""
+    flat = np.ascontiguousarray(flat)
+    lib = _load()
+    if lib is None or flat.dtype != np.uint8:
+        return flat.astype(np.float32)
+    out = np.empty(flat.shape, np.float32)
+    lib.u8_to_f32(flat.ctypes.data, out.ctypes.data, flat.size, _nthreads())
+    return out
+
+
+class ChunkReader:
+    """Double-buffered chunked file reader (background read-ahead thread in
+    C++; pure-Python fallback reads synchronously).
+
+    Iterates ``bytes`` chunks of size ``chunk_bytes`` (last may be short)::
+
+        for chunk in ChunkReader(path, 1 << 20):
+            ...
+    """
+
+    def __init__(self, path: str, chunk_bytes: int):
+        if chunk_bytes <= 0:
+            raise ValueError("chunk_bytes must be positive")
+        self.path = path
+        self.chunk_bytes = chunk_bytes
+        self._lib = _load()
+        self._handle = None
+        self._file = None
+        if self._lib is not None:
+            h = self._lib.reader_open(
+                path.encode(), ctypes.c_int64(chunk_bytes)
+            )
+            if not h:
+                raise FileNotFoundError(path)
+            self._handle = h
+        else:
+            self._file = open(path, "rb")
+
+    def __iter__(self):
+        buf = np.empty(self.chunk_bytes, np.uint8)
+        while True:
+            if self._handle is not None:
+                got = self._lib.reader_next(self._handle, buf.ctypes.data)
+                if got <= 0:
+                    return
+                yield buf[:got].tobytes()
+                if got < self.chunk_bytes:
+                    return
+            else:
+                data = self._file.read(self.chunk_bytes)
+                if not data:
+                    return
+                yield data
+                if len(data) < self.chunk_bytes:
+                    return
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.reader_close(self._handle)
+            self._handle = None
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

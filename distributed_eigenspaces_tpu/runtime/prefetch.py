@@ -1,0 +1,55 @@
+"""Host->device prefetch for block streams (SURVEY.md §7.2: double-buffered
+device placement).
+
+The reference's master "prefetch" is 5 in-flight AMQP messages hardcoded at
+``distributed.py:108``. Here the input pipeline overlaps three stages:
+host block preparation (the stream iterator), host->HBM transfer
+(``device_put`` / pool sharding), and device compute — by running the
+producer in a thread and keeping ``depth`` blocks in flight.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator
+
+import jax
+
+
+def prefetch_stream(
+    stream: Iterable,
+    *,
+    depth: int = 2,
+    place: Callable | None = None,
+) -> Iterator:
+    """Wrap a block stream with background production + device placement.
+
+    ``place`` maps a host block to its device-resident form (e.g.
+    ``WorkerPool.shard``); default is ``jax.device_put``. ``depth`` blocks
+    are kept resident ahead of the consumer (2 = classic double buffering).
+    Exceptions in the producer propagate to the consumer.
+    """
+    if depth < 1:
+        raise ValueError("depth must be >= 1")
+    put = place if place is not None else jax.device_put
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _END = object()
+
+    def producer():
+        try:
+            for block in stream:
+                q.put(put(block))
+            q.put(_END)
+        except BaseException as e:  # propagate to consumer
+            q.put(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item

@@ -1,0 +1,151 @@
+// Native host-side data runtime for distributed_eigenspaces_tpu.
+//
+// The reference's data path is pure Python: pickle loading
+// (load_data.py:8-15), numpy grayscale + flatten (distributed.py:170-173).
+// On a TPU host the input pipeline must keep one chip fed at HBM-copy rate,
+// so the conversion inner loops and the read-ahead live here:
+//
+//   - u8_nhwc_to_gray_f32 / u8_to_f32: multithreaded uint8 -> float32
+//     conversion (channel-mean grayscale or plain widen), the hot loop of
+//     CIFAR-style ingestion (reference C5).
+//   - reader_*: a chunked file reader with one background read-ahead thread
+//     (double buffer), so disk latency overlaps host->device transfer.
+//
+// Built with plain g++ (no external deps); loaded via ctypes
+// (runtime/native.py) with a numpy fallback when unavailable.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---- conversion kernels ---------------------------------------------------
+
+// (n, h, w, c) uint8 -> (n, h*w) float32 channel-mean grayscale.
+void u8_nhwc_to_gray_f32(const uint8_t* in, float* out, int64_t n,
+                         int64_t h, int64_t w, int64_t c,
+                         int32_t num_threads) {
+  const int64_t hw = h * w;
+  const float inv_c = 1.0f / static_cast<float>(c);
+  auto worker = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* row = in + i * hw * c;
+      float* dst = out + i * hw;
+      for (int64_t p = 0; p < hw; ++p) {
+        int32_t acc = 0;
+        for (int64_t ch = 0; ch < c; ++ch) acc += row[p * c + ch];
+        dst[p] = static_cast<float>(acc) * inv_c;
+      }
+    }
+  };
+  if (num_threads <= 1 || n < num_threads) {
+    worker(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t per = (n + num_threads - 1) / num_threads;
+  for (int32_t t = 0; t < num_threads; ++t) {
+    int64_t lo = t * per, hi = std::min<int64_t>(n, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back(worker, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// flat uint8 -> float32 widen (the RGB 3072-d path, B7).
+void u8_to_f32(const uint8_t* in, float* out, int64_t count,
+               int32_t num_threads) {
+  auto worker = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) out[i] = static_cast<float>(in[i]);
+  };
+  if (num_threads <= 1 || count < (1 << 20)) {
+    worker(0, count);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t per = (count + num_threads - 1) / num_threads;
+  for (int32_t t = 0; t < num_threads; ++t) {
+    int64_t lo = t * per, hi = std::min<int64_t>(count, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back(worker, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// ---- double-buffered chunk reader ----------------------------------------
+
+struct Reader {
+  FILE* f = nullptr;
+  int64_t chunk = 0;
+  std::vector<uint8_t> ahead;   // read-ahead buffer
+  int64_t ahead_len = 0;        // bytes valid in `ahead`
+  bool ahead_ready = false;
+  bool eof = false;
+  bool stop = false;
+  std::thread th;
+  std::mutex mu;
+  std::condition_variable cv;
+
+  void loop() {
+    for (;;) {
+      std::unique_lock<std::mutex> lk(mu);
+      cv.wait(lk, [&] { return stop || !ahead_ready; });
+      if (stop) return;
+      lk.unlock();
+      int64_t got = static_cast<int64_t>(
+          fread(ahead.data(), 1, static_cast<size_t>(chunk), f));
+      lk.lock();
+      ahead_len = got;
+      ahead_ready = true;
+      if (got < chunk) eof = true;
+      cv.notify_all();
+      if (eof) return;
+    }
+  }
+};
+
+void* reader_open(const char* path, int64_t chunk_bytes) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  Reader* r = new Reader();
+  r->f = f;
+  r->chunk = chunk_bytes;
+  r->ahead.resize(static_cast<size_t>(chunk_bytes));
+  r->th = std::thread([r] { r->loop(); });
+  return r;
+}
+
+// Copy the next chunk into buf; returns bytes delivered (0 at EOF).
+int64_t reader_next(void* h, uint8_t* buf) {
+  Reader* r = static_cast<Reader*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  // wait for data OR a finished reader (eof with its final chunk already
+  // consumed must return 0 immediately, not wait on a dead thread)
+  r->cv.wait(lk, [&] { return r->ahead_ready || r->eof; });
+  if (!r->ahead_ready) return 0;  // eof, final chunk already delivered
+  int64_t got = r->ahead_len;
+  if (got > 0) memcpy(buf, r->ahead.data(), static_cast<size_t>(got));
+  r->ahead_ready = false;
+  r->cv.notify_all();
+  return got;
+}
+
+void reader_close(void* h) {
+  Reader* r = static_cast<Reader*>(h);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->stop = true;
+  }
+  r->cv.notify_all();
+  if (r->th.joinable()) r->th.join();
+  fclose(r->f);
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,100 @@
+"""Native runtime tests: C++ conversion kernels vs numpy, chunk reader
+round-trip (native and fallback), prefetch stream semantics."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.runtime import (
+    ChunkReader,
+    native_available,
+    prefetch_stream,
+    to_f32,
+    to_gray_f32,
+)
+from distributed_eigenspaces_tpu.runtime import native as native_mod
+
+
+def test_native_builds():
+    """The toolchain is present in this image; the lib must compile."""
+    assert native_available(), "g++ build of native/loader.cc failed"
+
+
+def test_gray_matches_numpy(rng):
+    imgs = rng.integers(0, 256, (64, 32, 32, 3), dtype=np.uint8)
+    got = to_gray_f32(imgs)
+    want = imgs.astype(np.float32).mean(axis=3).reshape(64, 1024)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-4)
+    assert got.dtype == np.float32
+
+
+def test_widen_matches_numpy(rng):
+    x = rng.integers(0, 256, (3, 1000), dtype=np.uint8)
+    np.testing.assert_array_equal(to_f32(x), x.astype(np.float32))
+
+
+def test_gray_fallback_path(rng, monkeypatch):
+    """float input (or DET_NO_NATIVE) takes the numpy path, same result."""
+    imgs = rng.integers(0, 256, (8, 4, 4, 3), dtype=np.uint8)
+    want = to_gray_f32(imgs)
+    got = to_gray_f32(imgs.astype(np.float32))  # non-u8 -> fallback
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_chunk_reader_roundtrip(tmp_path, rng):
+    payload = rng.integers(0, 256, 10_000, dtype=np.uint8).tobytes()
+    p = tmp_path / "blob.bin"
+    p.write_bytes(payload)
+    for chunk in (1024, 3333, 10_000, 20_000):
+        with ChunkReader(str(p), chunk) as r:
+            got = b"".join(r)
+        assert got == payload, f"chunk={chunk}"
+
+
+def test_chunk_reader_exact_multiple(tmp_path, rng):
+    """File size an exact multiple of chunk size (EOF after full chunk)."""
+    payload = bytes(rng.integers(0, 256, 4096, dtype=np.uint8))
+    p = tmp_path / "b.bin"
+    p.write_bytes(payload)
+    with ChunkReader(str(p), 1024) as r:
+        chunks = list(r)
+    assert b"".join(chunks) == payload
+    assert len(chunks) == 4
+
+
+def test_chunk_reader_missing_file():
+    with pytest.raises(FileNotFoundError):
+        ChunkReader("/nonexistent/blob.bin", 128)
+
+
+def test_chunk_reader_python_fallback(tmp_path, rng, monkeypatch):
+    payload = bytes(rng.integers(0, 256, 5000, dtype=np.uint8))
+    p = tmp_path / "b.bin"
+    p.write_bytes(payload)
+    monkeypatch.setattr(native_mod, "_LIB", None)
+    monkeypatch.setattr(native_mod, "_LIB_FAILED", True)
+    with ChunkReader(str(p), 1500) as r:
+        assert r._handle is None  # fallback engaged
+        assert b"".join(r) == payload
+
+
+def test_prefetch_stream_order_and_placement():
+    blocks = [np.full((4,), i, np.float32) for i in range(6)]
+    seen = []
+    out = list(
+        prefetch_stream(iter(blocks), depth=2, place=lambda b: (seen.append(b) or b * 2))
+    )
+    assert len(out) == 6
+    np.testing.assert_allclose(out[3], blocks[3] * 2)
+
+
+def test_prefetch_stream_propagates_errors():
+    def bad():
+        yield np.zeros(2)
+        raise RuntimeError("stream died")
+
+    it = prefetch_stream(bad(), place=lambda b: b)
+    next(it)
+    with pytest.raises(RuntimeError, match="stream died"):
+        list(it)
