@@ -13,6 +13,12 @@ Prints ONE JSON line:
   reference notebook's cell-16 algorithm on this host's CPU (the reference
   publishes no numbers — SURVEY.md §6 — so the CPU baseline is measured
   here, per BASELINE.md's action item). Target from BASELINE.json: >=50x.
+  NOTE on framing: the baseline runs the reference AS IT SHIPS (exact eigh
+  per worker); the TPU numerator uses this framework's subspace solver, so
+  vs_baseline is framework-vs-reference, conflating algorithm + hardware
+  gains. The same-algorithm comparison (NumPy subspace solver, ~71k
+  samples/s on this host) still puts the chip at ~125x — both framings
+  clear the 50x target; see BASELINE.md's measured table.
 
 Accuracy is asserted, not just speed: the run must land within 1 degree
 (principal angle) of the planted subspace or the benchmark reports failure.
@@ -73,8 +79,13 @@ def measure_tpu(blocks_host, spectrum):
         top_k_eigvecs,
     )
 
+    # solver="subspace": block power iteration (matmul + thin QR) instead of
+    # full eigh — eigh at d=1024 costs ~400 ms/step on TPU vs ~5 ms for the
+    # whole subspace-solver round (measured; see BASELINE.md), and the
+    # accuracy gate below still holds with an order of magnitude to spare.
     cfg = PCAConfig(
-        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=TPU_STEPS
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=TPU_STEPS,
+        solver="subspace", subspace_iters=12,
     )
     step = make_train_step(cfg, mesh=None)
     blocks = [jnp.asarray(b) for b in blocks_host]
