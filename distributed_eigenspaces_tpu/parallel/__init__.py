@@ -13,10 +13,12 @@ from distributed_eigenspaces_tpu.parallel.mesh import (
     replicated_sharding,
 )
 from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
+from distributed_eigenspaces_tpu.parallel import multihost
 
 __all__ = [
     "make_mesh",
     "worker_sharding",
     "replicated_sharding",
     "WorkerPool",
+    "multihost",
 ]

@@ -1,0 +1,185 @@
+"""Multi-host (DCN) execution: one SPMD program over a pod, no broker.
+
+The reference scales out by pointing every process at a RabbitMQ broker IP
+(``--broker``, ``distributed.py:159,166-167``) and shipping d x k eigenvector
+matrices as JSON text through it (``distributed.py:51``); every node also
+loads the FULL dataset from disk (``distributed.py:169``) and only index
+ranges travel (C11, SURVEY.md §2).
+
+The TPU-native model inverts all of that:
+
+- control plane: ``jax.distributed.initialize`` (coordinator address instead
+  of a broker; processes rendezvous once, then every process runs the same
+  program) — :func:`initialize`.
+- data plane: each host loads ONLY the rows of the workers it owns
+  (:func:`host_worker_range`), assembles them into a global jit-ready array
+  with :func:`host_local_blocks_to_global`, and the projector merge is a
+  ``psum`` that XLA routes over ICI within a slice and DCN across slices.
+  No serialization, no broker process, no full-dataset copies.
+
+Single-process (including the 8-virtual-device CPU test rig) is the
+degenerate case: ``process_count() == 1`` and every helper reduces to the
+plain mesh path, so the same script runs unchanged from laptop to pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_eigenspaces_tpu.parallel.mesh import (
+    WORKER_AXIS,
+    make_mesh,
+    replicated_sharding,
+)
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kw,
+) -> None:
+    """Join (or create) the multi-host job. Safe to call single-process.
+
+    With no arguments, honors the standard env vars
+    (``JAX_COORDINATOR_ADDRESS`` etc.) or TPU-pod auto-detection; on a plain
+    single-process environment it is a no-op. This is the entire replacement
+    for the reference's broker bootstrap (``distributed.py:14-20``).
+
+    When multi-host arguments ARE given explicitly, failures propagate: a
+    bad coordinator address or late initialization must not silently
+    degrade a pod job into N independent single-process runs (each would
+    merge only its own shard — wrong results, no error). Only the
+    "already initialized" case is tolerated, for idempotent setup code.
+    Note this function must run before any JAX computation creates the
+    local backend (same rule as ``jax.distributed.initialize`` itself).
+    """
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+        or bool(kw)
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kw,
+        )
+    except (ValueError, RuntimeError) as e:
+        if "already" in str(e).lower():
+            return  # idempotent re-init — fine on any path
+        if explicit:
+            raise  # never swallow a real multi-host bootstrap failure
+        # auto-detect path with no coordinator configured: single-process
+
+
+@dataclasses.dataclass(frozen=True)
+class HostShard:
+    """This process's slice of the global worker axis."""
+
+    lo: int  # first global worker index owned by this host (inclusive)
+    hi: int  # last, exclusive
+    num_workers: int  # global m
+
+    @property
+    def count(self) -> int:
+        return self.hi - self.lo
+
+    def row_range(self, rows_per_worker: int) -> tuple[int, int]:
+        """Global row range [lo, hi) this host should load for one step —
+        the multi-host fix for the reference loading everything everywhere
+        (``distributed.py:169``)."""
+        return self.lo * rows_per_worker, self.hi * rows_per_worker
+
+
+def host_worker_range(
+    num_workers: int,
+    *,
+    process_index: int | None = None,
+    process_count: int | None = None,
+) -> HostShard:
+    """Contiguous block of global worker indices owned by one process.
+
+    Workers are split evenly over processes (num_workers must be divisible
+    by process_count — rejected loudly, unlike the reference's silent
+    remainder drop, SURVEY.md §2.2-B5).
+    """
+    pc = jax.process_count() if process_count is None else process_count
+    pi = jax.process_index() if process_index is None else process_index
+    if num_workers % pc:
+        raise ValueError(
+            f"num_workers={num_workers} not divisible by "
+            f"process_count={pc}"
+        )
+    per = num_workers // pc
+    return HostShard(lo=pi * per, hi=(pi + 1) * per, num_workers=num_workers)
+
+
+def global_mesh(
+    num_workers: int | None = None, num_feature_shards: int = 1
+) -> Mesh:
+    """Mesh over every device in the job (all hosts). After
+    :func:`initialize`, ``jax.devices()`` spans the slice/pod; the same
+    ``(workers, features)`` mesh code covers one chip to a pod, with the
+    ICI/DCN split decided by XLA from the device topology."""
+    return make_mesh(
+        num_workers=num_workers, num_feature_shards=num_feature_shards
+    )
+
+
+def host_local_blocks_to_global(
+    x_local: np.ndarray | jax.Array, mesh: Mesh
+) -> jax.Array:
+    """Assemble per-host ``(m_local, n, d)`` blocks into the global
+    ``(m, n, d)`` array sharded over ``workers``.
+
+    Each process passes only the blocks of the workers it owns
+    (:func:`host_worker_range`); the result is a single global jit-ready
+    array. This is the input-pipeline half of the reference's batch
+    dispatch (``distributed.py:108-112``) with the broker deleted.
+    """
+    sharding = NamedSharding(mesh, P(WORKER_AXIS))
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(x_local)
+    )
+
+
+def replicate_to_hosts(value, mesh: Mesh) -> jax.Array:
+    """Place a small host value (e.g. the (d, k) state) replicated on every
+    device of the global mesh."""
+    return jax.device_put(value, replicated_sharding(mesh))
+
+
+def fetch_replicated(x: jax.Array) -> np.ndarray:
+    """Bring a replicated global array back to this host as numpy.
+
+    Replicated outputs are fully addressable on every host, so this is a
+    local copy — the multi-host analogue of the master printing its merge
+    result (which the reference never actually surfaced, B4).
+    """
+    return np.asarray(jax.device_get(x))
+
+
+def make_multihost_train_step(cfg, mesh: Mesh):
+    """Build ``step(state, x_local) -> (state, v_bar)`` where ``x_local`` is
+    this host's ``(m_local, n, d)`` block stack.
+
+    Thin wrapper over :func:`algo.step.make_train_step` (the compiled program
+    is identical — SPMD doesn't care how many hosts run it); the wrapper only
+    handles the host-local -> global array assembly each step.
+    """
+    from distributed_eigenspaces_tpu.algo.step import make_train_step
+
+    inner = make_train_step(cfg, mesh=mesh)
+
+    def step(state, x_local):
+        x_global = host_local_blocks_to_global(x_local, mesh)
+        return inner(state, x_global)
+
+    return step

@@ -12,6 +12,10 @@ from distributed_eigenspaces_tpu.runtime.native import (
     ChunkReader,
 )
 from distributed_eigenspaces_tpu.runtime.prefetch import prefetch_stream
+from distributed_eigenspaces_tpu.runtime.scheduler import (
+    WorkQueue,
+    run_dynamic_round,
+)
 
 __all__ = [
     "native_available",
@@ -19,4 +23,6 @@ __all__ = [
     "to_f32",
     "ChunkReader",
     "prefetch_stream",
+    "WorkQueue",
+    "run_dynamic_round",
 ]

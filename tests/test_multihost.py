@@ -1,0 +1,77 @@
+"""Multi-host path (parallel/multihost.py) on the single-process degenerate
+case over 8 virtual devices — the same code path a pod runs, minus DCN.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.algo.online import OnlineState
+from distributed_eigenspaces_tpu.algo.step import make_train_step
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.parallel import multihost as mh
+from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
+
+
+def test_initialize_is_safe_single_process():
+    mh.initialize()  # no coordinator -> no-op
+    assert jax.process_count() == 1
+
+
+def test_host_worker_range_partition():
+    # pure function: simulate 4 processes owning 8 workers
+    shards = [
+        mh.host_worker_range(8, process_index=i, process_count=4)
+        for i in range(4)
+    ]
+    covered = []
+    for s in shards:
+        assert s.count == 2
+        covered.extend(range(s.lo, s.hi))
+    assert covered == list(range(8))
+    # row ranges tile the dataset contiguously
+    r0 = shards[0].row_range(16)
+    r1 = shards[1].row_range(16)
+    assert r0 == (0, 32) and r1 == (32, 64)
+
+
+def test_host_worker_range_rejects_ragged():
+    with pytest.raises(ValueError):
+        mh.host_worker_range(7, process_index=0, process_count=4)
+
+
+def test_local_blocks_to_global_roundtrip(devices):
+    mesh = mh.global_mesh(num_workers=8)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 4, 16)).astype(np.float32)
+    g = mh.host_local_blocks_to_global(x, mesh)
+    assert g.shape == (8, 4, 16)
+    assert g.sharding.spec == jax.sharding.PartitionSpec(WORKER_AXIS)
+    np.testing.assert_array_equal(np.asarray(g), x)
+
+
+def test_multihost_step_matches_single_device(devices):
+    m, n, d, k = 8, 32, 48, 3
+    cfg = PCAConfig(dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=4)
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((m, n, d)).astype(np.float32)
+
+    # single-device reference
+    ref_step = make_train_step(cfg, mesh=None, donate=False)
+    ref_state, ref_v = ref_step(OnlineState.initial(d), jnp.asarray(x))
+
+    # multihost path (1 process owning all workers)
+    mesh = mh.global_mesh(num_workers=8)
+    step = mh.make_multihost_train_step(cfg, mesh)
+    state = mh.replicate_to_hosts(OnlineState.initial(d), mesh)
+    state, v = step(state, x)
+
+    out = mh.fetch_replicated(v)
+    np.testing.assert_allclose(out, np.asarray(ref_v), atol=2e-4)
+    np.testing.assert_allclose(
+        mh.fetch_replicated(state.sigma_tilde),
+        np.asarray(ref_state.sigma_tilde),
+        atol=2e-4,
+    )
+    assert int(state.step) == 1

@@ -1,0 +1,142 @@
+"""Dynamic scheduler (runtime/scheduler.py): C13 semantics with the
+reference's B4/B5 failure modes fixed, plus the schedule-invariance claim.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.ops.linalg import (
+    gram,
+    principal_angles_degrees,
+    top_k_eigvecs,
+)
+from distributed_eigenspaces_tpu.runtime.scheduler import (
+    SchedulerError,
+    WorkQueue,
+    run_dynamic_round,
+)
+
+
+def test_all_tasks_complete_fifo_and_lifo():
+    for order in ("fifo", "lifo"):
+        wq = WorkQueue(list(range(10)), order=order, prefetch_depth=3)
+        out = wq.run(lambda p: p * 2, num_lanes=4)
+        assert out == [p * 2 for p in range(10)]
+
+
+def test_fewer_tasks_than_prefetch_depth():
+    # reference crashes with IndexError when --batches < 5 (B5); we clamp
+    wq = WorkQueue([1, 2], prefetch_depth=5)
+    assert wq.run(lambda p: p) == [1, 2]
+
+
+def test_duplicate_completion_is_idempotent():
+    # reference crashes with KeyError on a duplicate reply (B5)
+    wq = WorkQueue(["a", "b"])
+    rec = wq.acquire()
+    assert wq.complete(rec.task_id, "r1") is True
+    assert wq.complete(rec.task_id, "r2") is False  # dropped, no crash
+    assert wq.records[rec.task_id].result == "r1"
+
+
+def test_failed_task_is_retried_at_least_once():
+    attempts = {}
+    lock = threading.Lock()
+
+    def flaky(p):
+        with lock:
+            attempts[p] = attempts.get(p, 0) + 1
+            if attempts[p] == 1 and p % 2 == 0:
+                raise RuntimeError("boom")
+        return p
+
+    wq = WorkQueue(list(range(6)), max_retries=2)
+    out = wq.run(flaky, num_lanes=3)
+    assert out == list(range(6))
+    assert all(attempts[p] == 2 for p in range(0, 6, 2))
+
+
+def test_retry_budget_exhaustion_raises():
+    def always_fails(p):
+        raise RuntimeError("dead lane")
+
+    wq = WorkQueue([0], max_retries=1)
+    with pytest.raises(SchedulerError):
+        wq.run(always_fails, num_lanes=1)
+
+
+def test_lease_timeout_requeues_stalled_task():
+    """A lane that takes a task and never reports = crashed slave; the
+    lease expires and another lane completes it (the liveness logic the
+    reference lacks, SURVEY §5.3)."""
+    wq = WorkQueue([0, 1], lease_timeout=0.1, max_retries=5)
+    stalled = wq.acquire()  # lease and abandon (simulated dead lane)
+    assert stalled is not None
+    out = wq.run(lambda p: p + 10, num_lanes=2)
+    assert out == [10, 11]
+
+
+def test_dynamic_round_matches_static_merge(rng):
+    """Dynamic LIFO multi-lane scheduling must produce exactly the static
+    merge (the average is schedule-invariant — SURVEY §7 hard part (d))."""
+    n, d, k, m = 240, 32, 3, 6
+    x = rng.standard_normal((n, d)).astype(np.float32)
+
+    sigma_bar, v_bar = run_dynamic_round(
+        x, num_batches=m, k=k, num_lanes=3, order="lifo", prefetch_depth=4
+    )
+
+    # static reference merge
+    step = n // m
+    ps = np.zeros((d, d), np.float32)
+    for i in range(m):
+        v = np.asarray(top_k_eigvecs(gram(x[i * step : (i + 1) * step]), k))
+        ps += v @ v.T
+    ps /= m
+    np.testing.assert_allclose(np.asarray(sigma_bar), ps, atol=1e-5)
+    ref_top = top_k_eigvecs(ps, k)
+    ang = principal_angles_degrees(v_bar, ref_top)
+    assert float(np.max(np.asarray(ang))) < 0.1
+
+
+def test_dynamic_round_pad_tail_is_row_weighted(rng):
+    """A ragged 1-row tail under remainder='pad' must contribute ~1/N of the
+    mean, not a full batch share (config.py's 'weighted correctly')."""
+    n, d, k, m = 241, 16, 2, 4  # step=60, tail=1
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    sigma_bar, _ = run_dynamic_round(
+        x, num_batches=m, k=k, num_lanes=2, remainder="pad"
+    )
+    step = n // m
+    ps = np.zeros((d, d), np.float32)
+    ranges = [(i * step, (i + 1) * step) for i in range(m)] + [(m * step, n)]
+    for lo, hi in ranges:
+        v = np.asarray(top_k_eigvecs(gram(x[lo:hi]), k))
+        ps += (hi - lo) * (v @ v.T)
+    ps /= n
+    np.testing.assert_allclose(np.asarray(sigma_bar), ps, atol=1e-5)
+
+
+def test_dynamic_round_with_fault_injection(rng):
+    """Batches whose first attempt dies are retried and still folded
+    exactly once."""
+    n, d, k, m = 120, 16, 2, 4
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    died = set()
+    lock = threading.Lock()
+
+    def chaos(task_id):
+        with lock:
+            if task_id not in died:
+                died.add(task_id)
+                raise RuntimeError(f"worker {task_id} killed")
+
+    sigma_bar, v_bar = run_dynamic_round(
+        x, num_batches=m, k=k, num_lanes=2, fault_hook=chaos
+    )
+    clean, _ = run_dynamic_round(x, num_batches=m, k=k, num_lanes=1)
+    np.testing.assert_allclose(
+        np.asarray(sigma_bar), np.asarray(clean), atol=1e-5
+    )
