@@ -113,6 +113,13 @@ def measure_tpu(blocks_host, spectrum):
 def main():
     import jax
 
+    # `bench.py --eval [name ...]` runs the BASELINE.md config evals
+    # instead (one JSON line per config); no args = the headline metric.
+    if len(sys.argv) > 1 and sys.argv[1] == "--eval":
+        from distributed_eigenspaces_tpu.evals import main as evals_main
+
+        return evals_main(sys.argv[2:])
+
     # persistent compile cache: TPU eigh at d=1024 is minutes to compile via
     # a remote-compile path; cache makes reruns start in seconds
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")

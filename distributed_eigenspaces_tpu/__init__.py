@@ -33,6 +33,8 @@ from distributed_eigenspaces_tpu.algo.online import (
     one_shot_round,
 )
 from distributed_eigenspaces_tpu.api.estimator import OnlineDistributedPCA
+from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+from distributed_eigenspaces_tpu.algo.step import make_train_step
 
 __version__ = "0.1.0"
 
@@ -48,5 +50,7 @@ __all__ = [
     "online_distributed_pca",
     "one_shot_round",
     "OnlineDistributedPCA",
+    "make_scan_fit",
+    "make_train_step",
     "__version__",
 ]

@@ -1,0 +1,68 @@
+"""Whole-fit training: the entire T-step online loop as ONE XLA program.
+
+``make_train_step`` (algo/step.py) already fuses one round end-to-end; this
+module goes one level further and puts the outer ``t = 1..T`` loop (notebook
+cell 16's Python ``for``) inside the compiled program as a ``lax.scan`` —
+zero host involvement between steps, no per-step dispatch latency (which
+dominates when the host drives the device over a network tunnel), and XLA
+can overlap the collective of step t with compute of step t+1.
+
+The data for all T steps must be device-resident ``(T, m, n, d)`` — right
+for benchmark loops and moderate T; for unbounded streams use the
+per-step path with ``runtime.prefetch``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_eigenspaces_tpu.algo.online import OnlineState, update_state
+from distributed_eigenspaces_tpu.algo.step import make_round_core
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
+
+
+def make_scan_fit(cfg: PCAConfig, mesh: Mesh | None = None):
+    """Build ``fit(state, x_steps) -> (state, v_bars)``, jitted.
+
+    ``x_steps`` is ``(T, m, n, d)`` — T online steps of m-worker blocks;
+    ``v_bars`` is ``(T, d, k)``, the merged eigenspace after every step
+    (the scan's stacked per-step output). Semantically identical to calling
+    the per-step trainer T times (tested — both build on
+    :func:`~..algo.step.make_round_core`), just compiled as one program.
+    """
+    round_core = make_round_core(cfg)
+
+    def make_fit(axis_name):
+        def fit(state, x_steps):
+            def body(st, x):
+                _, v_bar = round_core(x, axis_name=axis_name)
+                st = update_state(
+                    st, v_bar, discount=cfg.discount,
+                    num_steps=cfg.num_steps,
+                )
+                return st, v_bar
+
+            return jax.lax.scan(body, state, x_steps)
+
+        return fit
+
+    if mesh is None:
+        return jax.jit(make_fit(axis_name=None))
+
+    # one shard_map around the whole scan: the worker axis stays
+    # device-resident across all T steps and only the k-width merge
+    # crosses ICI each step
+    rep = NamedSharding(mesh, P())
+    x_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
+    inner = jax.shard_map(
+        make_fit(axis_name=WORKER_AXIS),
+        mesh=mesh,
+        in_specs=(P(), P(None, WORKER_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(
+        inner, in_shardings=(rep, x_sharding), out_shardings=(rep, rep)
+    )

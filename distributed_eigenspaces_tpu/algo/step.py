@@ -29,6 +29,32 @@ from distributed_eigenspaces_tpu.parallel.worker_pool import (
 from distributed_eigenspaces_tpu.ops.linalg import merged_top_k
 
 
+def make_round_core(cfg: PCAConfig):
+    """Shared per-round compute: ``round_core(x_blocks, axis_name=None) ->
+    (sigma_bar, v_bar)``.
+
+    The single definition of "one algorithm round" (local eigenspaces ->
+    masked projector mean -> optional cross-device psum -> merged top-k)
+    used by both the per-step trainer here and the whole-fit scan trainer
+    (algo/scan.py), so solver/merge changes can't diverge between them.
+    ``axis_name`` names the mesh axis to allreduce over (None = single
+    device).
+    """
+    k, solver, iters = cfg.k, cfg.solver, cfg.subspace_iters
+
+    def round_core(x_blocks, axis_name=None):
+        vs = _local_eigenspaces(x_blocks, k, solver, iters)
+        mask = jnp.ones((x_blocks.shape[0],), jnp.float32)
+        psum, cnt = _masked_projector_mean(vs, mask)
+        if axis_name is not None:
+            psum = jax.lax.psum(psum, axis_name=axis_name)
+            cnt = jax.lax.psum(cnt, axis_name=axis_name)
+        sigma_bar = psum / cnt
+        return sigma_bar, merged_top_k(sigma_bar, k, solver, iters)
+
+    return round_core
+
+
 def make_train_step(
     cfg: PCAConfig, mesh: Mesh | None = None, *, donate: bool = True
 ):
@@ -44,44 +70,31 @@ def make_train_step(
     if the same state object will be passed again (e.g. repeated timing
     calls on fixed example args).
     """
-    k, solver, iters = cfg.k, cfg.solver, cfg.subspace_iters
+    round_core = make_round_core(cfg)
     donate_args = (0,) if donate else ()
 
-    def core(x_blocks):
-        vs = _local_eigenspaces(x_blocks, k, solver, iters)
-        mask = jnp.ones((x_blocks.shape[0],), jnp.float32)
-        return _masked_projector_mean(vs, mask)
+    def fold(state, v_bar):
+        return (
+            update_state(
+                state, v_bar, discount=cfg.discount, num_steps=cfg.num_steps
+            ),
+            v_bar,
+        )
 
     if mesh is None:
 
         @partial(jax.jit, donate_argnums=donate_args)
         def step(state: OnlineState, x_blocks):
-            psum, cnt = core(x_blocks)
-            sigma_bar = psum / cnt
-            v_bar = merged_top_k(sigma_bar, k, solver, iters)
-            return (
-                update_state(
-                    state, v_bar, discount=cfg.discount,
-                    num_steps=cfg.num_steps,
-                ),
-                v_bar,
-            )
+            _, v_bar = round_core(x_blocks)
+            return fold(state, v_bar)
 
         return step
 
     x_sharding = NamedSharding(mesh, P(WORKER_AXIS))
     rep = NamedSharding(mesh, P())
 
-    def sharded_core(xs):
-        psum, cnt = core(xs)
-        psum = jax.lax.psum(psum, axis_name=WORKER_AXIS)
-        cnt = jax.lax.psum(cnt, axis_name=WORKER_AXIS)
-        sigma_bar = psum / cnt
-        v_bar = merged_top_k(sigma_bar, k, solver, iters)
-        return sigma_bar, v_bar
-
     inner = jax.shard_map(
-        sharded_core,
+        partial(round_core, axis_name=WORKER_AXIS),
         mesh=mesh,
         in_specs=(P(WORKER_AXIS),),
         out_specs=(P(), P()),
@@ -96,11 +109,6 @@ def make_train_step(
     )
     def step(state: OnlineState, x_blocks):
         _, v_bar = inner(x_blocks)
-        return (
-            update_state(
-                state, v_bar, discount=cfg.discount, num_steps=cfg.num_steps
-            ),
-            v_bar,
-        )
+        return fold(state, v_bar)
 
     return step

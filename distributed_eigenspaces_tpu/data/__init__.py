@@ -22,8 +22,17 @@ from distributed_eigenspaces_tpu.data.stream import (
     make_batches,
     synthetic_stream,
 )
+from distributed_eigenspaces_tpu.data.mnist import load_mnist, read_idx
+from distributed_eigenspaces_tpu.data.bin_stream import (
+    bin_block_stream,
+    write_rows,
+)
 
 __all__ = [
+    "load_mnist",
+    "read_idx",
+    "bin_block_stream",
+    "write_rows",
     "unpickle",
     "load_cifar10",
     "load_CIFAR_10_data",

@@ -1,0 +1,111 @@
+"""Out-of-core block streaming from raw binary row files.
+
+BASELINE.md config 5 (CLIP ViT-L embeddings, 768-d, ~400M rows ≈ 1.2 TB
+fp32) cannot follow the reference's data model — every process loads the
+FULL dataset into memory (``distributed.py:169``). This module streams
+``(m, n, d)`` worker blocks straight from disk through the native
+double-buffered :class:`..runtime.native.ChunkReader` (C++ read-ahead
+thread overlapping disk latency with host->device transfer), so host
+memory holds only ~2 in-flight steps regardless of dataset size.
+
+File format: flat rows, ``dtype`` (float32 / bfloat16 / uint8), row length
+``dim`` — i.e. exactly ``array.tobytes()`` of an ``(N, dim)`` matrix.
+``write_rows`` produces it; uint8 rows are widened to float32 by the native
+conversion kernel, bfloat16 rows are bit-extended (uint16 -> high half of a
+float32 word — a reinterpretation, not a value cast) on the way in.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_eigenspaces_tpu.runtime.native import ChunkReader, to_f32
+
+
+def write_rows(path: str, data: np.ndarray) -> None:
+    """Write ``(N, d)`` rows as the flat binary format (fixtures / prep)."""
+    np.ascontiguousarray(data).tofile(path)
+
+
+def num_rows(path: str, dim: int, dtype=np.float32) -> int:
+    itemsize = np.dtype(dtype).itemsize
+    size = os.path.getsize(path)
+    if size % (dim * itemsize):
+        raise ValueError(
+            f"{path}: {size} bytes is not a whole number of "
+            f"{dim}x{np.dtype(dtype).name} rows"
+        )
+    return size // (dim * itemsize)
+
+
+def bin_block_stream(
+    path: str,
+    *,
+    dim: int,
+    num_workers: int,
+    rows_per_worker: int,
+    num_steps: int | None = None,
+    dtype=np.float32,
+    out_dtype=jnp.float32,
+    remainder: str = "drop",
+) -> Iterator[jnp.ndarray]:
+    """Yield ``(num_workers, rows_per_worker, dim)`` blocks from a binary
+    row file without ever materializing the dataset.
+
+    Same contract as :func:`.stream.block_stream` (advancing cursor,
+    explicit remainder policy) but O(step) memory: one step's bytes are
+    read per chunk, with the next chunk prefetched by the native reader's
+    background thread.
+    """
+    if remainder not in ("drop", "pad", "error"):
+        raise ValueError(f"unknown remainder policy: {remainder!r}")
+    in_dt = np.dtype(dtype)
+    is_bf16 = in_dt.name == "bfloat16"
+    step_rows = num_workers * rows_per_worker
+    chunk_bytes = step_rows * dim * in_dt.itemsize
+    total = num_rows(path, dim, dtype)
+    if step_rows > total:
+        raise ValueError(f"one step needs {step_rows} rows, file has {total}")
+
+    def convert(buf: bytes) -> np.ndarray:
+        if is_bf16:
+            # bit-reinterpret: each bf16 word is the high half of an f32
+            bits = np.frombuffer(buf, dtype=np.uint16)
+            return (bits.astype(np.uint32) << 16).view(np.float32)
+        arr = np.frombuffer(buf, dtype=in_dt)
+        if in_dt == np.uint8:
+            arr = to_f32(arr)  # native widen kernel
+        return np.asarray(arr, np.float32)
+
+    steps = 0
+    with ChunkReader(path, chunk_bytes) as reader:
+        for chunk in reader:
+            if num_steps is not None and steps >= num_steps:
+                return
+            if len(chunk) < chunk_bytes:  # ragged tail
+                tail_rows = len(chunk) // (dim * in_dt.itemsize)
+                if tail_rows == 0 or remainder == "drop":
+                    return
+                if remainder == "error":
+                    raise ValueError(
+                        f"{tail_rows} remainder rows (step={step_rows}); "
+                        "set remainder='drop'/'pad' or adjust sizes"
+                    )
+                block = np.zeros((step_rows, dim), np.float32)
+                block[:tail_rows] = convert(
+                    chunk[: tail_rows * dim * in_dt.itemsize]
+                ).reshape(tail_rows, dim)
+                yield jnp.asarray(
+                    block.reshape(num_workers, rows_per_worker, dim),
+                    dtype=out_dtype,
+                )
+                return
+            steps += 1
+            yield jnp.asarray(
+                convert(chunk).reshape(num_workers, rows_per_worker, dim),
+                dtype=out_dtype,
+            )

@@ -83,13 +83,19 @@ class WorkQueue:
         self.lease_timeout = lease_timeout
         self._lock = threading.Condition()
         self._pending: list[int] = list(range(len(self.records)))
-        self._leases: dict[int, float] = {}  # task_id -> lease deadline
+        # task_id -> (lease deadline, attempt number that holds the lease)
+        self._leases: dict[int, tuple[float, int]] = {}
         self._failed: Exception | None = None
 
     # -- lane-facing API -----------------------------------------------------
 
     def acquire(self) -> TaskRecord | None:
-        """Lease the next task; None when everything is complete."""
+        """Lease the next task; None when everything is complete.
+
+        Returns a *snapshot* of the record (``attempts`` identifies this
+        lane's lease — pass it back to :meth:`fail` so a stale attempt
+        can't disturb a newer lease on the same task).
+        """
         with self._lock:
             while True:
                 if self._failed is not None:
@@ -110,9 +116,10 @@ class WorkQueue:
                     rec.attempts += 1
                     if self.lease_timeout is not None:
                         self._leases[idx] = (
-                            time.monotonic() + self.lease_timeout
+                            time.monotonic() + self.lease_timeout,
+                            rec.attempts,
                         )
-                    return rec
+                    return dataclasses.replace(rec)
                 # nothing pending but tasks are leased out — wait for a
                 # completion, a lease expiry, or failure
                 timeout = self._next_wakeup_locked()
@@ -132,11 +139,22 @@ class WorkQueue:
             self._lock.notify_all()
             return True
 
-    def fail(self, task_id: int, exc: Exception) -> None:
+    def fail(
+        self, task_id: int, exc: Exception, attempt: int | None = None
+    ) -> None:
         """Report a lane failure; the task is re-queued (at-least-once)
-        unless its retry budget is exhausted."""
+        unless its retry budget is exhausted.
+
+        ``attempt`` (from the :meth:`acquire` snapshot's ``attempts``)
+        scopes the failure to this lane's lease: if the lease already
+        expired and the task was re-leased by another lane, a stale
+        failure neither pops the live lease nor double-queues the task.
+        """
         with self._lock:
             rec = self.records[task_id]
+            lease = self._leases.get(task_id)
+            if attempt is not None and lease is not None and lease[1] != attempt:
+                return  # stale: a newer attempt owns this task now
             self._leases.pop(task_id, None)
             if rec.done:
                 return
@@ -145,7 +163,7 @@ class WorkQueue:
                     f"task {task_id} failed after {rec.attempts} attempts"
                 )
                 self._failed.__cause__ = exc
-            else:
+            elif rec.task_id not in self._pending:
                 self._pending.append(rec.task_id)
             self._lock.notify_all()
 
@@ -158,7 +176,9 @@ class WorkQueue:
         if self.lease_timeout is None:
             return
         now = time.monotonic()
-        expired = [tid for tid, dl in self._leases.items() if dl <= now]
+        expired = [
+            tid for tid, (dl, _) in self._leases.items() if dl <= now
+        ]
         for tid in expired:
             del self._leases[tid]
             rec = self.records[tid]
@@ -168,15 +188,14 @@ class WorkQueue:
                         f"task {tid} leased {rec.attempts} times with no "
                         f"result (lease_timeout={self.lease_timeout}s)"
                     )
-                else:
+                elif tid not in self._pending:
                     self._pending.append(tid)  # requeue: liveness recovery
 
     def _next_wakeup_locked(self) -> float | None:
         if self.lease_timeout is None or not self._leases:
             return None
-        return max(
-            0.0, min(self._leases.values()) - time.monotonic()
-        ) + 1e-3
+        soonest = min(dl for dl, _ in self._leases.values())
+        return max(0.0, soonest - time.monotonic()) + 1e-3
 
     # -- driver --------------------------------------------------------------
 
@@ -209,10 +228,18 @@ class WorkQueue:
                 try:
                     out = worker_fn(rec.payload)
                 except Exception as e:
-                    self.fail(rec.task_id, e)
+                    self.fail(rec.task_id, e, attempt=rec.attempts)
                     continue
                 if self.complete(rec.task_id, out) and on_result:
-                    on_result(rec.task_id, out)
+                    try:
+                        on_result(rec.task_id, out)
+                    except Exception as e:
+                        # a broken result-fold poisons the whole run: the
+                        # task IS complete (idempotent), so retrying can't
+                        # help — surface the error instead of letting the
+                        # lane die silently with partial results
+                        errors.append(e)
+                        return
 
         threads = [
             threading.Thread(target=lane, daemon=True) for _ in range(lanes)
@@ -238,6 +265,8 @@ def run_dynamic_round(
     solver: str = "eigh",
     subspace_iters: int = 16,
     fault_hook: Callable[[int], None] | None = None,
+    max_retries: int = 3,
+    lease_timeout: float | None = None,
 ):
     """The reference master's one-shot round over the dynamic scheduler.
 
@@ -303,7 +332,8 @@ def run_dynamic_round(
         ranges,
         prefetch_depth=prefetch_depth,
         order=order,
-        lease_timeout=None,
+        max_retries=max_retries,
+        lease_timeout=lease_timeout,
     )
     wq.run(compute, num_lanes=num_lanes, on_result=fold)
 

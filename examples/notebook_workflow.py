@@ -1,0 +1,117 @@
+"""The reference notebook's end-to-end workflow (L7 in SURVEY.md §1), as a
+script — `Online Distributed PCA.ipynb` cells 3-22 done with the framework:
+
+  load CIFAR-10 (grayscale, 1024-d)       -> notebook cell 3/6
+  online distributed PCA, m=10, T=10, k=2 -> cell 16 (stream ADVANCES; B6 fix)
+  W = top-2 eigenspace; project data      -> cells 17-20
+  validate against exact PCA              -> cells 21-22, but quantified:
+      principal angles + explained variance instead of eyeballing scatters
+      (scatter PNGs are still written when matplotlib is available)
+
+Run:  python examples/notebook_workflow.py [--data cifar-10-batches-py]
+With no CIFAR pickles on disk (this repo's copy is stripped upstream), a
+planted-spectrum synthetic stand-in of the same shape is used.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+
+def load_or_synthesize(data_dir: str):
+    try:
+        from distributed_eigenspaces_tpu.data.cifar import load_cifar10
+
+        data, labels = load_cifar10(data_dir, grayscale=True)
+        return np.asarray(data, np.float32), np.asarray(labels), "cifar10"
+    except (FileNotFoundError, ValueError, OSError):
+        import jax
+
+        from distributed_eigenspaces_tpu.data.synthetic import (
+            planted_spectrum,
+        )
+
+        spec = planted_spectrum(1024, k_planted=8, gap=20.0, noise=0.05,
+                                seed=0)
+        x = np.asarray(spec.sample(jax.random.PRNGKey(1), 60000))
+        labels = (x @ np.asarray(spec.top_k(1))).ravel() > 0  # 2 clusters
+        return x, labels.astype(np.int64), "synthetic"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default="cifar-10-batches-py")
+    ap.add_argument("--plot", default=None,
+                    help="write A/B scatter PNG here (needs matplotlib)")
+    args = ap.parse_args()
+
+    from distributed_eigenspaces_tpu import (
+        OnlineDistributedPCA,
+        PCAConfig,
+        principal_angles_degrees,
+    )
+
+    data, labels, source = load_or_synthesize(args.data)
+    data = data - data.mean(axis=0)  # center, so exact PCA is comparable
+    d = data.shape[1]
+
+    # notebook constants: m=10 workers, T=10 steps, k=2 (cells 9, 16)
+    cfg = PCAConfig(dim=d, k=2, num_workers=10, rows_per_worker=600,
+                    num_steps=10, solver="subspace", subspace_iters=24)
+    est = OnlineDistributedPCA(cfg).fit(data)
+    z = np.asarray(est.transform(data))  # cells 19-20: data @ W
+
+    # cells 21-22, quantified: exact PCA comparison
+    g = (data.T @ data) / len(data)
+    _, v = np.linalg.eigh(g.astype(np.float64))
+    w_exact = v[:, -2:][:, ::-1].astype(np.float32)
+    ang = float(np.max(np.asarray(
+        principal_angles_degrees(est.components_, w_exact)
+    )))
+    report = {
+        "source": source,
+        "shape": list(data.shape),
+        "k": 2,
+        "principal_angle_vs_exact_deg": round(ang, 4),
+        **est.score(data),
+    }
+    print(json.dumps(report))
+
+    if args.plot:
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+
+            z_exact = data @ w_exact
+            fig, axes = plt.subplots(1, 2, figsize=(11, 5), sharex=True,
+                                     sharey=True)
+            for ax, pts, title in (
+                (axes[0], z, "online distributed PCA"),
+                (axes[1], z_exact, "exact PCA"),
+            ):
+                sub = np.random.default_rng(0).choice(
+                    len(pts), size=min(5000, len(pts)), replace=False
+                )
+                ax.scatter(pts[sub, 0], pts[sub, 1], c=labels[sub], s=4,
+                           cmap="tab10", alpha=0.6)
+                ax.set_title(title)
+            fig.savefig(args.plot, dpi=120, bbox_inches="tight")
+            print(f"wrote {args.plot}")
+        except ImportError:
+            print("matplotlib unavailable; skipped plot")
+
+    # notebook-scale gate: with m=10 workers of only 600 rows each per step
+    # (n < d — rank-deficient local covariances, like the reference's
+    # batch=8!), a couple degrees vs exact PCA is the method's accuracy at
+    # this config; the tighter 1-degree gate applies to the well-fed
+    # BASELINE configs (see evals.py / bench.py)
+    return 0 if ang <= 2.5 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

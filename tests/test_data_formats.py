@@ -1,0 +1,138 @@
+"""MNIST IDX loader + out-of-core binary block streaming."""
+
+import gzip
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.data.bin_stream import (
+    bin_block_stream,
+    num_rows,
+    write_rows,
+)
+from distributed_eigenspaces_tpu.data.mnist import (
+    load_mnist,
+    read_idx,
+    write_idx,
+)
+
+
+@pytest.fixture()
+def mnist_dir(tmp_path, rng):
+    imgs = rng.integers(0, 256, (50, 28, 28), dtype=np.uint8)
+    lbls = rng.integers(0, 10, (50,), dtype=np.uint8)
+    write_idx(str(tmp_path / "train-images-idx3-ubyte"), imgs)
+    write_idx(str(tmp_path / "train-labels-idx1-ubyte.gz"), lbls)
+    return tmp_path, imgs, lbls
+
+
+def test_idx_roundtrip(tmp_path, rng):
+    arr = rng.integers(0, 256, (7, 5), dtype=np.uint8)
+    for name in ("a.idx", "a.idx.gz"):
+        write_idx(str(tmp_path / name), arr)
+        np.testing.assert_array_equal(read_idx(str(tmp_path / name)), arr)
+
+
+def test_load_mnist(mnist_dir):
+    d, imgs, lbls = mnist_dir
+    data, labels = load_mnist(str(d))
+    assert data.shape == (50, 784) and data.dtype == np.float32
+    np.testing.assert_array_equal(
+        data, imgs.reshape(50, 784).astype(np.float32)
+    )
+    np.testing.assert_array_equal(labels, lbls)
+
+
+def test_load_mnist_missing(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_mnist(str(tmp_path))
+
+
+def test_idx_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.idx"
+    p.write_bytes(b"\xff\xff\xff\xff" + b"0" * 16)
+    with pytest.raises(ValueError):
+        read_idx(str(p))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.uint8])
+def test_bin_stream_roundtrip(tmp_path, rng, dtype):
+    m, n, d, steps = 4, 8, 16, 3
+    if dtype == np.uint8:
+        data = rng.integers(0, 256, (m * n * steps, d), dtype=np.uint8)
+    else:
+        data = rng.standard_normal((m * n * steps, d)).astype(np.float32)
+    path = str(tmp_path / "rows.bin")
+    write_rows(path, data)
+    assert num_rows(path, d, dtype) == m * n * steps
+
+    blocks = list(
+        bin_block_stream(
+            path, dim=d, num_workers=m, rows_per_worker=n, dtype=dtype
+        )
+    )
+    assert len(blocks) == steps
+    flat = np.concatenate([np.asarray(b).reshape(m * n, d) for b in blocks])
+    np.testing.assert_array_equal(flat, data.astype(np.float32))
+
+
+def test_bin_stream_bfloat16_bit_reinterpretation(tmp_path, rng):
+    """bf16 rows must be bit-extended, not value-cast: bf16 1.0 (0x3F80)
+    streams back as 1.0, not 16256.0."""
+    m, n, d = 2, 4, 8
+    vals = rng.standard_normal((m * n * 2, d)).astype(np.float32)
+    bf16 = jnp.asarray(vals, jnp.bfloat16)
+    path = str(tmp_path / "rows16.bin")
+    with open(path, "wb") as f:
+        f.write(np.asarray(bf16).tobytes())
+    assert num_rows(path, d, jnp.bfloat16) == m * n * 2
+
+    blocks = list(
+        bin_block_stream(
+            path, dim=d, num_workers=m, rows_per_worker=n,
+            dtype=jnp.bfloat16,
+        )
+    )
+    flat = np.concatenate([np.asarray(b).reshape(m * n, d) for b in blocks])
+    np.testing.assert_array_equal(
+        flat, np.asarray(bf16, np.float32)  # exact: bf16 -> f32 is lossless
+    )
+
+
+def test_bin_stream_remainder_policies(tmp_path, rng):
+    m, n, d = 2, 4, 8  # step = 8 rows
+    data = rng.standard_normal((8 + 3, d)).astype(np.float32)  # 3-row tail
+    path = str(tmp_path / "rows.bin")
+    write_rows(path, data)
+
+    drop = list(bin_block_stream(path, dim=d, num_workers=m,
+                                 rows_per_worker=n))
+    assert len(drop) == 1
+
+    pad = list(bin_block_stream(path, dim=d, num_workers=m,
+                                rows_per_worker=n, remainder="pad"))
+    assert len(pad) == 2
+    tail = np.asarray(pad[1]).reshape(8, d)
+    np.testing.assert_array_equal(tail[:3], data[8:])
+    assert not tail[3:].any()
+
+    with pytest.raises(ValueError):
+        list(bin_block_stream(path, dim=d, num_workers=m,
+                              rows_per_worker=n, remainder="error"))
+
+
+def test_bin_stream_matches_block_stream(tmp_path, rng):
+    """Out-of-core streaming is bit-identical to the in-memory batcher."""
+    from distributed_eigenspaces_tpu.data.stream import block_stream
+
+    data = rng.standard_normal((96, 12)).astype(np.float32)
+    path = str(tmp_path / "rows.bin")
+    write_rows(path, data)
+    a = [np.asarray(b) for b in bin_block_stream(
+        path, dim=12, num_workers=4, rows_per_worker=6)]
+    b = [np.asarray(b) for b in block_stream(
+        data, num_workers=4, rows_per_worker=6)]
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)

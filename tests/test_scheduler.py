@@ -78,6 +78,33 @@ def test_lease_timeout_requeues_stalled_task():
     assert out == [10, 11]
 
 
+def test_stale_failure_does_not_disturb_new_lease():
+    """Lane A's lease expires and the task is re-leased by lane B; A's
+    late fail() must neither pop B's live lease nor re-queue the task."""
+    import time
+
+    wq = WorkQueue([0], lease_timeout=0.05, max_retries=10)
+    rec_a = wq.acquire()
+    time.sleep(0.08)  # A's lease expires
+    rec_b = wq.acquire()  # expiry requeues; B re-leases
+    assert rec_b is not None and rec_b.attempts == rec_a.attempts + 1
+    wq.fail(rec_a.task_id, RuntimeError("late"), attempt=rec_a.attempts)
+    assert wq._pending == []  # not double-queued
+    assert rec_b.task_id in wq._leases  # B's lease intact
+    assert wq.complete(rec_b.task_id, "ok")
+    assert wq.run(lambda p: p) == ["ok"]
+
+
+def test_on_result_exception_propagates():
+    """A broken result-fold must fail the run, not silently drop lanes."""
+    def bad_fold(task_id, result):
+        raise ValueError("fold broke")
+
+    wq = WorkQueue([1, 2, 3])
+    with pytest.raises(ValueError, match="fold broke"):
+        wq.run(lambda p: p, num_lanes=1, on_result=bad_fold)
+
+
 def test_dynamic_round_matches_static_merge(rng):
     """Dynamic LIFO multi-lane scheduling must produce exactly the static
     merge (the average is schedule-invariant — SURVEY §7 hard part (d))."""

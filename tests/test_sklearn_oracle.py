@@ -1,0 +1,66 @@
+"""C17 hardened: the reference validates by eyeballing a scatter of its
+projection against sklearn PCA (notebook cells 21-22). Here the same A/B is
+a principal-angle assertion, plus a bf16 end-to-end run (the TPU dtype).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_eigenspaces_tpu import (
+    OnlineDistributedPCA,
+    PCAConfig,
+    principal_angles_degrees,
+)
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+
+
+def _data(d=96, k=4, n=8192, seed=0):
+    spec = planted_spectrum(d, k_planted=k, gap=20.0, noise=0.01, seed=seed)
+    x = np.asarray(spec.sample(jax.random.PRNGKey(seed + 1), n))
+    return x - x.mean(axis=0), spec  # centered: sklearn PCA centers too
+
+
+def test_matches_sklearn_pca_subspace():
+    from sklearn.decomposition import PCA
+
+    x, _ = _data()
+    k = 4
+    cfg = PCAConfig(dim=x.shape[1], k=k, num_workers=8, rows_per_worker=128,
+                    num_steps=8)
+    est = OnlineDistributedPCA(cfg).fit(x)
+
+    sk = PCA(n_components=k).fit(x)
+    w_sk = sk.components_.T  # (d, k)
+    ang = float(np.max(np.asarray(
+        principal_angles_degrees(est.components_, jnp.asarray(w_sk))
+    )))
+    assert ang <= 1.0, f"vs sklearn PCA: {ang} deg"
+
+    # the notebook's visual check, quantified: projections span the same
+    # plane, so the per-sample projection norms agree closely
+    z_ours = np.asarray(est.transform(x))
+    z_sk = sk.transform(x)
+    np.testing.assert_allclose(
+        np.linalg.norm(z_ours, axis=1),
+        np.linalg.norm(z_sk, axis=1),
+        rtol=0.05, atol=0.1,
+    )
+
+
+def test_bfloat16_end_to_end():
+    x, spec = _data(seed=3)
+    k = 4
+    cfg = PCAConfig(dim=x.shape[1], k=k, num_workers=8, rows_per_worker=128,
+                    num_steps=8, dtype=jnp.bfloat16, solver="subspace",
+                    subspace_iters=24)
+    est = OnlineDistributedPCA(cfg).fit(x)
+    assert est.components_.shape == (x.shape[1], k)
+    # bf16 inputs with fp32 accumulation: a few degrees is expected; the
+    # gate here is "right subspace", not fp32-grade accuracy
+    ang = float(np.max(np.asarray(
+        principal_angles_degrees(est.components_, spec.top_k(k))
+    )))
+    assert ang <= 5.0, f"bf16 run off by {ang} deg"
+    z = est.transform(x)
+    assert z.dtype == jnp.bfloat16
