@@ -38,6 +38,11 @@ TPU_STEPS = 30
 CPU_STEPS = 2
 DISTINCT_BLOCKS = 4  # pre-staged device blocks cycled during timing
 
+import os as _os
+
+if _os.environ.get("DET_BENCH_SMALL") == "1":  # CI smoke mode, not a result
+    M, N, D, K, TPU_STEPS, CPU_STEPS = 4, 256, 128, 4, 6, 1
+
 
 def numpy_reference_step(blocks, k):
     """One outer step of the reference algorithm in NumPy (notebook cell 16
@@ -110,15 +115,61 @@ def measure_tpu(blocks_host, spectrum):
     return (TPU_STEPS * M * N) / dt, ang
 
 
+def measure_tpu_scan(blocks_host, spectrum):
+    """Same workload as measure_tpu but with the whole T-step loop compiled
+    as one lax.scan program (algo/scan.py) — zero per-step dispatch. The
+    T-step input is gathered on-device from the staged distinct blocks, so
+    no extra host->HBM traffic is timed."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.algo.online import OnlineState
+    from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+        top_k_eigvecs,
+    )
+
+    cfg = PCAConfig(
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=TPU_STEPS,
+        solver="subspace", subspace_iters=12,
+    )
+    # gather=True: the scan body indexes the B staged blocks per step, so
+    # HBM holds O(B) blocks, not the full (T, m, n, d) cycle
+    fit = make_scan_fit(cfg, gather=True)
+    stacked = jnp.stack([jnp.asarray(b) for b in blocks_host])
+    idx = jnp.arange(TPU_STEPS, dtype=jnp.int32) % len(blocks_host)
+    jax.block_until_ready(stacked)
+
+    state, _ = fit(OnlineState.initial(D), stacked, idx)  # compile + warm-up
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    state, _ = fit(OnlineState.initial(D), stacked, idx)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    w_est = top_k_eigvecs(state.sigma_tilde, K)
+    ang = float(
+        jnp.max(principal_angles_degrees(w_est, spectrum.top_k(K)))
+    )
+    return (TPU_STEPS * M * N) / dt, ang
+
+
 def main():
     import jax
 
     # `bench.py --eval [name ...]` runs the BASELINE.md config evals
     # instead (one JSON line per config); no args = the headline metric.
-    if len(sys.argv) > 1 and sys.argv[1] == "--eval":
+    # Flags are position-independent; everything after --eval goes to the
+    # evals CLI.
+    args = sys.argv[1:]
+    if "--eval" in args:
         from distributed_eigenspaces_tpu.evals import main as evals_main
 
-        return evals_main(sys.argv[2:])
+        return evals_main(args[args.index("--eval") + 1 :])
+    use_scan = "--scan" in args
 
     # persistent compile cache: TPU eigh at d=1024 is minutes to compile via
     # a remote-compile path; cache makes reruns start in seconds
@@ -136,7 +187,10 @@ def main():
             np.asarray(spectrum.sample(sub, M * N)).reshape(M, N, D)
         )
 
-    tpu_sps, angle_deg = measure_tpu(blocks_host, spectrum)
+    if use_scan:
+        tpu_sps, angle_deg = measure_tpu_scan(blocks_host, spectrum)
+    else:
+        tpu_sps, angle_deg = measure_tpu(blocks_host, spectrum)
     cpu_sps = measure_cpu_baseline(blocks_host)
 
     result = {

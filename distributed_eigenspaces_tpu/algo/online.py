@@ -126,6 +126,21 @@ def online_distributed_pca(
     if state is None:
         state = OnlineState.initial(cfg.dim, cfg.state_dtype)
 
+    if cfg.prefetch_depth > 0:
+        # overlap host block prep + host->HBM transfer with device compute
+        # (the reference's 5-in-flight AMQP window, done as a real pipeline;
+        # pool.shard is idempotent so the loop's shard call stays a no-op).
+        # NOTE: the producer reads ahead, so the caller's underlying
+        # iterable may be advanced past the last consumed step — pass
+        # prefetch_depth=0 when sharing an iterator across fit calls.
+        from distributed_eigenspaces_tpu.runtime.prefetch import (
+            prefetch_stream,
+        )
+
+        stream = prefetch_stream(
+            stream, depth=cfg.prefetch_depth, place=pool.shard
+        )
+
     update = jax.jit(
         lambda s, v: update_state(
             s, v, discount=cfg.discount, num_steps=cfg.num_steps
@@ -134,16 +149,23 @@ def online_distributed_pca(
 
     cap = cfg.num_steps if max_steps == "auto" else max_steps
     steps_done = int(state.step)
-    for x_blocks in stream:
-        if cap is not None and steps_done >= cap and cfg.discount != "1/t":
-            break
-        mask = next(worker_masks) if worker_masks is not None else None
-        x_blocks = pool.shard(x_blocks)
-        _, v_bar = pool.round(x_blocks, cfg.k, worker_mask=mask)
-        state = update(state, v_bar)
-        steps_done += 1
-        if on_step is not None:
-            on_step(steps_done, state, v_bar)
+    try:
+        for x_blocks in stream:
+            if cap is not None and steps_done >= cap and cfg.discount != "1/t":
+                break
+            mask = next(worker_masks) if worker_masks is not None else None
+            x_blocks = pool.shard(x_blocks)
+            _, v_bar = pool.round(x_blocks, cfg.k, worker_mask=mask)
+            state = update(state, v_bar)
+            steps_done += 1
+            if on_step is not None:
+                on_step(steps_done, state, v_bar)
+    finally:
+        # deterministic cleanup of the prefetch producer thread (and its
+        # pinned device blocks) when the loop exits early
+        close = getattr(stream, "close", None)
+        if close is not None:
+            close()
 
     w = top_k_eigvecs(state.sigma_tilde, cfg.k)
     return w, state

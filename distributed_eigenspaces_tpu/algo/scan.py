@@ -23,28 +23,47 @@ from distributed_eigenspaces_tpu.config import PCAConfig
 from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
 
 
-def make_scan_fit(cfg: PCAConfig, mesh: Mesh | None = None):
-    """Build ``fit(state, x_steps) -> (state, v_bars)``, jitted.
+def make_scan_fit(
+    cfg: PCAConfig, mesh: Mesh | None = None, *, gather: bool = False
+):
+    """Build the whole-fit trainer, jitted.
 
+    ``gather=False``: ``fit(state, x_steps) -> (state, v_bars)`` where
     ``x_steps`` is ``(T, m, n, d)`` — T online steps of m-worker blocks;
-    ``v_bars`` is ``(T, d, k)``, the merged eigenspace after every step
-    (the scan's stacked per-step output). Semantically identical to calling
-    the per-step trainer T times (tested — both build on
-    :func:`~..algo.step.make_round_core`), just compiled as one program.
+    ``v_bars`` is ``(T, d, k)``, the merged eigenspace after every step.
+
+    ``gather=True``: ``fit(state, blocks, idx) -> (state, v_bars)`` where
+    ``blocks`` is ``(B, m, n, d)`` distinct staged blocks and ``idx`` a
+    ``(T,)`` int32 schedule — each scan step gathers ``blocks[idx[t]]``
+    inside the body, so device memory stays O(B) instead of O(T) (the
+    cycled-blocks benchmark pattern without materializing the cycle).
+
+    Semantically identical to calling the per-step trainer T times (tested —
+    both build on :func:`~..algo.step.make_round_core`), just compiled as
+    one program.
     """
     round_core = make_round_core(cfg)
 
     def make_fit(axis_name):
-        def fit(state, x_steps):
-            def body(st, x):
-                _, v_bar = round_core(x, axis_name=axis_name)
-                st = update_state(
-                    st, v_bar, discount=cfg.discount,
-                    num_steps=cfg.num_steps,
-                )
-                return st, v_bar
+        def step_body(st, x):
+            _, v_bar = round_core(x, axis_name=axis_name)
+            st = update_state(
+                st, v_bar, discount=cfg.discount, num_steps=cfg.num_steps
+            )
+            return st, v_bar
 
-            return jax.lax.scan(body, state, x_steps)
+        if gather:
+
+            def fit(state, blocks, idx):
+                def body(st, i):
+                    return step_body(st, blocks[i])
+
+                return jax.lax.scan(body, state, idx)
+
+        else:
+
+            def fit(state, x_steps):
+                return jax.lax.scan(step_body, state, x_steps)
 
         return fit
 
@@ -56,13 +75,15 @@ def make_scan_fit(cfg: PCAConfig, mesh: Mesh | None = None):
     # crosses ICI each step
     rep = NamedSharding(mesh, P())
     x_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
+    in_specs = (P(), P(None, WORKER_AXIS)) + ((P(),) if gather else ())
+    in_shardings = (rep, x_sharding) + ((rep,) if gather else ())
     inner = jax.shard_map(
         make_fit(axis_name=WORKER_AXIS),
         mesh=mesh,
-        in_specs=(P(), P(None, WORKER_AXIS)),
+        in_specs=in_specs,
         out_specs=(P(), P()),
         check_vma=False,
     )
     return jax.jit(
-        inner, in_shardings=(rep, x_sharding), out_shardings=(rep, rep)
+        inner, in_shardings=in_shardings, out_shardings=(rep, rep)
     )

@@ -45,6 +45,10 @@ class PCAConfig:
       remainder: batcher remainder policy: ``"drop"`` (reference CLI behavior,
         ``distributed.py:99-104``), ``"pad"`` (zero-pad final block, weighted
         correctly), or ``"error"``.
+      prefetch_depth: host->device blocks kept in flight by the training
+        loop (runtime/prefetch.py). The reference hardcoded 5 in-flight
+        AMQP messages (``distributed.py:108``, crashing when fewer batches
+        exist — B5); here it's a knob, and 0 disables prefetching.
       mesh_shape: optional explicit mesh layout, e.g. ``{"workers": 4,
         "features": 2}``; ``None`` = one ``workers`` axis over all devices.
       seed: PRNG seed for initialization (subspace solver, synthetic data).
@@ -62,18 +66,27 @@ class PCAConfig:
     dtype: Any = jnp.float32
     state_dtype: Any = jnp.float32
     remainder: str = "drop"
+    prefetch_depth: int = 2
     mesh_shape: dict[str, int] | None = None
     seed: int = 0
 
     def __post_init__(self):
         if self.discount not in ("1/T", "1/t", "notebook"):
             raise ValueError(f"unknown discount rule: {self.discount!r}")
-        if self.backend not in ("auto", "local", "shard_map", "feature_sharded"):
+        if self.backend not in (
+            "auto", "local", "shard_map", "tpu", "feature_sharded"
+        ):
+            # "tpu" = the north star's name for the mesh backend
+            # (BASELINE.json); alias of "shard_map"
             raise ValueError(f"unknown backend: {self.backend!r}")
         if self.solver not in ("eigh", "subspace"):
             raise ValueError(f"unknown solver: {self.solver!r}")
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")
+        if self.prefetch_depth < 0:
+            raise ValueError(
+                f"prefetch_depth must be >= 0, got {self.prefetch_depth}"
+            )
         if not (0 < self.k <= self.dim):
             raise ValueError(f"need 0 < k <= dim, got k={self.k}, dim={self.dim}")
 

@@ -100,9 +100,10 @@ def _real_data(spec: EvalSpec, data_dir: str | None):
     return None
 
 
-def _exact_top_k(data: np.ndarray, k: int) -> np.ndarray:
-    """Exact top-k eigenspace of the (uncentered) covariance — the oracle
-    the notebook eyeballs against sklearn (cells 21-22), hardened."""
+def exact_top_k(data: np.ndarray, k: int) -> np.ndarray:
+    """Exact top-k eigenspace of the (uncentered) covariance in float64 —
+    the oracle the notebook eyeballs against sklearn (cells 21-22),
+    hardened. The ONE definition of ground truth for evals and examples."""
     g = (data.T @ data) / len(data)
     _, v = np.linalg.eigh(g.astype(np.float64))
     return v[:, -k:][:, ::-1].astype(np.float32)
@@ -143,7 +144,7 @@ def run_eval(
         # than crash mid-reshape
         real = None
     if real is not None:
-        truth = _exact_top_k(real, k)
+        truth = exact_top_k(real, k)
 
         def sample_step(key):
             # cycle through the dataset (advancing cursor, wraparound)

@@ -121,6 +121,10 @@ class WorkerPool:
         solver: str = "eigh",
         subspace_iters: int = 16,
     ):
+        if backend == "tpu":
+            # the north star's `backend="tpu"` selector (BASELINE.json):
+            # mesh-sharded workers with the ICI psum merge
+            backend = "shard_map"
         if backend == "auto":
             backend = "shard_map" if len(jax.devices()) > 1 else "local"
         if backend not in ("local", "shard_map"):

@@ -29,27 +29,60 @@ def prefetch_stream(
     ``WorkerPool.shard``); default is ``jax.device_put``. ``depth`` blocks
     are kept resident ahead of the consumer (2 = classic double buffering).
     Exceptions in the producer propagate to the consumer.
+
+    The returned generator owns a producer thread. Abandoning it mid-stream
+    (``break`` in the consumer, or explicit ``.close()``) signals the
+    producer to stop — the thread exits promptly instead of blocking
+    forever on the bounded queue, and its in-flight blocks are released.
+    Note the producer reads AHEAD: up to ``depth + 1`` items may already be
+    consumed from the underlying iterable when the consumer stops — don't
+    share that iterable with other readers unless prefetching is disabled.
     """
     if depth < 1:
         raise ValueError("depth must be >= 1")
     put = place if place is not None else jax.device_put
     q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
     _END = object()
+
+    def q_put(item) -> bool:
+        """Bounded put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer():
         try:
             for block in stream:
-                q.put(put(block))
-            q.put(_END)
+                if stop.is_set() or not q_put(put(block)):
+                    return
+            q_put(_END)
         except BaseException as e:  # propagate to consumer
-            q.put(e)
+            q_put(e)
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+
+    def gen():
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # consumer finished or abandoned us: release the producer
+            stop.set()
+            while True:  # drain so a blocked q_put wakes immediately
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+    return gen()

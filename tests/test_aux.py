@@ -279,3 +279,21 @@ def test_checkpoint_rewrite_crash_leaves_no_committed_corruption(tmp_path):
         np.savez = real_savez
     with pytest.raises(FileNotFoundError):
         restore_checkpoint(path)
+
+
+def test_config_validation_errors():
+    import pytest
+    from distributed_eigenspaces_tpu.config import PCAConfig
+
+    for bad in (
+        dict(discount="bogus"),
+        dict(backend="cuda"),
+        dict(solver="lanczos"),
+        dict(remainder="wrap"),
+        dict(prefetch_depth=-1),
+        dict(k=0),
+    ):
+        with pytest.raises(ValueError):
+            PCAConfig(dim=16, k=bad.pop("k", 4), **bad)
+    # the north-star alias is accepted
+    assert PCAConfig(dim=16, k=4, backend="tpu").backend == "tpu"

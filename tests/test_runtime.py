@@ -98,3 +98,36 @@ def test_prefetch_stream_propagates_errors():
     next(it)
     with pytest.raises(RuntimeError, match="stream died"):
         list(it)
+
+
+def test_prefetch_abandoned_consumer_stops_producer():
+    """Breaking out of a prefetched stream must release the producer thread
+    (no permanently blocked q.put) and close() must be idempotent."""
+    import itertools
+    import threading
+    import time
+
+    from distributed_eigenspaces_tpu.runtime.prefetch import prefetch_stream
+
+    produced = []
+
+    def infinite():
+        for i in itertools.count():
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    s = prefetch_stream(infinite(), depth=2, place=lambda x: x)
+    got = []
+    for item in s:
+        got.append(item)
+        if len(got) == 3:
+            break
+    s.close()
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before, "producer thread leaked"
+    # read-ahead is bounded: depth + in-flight put + one being produced
+    assert len(produced) <= 3 + 2 + 2
+    s.close()  # idempotent

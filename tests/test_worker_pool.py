@@ -129,3 +129,10 @@ def test_mesh_validation(devices):
     pool = WorkerPool(8, backend="shard_map")
     with pytest.raises(ValueError):
         pool.round(jnp.zeros((4, 8, 8)), k=2)  # wrong worker count
+
+
+def test_backend_tpu_alias(devices):
+    """BASELINE.json's north-star `backend="tpu"` selector maps to the
+    mesh/shard_map backend."""
+    pool = WorkerPool(8, backend="tpu")
+    assert pool.backend == "shard_map"
