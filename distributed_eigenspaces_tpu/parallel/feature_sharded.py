@@ -272,4 +272,31 @@ def make_feature_sharded_step(
 
     step.init_state = init_state
     step.rank = r
+    step.x_sharding = x_sharding  # for input pipelines / prefetch placement
+    step.state_shardings = state_shardings
     return step
+
+
+def auto_feature_mesh(cfg: PCAConfig) -> Mesh:
+    """Pick a ``(workers, features)`` mesh for ``backend="feature_sharded"``.
+
+    Honors ``cfg.mesh_shape`` when given; otherwise prefers a features axis
+    of 2 when the device count and ``dim`` allow it (the minimal layout that
+    actually exercises feature sharding), with the workers axis the largest
+    divisor of ``num_workers`` that fits the remaining devices.
+    """
+    from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+    if cfg.mesh_shape:
+        return make_mesh(
+            num_workers=cfg.mesh_shape.get(WORKER_AXIS),
+            num_feature_shards=cfg.mesh_shape.get(FEATURE_AXIS, 1),
+        )
+    n_dev = len(jax.devices())
+    feats = 2 if (n_dev >= 2 and n_dev % 2 == 0 and cfg.dim % 2 == 0) else 1
+    cap = max(n_dev // feats, 1)
+    workers = next(
+        s for s in range(min(cfg.num_workers, cap), 0, -1)
+        if cfg.num_workers % s == 0
+    )
+    return make_mesh(num_workers=workers, num_feature_shards=feats)
