@@ -14,11 +14,14 @@ Prints ONE JSON line:
   publishes no numbers — SURVEY.md §6 — so the CPU baseline is measured
   here, per BASELINE.md's action item). Target from BASELINE.json: >=50x.
   NOTE on framing: the baseline runs the reference AS IT SHIPS (exact eigh
-  per worker); the TPU numerator uses this framework's subspace solver, so
-  vs_baseline is framework-vs-reference, conflating algorithm + hardware
-  gains. The same-algorithm comparison (NumPy subspace solver, ~71k
-  samples/s on this host) still puts the chip at ~125x — both framings
-  clear the 50x target; see BASELINE.md's measured table.
+  per worker); the TPU numerator uses this framework's subspace solver +
+  exact low-rank merge, so vs_baseline is framework-vs-reference,
+  conflating algorithm + hardware gains. The same-algorithm comparison
+  (NumPy subspace solver, ~71k samples/s on this host) still puts the chip
+  at ~280x — both framings clear the 50x target; see BASELINE.md's
+  measured table and its timing-methodology notes (the tunneled dev
+  backend neither fences on block_until_ready nor re-executes cached
+  (executable, operand) pairs — both pitfalls are handled here).
 
 Accuracy is asserted, not just speed: the run must land within 1 degree
 (principal angle) of the planted subspace or the benchmark reports failure.
@@ -34,7 +37,7 @@ import numpy as np
 
 # Workload (per step): m workers x n rows of dimension d, top-k.
 M, N, D, K = 8, 4096, 1024, 8
-TPU_STEPS = 30
+TPU_STEPS = 600  # long enough that fixed dispatch/RPC overhead is <15%
 CPU_STEPS = 2
 DISTINCT_BLOCKS = 4  # pre-staged device blocks cycled during timing
 
@@ -72,89 +75,154 @@ def measure_cpu_baseline(blocks):
     return (CPU_STEPS * M * N) / dt
 
 
-def measure_tpu(blocks_host, spectrum):
+def _bench_cfg():
+    from distributed_eigenspaces_tpu.config import PCAConfig
+
+    # solver="subspace": block power iteration instead of full eigh — eigh
+    # at d=1024 costs ~400 ms/step on TPU vs <1 ms for the whole
+    # subspace-solver round (measured; see BASELINE.md).
+    # orth_method="cholqr2": CholeskyQR2 instead of Householder QR — the
+    # per-iteration orthonormalization becomes a few MXU matmuls instead of
+    # a long sequential reflector chain.
+    # compute_dtype="bfloat16": the n x d^2 Gram contraction runs at full
+    # MXU rate with fp32 accumulation. The ≤1° accuracy gate below is
+    # asserted on the result of exactly this configuration.
+    return PCAConfig(
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=TPU_STEPS,
+        solver="subspace", subspace_iters=12,
+        orth_method="cholqr2", compute_dtype="bfloat16",
+    )
+
+
+def _gate_angle(state, spectrum):
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+        top_k_eigvecs,
+    )
+
+    w_est = top_k_eigvecs(state.sigma_tilde, K)
+    return float(
+        jnp.max(principal_angles_degrees(w_est, spectrum.top_k(K)))
+    )
+
+
+def _sync(x):
+    """Force materialization and device->host transfer of a scalar summary.
+
+    THE load-bearing sync of this benchmark: on the tunneled dev backend
+    ``jax.block_until_ready`` returns without waiting for execution
+    (verified empirically — a 40 TFLOP program "completes" in microseconds
+    under it), so the only honest fence is demanding a value.
+    """
+    import jax.numpy as jnp
+
+    return float(jnp.sum(x))
+
+
+def _rpc_overhead():
+    """Measured fixed cost of one dispatch+fetch round trip (~100 ms over
+    the axon tunnel, ~0 locally) — subtracted from the timed fit so the
+    metric is device throughput, not network latency."""
     import jax
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: x + 1.0)
+    s = jnp.zeros(())
+    s = tiny(s)
+    _sync(s)  # compile
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        s = tiny(s + 1.0)  # fresh operand each time: defeats result caching
+        _sync(s)
+    return (time.perf_counter() - t0) / reps
+
+
+def measure_tpu(blocks_host, spectrum):
+    """Per-step-dispatch variant (one device program per online step).
+
+    NOTE: when the host drives the device over a network tunnel (the axon
+    dev setup), per-step dispatch latency dominates this number — it
+    measures the driving setup, not the chip. The scan variant below is the
+    headline metric; this one is kept for the dispatch-overhead comparison.
+    """
     import jax.numpy as jnp
 
     from distributed_eigenspaces_tpu.algo.online import OnlineState
     from distributed_eigenspaces_tpu.algo.step import make_train_step
-    from distributed_eigenspaces_tpu.config import PCAConfig
-    from distributed_eigenspaces_tpu.ops.linalg import (
-        principal_angles_degrees,
-        top_k_eigvecs,
-    )
 
-    # solver="subspace": block power iteration (matmul + thin QR) instead of
-    # full eigh — eigh at d=1024 costs ~400 ms/step on TPU vs ~5 ms for the
-    # whole subspace-solver round (measured; see BASELINE.md), and the
-    # accuracy gate below still holds with an order of magnitude to spare.
-    cfg = PCAConfig(
-        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=TPU_STEPS,
-        solver="subspace", subspace_iters=12,
-    )
-    step = make_train_step(cfg, mesh=None)
+    steps = min(TPU_STEPS, 60)  # dispatch-bound: keep the wall time sane
+    step = make_train_step(_bench_cfg(), mesh=None)
     blocks = [jnp.asarray(b) for b in blocks_host]
 
-    # compile + warm-up (state is donated, so keep a fresh one for timing)
+    # compile + warm-up; salt the warm-up state so the first timed step's
+    # (executable, operands) pair is fresh (the backend caches identical
+    # pairs — see BASELINE.md methodology notes)
     state = OnlineState.initial(D)
+    state = state._replace(sigma_tilde=state.sigma_tilde + 1e-20)
     state, _ = step(state, blocks[0])
-    jax.block_until_ready(state)
+    _sync(state.sigma_tilde)
 
     state = OnlineState.initial(D)
     t0 = time.perf_counter()
-    for s in range(TPU_STEPS):
+    for s in range(steps):
         state, _ = step(state, blocks[s % len(blocks)])
-    jax.block_until_ready(state)
+    _sync(state.sigma_tilde)
     dt = time.perf_counter() - t0
 
-    # accuracy gate: recovered subspace vs planted truth
-    w_est = top_k_eigvecs(state.sigma_tilde, K)
-    ang = float(
-        jnp.max(principal_angles_degrees(w_est, spectrum.top_k(K)))
-    )
-    return (TPU_STEPS * M * N) / dt, ang
+    return (steps * M * N) / dt, _gate_angle(state, spectrum)
 
 
 def measure_tpu_scan(blocks_host, spectrum):
-    """Same workload as measure_tpu but with the whole T-step loop compiled
-    as one lax.scan program (algo/scan.py) — zero per-step dispatch. The
-    T-step input is gathered on-device from the staged distinct blocks, so
-    no extra host->HBM traffic is timed."""
-    import jax
+    """Headline measurement: the whole T-step online loop compiled as ONE
+    lax.scan program (algo/scan.py), timed as a single execution with a
+    value-fetch fence.
+
+    Methodology notes (why this shape):
+      - gather=True: the scan body indexes the B staged blocks per step, so
+        HBM holds O(B) blocks and no host->HBM traffic is timed.
+      - one long fit (T = TPU_STEPS = hundreds) makes the fixed ~100 ms
+        dispatch+RPC cost of the tunneled dev backend small; what remains
+        is measured by :func:`_rpc_overhead` and subtracted.
+      - the warm-up call uses a salted initial state and a rolled schedule,
+        so the timed call's (executable, operands) pair is fresh —
+        identical pairs can be served from a cache on this backend, which
+        would make the timed run free and the throughput fictitious.
+      - the sync is a value fetch (see :func:`_sync`): block_until_ready
+        does not actually fence on this backend.
+    """
     import jax.numpy as jnp
 
     from distributed_eigenspaces_tpu.algo.online import OnlineState
     from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
-    from distributed_eigenspaces_tpu.config import PCAConfig
-    from distributed_eigenspaces_tpu.ops.linalg import (
-        principal_angles_degrees,
-        top_k_eigvecs,
-    )
 
-    cfg = PCAConfig(
-        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=TPU_STEPS,
-        solver="subspace", subspace_iters=12,
-    )
-    # gather=True: the scan body indexes the B staged blocks per step, so
-    # HBM holds O(B) blocks, not the full (T, m, n, d) cycle
-    fit = make_scan_fit(cfg, gather=True)
+    fit = make_scan_fit(_bench_cfg(), gather=True)
     stacked = jnp.stack([jnp.asarray(b) for b in blocks_host])
     idx = jnp.arange(TPU_STEPS, dtype=jnp.int32) % len(blocks_host)
-    jax.block_until_ready(stacked)
+    _sync(stacked)
 
-    state, _ = fit(OnlineState.initial(D), stacked, idx)  # compile + warm-up
-    jax.block_until_ready(state)
+    # compile + warm-up on DIFFERENT operands (salted state, rolled idx)
+    warm = OnlineState.initial(D)
+    warm = warm._replace(
+        sigma_tilde=warm.sigma_tilde + 1e-20 * jnp.eye(D, dtype=jnp.float32)
+    )
+    state, _ = fit(warm, stacked, jnp.roll(idx, 1))
+    _sync(state.sigma_tilde)
+
+    rpc = _rpc_overhead()
 
     t0 = time.perf_counter()
     state, _ = fit(OnlineState.initial(D), stacked, idx)
-    jax.block_until_ready(state)
+    _sync(state.sigma_tilde)
     dt = time.perf_counter() - t0
+    if dt > 4 * rpc:
+        # only subtract the link cost when the device time dominates it;
+        # otherwise (tiny CI smoke workloads) report the raw number
+        dt -= rpc
 
-    w_est = top_k_eigvecs(state.sigma_tilde, K)
-    ang = float(
-        jnp.max(principal_angles_degrees(w_est, spectrum.top_k(K)))
-    )
-    return (TPU_STEPS * M * N) / dt, ang
+    return (TPU_STEPS * M * N) / dt, _gate_angle(state, spectrum)
 
 
 def main():
@@ -169,7 +237,11 @@ def main():
         from distributed_eigenspaces_tpu.evals import main as evals_main
 
         return evals_main(args[args.index("--eval") + 1 :])
-    use_scan = "--scan" in args
+    # default = whole-fit scan (the honest chip number; see
+    # measure_tpu_scan's methodology notes). --steploop times one dispatch
+    # per online step instead, which on a tunneled dev host measures the
+    # host->device link more than the chip.
+    use_scan = "--steploop" not in args
 
     # persistent compile cache: TPU eigh at d=1024 is minutes to compile via
     # a remote-compile path; cache makes reruns start in seconds

@@ -122,6 +122,8 @@ def online_distributed_pca(
             else ("shard_map" if cfg.backend == "auto" else cfg.backend),
             solver=cfg.solver,
             subspace_iters=cfg.subspace_iters,
+            orth_method=cfg.orth_method,
+            compute_dtype=cfg.compute_dtype,
         )
     if state is None:
         state = OnlineState.initial(cfg.dim, cfg.state_dtype)

@@ -46,7 +46,7 @@ def make_scan_fit(
 
     def make_fit(axis_name):
         def step_body(st, x):
-            _, v_bar = round_core(x, axis_name=axis_name)
+            v_bar = round_core(x, axis_name=axis_name)
             st = update_state(
                 st, v_bar, discount=cfg.discount, num_steps=cfg.num_steps
             )

@@ -24,33 +24,34 @@ from distributed_eigenspaces_tpu.config import PCAConfig
 from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
 from distributed_eigenspaces_tpu.parallel.worker_pool import (
     _local_eigenspaces,
-    _masked_projector_mean,
 )
-from distributed_eigenspaces_tpu.ops.linalg import merged_top_k
+from distributed_eigenspaces_tpu.ops.linalg import merged_top_k_lowrank
 
 
 def make_round_core(cfg: PCAConfig):
     """Shared per-round compute: ``round_core(x_blocks, axis_name=None) ->
-    (sigma_bar, v_bar)``.
+    v_bar``.
 
     The single definition of "one algorithm round" (local eigenspaces ->
-    masked projector mean -> optional cross-device psum -> merged top-k)
-    used by both the per-step trainer here and the whole-fit scan trainer
-    (algo/scan.py), so solver/merge changes can't diverge between them.
-    ``axis_name`` names the mesh axis to allreduce over (None = single
-    device).
+    cross-device ``all_gather`` of the (m, d, k) factors -> exact low-rank
+    merged top-k, :func:`~..ops.linalg.merged_top_k_lowrank`) used by both
+    the per-step trainer here and the whole-fit scan trainer (algo/scan.py),
+    so solver/merge changes can't diverge between them. The d x d mean
+    projector is never materialized on this path (the WorkerPool.round API
+    still exposes it). ``axis_name`` names the mesh axis to gather over
+    (None = single device).
     """
     k, solver, iters = cfg.k, cfg.solver, cfg.subspace_iters
+    orth, cdtype = cfg.orth_method, cfg.compute_dtype
 
     def round_core(x_blocks, axis_name=None):
-        vs = _local_eigenspaces(x_blocks, k, solver, iters)
-        mask = jnp.ones((x_blocks.shape[0],), jnp.float32)
-        psum, cnt = _masked_projector_mean(vs, mask)
+        vs = _local_eigenspaces(x_blocks, k, solver, iters, orth, cdtype)
         if axis_name is not None:
-            psum = jax.lax.psum(psum, axis_name=axis_name)
-            cnt = jax.lax.psum(cnt, axis_name=axis_name)
-        sigma_bar = psum / cnt
-        return sigma_bar, merged_top_k(sigma_bar, k, solver, iters)
+            # the entire reference wire protocol (C11) is this one gather
+            # of d x k factors — m*d*k floats over ICI, vs the d*d psum a
+            # dense merge would need
+            vs = jax.lax.all_gather(vs, axis_name, axis=0, tiled=True)
+        return merged_top_k_lowrank(vs, k)
 
     return round_core
 
@@ -85,8 +86,7 @@ def make_train_step(
 
         @partial(jax.jit, donate_argnums=donate_args)
         def step(state: OnlineState, x_blocks):
-            _, v_bar = round_core(x_blocks)
-            return fold(state, v_bar)
+            return fold(state, round_core(x_blocks))
 
         return step
 
@@ -97,7 +97,7 @@ def make_train_step(
         partial(round_core, axis_name=WORKER_AXIS),
         mesh=mesh,
         in_specs=(P(WORKER_AXIS),),
-        out_specs=(P(), P()),
+        out_specs=P(),
         check_vma=False,
     )
 
@@ -108,7 +108,6 @@ def make_train_step(
         donate_argnums=donate_args,
     )
     def step(state: OnlineState, x_blocks):
-        _, v_bar = inner(x_blocks)
-        return fold(state, v_bar)
+        return fold(state, inner(x_blocks))
 
     return step

@@ -39,6 +39,14 @@ class PCAConfig:
         ``"subspace"`` (block power iteration; never materializes d x d in the
         streaming path).
       subspace_iters: power-iteration steps when ``solver="subspace"``.
+      orth_method: orthonormalization inside the subspace solver:
+        ``"cholqr2"`` (CholeskyQR2 — MXU matmuls with a shallow dependency
+        chain, the TPU default) or ``"qr"`` (Householder — bulletproof but a
+        long sequential chain of small ops, the TPU latency anti-pattern).
+      compute_dtype: optional cast applied to data blocks entering the Gram
+        matmul (``"bfloat16"`` runs the n x d^2 contraction at full MXU rate;
+        accumulation stays fp32). ``None`` computes in the block dtype with
+        fp32-equivalent precision.
       dtype: storage/compute dtype for data blocks (bfloat16 keeps the MXU
         saturated; accumulation is always fp32 inside the kernels).
       state_dtype: dtype of the running ``sigma_tilde`` state.
@@ -63,6 +71,8 @@ class PCAConfig:
     backend: str = "auto"
     solver: str = "eigh"
     subspace_iters: int = 16
+    orth_method: str = "cholqr2"
+    compute_dtype: Any = None
     dtype: Any = jnp.float32
     state_dtype: Any = jnp.float32
     remainder: str = "drop"
@@ -81,6 +91,10 @@ class PCAConfig:
             raise ValueError(f"unknown backend: {self.backend!r}")
         if self.solver not in ("eigh", "subspace"):
             raise ValueError(f"unknown solver: {self.solver!r}")
+        if self.orth_method not in ("qr", "cholqr2"):
+            raise ValueError(f"unknown orth_method: {self.orth_method!r}")
+        if self.compute_dtype is not None:
+            jnp.dtype(self.compute_dtype)  # raises on junk
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")
         if self.prefetch_depth < 0:

@@ -15,9 +15,15 @@ from distributed_eigenspaces_tpu.ops.linalg import (
     merge_projectors,
     subspace_iteration,
     top_k_eigvecs_streaming,
+    orthonormalize,
+    merged_top_k,
+    merged_top_k_lowrank,
 )
 
 __all__ = [
+    "orthonormalize",
+    "merged_top_k",
+    "merged_top_k_lowrank",
     "gram",
     "top_k_eigvecs",
     "canonicalize_signs",

@@ -106,7 +106,7 @@ def top_k_eig(m: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
 
 
 def merged_top_k(p: jax.Array, k: int, solver: str = "eigh",
-                 iters: int = 16) -> jax.Array:
+                 iters: int = 16, orth: str = "cholqr2") -> jax.Array:
     """Top-k of a (replicated) symmetric matrix by the configured solver —
     the shared dispatch used by both the WorkerPool round and the fused
     train step (keeps their numerics identical by construction)."""
@@ -116,8 +116,50 @@ def merged_top_k(p: jax.Array, k: int, solver: str = "eigh",
             p.shape[0],
             k,
             iters=iters,
+            orth=orth,
         )
     return top_k_eigvecs(p, k)
+
+
+def merged_top_k_lowrank(
+    v_stack: jax.Array, k: int, mask: jax.Array | None = None
+) -> jax.Array:
+    """EXACT top-k eigenvectors of the (masked) mean of projectors
+    ``sigma_bar = (1/sum w) sum_l w_l V_l V_l^T`` — without materializing the
+    d x d matrix and without iteration.
+
+    ``sigma_bar = C C^T`` for the concatenation ``C (d, m*k)`` of the scaled
+    factors ``sqrt(w_l / sum w) V_l``, so its top-k eigenvectors are the top-k
+    left singular vectors of ``C``: eigendecompose the small ``(m*k, m*k)``
+    Gram ``C^T C`` and map back. On TPU this replaces the merged-eigensolve
+    stage (a d x d ``eigh`` or a ~13-deep subspace-iteration chain of small
+    sequential kernels) with two MXU matmuls and one tiny eigh — it is both
+    faster and exact. Under ``shard_map`` the inputs it needs are the
+    ``(m, d, k)`` factors, so the cross-device merge becomes an
+    ``all_gather`` of ``m*d*k`` floats instead of a ``psum`` of ``d**2``
+    (16x less ICI traffic for the benchmark config).
+
+    This is the merge the reference master computes serially and then
+    discards (``distributed.py:126-131``); result columns are descending,
+    sign-canonicalized (matches :func:`top_k_eigvecs` of the dense mean).
+    """
+    m = v_stack.shape[0]
+    if mask is None:
+        w = jnp.ones((m,), jnp.float32)
+    else:
+        w = mask.astype(jnp.float32)
+    cnt = jnp.maximum(jnp.sum(w), 1.0)
+    c = v_stack * jnp.sqrt(w / cnt)[:, None, None]
+    d = c.shape[1]
+    c = jnp.transpose(c, (1, 0, 2)).reshape(d, -1)  # (d, m*k)
+    b = jnp.matmul(c.T, c, precision=lax.Precision.HIGHEST)
+    with jax.default_matmul_precision("highest"):
+        ew, u = jnp.linalg.eigh(0.5 * (b + b.T))
+    wk = ew[-k:][::-1]
+    uk = u[:, -k:][:, ::-1]
+    vb = jnp.matmul(c, uk, precision=lax.Precision.HIGHEST)
+    vb = vb / jnp.sqrt(jnp.maximum(wk, 1e-12))[None, :]
+    return canonicalize_signs(vb)
 
 
 def projector(v: jax.Array) -> jax.Array:
@@ -181,11 +223,50 @@ def grassmann_distance(u: jax.Array, v: jax.Array) -> jax.Array:
     return jnp.linalg.norm(principal_angles(u, v))
 
 
-def _orthonormalize(v: jax.Array) -> jax.Array:
-    """Thin-QR orthonormalization of the columns of ``v (d, k)``."""
+def _cholqr2(v: jax.Array) -> jax.Array:
+    """CholeskyQR2 orthonormalization of tall-skinny ``v (d, k)``.
+
+    Two rounds of (k x k Gram -> Cholesky -> right triangular solve). On TPU
+    this is a handful of MXU-friendly ops with a shallow dependency chain,
+    versus Householder QR's sequential per-column reflectors — the dominant
+    latency term of the subspace solver (measured: see BASELINE.md). The
+    trace-scaled jitter keeps the Cholesky PD even when the iterate is
+    nearly rank-deficient; the second round restores orthonormality to
+    ~machine precision for cond(v) up to ~1/sqrt(eps) (the regime subspace
+    iteration stays in because it re-orthonormalizes every step).
+    """
+    for _ in range(2):
+        s = jnp.matmul(v.T, v, precision=lax.Precision.HIGHEST)
+        jitter = 1e-7 * jnp.trace(s) + 1e-30
+        l = jnp.linalg.cholesky(
+            s + jitter * jnp.eye(s.shape[1], dtype=s.dtype)
+        )
+        # solve X @ L^T = V  ->  X = V R^{-1} with R = L^T
+        v = lax.linalg.triangular_solve(
+            l, v, left_side=False, lower=True, transpose_a=True
+        )
+    return v
+
+
+def orthonormalize(v: jax.Array, method: str = "qr") -> jax.Array:
+    """Orthonormalize the columns of ``v (d, k)``.
+
+    ``method="qr"``: Householder thin-QR (bulletproof, but a long sequential
+    chain of small ops on TPU). ``method="cholqr2"``: CholeskyQR2 (see
+    :func:`_cholqr2`) — the TPU fast path and the framework default.
+    """
+    if method == "cholqr2":
+        return _cholqr2(v)
+    if method != "qr":
+        raise ValueError(f"unknown orthonormalization method: {method!r}")
     with jax.default_matmul_precision("highest"):
         q, _ = jnp.linalg.qr(v)
     return q
+
+
+def _orthonormalize(v: jax.Array) -> jax.Array:
+    """Thin-QR orthonormalization of the columns of ``v (d, k)``."""
+    return orthonormalize(v, "qr")
 
 
 def subspace_iteration(
@@ -196,6 +277,7 @@ def subspace_iteration(
     iters: int = 16,
     key: jax.Array | None = None,
     v0: jax.Array | None = None,
+    orth: str = "cholqr2",
 ) -> jax.Array:
     """Top-k invariant subspace of a symmetric PSD operator by block power iteration.
 
@@ -213,15 +295,18 @@ def subspace_iteration(
     Convergence is geometric in the eigengap ratio ``(lambda_{k+1}/lambda_k)^iters``;
     callers with tight accuracy targets should oversample (pass a larger k and
     truncate) or raise ``iters``.
+
+    ``orth`` selects the per-step orthonormalization: ``"cholqr2"`` (default;
+    MXU-friendly, shallow op chain) or ``"qr"`` (Householder).
     """
     if v0 is None:
         if key is None:
             key = jax.random.PRNGKey(0)
         v0 = jax.random.normal(key, (d, k), dtype=jnp.float32)
-    v = _orthonormalize(v0)
+    v = orthonormalize(v0, orth)
 
     def body(_, v):
-        return _orthonormalize(matvec(v))
+        return orthonormalize(matvec(v), orth)
 
     v = jax.lax.fori_loop(0, iters, body, v)
     # Rayleigh–Ritz: rotate the converged basis to eigenvector coordinates so
@@ -240,6 +325,7 @@ def top_k_eigvecs_streaming(
     *,
     iters: int = 16,
     key: jax.Array | None = None,
+    orth: str = "cholqr2",
 ) -> jax.Array:
     """Top-k eigenvectors of ``(1/N) X^T X`` for ``x_blocks (b, n, d)`` without
     ever forming the d x d Gram matrix.
@@ -260,4 +346,4 @@ def top_k_eigvecs_streaming(
         acc, _ = jax.lax.scan(body, acc0, x_blocks)
         return acc / (b * n)
 
-    return subspace_iteration(matvec, d, k, iters=iters, key=key)
+    return subspace_iteration(matvec, d, k, iters=iters, key=key, orth=orth)

@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_eigenspaces_tpu.ops.linalg import (
     gram,
-    merged_top_k,
+    merged_top_k_lowrank,
     top_k_eigvecs,
     subspace_iteration,
 )
@@ -42,12 +42,21 @@ from distributed_eigenspaces_tpu.parallel.mesh import (
 )
 
 
-def _local_eigenspaces(x_blocks: jax.Array, k: int, solver: str, iters: int):
+def _local_eigenspaces(
+    x_blocks: jax.Array,
+    k: int,
+    solver: str,
+    iters: int,
+    orth: str = "cholqr2",
+    compute_dtype=None,
+):
     """Per-worker ``V_hat``: ``(m, n, d) -> (m, d, k)`` (vmapped C8 -> C7).
 
     The Gram uses the Pallas streaming kernel on TPU for MXU-aligned shapes
     (``ops.pallas_gram``), falling back to the XLA einsum elsewhere — same
-    math, tested against each other.
+    math, tested against each other. ``compute_dtype`` (e.g. bfloat16) casts
+    the block before the Gram contraction for full MXU rate; accumulation
+    stays fp32 either way.
     """
     import os
 
@@ -56,6 +65,8 @@ def _local_eigenspaces(x_blocks: jax.Array, k: int, solver: str, iters: int):
     use_pallas = os.environ.get("DET_NO_PALLAS", "0") != "1"
 
     def one(xb):
+        if compute_dtype is not None:
+            xb = xb.astype(compute_dtype)
         g = gram_auto(xb) if use_pallas else gram(xb)
         if solver == "subspace":
             return subspace_iteration(
@@ -65,6 +76,7 @@ def _local_eigenspaces(x_blocks: jax.Array, k: int, solver: str, iters: int):
                 g.shape[0],
                 k,
                 iters=iters,
+                orth=orth,
             )
         return top_k_eigvecs(g, k)
 
@@ -120,6 +132,8 @@ class WorkerPool:
         mesh: Mesh | None = None,
         solver: str = "eigh",
         subspace_iters: int = 16,
+        orth_method: str = "cholqr2",
+        compute_dtype=None,
     ):
         if backend == "tpu":
             # the north star's `backend="tpu"` selector (BASELINE.json):
@@ -133,6 +147,8 @@ class WorkerPool:
         self.backend = backend
         self.solver = solver
         self.subspace_iters = subspace_iters
+        self.orth_method = orth_method
+        self.compute_dtype = compute_dtype
         if backend == "shard_map":
             if mesh is None:
                 n_dev = len(jax.devices())
@@ -184,6 +200,8 @@ class WorkerPool:
                 _local_eigenspaces,
                 solver=self.solver,
                 iters=self.subspace_iters,
+                orth=self.orth_method,
+                compute_dtype=self.compute_dtype,
             ),
             static_argnames=("k",),
         )(x_blocks, k=k)
@@ -192,18 +210,26 @@ class WorkerPool:
 
     def _build_round(self):
         solver, iters = self.solver, self.subspace_iters
+        orth, cdtype = self.orth_method, self.compute_dtype
 
-        def merged(p, k):
-            return merged_top_k(p, k, solver, iters)
+        def merge(vs, mask, k):
+            """Masked mean projector + its EXACT top-k from the factors.
+
+            ``v_bar`` comes from the low-rank merge (no iteration, no d x d
+            dependency); ``sigma_bar`` is materialized only because the
+            round() API exposes it (reference parity: it is what the master
+            computed at ``distributed.py:126-131``).
+            """
+            psum, cnt = _masked_projector_mean(vs, mask)
+            sigma_bar = psum / jnp.maximum(cnt, 1.0)
+            return sigma_bar, merged_top_k_lowrank(vs, k, mask)
 
         if self.backend == "local":
 
             @partial(jax.jit, static_argnames=("k",))
             def round_local(x_blocks, mask, k):
-                vs = _local_eigenspaces(x_blocks, k, solver, iters)
-                psum, cnt = _masked_projector_mean(vs, mask)
-                sigma_bar = psum / jnp.maximum(cnt, 1.0)
-                return sigma_bar, merged(sigma_bar, k)
+                vs = _local_eigenspaces(x_blocks, k, solver, iters, orth, cdtype)
+                return merge(vs, mask, k)
 
             return round_local
 
@@ -214,14 +240,15 @@ class WorkerPool:
         def round_sharded(x_blocks, mask, k):
             def shard_fn(xs, mask_s):
                 # xs: (m_local, n, d) on this device's worker slot(s)
-                vs = _local_eigenspaces(xs, k, solver, iters)
-                psum, cnt = _masked_projector_mean(vs, mask_s)
-                # ICI allreduce — the entire reference wire protocol (C11)
-                # collapses to these two lines.
-                psum = jax.lax.psum(psum, axis_name=WORKER_AXIS)
-                cnt = jax.lax.psum(cnt, axis_name=WORKER_AXIS)
-                sigma_bar = psum / jnp.maximum(cnt, 1.0)
-                return sigma_bar, merged(sigma_bar, k)
+                vs = _local_eigenspaces(xs, k, solver, iters, orth, cdtype)
+                # ICI gather of the d x k factors — the entire reference
+                # wire protocol (C11) collapses to these two lines, moving
+                # m*d*k floats instead of the d*d a dense-merge psum needs.
+                vs = jax.lax.all_gather(vs, WORKER_AXIS, axis=0, tiled=True)
+                mask_all = jax.lax.all_gather(
+                    mask_s, WORKER_AXIS, axis=0, tiled=True
+                )
+                return merge(vs, mask_all, k)
 
             return jax.shard_map(
                 partial(shard_fn),

@@ -264,6 +264,8 @@ def run_dynamic_round(
     remainder: str = "drop",
     solver: str = "eigh",
     subspace_iters: int = 16,
+    orth_method: str = "cholqr2",
+    compute_dtype=None,
     fault_hook: Callable[[int], None] | None = None,
     max_retries: int = 3,
     lease_timeout: float | None = None,
@@ -303,8 +305,11 @@ def run_dynamic_round(
     @jax.jit
     def eigenspace(x):
         # shared solver dispatch (keeps numerics — incl. HIGHEST-precision
-        # matvecs in the subspace path — identical to every other call site)
-        return merged_top_k(gram(x), k, solver, subspace_iters)
+        # matvecs in the subspace path and the configured orthonormalization
+        # — identical to every other call site)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        return merged_top_k(gram(x), k, solver, subspace_iters, orth_method)
 
     # Projector mean weighted by batch row count: equal weights for the
     # equal-size batches (reference (1/m) merge, distributed.py:126-131),
@@ -338,5 +343,5 @@ def run_dynamic_round(
     wq.run(compute, num_lanes=num_lanes, on_result=fold)
 
     sigma_bar = jnp.asarray(merged_sum / max(merged_rows, 1))
-    v_bar = merged_top_k(sigma_bar, k, solver, subspace_iters)
+    v_bar = merged_top_k(sigma_bar, k, solver, subspace_iters, orth_method)
     return sigma_bar, v_bar

@@ -158,3 +158,82 @@ def test_top_k_eigvecs_jit_cache():
     v1 = top_k_eigvecs(m, 2)
     v2 = top_k_eigvecs(m + 0.1, 2)
     assert v1.shape == v2.shape == (8, 2)
+
+
+def test_orthonormalize_cholqr2_matches_qr_span(rng):
+    """CholeskyQR2 produces an orthonormal basis spanning the same space as
+    Householder QR, including for badly-scaled input."""
+    from distributed_eigenspaces_tpu.ops.linalg import orthonormalize
+
+    v = rng.standard_normal((64, 6)).astype(np.float32)
+    v[:, 0] *= 1e4  # bad column scaling
+    q_chol = np.asarray(orthonormalize(jnp.asarray(v), "cholqr2"))
+    q_house = np.asarray(orthonormalize(jnp.asarray(v), "qr"))
+    np.testing.assert_allclose(
+        q_chol.T @ q_chol, np.eye(6), atol=5e-5
+    )
+    ang = np.degrees(
+        np.asarray(principal_angles(jnp.asarray(q_chol), jnp.asarray(q_house)))
+    )
+    assert ang.max() < 0.1
+
+
+def test_orthonormalize_unknown_method():
+    with pytest.raises(ValueError):
+        from distributed_eigenspaces_tpu.ops.linalg import orthonormalize
+
+        orthonormalize(jnp.zeros((4, 2)), "gram-schmidt")
+
+
+def test_merged_top_k_lowrank_exact(rng):
+    """The low-rank merge equals the dense mean-projector top-k exactly
+    (it's the same eigenproblem via the factor Gram)."""
+    from distributed_eigenspaces_tpu.ops.linalg import merged_top_k_lowrank
+
+    m, d, k = 5, 48, 3
+    # workers agree on a common subspace up to small perturbations, so the
+    # mean projector has a clean top-k eigengap (the algorithm's operating
+    # regime) and fp32 eigenvector noise stays tiny
+    base = rng.standard_normal((d, k))
+    vs = np.stack(
+        [
+            np.linalg.qr(base + 0.05 * rng.standard_normal((d, k)))[0]
+            for _ in range(m)
+        ]
+    ).astype(np.float32)
+    sigma_bar = np.mean(
+        [v @ v.T for v in vs], axis=0
+    ).astype(np.float32)
+    want = np.asarray(top_k_eigvecs(jnp.asarray(sigma_bar), k))
+    got = np.asarray(merged_top_k_lowrank(jnp.asarray(vs), k))
+    ang = np.degrees(
+        np.asarray(principal_angles(jnp.asarray(got), jnp.asarray(want)))
+    )
+    assert ang.max() < 0.1
+    # orthonormal output, canonical signs
+    np.testing.assert_allclose(got.T @ got, np.eye(k), atol=1e-4)
+    np.testing.assert_allclose(got, np.asarray(canonicalize_signs(jnp.asarray(got))))
+
+
+def test_merged_top_k_lowrank_masked(rng):
+    """A masked-out worker is excluded exactly — same as dropping it from
+    the dense mean."""
+    from distributed_eigenspaces_tpu.ops.linalg import merged_top_k_lowrank
+
+    m, d, k = 4, 32, 2
+    base = rng.standard_normal((d, k))
+    vs = np.stack(
+        [
+            np.linalg.qr(base + 0.05 * rng.standard_normal((d, k)))[0]
+            for _ in range(m)
+        ]
+    ).astype(np.float32)
+    mask = jnp.asarray([1.0, 0.0, 1.0, 1.0])
+    kept = vs[[0, 2, 3]]
+    sigma_bar = np.mean([v @ v.T for v in kept], axis=0).astype(np.float32)
+    want = np.asarray(top_k_eigvecs(jnp.asarray(sigma_bar), k))
+    got = np.asarray(merged_top_k_lowrank(jnp.asarray(vs), k, mask))
+    ang = np.degrees(
+        np.asarray(principal_angles(jnp.asarray(got), jnp.asarray(want)))
+    )
+    assert ang.max() < 0.1
