@@ -15,6 +15,7 @@ per-step path with ``runtime.prefetch``.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_eigenspaces_tpu.algo.online import OnlineState, update_state
@@ -41,31 +42,76 @@ def make_scan_fit(
     Semantically identical to calling the per-step trainer T times (tested —
     both build on :func:`~..algo.step.make_round_core`), just compiled as
     one program.
+
+    With ``cfg.warm_start_iters`` set (subspace solver only), the first
+    step runs the full-iteration cold core and every later step warm-starts
+    its per-worker solves from the previous merged ``v_bar`` with the short
+    iteration count — the online-stream optimization BASELINE.md measures.
     """
     round_core = make_round_core(cfg)
+    warm = cfg.warm_start_iters is not None and cfg.solver == "subspace"
+    warm_core = (
+        make_round_core(cfg, iters=cfg.warm_start_iters) if warm else None
+    )
 
     def make_fit(axis_name):
-        def step_body(st, x):
-            v_bar = round_core(x, axis_name=axis_name)
-            st = update_state(
+        def update(st, v_bar):
+            return update_state(
                 st, v_bar, discount=cfg.discount, num_steps=cfg.num_steps
             )
-            return st, v_bar
+
+        def step_body(st, x):
+            v_bar = round_core(x, axis_name=axis_name)
+            return update(st, v_bar), v_bar
+
+        def warm_body(carry, x):
+            st, v_prev = carry
+            v_bar = warm_core(x, axis_name=axis_name, v0=v_prev)
+            return (update(st, v_bar), v_bar), v_bar
+
+        def warm_fit(first_x, scan_body, xs_rest, state):
+            # step 1: cold, full iterations (also the resume-safe path:
+            # no solver state is assumed to exist)
+            v0_bar = round_core(first_x, axis_name=axis_name)
+            state = update(state, v0_bar)
+            (state, _), v_bars = jax.lax.scan(
+                scan_body, (state, v0_bar), xs_rest
+            )
+            return state, jnp.concatenate([v0_bar[None], v_bars], axis=0)
+
+        if warm and gather:
+
+            def fit(state, blocks, idx):
+                def body(carry, i):
+                    return warm_body(carry, blocks[i])
+
+                return warm_fit(blocks[idx[0]], body, idx[1:], state)
+
+            return fit
+
+        if warm:
+
+            def fit(state, x_steps):
+                return warm_fit(
+                    x_steps[0], warm_body, x_steps[1:], state
+                )
+
+            return fit
 
         if gather:
 
-            def fit(state, blocks, idx):
+            def fit_gather(state, blocks, idx):
                 def body(st, i):
                     return step_body(st, blocks[i])
 
                 return jax.lax.scan(body, state, idx)
 
-        else:
+            return fit_gather
 
-            def fit(state, x_steps):
-                return jax.lax.scan(step_body, state, x_steps)
+        def fit_dense(state, x_steps):
+            return jax.lax.scan(step_body, state, x_steps)
 
-        return fit
+        return fit_dense
 
     if mesh is None:
         return jax.jit(make_fit(axis_name=None))

@@ -28,9 +28,9 @@ from distributed_eigenspaces_tpu.parallel.worker_pool import (
 from distributed_eigenspaces_tpu.ops.linalg import merged_top_k_lowrank
 
 
-def make_round_core(cfg: PCAConfig):
-    """Shared per-round compute: ``round_core(x_blocks, axis_name=None) ->
-    v_bar``.
+def make_round_core(cfg: PCAConfig, iters: int | None = None):
+    """Shared per-round compute: ``round_core(x_blocks, axis_name=None,
+    v0=None) -> v_bar``.
 
     The single definition of "one algorithm round" (local eigenspaces ->
     cross-device ``all_gather`` of the (m, d, k) factors -> exact low-rank
@@ -39,13 +39,17 @@ def make_round_core(cfg: PCAConfig):
     so solver/merge changes can't diverge between them. The d x d mean
     projector is never materialized on this path (the WorkerPool.round API
     still exposes it). ``axis_name`` names the mesh axis to gather over
-    (None = single device).
+    (None = single device). ``iters`` overrides ``cfg.subspace_iters``
+    (the warm-start trainer uses a short-iteration core for steps > 0);
+    ``v0`` warm-starts the per-worker subspace iterations.
     """
-    k, solver, iters = cfg.k, cfg.solver, cfg.subspace_iters
+    k, solver = cfg.k, cfg.solver
+    if iters is None:
+        iters = cfg.subspace_iters
     orth, cdtype = cfg.orth_method, cfg.compute_dtype
 
-    def round_core(x_blocks, axis_name=None):
-        vs = _local_eigenspaces(x_blocks, k, solver, iters, orth, cdtype)
+    def round_core(x_blocks, axis_name=None, v0=None):
+        vs = _local_eigenspaces(x_blocks, k, solver, iters, orth, cdtype, v0)
         if axis_name is not None:
             # the entire reference wire protocol (C11) is this one gather
             # of d x k factors — m*d*k floats over ICI, vs the d*d psum a

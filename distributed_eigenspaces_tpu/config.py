@@ -39,6 +39,15 @@ class PCAConfig:
         ``"subspace"`` (block power iteration; never materializes d x d in the
         streaming path).
       subspace_iters: power-iteration steps when ``solver="subspace"``.
+      warm_start_iters: online warm start for the whole-fit scan trainer
+        (``algo/scan.py``): when set and ``solver="subspace"``, step 1 runs
+        the full ``subspace_iters`` cold, and every later step initializes
+        each worker's subspace iteration from the previous merged estimate
+        and runs only this many iterations (the previous ``v_bar`` is an
+        excellent initializer for a slowly-varying online stream — same
+        converged subspace, ~3x shorter per-step solver chain). ``None``
+        disables (every step runs cold). The per-step trainer ignores it
+        (its API carries no cross-step solver state).
       orth_method: orthonormalization inside the subspace solver:
         ``"cholqr2"`` (CholeskyQR2 — MXU matmuls with a shallow dependency
         chain, the TPU default) or ``"qr"`` (Householder — bulletproof but a
@@ -71,6 +80,7 @@ class PCAConfig:
     backend: str = "auto"
     solver: str = "eigh"
     subspace_iters: int = 16
+    warm_start_iters: int | None = None
     orth_method: str = "cholqr2"
     compute_dtype: Any = None
     dtype: Any = jnp.float32
@@ -91,6 +101,11 @@ class PCAConfig:
             raise ValueError(f"unknown backend: {self.backend!r}")
         if self.solver not in ("eigh", "subspace"):
             raise ValueError(f"unknown solver: {self.solver!r}")
+        if self.warm_start_iters is not None and self.warm_start_iters < 1:
+            raise ValueError(
+                f"warm_start_iters must be >= 1 or None, got "
+                f"{self.warm_start_iters}"
+            )
         if self.orth_method not in ("qr", "cholqr2"):
             raise ValueError(f"unknown orth_method: {self.orth_method!r}")
         if self.compute_dtype is not None:

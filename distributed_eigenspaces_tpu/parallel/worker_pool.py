@@ -49,6 +49,7 @@ def _local_eigenspaces(
     iters: int,
     orth: str = "cholqr2",
     compute_dtype=None,
+    v0: jax.Array | None = None,
 ):
     """Per-worker ``V_hat``: ``(m, n, d) -> (m, d, k)`` (vmapped C8 -> C7).
 
@@ -56,7 +57,10 @@ def _local_eigenspaces(
     (``ops.pallas_gram``), falling back to the XLA einsum elsewhere — same
     math, tested against each other. ``compute_dtype`` (e.g. bfloat16) casts
     the block before the Gram contraction for full MXU rate; accumulation
-    stays fp32 either way.
+    stays fp32 either way. ``v0`` (d, k) warm-starts every worker's subspace
+    iteration (online steps: the previous merged estimate is an excellent
+    initializer, so far fewer iterations are needed); ignored by the eigh
+    solver.
     """
     import os
 
@@ -77,6 +81,7 @@ def _local_eigenspaces(
                 k,
                 iters=iters,
                 orth=orth,
+                v0=v0,
             )
         return top_k_eigvecs(g, k)
 
