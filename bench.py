@@ -223,9 +223,11 @@ def measure_tpu_scan(blocks_host, spectrum):
     state, _ = fit(OnlineState.initial(D), stacked, idx)
     _sync(state.sigma_tilde)
     dt = time.perf_counter() - t0
-    # subtract the link cost, capped so tiny CI smoke workloads can't go
-    # negative or cliff (continuous in dt, exact when device time dominates)
-    dt -= min(rpc, 0.9 * dt)
+    # subtract the link cost, capped at 25% of the raw time: exact when the
+    # device time dominates (dt >= 4*rpc), continuous (no threshold cliff),
+    # and bounded so a tiny CI smoke workload can't be inflated more than
+    # 1.33x — smoke numbers stay order-of-magnitude honest
+    dt -= min(rpc, 0.25 * dt)
 
     return (TPU_STEPS * M * N) / dt, _gate_angle(state, spectrum)
 

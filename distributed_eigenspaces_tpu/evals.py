@@ -127,10 +127,9 @@ def run_eval(
     from distributed_eigenspaces_tpu.algo.online import OnlineState
     from distributed_eigenspaces_tpu.algo.step import make_train_step
     from distributed_eigenspaces_tpu.config import PCAConfig
-    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.data.synthetic import planted_subspace
     from distributed_eigenspaces_tpu.ops.linalg import (
         principal_angles_degrees,
-        top_k_eigvecs,
     )
 
     spec = EVAL_SPECS[name].replace(**overrides)
@@ -163,13 +162,18 @@ def run_eval(
         decay = max(
             0.8, float((100.0 * noise / gap) ** (1.0 / max(k - 1, 1)))
         )
-        spectrum = planted_spectrum(
+        # low-rank planted model: O(d*k) setup and device-side sampling —
+        # the full-basis planted_spectrum takes minutes at d=12288 and
+        # would drag every block across the (slow) host link
+        spectrum = planted_subspace(
             d, k_planted=k, gap=gap, decay=decay, noise=noise, seed=seed
         )
         truth = np.asarray(spectrum.top_k(k))
 
         def sample_step(key):
-            return np.asarray(spectrum.sample(key, step_rows))
+            # stays a device array in "memory" mode (no host round trip);
+            # the "bin" path converts to host bytes where it writes the file
+            return spectrum.sample(key, step_rows)
 
         data_kind = "synthetic"
 
@@ -209,12 +213,21 @@ def run_eval(
         step_fn = fstep
         final_w = lambda st: np.asarray(st.u)[:, :k]  # noqa: E731
     else:
+        from distributed_eigenspaces_tpu.ops.linalg import merged_top_k
+
         step_fn = make_train_step(
             cfg, mesh=mesh if backend_used == "shard_map" else None
         )
         state = OnlineState.initial(d)
+        # final extraction honors the configured solver: a full d x d eigh
+        # at d=12288 needs ~31 GB of HLO temps (OOM on one chip); the
+        # subspace solver converges in a few iterations on sigma_tilde's
+        # clean ~1-vs-~0 projector-average spectrum
         final_w = lambda st: np.asarray(  # noqa: E731
-            top_k_eigvecs(st.sigma_tilde, k)
+            merged_top_k(
+                st.sigma_tilde, k, spec.solver,
+                max(spec.subspace_iters, 16),
+            )
         )
 
     # --- stage data --------------------------------------------------------
@@ -236,7 +249,7 @@ def run_eval(
         with open(bin_path, "wb") as f:
             for s in range(spec.steps):
                 f.write(
-                    host_blocks[s % n_distinct]
+                    np.asarray(host_blocks[s % n_distinct])
                     .reshape(step_rows, d)
                     .tobytes()
                 )
@@ -272,7 +285,9 @@ def run_eval(
         warm = jnp.asarray(host_blocks[0])
         out = step_fn(state, warm)
         state_w = out[0]
-        jax.block_until_ready(jax.tree_util.tree_leaves(state_w)[0])
+        # value fetch, not block_until_ready: the tunneled dev backend does
+        # not fence on block_until_ready (BASELINE.md timing methodology)
+        float(jnp.sum(jax.tree_util.tree_leaves(state_w)[0]))
 
         # --- timed run -----------------------------------------------------
         if backend_used == "feature_sharded":
@@ -284,7 +299,7 @@ def run_eval(
         for x in stream():
             state, _ = step_fn(state, x)
             steps_run += 1
-        jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+        float(jnp.sum(jax.tree_util.tree_leaves(state)[0]))  # honest fence
         dt = time.perf_counter() - t0
     finally:
         if bin_path is not None:

@@ -16,8 +16,10 @@ Machinery (all inside one ``shard_map`` over a ``(workers, features)`` mesh):
   reference's JSON eigenspace messages — but over ICI, not AMQP);
 - orthonormalization by CholeskyQR2 (two rounds of Gram + Cholesky + solve
   — MXU-friendly tall-skinny QR; the Gram is a k x k ``psum``);
-- the worker merge as subspace iteration on the implicit operator
-  ``P U = (1/m) sum_l V_l (V_l^T U)`` — a ``psum`` over ``workers``;
+- the worker merge EXACT from the factors (top-k left singular vectors of
+  the scaled concatenation ``[V_1 .. V_m]/sqrt(m)`` via an (m*k)-sized
+  replicated eigh) — an ``all_gather`` over ``workers`` plus a ``features``
+  psum, no iteration;
 - the online state as a rank-r eigendecomposition ``sigma_tilde ~= U S U^T``
   updated incrementally (append the new projector's columns, re-eigensolve
   an (r+k) x (r+k) Gram, truncate) — O(d r^2 / f) per device per step.
@@ -131,40 +133,35 @@ def worker_subspace_sharded(x, k, iters, n_total_rows, key):
     return jnp.einsum("mdk,mkl->mdl", v, q, precision=HP)
 
 
-def merged_subspace_sharded(v_workers, k, iters, key):
-    """Top-k of the mean projector ``(1/m) sum_l V_l V_l^T`` without forming
-    it: subspace iteration on the implicit operator.
+def merged_lowrank_sharded(v_workers, k):
+    """EXACT top-k of the mean projector ``(1/m) sum_l V_l V_l^T`` from its
+    factors, fully sharded — the feature-sharded twin of
+    :func:`~..ops.linalg.merged_top_k_lowrank`.
 
-    ``v_workers``: (m_local, d_local, k) shards. Returns (d_local, k) shard
-    of the merged eigenspace (replicated over ``workers``).
+    ``v_workers``: (m_local, d_local, k) shards over ``(workers, features)``.
+    The mean projector is ``C C^T`` for ``C = [V_1 .. V_m] / sqrt(m)``, so
+    its top-k eigenvectors are C's top-k left singular vectors: all_gather
+    the factors over ``workers`` (m*d_local*k floats — the only worker-axis
+    traffic), form the (m*k, m*k) Gram with a ``features`` psum, eigensolve
+    it replicated, and map back. No iteration, no d x d, and ~6 kernels
+    instead of the ~50-collective subspace-iteration chain this replaces
+    (BASELINE.md "what makes it fast" item 4).
+
+    Returns (d_local, k), replicated over ``workers``, descending order.
     """
-    m_local, d_local, _ = v_workers.shape
-    m_total = jax.lax.psum(
-        jnp.asarray(m_local, jnp.float32), WORKER_AXIS
+    c = jax.lax.all_gather(
+        v_workers, WORKER_AXIS, axis=0, tiled=True
+    )  # (m_total, d_local, k)
+    m_total, d_local = c.shape[0], c.shape[1]  # static — no collective
+    c = jnp.transpose(c, (1, 0, 2)).reshape(d_local, -1) * (
+        1.0 / m_total**0.5
     )
-
-    def matvec(u):
-        # u: (d_local, k) replicated over workers.
-        w = jnp.einsum("mdk,dj->mkj", v_workers, u, precision=HP)
-        w = jax.lax.psum(w, FEATURE_AXIS)  # full V_l^T U, per local worker
-        y = jnp.einsum("mdk,mkj->dj", v_workers, w, precision=HP)
-        return jax.lax.psum(y, WORKER_AXIS) / m_total
-
-    fidx = jax.lax.axis_index(FEATURE_AXIS)
-    u = jax.random.normal(
-        jax.random.fold_in(key, fidx), (d_local, k), jnp.float32
-    )
-    u = chol_qr2(u, FEATURE_AXIS)
-
-    def body(_, u):
-        return chol_qr2(matvec(u), FEATURE_AXIS)
-
-    u = jax.lax.fori_loop(0, iters, body, u)
-    au = matvec(u)
-    small = jnp.einsum("dk,dl->kl", u, au, precision=HP)
-    small = jax.lax.psum(small, FEATURE_AXIS)
-    _, q = _small_eigh_desc(small)
-    return jnp.matmul(u, q, precision=HP)
+    b = jnp.matmul(c.T, c, precision=HP)
+    b = jax.lax.psum(b, FEATURE_AXIS)
+    w, q = _small_eigh_desc(b)
+    wk = jnp.maximum(w[:k], 0.0)
+    inv = jnp.where(wk > 1e-12, jax.lax.rsqrt(jnp.maximum(wk, 1e-30)), 0.0)
+    return jnp.einsum("dc,ck,k->dk", c, q[:, :k], inv, precision=HP)
 
 
 def lowrank_update(state: LowRankState, v_bar, weight, keep=1.0):
@@ -232,7 +229,7 @@ def make_feature_sharded_step(
     def sharded(state, x):
         # x: (m_local, n, d_local); state.u: (d_local_f, r)
         vws = worker_subspace_sharded(x, k, iters, n, key)
-        v_bar = merged_subspace_sharded(vws, k, iters, jax.random.fold_in(key, 1))
+        v_bar = merged_lowrank_sharded(vws, k)
         w, keep = weights(state.step)
         new_state = _lowrank_update(state, v_bar, w, keep, axis_name=FEATURE_AXIS)
         return new_state, v_bar

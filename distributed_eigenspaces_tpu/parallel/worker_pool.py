@@ -68,9 +68,37 @@ def _local_eigenspaces(
 
     use_pallas = os.environ.get("DET_NO_PALLAS", "0") != "1"
 
+    d = x_blocks.shape[2]
+    # Large-d subspace solves never materialize the d x d Gram (SURVEY.md §7
+    # hard part (a)): apply the covariance as X^T (X v) / n per iteration —
+    # fewer FLOPs than forming the Gram whenever 2*k*iters << d, and O(d*k)
+    # memory instead of O(d^2) (600 MB/worker at the 12288-d config).
+    streaming = solver == "subspace" and d >= 4096 and 2 * k * iters < d
+
     def one(xb):
         if compute_dtype is not None:
             xb = xb.astype(compute_dtype)
+        prec = (
+            jax.lax.Precision.HIGHEST
+            if xb.dtype == jnp.float32
+            else None
+        )
+        if streaming:
+            n = xb.shape[0]
+
+            def mv(v):
+                xv = jnp.matmul(
+                    xb, v.astype(xb.dtype), precision=prec,
+                    preferred_element_type=jnp.float32,
+                )
+                return jnp.matmul(
+                    xb.T, xv.astype(xb.dtype), precision=prec,
+                    preferred_element_type=jnp.float32,
+                ) / n
+
+            return subspace_iteration(
+                mv, d, k, iters=iters, orth=orth, v0=v0
+            )
         g = gram_auto(xb) if use_pallas else gram(xb)
         if solver == "subspace":
             return subspace_iteration(

@@ -142,3 +142,33 @@ def test_planted_spectrum_properties():
     emp = x.T @ x / len(x)
     want = (q * lam) @ q.T
     assert np.abs(emp - want).max() < 0.5
+
+
+def test_planted_subspace_low_rank_model(rng):
+    """PlantedSubspace: exact top-k oracle, device-side sampling, sample
+    covariance concentrates on the planted directions."""
+    import jax
+
+    from distributed_eigenspaces_tpu.data.synthetic import planted_subspace
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+        top_k_eigvecs,
+    )
+
+    d, r = 96, 5
+    spec = planted_subspace(d, k_planted=r, gap=25.0, noise=0.01, seed=4)
+    q = np.asarray(spec.top_k(r))
+    np.testing.assert_allclose(q.T @ q, np.eye(r), atol=1e-5)
+    with pytest.raises(ValueError):
+        spec.top_k(r + 1)
+
+    import jax.numpy as jnp
+
+    x = np.asarray(spec.sample(jax.random.PRNGKey(0), 4096))
+    assert x.shape == (4096, d)
+    g = jnp.asarray(x.T @ x / len(x))
+    v = np.asarray(top_k_eigvecs(g, r))
+    ang = np.asarray(
+        principal_angles_degrees(jnp.asarray(v), jnp.asarray(q))
+    )
+    assert ang.max() < 2.0, ang
