@@ -191,11 +191,13 @@ def run_eval(
 
         n_dev = len(jax.devices())
         if spec.backend == "feature_sharded" and n_dev >= 2:
-            feats = 2 if d % 2 == 0 else 1
-            workers = min(m, max(n_dev // feats, 1))
-            while m % workers:
-                workers -= 1
-            mesh = make_mesh(num_workers=workers, num_feature_shards=feats)
+            # one definition of the layout policy (also honors
+            # cfg.mesh_shape when a caller overrides it)
+            from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+                auto_feature_mesh,
+            )
+
+            mesh = auto_feature_mesh(cfg)
         elif spec.backend == "shard_map" and n_dev >= 2:
             workers = m
             while workers > 1 and (m % workers or workers > n_dev):
@@ -246,13 +248,15 @@ def run_eval(
 
         fd, bin_path = tempfile.mkstemp(suffix=".bin")
         os.close(fd)
+        # one device->host conversion per distinct block, not per step (a
+        # per-step np.asarray would re-fetch ~50 MB over the slow link)
+        host_bytes = [
+            np.asarray(b).reshape(step_rows, d).tobytes()
+            for b in host_blocks
+        ]
         with open(bin_path, "wb") as f:
             for s in range(spec.steps):
-                f.write(
-                    np.asarray(host_blocks[s % n_distinct])
-                    .reshape(step_rows, d)
-                    .tobytes()
-                )
+                f.write(host_bytes[s % n_distinct])
 
     if spec.streaming == "memory":
         # pre-stage distinct blocks on device (cycled during timing) so the

@@ -97,19 +97,31 @@ def _small_eigh_desc(g):
     return w[..., ::-1], q[..., ::-1]
 
 
-def worker_subspace_sharded(x, k, iters, n_total_rows, key):
+def worker_subspace_sharded(x, k, iters, n_total_rows, key, collectives="xla"):
     """Per-worker top-k eigenspaces with the feature dim sharded.
 
     ``x``: (m_local, n, d_local) — this device's row-block columns for its
     local workers. Returns (m_local, d_local, k) orthonormal (globally, over
-    the features axis) eigenvector shards.
+    the features axis) eigenvector shards. ``collectives="ring"`` reduces
+    the (m, n, k) partial products with the explicit ``ppermute`` ring
+    schedule (``parallel/ring.py``) instead of ``psum`` — same result,
+    neighbor-only traffic per hop.
     """
     m_local, n, d_local = x.shape
+
+    if collectives == "ring":
+        from distributed_eigenspaces_tpu.parallel.ring import ring_psum
+
+        reduce_features = lambda t: ring_psum(t, FEATURE_AXIS)  # noqa: E731
+    else:
+        reduce_features = lambda t: jax.lax.psum(  # noqa: E731
+            t, FEATURE_AXIS
+        )
 
     def matvec(v):
         # v: (m_local, d_local, k). X V reduces over the sharded d axis.
         xv = jnp.einsum("mnd,mdk->mnk", x, v, precision=HP)
-        xv = jax.lax.psum(xv, FEATURE_AXIS)
+        xv = reduce_features(xv)
         return (
             jnp.einsum("mnd,mnk->mdk", x, xv, precision=HP) / n_total_rows
         )
@@ -200,6 +212,7 @@ def make_feature_sharded_step(
     *,
     rank: int | None = None,
     seed: int = 0,
+    collectives: str = "xla",
 ):
     """Build the fully-sharded training step for the ``(workers, features)``
     mesh: ``step(state, x_blocks) -> (state, v_bar)``.
@@ -207,7 +220,11 @@ def make_feature_sharded_step(
     ``x_blocks`` (m, n, d) is sharded ``P(workers, None, features)``;
     ``state.u`` (d, r) is sharded ``P(features, None)``; ``v_bar`` (d, k)
     comes back sharded ``P(features, None)``. One jit, zero host hops.
+    ``collectives="ring"`` swaps the matvec reduction onto the explicit
+    ``ppermute`` ring schedule (``parallel/ring.py``).
     """
+    if collectives not in ("xla", "ring"):
+        raise ValueError(f"unknown collectives mode: {collectives!r}")
     k, iters = cfg.k, cfg.subspace_iters
     r = rank if rank is not None else min(cfg.dim, 2 * k + 8)
     m, n = cfg.num_workers, cfg.rows_per_worker
@@ -228,7 +245,7 @@ def make_feature_sharded_step(
 
     def sharded(state, x):
         # x: (m_local, n, d_local); state.u: (d_local_f, r)
-        vws = worker_subspace_sharded(x, k, iters, n, key)
+        vws = worker_subspace_sharded(x, k, iters, n, key, collectives)
         v_bar = merged_lowrank_sharded(vws, k)
         w, keep = weights(state.step)
         new_state = _lowrank_update(state, v_bar, w, keep, axis_name=FEATURE_AXIS)

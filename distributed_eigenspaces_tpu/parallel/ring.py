@@ -1,0 +1,90 @@
+"""Explicit ring collectives over a mesh axis (``ppermute`` schedules).
+
+The default compute paths use ``jax.lax.psum`` / ``all_gather`` and let XLA
+lower them — on ICI meshes XLA already picks ring/bidirectional-ring
+algorithms, so these are normally the right choice. This module provides the
+same reductions as EXPLICIT neighbor-exchange rings, the communication
+pattern ring attention / ring self-attention use for sequence parallelism
+(this workload's sequence-parallel slot is the feature axis, SURVEY.md §5.7):
+
+- each hop moves data only between ring neighbors (``ppermute`` with a
+  cyclic permutation), so per-hop traffic and memory are constant in the
+  axis size;
+- the per-hop compute (``+`` here; a block matmul in the matvec variant)
+  sits inside the loop with the permute, so XLA can overlap a hop's
+  collective with the previous hop's compute — the property that makes
+  ring schedules attractive when the reduced operand is large.
+
+``ring_psum`` is the production entry point — it is what
+``parallel/feature_sharded.py`` wires into its matvec reduction when built
+with ``collectives="ring"``; ``ring_all_gather`` is its gather twin.
+Equivalence with the XLA collectives is tested on the 8-device CPU mesh
+(tests/test_ring.py), including through a full feature-sharded training
+step.
+
+There is no counterpart anywhere in the reference — its only "collective"
+is JSON messages through a RabbitMQ broker (``distributed.py:51``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _ring_perm(axis_name):
+    """Cyclic +1 neighbor permutation for the named mesh axis."""
+    size = jax.lax.axis_size(axis_name)
+    return [(i, (i + 1) % size) for i in range(size)]
+
+
+def ring_psum(x: jax.Array, axis_name: str) -> jax.Array:
+    """All-reduce-sum over ``axis_name`` as an explicit ring.
+
+    Every device passes its running copy to the next ring neighbor
+    ``size - 1`` times, adding what it receives: after the loop each device
+    holds the full sum. Same result as ``jax.lax.psum(x, axis_name)`` (up
+    to fp addition order, which is fixed and deterministic here).
+    """
+    size = jax.lax.axis_size(axis_name)
+    perm = _ring_perm(axis_name)
+
+    def hop(_, carry):
+        acc, cur = carry
+        cur = jax.lax.ppermute(cur, axis_name, perm)
+        return acc + cur, cur
+
+    acc, _ = jax.lax.fori_loop(0, size - 1, hop, (x, x))
+    return acc
+
+
+def ring_all_gather(x: jax.Array, axis_name: str) -> jax.Array:
+    """All-gather over ``axis_name`` as an explicit ring.
+
+    Returns the same ``(size * x.shape[0], ...)`` tiled concatenation as
+    ``jax.lax.all_gather(x, axis_name, axis=0, tiled=True)``, assembled by
+    rotating shards around the ring and placing each at its source index.
+    """
+    size = jax.lax.axis_size(axis_name)
+    perm = _ring_perm(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    n_local = x.shape[0]
+    out = jnp.zeros((size * n_local,) + x.shape[1:], x.dtype)
+
+    def place(out, shard, src):
+        return jax.lax.dynamic_update_slice_in_dim(
+            out, shard, src * n_local, axis=0
+        )
+
+    def hop(i, carry):
+        out, cur = carry
+        cur = jax.lax.ppermute(cur, axis_name, perm)
+        # after i+1 forward hops we hold the shard of the device i+1 behind
+        src = (idx - (i + 1)) % size
+        return place(out, cur, src), cur
+
+    out = place(out, x, idx)
+    out, _ = jax.lax.fori_loop(0, size - 1, hop, (out, x))
+    return out
+
+

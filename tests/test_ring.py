@@ -1,0 +1,148 @@
+"""Explicit ring collectives (parallel/ring.py) vs the XLA collectives,
+on the 8-virtual-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.parallel.ring import (
+    ring_all_gather,
+    ring_psum,
+)
+
+pytestmark = pytest.mark.usefixtures("devices")
+
+
+def _mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]), ("ax",))
+
+
+def _run(fn, *args, in_specs, out_specs):
+    from jax.sharding import PartitionSpec as P  # noqa: F401
+
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=_mesh(), in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )(*args)
+
+
+def test_ring_psum_matches_psum(rng):
+    from jax.sharding import PartitionSpec as P
+
+    x = rng.standard_normal((8, 4, 5)).astype(np.float32)
+    got = _run(
+        lambda s: ring_psum(s, "ax"),
+        jnp.asarray(x),
+        in_specs=(P("ax"),),
+        out_specs=P(),
+    )
+    want = _run(
+        lambda s: jax.lax.psum(s, "ax"),
+        jnp.asarray(x),
+        in_specs=(P("ax"),),
+        out_specs=P(),
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got), x.sum(0)[None], atol=1e-5)
+
+
+def test_ring_all_gather_matches_all_gather(rng):
+    from jax.sharding import PartitionSpec as P
+
+    x = rng.standard_normal((16, 3)).astype(np.float32)  # 2 rows/device
+    got = _run(
+        lambda s: ring_all_gather(s, "ax"),
+        jnp.asarray(x),
+        in_specs=(P("ax"),),
+        out_specs=P(),
+    )
+    want = _run(
+        lambda s: jax.lax.all_gather(s, "ax", axis=0, tiled=True),
+        jnp.asarray(x),
+        in_specs=(P("ax"),),
+        out_specs=P(),
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=0)
+    np.testing.assert_allclose(np.asarray(got), x, atol=0)
+
+
+def test_ring_reduced_matvec_matches_dense(rng):
+    """X^T(XV)/n with X column-sharded, partials reduced by ring_psum (the
+    composition worker_subspace_sharded uses with collectives='ring'),
+    equals the dense single-device computation."""
+    from jax.sharding import PartitionSpec as P
+
+    n, d, k = 64, 32, 3  # d splits 8 ways into 4-column shards
+
+    def sharded_matvec(xs, vs):
+        xv = ring_psum(jnp.matmul(xs, vs), "ax")
+        return jnp.matmul(xs.T, xv) / n
+
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    v = rng.standard_normal((d, k)).astype(np.float32)
+    got = _run(
+        sharded_matvec,
+        jnp.asarray(x),
+        jnp.asarray(v),
+        in_specs=(P(None, "ax"), P("ax", None)),
+        out_specs=P("ax", None),
+    )
+    want = x.T @ (x @ v) / n
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+
+
+def test_feature_sharded_ring_collectives_match_xla(rng):
+    """The feature-sharded training step built with collectives='ring'
+    produces the same state trajectory as the XLA-collectives build."""
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        make_feature_sharded_step,
+    )
+    from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+    d, k, m, n = 64, 3, 4, 128
+    cfg = PCAConfig(
+        dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=3,
+        subspace_iters=20,
+    )
+    mesh = make_mesh(num_workers=4, num_feature_shards=2)
+    spec = planted_spectrum(d, k_planted=k, gap=25.0, noise=0.01, seed=2)
+    x = jnp.asarray(
+        np.asarray(spec.sample(jax.random.PRNGKey(0), m * n)).reshape(
+            m, n, d
+        )
+    )
+
+    outs = {}
+    for mode in ("xla", "ring"):
+        step = make_feature_sharded_step(
+            cfg, mesh, seed=0, collectives=mode
+        )
+        state, v_bar = step(step.init_state(), x)
+        outs[mode] = (np.asarray(state.u), np.asarray(v_bar))
+    np.testing.assert_allclose(
+        outs["xla"][0], outs["ring"][0], atol=5e-4
+    )
+    np.testing.assert_allclose(
+        outs["xla"][1], outs["ring"][1], atol=5e-4
+    )
+
+
+def test_feature_sharded_bad_collectives():
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        make_feature_sharded_step,
+    )
+    from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+    cfg = PCAConfig(dim=16, k=2, num_workers=4, rows_per_worker=8)
+    with pytest.raises(ValueError):
+        make_feature_sharded_step(
+            cfg, make_mesh(num_workers=4), collectives="nccl"
+        )
