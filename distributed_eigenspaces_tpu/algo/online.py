@@ -115,6 +115,11 @@ def online_distributed_pca(
       ``(w, state)`` — ``w`` the final (dim, k) principal subspace estimate
       (descending order, canonical signs), ``state`` the final online state.
     """
+    if pool is None and cfg.backend == "feature_sharded":
+        return _fit_feature_sharded(
+            stream, cfg, state=state, on_step=on_step,
+            worker_masks=worker_masks, max_steps=max_steps,
+        )
     if pool is None:
         pool = WorkerPool(
             cfg.num_workers,
@@ -128,10 +133,38 @@ def online_distributed_pca(
     if state is None:
         state = OnlineState.initial(cfg.dim, cfg.state_dtype)
 
+    update = jax.jit(
+        lambda s, v: update_state(
+            s, v, discount=cfg.discount, num_steps=cfg.num_steps
+        )
+    )
+
+    def step(st, x_blocks):
+        mask = next(worker_masks) if worker_masks is not None else None
+        # pool.shard is idempotent, so prefetch-placed blocks pass through
+        _, v_bar = pool.round(pool.shard(x_blocks), cfg.k, worker_mask=mask)
+        return update(st, v_bar), v_bar
+
+    state = _drive_stream(
+        stream, cfg, place=pool.shard, step=step, state=state,
+        on_step=on_step, max_steps=max_steps,
+    )
+    w = top_k_eigvecs(state.sigma_tilde, cfg.k)
+    return w, state
+
+
+def _drive_stream(stream, cfg, *, place, step, state, on_step, max_steps):
+    """Shared training-loop scaffolding for the per-step backends: prefetch
+    wiring, the step cap (open-ended for 1/t running means), step
+    bookkeeping, and deterministic prefetch-producer cleanup.
+
+    ``step(state, x) -> (state, v_bar)``; ``place`` stages a host block on
+    the backend's devices (must be idempotent — the prefetch producer
+    applies it ahead of the loop).
+    """
     if cfg.prefetch_depth > 0:
         # overlap host block prep + host->HBM transfer with device compute
-        # (the reference's 5-in-flight AMQP window, done as a real pipeline;
-        # pool.shard is idempotent so the loop's shard call stays a no-op).
+        # (the reference's 5-in-flight AMQP window, done as a real pipeline).
         # NOTE: the producer reads ahead, so the caller's underlying
         # iterable may be advanced past the last consumed step — pass
         # prefetch_depth=0 when sharing an iterator across fit calls.
@@ -139,15 +172,7 @@ def online_distributed_pca(
             prefetch_stream,
         )
 
-        stream = prefetch_stream(
-            stream, depth=cfg.prefetch_depth, place=pool.shard
-        )
-
-    update = jax.jit(
-        lambda s, v: update_state(
-            s, v, discount=cfg.discount, num_steps=cfg.num_steps
-        )
-    )
+        stream = prefetch_stream(stream, depth=cfg.prefetch_depth, place=place)
 
     cap = cfg.num_steps if max_steps == "auto" else max_steps
     steps_done = int(state.step)
@@ -155,10 +180,7 @@ def online_distributed_pca(
         for x_blocks in stream:
             if cap is not None and steps_done >= cap and cfg.discount != "1/t":
                 break
-            mask = next(worker_masks) if worker_masks is not None else None
-            x_blocks = pool.shard(x_blocks)
-            _, v_bar = pool.round(x_blocks, cfg.k, worker_mask=mask)
-            state = update(state, v_bar)
+            state, v_bar = step(state, x_blocks)
             steps_done += 1
             if on_step is not None:
                 on_step(steps_done, state, v_bar)
@@ -168,8 +190,48 @@ def online_distributed_pca(
         close = getattr(stream, "close", None)
         if close is not None:
             close()
+    return state
 
-    w = top_k_eigvecs(state.sigma_tilde, cfg.k)
+
+def _fit_feature_sharded(
+    stream,
+    cfg: PCAConfig,
+    *,
+    state=None,
+    on_step=None,
+    worker_masks=None,
+    max_steps="auto",
+):
+    """The large-d backend behind :func:`online_distributed_pca`: routes the
+    same stream/loop semantics through the feature-sharded training step
+    (``parallel/feature_sharded.py`` — d sharded over a second mesh axis,
+    no d x d matrix anywhere, rank-r online state).
+    """
+    from distributed_eigenspaces_tpu.ops.linalg import canonicalize_signs
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        auto_feature_mesh,
+        make_feature_sharded_step,
+    )
+
+    if worker_masks is not None:
+        raise NotImplementedError(
+            "worker_masks is not supported on the feature_sharded backend "
+            "yet — use backend='shard_map' for fault-injection runs"
+        )
+    mesh = auto_feature_mesh(cfg)
+    fstep = make_feature_sharded_step(cfg, mesh, seed=cfg.seed)
+    if state is None:
+        state = fstep.init_state()
+
+    place = lambda x: jax.device_put(  # noqa: E731
+        jnp.asarray(x), fstep.x_sharding
+    )
+    state = _drive_stream(
+        stream, cfg, place=place,
+        step=lambda st, x: fstep(st, place(x)),
+        state=state, on_step=on_step, max_steps=max_steps,
+    )
+    w = canonicalize_signs(state.u[:, : cfg.k])
     return w, state
 
 

@@ -306,11 +306,9 @@ def auto_feature_mesh(cfg: PCAConfig) -> Mesh:
             num_workers=cfg.mesh_shape.get(WORKER_AXIS),
             num_feature_shards=cfg.mesh_shape.get(FEATURE_AXIS, 1),
         )
+    from distributed_eigenspaces_tpu.parallel.mesh import largest_divisor_leq
+
     n_dev = len(jax.devices())
     feats = 2 if (n_dev >= 2 and n_dev % 2 == 0 and cfg.dim % 2 == 0) else 1
-    cap = max(n_dev // feats, 1)
-    workers = next(
-        s for s in range(min(cfg.num_workers, cap), 0, -1)
-        if cfg.num_workers % s == 0
-    )
+    workers = largest_divisor_leq(cfg.num_workers, max(n_dev // feats, 1))
     return make_mesh(num_workers=workers, num_feature_shards=feats)

@@ -56,6 +56,16 @@ def make_mesh(
     return Mesh(grid, (WORKER_AXIS, FEATURE_AXIS))
 
 
+def largest_divisor_leq(m: int, cap: int) -> int:
+    """Largest divisor of ``m`` that is <= ``cap`` — the shared policy for
+    sizing a worker axis that must divide the worker count (WorkerPool's
+    auto mesh, the CLI scan trainer's mesh, auto_feature_mesh)."""
+    for s in range(min(m, cap), 0, -1):
+        if m % s == 0:
+            return s
+    return 1
+
+
 def worker_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for per-worker data blocks ``(m, n, d)``: split axis 0 over
     ``workers``, features replicated (1-D DP layout)."""

@@ -37,6 +37,7 @@ from distributed_eigenspaces_tpu.ops.linalg import (
 )
 from distributed_eigenspaces_tpu.parallel.mesh import (
     WORKER_AXIS,
+    largest_divisor_leq,
     make_mesh,
     worker_sharding,
 )
@@ -185,7 +186,7 @@ class WorkerPool:
         if backend == "shard_map":
             if mesh is None:
                 n_dev = len(jax.devices())
-                shards = _largest_divisor_leq(num_workers, n_dev)
+                shards = largest_divisor_leq(num_workers, n_dev)
                 mesh = make_mesh(num_workers=shards)
             axis = mesh.shape[WORKER_AXIS]
             if num_workers % axis:
@@ -294,9 +295,3 @@ class WorkerPool:
         return round_sharded
 
 
-def _largest_divisor_leq(m: int, cap: int) -> int:
-    """Largest divisor of ``m`` that is <= ``cap`` (worker-axis size)."""
-    for s in range(min(m, cap), 0, -1):
-        if m % s == 0:
-            return s
-    return 1

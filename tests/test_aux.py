@@ -297,3 +297,67 @@ def test_config_validation_errors():
             PCAConfig(dim=16, k=bad.pop("k", 4), **bad)
     # the north-star alias is accepted
     assert PCAConfig(dim=16, k=4, backend="tpu").backend == "tpu"
+
+
+def test_cli_scan_trainer(tmp_path):
+    out = tmp_path / "w.npy"
+    r = _run_cli(
+        "--mode", "fit", "--data", "synthetic", "--dim", "96",
+        "--rank", "3", "--workers", "4", "--steps", "5",
+        "--solver", "subspace", "--trainer", "scan",
+        "--warm-start-iters", "2", "--save", str(out),
+    )
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["trainer"] == "scan" and rep["steps"] == 5
+    assert rep["principal_angle_deg"] <= 1.0, rep
+    w = np.load(out)
+    assert w.shape == (96, 3)
+
+
+def test_cli_feature_sharded_backend():
+    r = _run_cli(
+        "--mode", "fit", "--data", "synthetic", "--dim", "96",
+        "--rank", "3", "--workers", "4", "--steps", "5",
+        "--solver", "subspace", "--backend", "feature_sharded",
+        "--metrics",
+    )
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["final_principal_angle_deg"] <= 1.5, rep
+
+
+def test_estimator_feature_sharded_backend(devices):
+    """backend='feature_sharded' routes through the estimator API: fit,
+    transform, components_, planted-subspace accuracy."""
+    import jax
+
+    from distributed_eigenspaces_tpu.api.estimator import (
+        OnlineDistributedPCA,
+    )
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+
+    d, k, m, n, T = 96, 3, 4, 128, 6
+    spec = planted_spectrum(d, k_planted=k, gap=25.0, noise=0.01, seed=8)
+    data = np.asarray(spec.sample(jax.random.PRNGKey(0), m * n * T))
+    cfg = PCAConfig(
+        dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=T,
+        solver="subspace", subspace_iters=24, backend="feature_sharded",
+    )
+    pca = OnlineDistributedPCA(cfg).fit(data)
+    assert pca.components_.shape == (d, k)
+    ang = float(
+        jnp.max(principal_angles_degrees(pca.components_, spec.top_k(k)))
+    )
+    assert ang <= 1.0, ang
+    z = pca.transform(data[:50])
+    assert z.shape == (50, k)
+    # worker_masks unsupported on this backend: loud error, not silence
+    with pytest.raises(NotImplementedError):
+        OnlineDistributedPCA(cfg).fit(
+            data, worker_masks=iter([jnp.ones((m,))])
+        )
