@@ -115,7 +115,13 @@ def online_distributed_pca(
       ``(w, state)`` — ``w`` the final (dim, k) principal subspace estimate
       (descending order, canonical signs), ``state`` the final online state.
     """
-    if pool is None and cfg.backend == "feature_sharded":
+    if cfg.backend == "feature_sharded":
+        if pool is not None:
+            raise ValueError(
+                "backend='feature_sharded' builds its own 2-D mesh step — "
+                "an explicit WorkerPool cannot drive it (drop the pool "
+                "argument, or use backend='shard_map' with your pool)"
+            )
         return _fit_feature_sharded(
             stream, cfg, state=state, on_step=on_step,
             worker_masks=worker_masks, max_steps=max_steps,

@@ -242,6 +242,14 @@ def main(argv=None) -> int:
     n_total, dim = data.shape
 
     if args.mode in ("oneshot", "master"):
+        if args.backend == "feature_sharded":
+            print(
+                "error: --mode oneshot runs a single WorkerPool round; "
+                "--backend feature_sharded is only available with "
+                "--mode fit (use --backend shard_map here)",
+                file=sys.stderr,
+            )
+            return 2
         # reference master semantics (one round), result actually produced
         m = args.batches or args.workers
         rows = n_total // m

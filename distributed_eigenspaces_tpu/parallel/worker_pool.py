@@ -70,11 +70,19 @@ def _local_eigenspaces(
     use_pallas = os.environ.get("DET_NO_PALLAS", "0") != "1"
 
     d = x_blocks.shape[2]
-    # Large-d subspace solves never materialize the d x d Gram (SURVEY.md §7
-    # hard part (a)): apply the covariance as X^T (X v) / n per iteration —
-    # fewer FLOPs than forming the Gram whenever 2*k*iters << d, and O(d*k)
-    # memory instead of O(d^2) (600 MB/worker at the 12288-d config).
-    streaming = solver == "subspace" and d >= 4096 and 2 * k * iters < d
+    # Streaming subspace solves apply the covariance as X^T (X v) / n and
+    # never materialize the d x d Gram (SURVEY.md §7 hard part (a)):
+    # mandatory at large d (O(d*k) memory instead of the 600 MB/worker d^2
+    # at the 12288-d config), and also faster at small d when the
+    # iteration count is low — each iteration re-reads X (2 passes), while
+    # the Gram path pays the n*d^2 contraction up front; measured crossover
+    # on TPU v5e at d=1024, n=4096, k=8 is ~6 iterations (BASELINE.md),
+    # which is why the warm-started scan steps (1-4 iters) stream.
+    streaming = (
+        solver == "subspace"
+        and 2 * k * iters < d
+        and (d >= 4096 or iters <= 6)
+    )
 
     def one(xb):
         if compute_dtype is not None:
