@@ -78,10 +78,11 @@ def _local_eigenspaces(
     # the Gram path pays the n*d^2 contraction up front; measured crossover
     # on TPU v5e at d=1024, n=4096, k=8 is ~6 iterations (BASELINE.md),
     # which is why the warm-started scan steps (1-4 iters) stream.
-    streaming = (
-        solver == "subspace"
-        and 2 * k * iters < d
-        and (d >= 4096 or iters <= 6)
+    # At d >= 4096 streaming is unconditional — memory correctness (no d^2
+    # allocation) outranks the FLOP trade-off even when k*iters is large.
+    # Below that, stream only when it is the cheaper schedule.
+    streaming = solver == "subspace" and (
+        d >= 4096 or (2 * k * iters < d and iters <= 6)
     )
 
     def one(xb):
