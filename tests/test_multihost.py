@@ -1,5 +1,7 @@
-"""Multi-host path (parallel/multihost.py) on the single-process degenerate
-case over 8 virtual devices — the same code path a pod runs, minus DCN.
+"""Multi-host path (parallel/multihost.py): the single-process degenerate
+case over 8 virtual devices, plus a REAL two-OS-process run (gloo/gRPC
+cross-process collectives — the DCN control plane) checked against the
+single-process reference.
 """
 
 import jax
@@ -75,3 +77,109 @@ def test_multihost_step_matches_single_device(devices):
         atol=2e-4,
     )
     assert int(state.step) == 1
+
+
+def test_two_process_dcn_step():
+    """REAL multi-process execution: two OS processes rendezvous via
+    jax.distributed (gloo/gRPC — the DCN control plane), each owning half
+    the workers with 2 virtual CPU devices, and one training step produces
+    identical replicated results on both hosts, matching the single-process
+    reference."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    # ONE definition of the problem, injected into the child script and
+    # exec'd for the parent reference below — the two sides cannot drift
+    problem = textwrap.dedent(
+        """
+        import numpy as np
+        from distributed_eigenspaces_tpu.config import PCAConfig
+        M, N, D, K = 4, 64, 32, 2
+        FULL = np.random.default_rng(0).standard_normal(
+            (M, N, D)).astype(np.float32)
+        CFG = PCAConfig(dim=D, k=K, num_workers=M, rows_per_worker=N,
+                        num_steps=3, solver="subspace", subspace_iters=20)
+        """
+    )
+    script = textwrap.dedent(
+        """
+        import sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        pid = int(sys.argv[1])
+        jax.distributed.initialize(coordinator_address=sys.argv[2],
+                                   num_processes=2, process_id=pid)
+        import numpy as np
+        import distributed_eigenspaces_tpu.parallel.multihost as mh
+        from distributed_eigenspaces_tpu.algo.online import OnlineState
+        {problem}
+        assert jax.process_count() == 2
+        mesh = mh.global_mesh(num_workers=M)
+        shard = mh.host_worker_range(M)
+        step = mh.make_multihost_train_step(CFG, mesh)
+        st = mh.replicate_to_hosts(OnlineState.initial(D), mesh)
+        st, v = step(st, FULL[shard.lo:shard.hi])
+        print("CHECKSUM %.8f" % float(np.sum(mh.fetch_replicated(v))))
+        """
+    ).format(problem=problem)  # both are dedented to column 0
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        JAX_PLATFORMS="cpu",
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(i), f"127.0.0.1:{port}"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    sums = []
+    try:
+        for i, p in enumerate(procs):
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
+            line = [
+                l for l in out.splitlines() if l.startswith("CHECKSUM")
+            ][-1]
+            sums.append(float(line.split()[1]))
+    finally:
+        # never leak a child blocked in the rendezvous when the sibling
+        # died or an assert above fired
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert sums[0] == sums[1], sums
+
+    # single-process reference of the same step on this pytest process's
+    # 8-device mesh (exact same problem block)
+    from distributed_eigenspaces_tpu.algo.step import make_train_step
+    from distributed_eigenspaces_tpu.parallel.mesh import (
+        make_mesh,
+        replicated_sharding,
+        worker_sharding,
+    )
+
+    ns = {}
+    exec(problem, ns)
+    mesh = make_mesh(num_workers=ns["M"])
+    step = make_train_step(ns["CFG"], mesh=mesh)
+    st = jax.device_put(
+        OnlineState.initial(ns["D"]), replicated_sharding(mesh)
+    )
+    st, v = step(
+        st, jax.device_put(jnp.asarray(ns["FULL"]), worker_sharding(mesh))
+    )
+    ref = float(np.sum(np.asarray(v)))
+    assert abs(ref - sums[0]) < 1e-4, (ref, sums[0])
