@@ -43,13 +43,20 @@ def make_round_core(cfg: PCAConfig, iters: int | None = None):
     (the warm-start trainer uses a short-iteration core for steps > 0);
     ``v0`` warm-starts the per-worker subspace iterations.
     """
+    from distributed_eigenspaces_tpu.ops.pallas_xtxv import resolve_fused
+
     k, solver = cfg.k, cfg.solver
     if iters is None:
         iters = cfg.subspace_iters
     orth, cdtype = cfg.orth_method, cfg.compute_dtype
+    # resolved at build time (an env read under jit is frozen by the trace
+    # cache — resolving here makes the contract explicit)
+    fused = resolve_fused()
 
     def round_core(x_blocks, axis_name=None, v0=None):
-        vs = _local_eigenspaces(x_blocks, k, solver, iters, orth, cdtype, v0)
+        vs = _local_eigenspaces(
+            x_blocks, k, solver, iters, orth, cdtype, v0, fused_xtxv=fused
+        )
         if axis_name is not None:
             # the entire reference wire protocol (C11) is this one gather
             # of d x k factors — m*d*k floats over ICI, vs the d*d psum a

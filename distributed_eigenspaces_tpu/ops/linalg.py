@@ -309,9 +309,15 @@ def subspace_iteration(
         return orthonormalize(matvec(v), orth)
 
     v = jax.lax.fori_loop(0, iters, body, v)
-    # Rayleigh–Ritz: rotate the converged basis to eigenvector coordinates so
-    # columns come out in descending-eigenvalue order like top_k_eigvecs.
-    av = matvec(v)
+    return rayleigh_ritz(v, matvec(v))
+
+
+def rayleigh_ritz(v: jax.Array, av: jax.Array) -> jax.Array:
+    """Rotate a converged orthonormal basis ``v (d, k)`` to eigenvector
+    coordinates of the operator, given ``av = A @ v``: columns come out in
+    descending-eigenvalue order with canonical signs (matching
+    :func:`top_k_eigvecs`). THE shared tail of every iterative solver
+    (``subspace_iteration`` and the batched streaming solver vmap it)."""
     small = jnp.matmul(v.T, av, precision=lax.Precision.HIGHEST)  # (k, k) sym
     with jax.default_matmul_precision("highest"):
         _, r = jnp.linalg.eigh(0.5 * (small + small.T))

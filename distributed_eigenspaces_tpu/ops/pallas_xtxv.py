@@ -1,0 +1,186 @@
+"""Pallas TPU kernel: fused covariance matvec ``X^T (X V)`` in ONE pass
+over X, batched over workers.
+
+The streaming subspace solver's hot op (the warm online steps,
+BASELINE.md "what makes it fast" item 6) is ``X^T (X V) / n`` per worker.
+As two XLA matmuls it reads the (n, d) block from HBM twice — once for
+``X V`` and once for ``X^T (X V)`` — and round-trips the (n, k)
+intermediate through HBM. This kernel streams X through VMEM in row blocks
+and computes BOTH products per block while it is resident:
+
+    per (worker b, row-block i):  xv = X_bi @ V_b      (bn, k)   MXU
+                                  acc_b += X_bi^T @ xv (d, k)    MXU, fp32
+
+halving the dominant HBM traffic of the warm path.
+
+The worker axis is a NATIVE grid dimension (grid = (m, n/block_n)), not
+``jax.vmap``: Pallas's vmap batching rule prepends the batch dimension to
+the grid, which silently re-targets the ``program_id`` used by the
+accumulator's zero-init guard — the classic footgun for reduction kernels.
+Callers invoke this on the full (m, n, d) stack outside any vmap
+(``worker_pool._batched_streaming_eigenspaces``).
+
+Shape domain: ``d * block_n`` elements per X tile must fit VMEM — enforced
+by :func:`xtxv_auto`'s gates, which otherwise fall back to the batched
+two-einsum XLA path (identical math, tested against each other in
+tests/test_pallas_xtxv.py; ``interpret=True`` runs the kernel on CPU).
+fp32 inputs always take the fallback: the XLA path runs at HIGHEST
+precision while in-kernel dots run MXU-native (measured ~3e-3 relative
+divergence on fp32 operands on v5e) — the fused win is reserved for the
+bf16 fast path where the numerics already match.
+
+MEASURED (v5e, benchmark shape d=1024/n=4096/k=8/m=8, bf16): even batched,
+the fused kernel does not beat XLA's pipelined two-matmul schedule
+end-to-end at this size, so it is OPT-IN (``DET_FUSED_XTXV=1`` read at
+WorkerPool/round-core build time) — kept for shapes where HBM traffic
+dominates and as the template for future fusions. See BASELINE.md.
+
+No reference counterpart: the reference's only covariance op is a dense
+``np.dot(x.T, x)`` (``distributed.py:67-69``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _xtxv_kernel(x_ref, v_ref, out_ref):
+    """Grid (m, n/block_n): accumulate X_b^T (X_b V_b) over row blocks.
+
+    The row-block axis is grid dim 1 (innermost, "arbitrary"): the (d, k)
+    accumulator block stays in VMEM across it and is zeroed on its first
+    visit. Grid dim 0 is the worker axis ("parallel" — distinct output
+    blocks).
+    """
+
+    @pl.when(pl.program_id(1) == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    xb = x_ref[0]  # (block_n, d), resident for BOTH products
+    xv = jax.lax.dot_general(
+        xb,
+        v_ref[0],
+        dimension_numbers=(((1,), (0,)), ((), ())),  # (bn, d) @ (d, k)
+        preferred_element_type=jnp.float32,
+    )
+    out_ref[0, :, :] += jax.lax.dot_general(
+        xb,
+        xv.astype(xb.dtype),
+        dimension_numbers=(((0,), (0,)), ((), ())),  # contract rows: X^T xv
+        preferred_element_type=jnp.float32,
+    )
+
+
+@partial(jax.jit, static_argnames=("block_n", "interpret"))
+def xtxv_pallas(
+    x: jax.Array,
+    v: jax.Array,
+    *,
+    block_n: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """``(m, n, d), (m, d, k) -> (m, d, k)`` fused ``X^T (X v)`` per worker
+    (unnormalized).
+
+    Requires ``n % block_n == 0`` (callers fall back — :func:`xtxv_auto`).
+    The second contraction feeds ``xv`` back to the MXU in ``x``'s dtype
+    (bf16 inputs keep full MXU rate), with fp32 accumulation — matching the
+    two-einsum streaming path numerics for bf16 operands.
+    """
+    m, n, d = x.shape
+    k = v.shape[2]
+    if n % block_n:
+        raise ValueError(f"n={n} not divisible by block_n={block_n}")
+    return pl.pallas_call(
+        _xtxv_kernel,
+        grid=(m, n // block_n),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_n, d),
+                lambda b, i: (b, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, d, k), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, d, k), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, d, k), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x, v.astype(x.dtype))
+
+
+# VMEM budget for one X tile (bytes); v5e has ~16 MB of VMEM per core and
+# the tile shares it with v, xv, and the fp32 accumulator
+_X_TILE_BUDGET = 4 * 1024 * 1024
+
+
+def _pick_block_n(n: int, d: int, itemsize: int) -> int | None:
+    """Largest 128-multiple divisor of n whose (bn, d) tile fits the
+    budget; None when no aligned block fits."""
+    cap = _X_TILE_BUDGET // max(d * itemsize, 1)
+    best = None
+    for b in range(min(n, cap), 127, -1):
+        if n % b == 0 and b % 128 == 0:
+            best = b
+            break
+    return best
+
+
+def xtxv_fallback(x: jax.Array, v: jax.Array) -> jax.Array:
+    """The batched two-einsum path — THE definition of the streaming matvec
+    numerics (the kernel must match it for bf16; fp32 runs only here,
+    at HIGHEST precision)."""
+    prec = jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
+    xv = jnp.einsum(
+        "mnd,mdk->mnk", x, v.astype(x.dtype), precision=prec,
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.einsum(
+        "mnd,mnk->mdk", x, xv.astype(x.dtype), precision=prec,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def resolve_fused(explicit: bool | None = None) -> bool:
+    """THE build-time resolution of the fused-kernel opt-in, shared by every
+    solver-building site (WorkerPool.__init__, make_round_core,
+    _local_eigenspaces's None fallback).
+
+    ``DET_NO_PALLAS=1`` — the repo-wide Pallas escape hatch — vetoes the
+    kernel unconditionally (including an explicit ``True``); otherwise an
+    explicit value wins, else ``DET_FUSED_XTXV=1`` opts in.
+    """
+    import os
+
+    if os.environ.get("DET_NO_PALLAS") == "1":
+        return False
+    if explicit is not None:
+        return explicit
+    return os.environ.get("DET_FUSED_XTXV") == "1"
+
+
+def xtxv_auto(x: jax.Array, v: jax.Array, *, fused: bool = True) -> jax.Array:
+    """Fused kernel on TPU for aligned bf16 shapes (and ``fused=True``),
+    else :func:`xtxv_fallback` (identical math)."""
+    m, n, d = x.shape
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    block_n = (
+        _pick_block_n(n, d, x.dtype.itemsize)
+        if fused and on_tpu and x.dtype != jnp.float32
+        else None
+    )
+    if block_n is None or d % 128 or v.shape[2] > 512:
+        return xtxv_fallback(x, v)
+    return xtxv_pallas(x, v, block_n=block_n)

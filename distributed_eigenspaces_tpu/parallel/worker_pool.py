@@ -43,6 +43,43 @@ from distributed_eigenspaces_tpu.parallel.mesh import (
 )
 
 
+def _batched_streaming_eigenspaces(
+    x: jax.Array, k: int, iters: int, orth: str, v0, fused: bool
+):
+    """Streaming per-worker subspace solves on the full (m, n, d) stack.
+
+    Only the MATVEC is batched natively (no ``jax.vmap``): the fused Pallas
+    kernel must own the worker axis as a grid dimension, because vmapping a
+    reduction kernel silently re-targets its zero-init ``program_id`` (see
+    ops/pallas_xtxv.py). The orthonormalization and Rayleigh-Ritz steps are
+    plain XLA and reuse the canonical single-worker implementations
+    (``linalg.orthonormalize`` / ``linalg.rayleigh_ritz``) under ``vmap`` —
+    one definition of the numerics, including method validation.
+    """
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        orthonormalize,
+        rayleigh_ritz,
+    )
+    from distributed_eigenspaces_tpu.ops.pallas_xtxv import xtxv_auto
+
+    m, n, d = x.shape
+    orthonormalize(jnp.zeros((2, 1)), orth)  # validate method eagerly
+    orth_b = jax.vmap(lambda v: orthonormalize(v, orth))
+
+    def mv(vs):  # (m, d, k) -> (m, d, k)
+        return xtxv_auto(x, vs, fused=fused) / n
+
+    if v0 is None:
+        v0 = jax.random.normal(jax.random.PRNGKey(0), (d, k), jnp.float32)
+    vs = orth_b(jnp.broadcast_to(v0[None], (m, d, k)).astype(jnp.float32))
+
+    def body(_, vs):
+        return orth_b(mv(vs))
+
+    vs = jax.lax.fori_loop(0, iters, body, vs)
+    return jax.vmap(rayleigh_ritz)(vs, mv(vs))
+
+
 def _local_eigenspaces(
     x_blocks: jax.Array,
     k: int,
@@ -51,6 +88,7 @@ def _local_eigenspaces(
     orth: str = "cholqr2",
     compute_dtype=None,
     v0: jax.Array | None = None,
+    fused_xtxv: bool | None = None,
 ):
     """Per-worker ``V_hat``: ``(m, n, d) -> (m, d, k)`` (vmapped C8 -> C7).
 
@@ -61,13 +99,21 @@ def _local_eigenspaces(
     stays fp32 either way. ``v0`` (d, k) warm-starts every worker's subspace
     iteration (online steps: the previous merged estimate is an excellent
     initializer, so far fewer iterations are needed); ignored by the eigh
-    solver.
+    solver. ``fused_xtxv`` opts the streaming branch into the fused Pallas
+    matvec (resolved through :func:`~..ops.pallas_xtxv.resolve_fused`:
+    ``DET_NO_PALLAS=1`` vetoes unconditionally, else an explicit value wins,
+    else ``DET_FUSED_XTXV=1`` — callers that jit resolve at build time, as
+    WorkerPool and make_round_core do, so a later env change can't be
+    masked by the jit cache).
     """
     import os
 
     from distributed_eigenspaces_tpu.ops.pallas_gram import gram_auto
 
+    from distributed_eigenspaces_tpu.ops.pallas_xtxv import resolve_fused
+
     use_pallas = os.environ.get("DET_NO_PALLAS", "0") != "1"
+    fused_xtxv = resolve_fused(fused_xtxv)
 
     d = x_blocks.shape[2]
     # Streaming subspace solves apply the covariance as X^T (X v) / n and
@@ -84,31 +130,19 @@ def _local_eigenspaces(
     streaming = solver == "subspace" and (
         d >= 4096 or (2 * k * iters < d and iters <= 6)
     )
+    if streaming:
+        xall = (
+            x_blocks.astype(compute_dtype)
+            if compute_dtype is not None
+            else x_blocks
+        )
+        return _batched_streaming_eigenspaces(
+            xall, k, iters, orth, v0, fused_xtxv
+        )
 
     def one(xb):
         if compute_dtype is not None:
             xb = xb.astype(compute_dtype)
-        prec = (
-            jax.lax.Precision.HIGHEST
-            if xb.dtype == jnp.float32
-            else None
-        )
-        if streaming:
-            n = xb.shape[0]
-
-            def mv(v):
-                xv = jnp.matmul(
-                    xb, v.astype(xb.dtype), precision=prec,
-                    preferred_element_type=jnp.float32,
-                )
-                return jnp.matmul(
-                    xb.T, xv.astype(xb.dtype), precision=prec,
-                    preferred_element_type=jnp.float32,
-                ) / n
-
-            return subspace_iteration(
-                mv, d, k, iters=iters, orth=orth, v0=v0
-            )
         g = gram_auto(xb) if use_pallas else gram(xb)
         if solver == "subspace":
             return subspace_iteration(
@@ -177,6 +211,7 @@ class WorkerPool:
         subspace_iters: int = 16,
         orth_method: str = "cholqr2",
         compute_dtype=None,
+        fused_xtxv: bool | None = None,
     ):
         if backend == "tpu":
             # the north star's `backend="tpu"` selector (BASELINE.json):
@@ -192,6 +227,12 @@ class WorkerPool:
         self.subspace_iters = subspace_iters
         self.orth_method = orth_method
         self.compute_dtype = compute_dtype
+        # resolved ONCE at build time (the round fn is jitted; an env read
+        # under jit would be frozen by the trace cache anyway — this makes
+        # the when-it-is-read contract explicit). DET_NO_PALLAS vetoes.
+        from distributed_eigenspaces_tpu.ops.pallas_xtxv import resolve_fused
+
+        self.fused_xtxv = resolve_fused(fused_xtxv)
         if backend == "shard_map":
             if mesh is None:
                 n_dev = len(jax.devices())
@@ -245,6 +286,7 @@ class WorkerPool:
                 iters=self.subspace_iters,
                 orth=self.orth_method,
                 compute_dtype=self.compute_dtype,
+                fused_xtxv=self.fused_xtxv,
             ),
             static_argnames=("k",),
         )(x_blocks, k=k)
@@ -254,6 +296,7 @@ class WorkerPool:
     def _build_round(self):
         solver, iters = self.solver, self.subspace_iters
         orth, cdtype = self.orth_method, self.compute_dtype
+        fused = self.fused_xtxv
 
         def merge(vs, mask, k):
             """Masked mean projector + its EXACT top-k from the factors.
@@ -271,7 +314,10 @@ class WorkerPool:
 
             @partial(jax.jit, static_argnames=("k",))
             def round_local(x_blocks, mask, k):
-                vs = _local_eigenspaces(x_blocks, k, solver, iters, orth, cdtype)
+                vs = _local_eigenspaces(
+                    x_blocks, k, solver, iters, orth, cdtype,
+                    fused_xtxv=fused,
+                )
                 return merge(vs, mask, k)
 
             return round_local
@@ -283,7 +329,9 @@ class WorkerPool:
         def round_sharded(x_blocks, mask, k):
             def shard_fn(xs, mask_s):
                 # xs: (m_local, n, d) on this device's worker slot(s)
-                vs = _local_eigenspaces(xs, k, solver, iters, orth, cdtype)
+                vs = _local_eigenspaces(
+                    xs, k, solver, iters, orth, cdtype, fused_xtxv=fused
+                )
                 # ICI gather of the d x k factors — the entire reference
                 # wire protocol (C11) collapses to these two lines, moving
                 # m*d*k floats instead of the d*d a dense-merge psum needs.
