@@ -97,7 +97,9 @@ def _small_eigh_desc(g):
     return w[..., ::-1], q[..., ::-1]
 
 
-def worker_subspace_sharded(x, k, iters, n_total_rows, key, collectives="xla"):
+def worker_subspace_sharded(
+    x, k, iters, n_total_rows, key, collectives="xla", v0=None
+):
     """Per-worker top-k eigenspaces with the feature dim sharded.
 
     ``x``: (m_local, n, d_local) — this device's row-block columns for its
@@ -105,7 +107,9 @@ def worker_subspace_sharded(x, k, iters, n_total_rows, key, collectives="xla"):
     the features axis) eigenvector shards. ``collectives="ring"`` reduces
     the (m, n, k) partial products with the explicit ``ppermute`` ring
     schedule (``parallel/ring.py``) instead of ``psum`` — same result,
-    neighbor-only traffic per hop.
+    neighbor-only traffic per hop. ``v0`` (d_local, k) warm-starts every
+    worker's iteration (blended with scaled noise, so a zero ``v0`` — the
+    cold first online step — degrades gracefully to the random init).
     """
     m_local, n, d_local = x.shape
 
@@ -131,6 +135,14 @@ def worker_subspace_sharded(x, k, iters, n_total_rows, key, collectives="xla"):
     v = jax.random.normal(
         jax.random.fold_in(key, fidx), (m_local, d_local, k), jnp.float32
     )
+    if v0 is not None:
+        # warm start from the running estimate. The noise is scaled so its
+        # COLUMN norm is ~1e-3 of v0's unit columns regardless of d (raw
+        # per-entry noise would grow as sqrt(d) against the 1/sqrt(d)
+        # entries of an orthonormal v0 — worst exactly at large d); a zero
+        # v0 (cold first step) leaves the pure random init, rescaled.
+        d_total = jax.lax.psum(jnp.asarray(d_local, jnp.float32), FEATURE_AXIS)
+        v = v0[None, :, :] + (1e-3 * jax.lax.rsqrt(d_total)) * v
     v = chol_qr2(v, FEATURE_AXIS)
 
     def body(_, v):
@@ -222,9 +234,20 @@ def make_feature_sharded_step(
     comes back sharded ``P(features, None)``. One jit, zero host hops.
     ``collectives="ring"`` swaps the matvec reduction onto the explicit
     ``ppermute`` ring schedule (``parallel/ring.py``).
+
+    Worker solves warm-start from the running estimate's top-k every step
+    (free accuracy); with ``cfg.warm_start_iters`` set, the first step runs
+    the full ``cfg.subspace_iters`` cold and later steps run the short
+    count (scan-trainer contract — the dispatch reads the replicated step
+    counter on the host).
     """
     if collectives not in ("xla", "ring"):
         raise ValueError(f"unknown collectives mode: {collectives!r}")
+    if rank is not None and rank < cfg.k:
+        raise ValueError(
+            f"rank={rank} must be >= k={cfg.k} (the warm start and the "
+            "final top-k both read state.u[:, :k])"
+        )
     k, iters = cfg.k, cfg.subspace_iters
     r = rank if rank is not None else min(cfg.dim, 2 * k + 8)
     m, n = cfg.num_workers, cfg.rows_per_worker
@@ -243,25 +266,28 @@ def make_feature_sharded_step(
         def weights(step):
             return 1.0 / (step.astype(jnp.float32) + 2.0), 1.0
 
-    def sharded(state, x):
-        # x: (m_local, n, d_local); state.u: (d_local_f, r)
-        vws = worker_subspace_sharded(x, k, iters, n, key, collectives)
-        v_bar = merged_lowrank_sharded(vws, k)
-        w, keep = weights(state.step)
-        new_state = _lowrank_update(state, v_bar, w, keep, axis_name=FEATURE_AXIS)
-        return new_state, v_bar
+    def make_sharded(step_iters):
+        def sharded(state, x):
+            # x: (m_local, n, d_local); state.u: (d_local_f, r)
+            # warm-start worker solves from the running estimate's top-k
+            # (zero on the cold first step -> graceful fallback to random
+            # init); the online subspace moves slowly, so warm steps
+            # converge in far fewer iterations
+            vws = worker_subspace_sharded(
+                x, k, step_iters, n, key, collectives, v0=state.u[:, :k]
+            )
+            v_bar = merged_lowrank_sharded(vws, k)
+            w, keep = weights(state.step)
+            new_state = _lowrank_update(
+                state, v_bar, w, keep, axis_name=FEATURE_AXIS
+            )
+            return new_state, v_bar
+
+        return sharded
 
     x_spec = P(WORKER_AXIS, None, FEATURE_AXIS)
     u_spec = P(FEATURE_AXIS, None)
     state_specs = LowRankState(u=u_spec, s=P(), step=P())
-
-    inner = jax.shard_map(
-        sharded,
-        mesh=mesh,
-        in_specs=(state_specs, x_spec),
-        out_specs=(state_specs, u_spec),
-        check_vma=False,
-    )
 
     x_sharding = NamedSharding(mesh, x_spec)
     state_shardings = LowRankState(
@@ -271,13 +297,35 @@ def make_feature_sharded_step(
     )
     v_sharding = NamedSharding(mesh, u_spec)
 
-    @partial(
-        jax.jit,
-        in_shardings=(state_shardings, x_sharding),
-        out_shardings=(state_shardings, v_sharding),
+    def build(step_iters):
+        inner = jax.shard_map(
+            make_sharded(step_iters),
+            mesh=mesh,
+            in_specs=(state_specs, x_spec),
+            out_specs=(state_specs, u_spec),
+            check_vma=False,
+        )
+        return jax.jit(
+            inner,
+            in_shardings=(state_shardings, x_sharding),
+            out_shardings=(state_shardings, v_sharding),
+        )
+
+    cold = build(iters)
+    # cfg.warm_start_iters: cold first step at the full iteration count,
+    # later steps short (same contract as the scan trainer). Dispatching on
+    # the host reads the replicated scalar step counter — one tiny fetch
+    # per call on a path that is host-driven per step anyway.
+    warm = (
+        build(cfg.warm_start_iters)
+        if cfg.warm_start_iters is not None and cfg.solver == "subspace"
+        else None
     )
+
     def step(state, x_blocks):
-        return inner(state, x_blocks)
+        if warm is not None and int(state.step) > 0:
+            return warm(state, x_blocks)
+        return cold(state, x_blocks)
 
     def init_state():
         return jax.device_put(

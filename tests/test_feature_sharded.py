@@ -205,3 +205,31 @@ def test_auto_feature_mesh(devices):
     state, v_bar = step(step.init_state(), x)
     assert v_bar.shape == (D, K)
     assert int(state.step) == 1
+
+
+def test_warm_started_steps_converge_with_few_iters(mesh, devices):
+    """cfg.warm_start_iters on the feature-sharded step: cold first step at
+    the full iteration count, later steps at the short count, initialized
+    from the running estimate — same contract as the scan trainer."""
+    spec = _spec()
+    cfg = _cfg(subspace_iters=30, warm_start_iters=3, num_steps=6)
+    step = make_feature_sharded_step(cfg, mesh, seed=0)
+    state = step.init_state()
+    key = jax.random.PRNGKey(0)
+    for _ in range(6):
+        key, sub = jax.random.split(key)
+        x = jnp.asarray(
+            np.asarray(spec.sample(sub, M * N)).reshape(M, N, D)
+        )
+        state, v_bar = step(state, x)
+    ang = np.asarray(
+        principal_angles_degrees(
+            np.asarray(state.u)[:, :K], np.asarray(spec.top_k(K))
+        )
+    )
+    assert ang.max() <= 1.0, ang
+
+
+def test_rank_below_k_rejected(mesh):
+    with pytest.raises(ValueError):
+        make_feature_sharded_step(_cfg(), mesh, rank=K - 1)
