@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_eigenspaces_tpu.algo.online import OnlineState, update_state
+from distributed_eigenspaces_tpu.algo.online import update_state
 from distributed_eigenspaces_tpu.algo.step import make_round_core
 from distributed_eigenspaces_tpu.config import PCAConfig
 from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS

@@ -244,8 +244,6 @@ def run_eval(
 
     bin_path = None
     if spec.streaming == "bin":
-        from distributed_eigenspaces_tpu.data.bin_stream import write_rows
-
         fd, bin_path = tempfile.mkstemp(suffix=".bin")
         os.close(fd)
         # one device->host conversion per distinct block, not per step (a

@@ -1,6 +1,5 @@
 """MNIST IDX loader + out-of-core binary block streaming."""
 
-import gzip
 
 import jax.numpy as jnp
 import numpy as np

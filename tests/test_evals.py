@@ -5,7 +5,6 @@ scaled down; the full-size specs are what ``bench.py --eval`` runs on TPU.
 """
 
 import numpy as np
-import pytest
 
 from distributed_eigenspaces_tpu.evals import EVAL_SPECS, run_eval
 
