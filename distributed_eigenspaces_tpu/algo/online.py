@@ -225,7 +225,9 @@ def _fit_feature_sharded(
             "yet — use backend='shard_map' for fault-injection runs"
         )
     mesh = auto_feature_mesh(cfg)
-    fstep = make_feature_sharded_step(cfg, mesh, seed=cfg.seed)
+    fstep = make_feature_sharded_step(
+        cfg, mesh, seed=cfg.seed, collectives=cfg.collectives
+    )
     if state is None:
         state = fstep.init_state()
 

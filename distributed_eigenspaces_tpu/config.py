@@ -68,6 +68,10 @@ class PCAConfig:
         exist — B5); here it's a knob, and 0 disables prefetching.
       mesh_shape: optional explicit mesh layout, e.g. ``{"workers": 4,
         "features": 2}``; ``None`` = one ``workers`` axis over all devices.
+      collectives: cross-device reduction schedule for the feature-sharded
+        backend: ``"xla"`` (``lax.psum``/``all_gather`` — XLA already lowers
+        these to ICI rings) or ``"ring"`` (explicit ``ppermute``
+        neighbor-exchange schedules, ``parallel/ring.py``).
       seed: PRNG seed for initialization (subspace solver, synthetic data).
     """
 
@@ -88,6 +92,7 @@ class PCAConfig:
     remainder: str = "drop"
     prefetch_depth: int = 2
     mesh_shape: dict[str, int] | None = None
+    collectives: str = "xla"
     seed: int = 0
 
     def __post_init__(self):
@@ -110,6 +115,8 @@ class PCAConfig:
             raise ValueError(f"unknown orth_method: {self.orth_method!r}")
         if self.compute_dtype is not None:
             jnp.dtype(self.compute_dtype)  # raises on junk
+        if self.collectives not in ("xla", "ring"):
+            raise ValueError(f"unknown collectives mode: {self.collectives!r}")
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")
         if self.prefetch_depth < 0:

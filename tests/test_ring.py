@@ -146,3 +146,37 @@ def test_feature_sharded_bad_collectives():
         make_feature_sharded_step(
             cfg, make_mesh(num_workers=4), collectives="nccl"
         )
+
+
+def test_estimator_ring_collectives():
+    """cfg.collectives='ring' reaches the feature-sharded backend through
+    the public estimator and recovers the planted subspace."""
+    from distributed_eigenspaces_tpu.api.estimator import (
+        OnlineDistributedPCA,
+    )
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+
+    d, k, m, n, T = 64, 2, 4, 128, 4
+    spec = planted_spectrum(d, k_planted=k, gap=25.0, noise=0.01, seed=6)
+    data = np.asarray(spec.sample(jax.random.PRNGKey(0), m * n * T))
+    cfg = PCAConfig(
+        dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=T,
+        solver="subspace", subspace_iters=24, backend="feature_sharded",
+        collectives="ring",
+    )
+    pca = OnlineDistributedPCA(cfg).fit(data)
+    ang = float(
+        jnp.max(principal_angles_degrees(pca.components_, spec.top_k(k)))
+    )
+    assert ang <= 1.0, ang
+
+
+def test_config_rejects_bad_collectives():
+    from distributed_eigenspaces_tpu.config import PCAConfig
+
+    with pytest.raises(ValueError):
+        PCAConfig(dim=8, k=2, collectives="nccl")
