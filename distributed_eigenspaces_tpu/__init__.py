@@ -9,7 +9,8 @@ estimation):
   ``jnp.linalg.eigh`` / streaming subspace iteration (:mod:`.ops.linalg`);
 - the RabbitMQ master/worker topology (``distributed.py:82-143``) becomes a
   :class:`~distributed_eigenspaces_tpu.parallel.WorkerPool` over a
-  ``jax.sharding.Mesh``, with the projector merge as a ``lax.pmean`` allreduce
+  ``jax.sharding.Mesh``, with the projector merge exact from the d x k
+factors after an ``all_gather``
   over ICI (:mod:`.parallel`);
 - the notebook's online outer loop (cell 16) becomes
   :func:`~distributed_eigenspaces_tpu.algo.online_distributed_pca`, implementing

@@ -6,7 +6,7 @@ The algorithm (reference pseudocode, ``assets/algorithm.png`` / notebook cell
     sigma_tilde(0) = 0
     for t = 1..T:
         per worker l: V_hat_l = top-k eigvecs of (1/n) X_l^T X_l
-        sigma_bar = (1/m) sum_l V_hat_l V_hat_l^T       # one pmean on TPU
+        sigma_bar = (1/m) sum_l V_hat_l V_hat_l^T       # one gather on TPU
         v_bar = top-k eigvecs of sigma_bar
         sigma_tilde += discount * v_bar v_bar^T
     output: top-k eigvecs of sigma_tilde

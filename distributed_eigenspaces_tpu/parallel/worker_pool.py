@@ -4,10 +4,11 @@ This single class replaces the reference's C10-C13 (SURVEY.md §2): AMQP
 transport, JSON protocol, the slave consume loop (``distributed.py:32-57``)
 and the master's dynamic work queue (``distributed.py:82-143``). One algorithm
 "round" — every worker computes a local covariance + top-k eigenspace, the
-projectors are averaged, the merged top-k is extracted — is a single jitted
-function; on the ``shard_map`` backend the average is a ``lax.pmean``
-allreduce over ICI instead of d x k floats serialized as JSON text
-(``distributed.py:51``).
+projector mean's top-k is extracted EXACTLY from the factors
+(``ops.linalg.merged_top_k_lowrank``) — is a single jitted function; on the
+``shard_map`` backend the merge traffic is an ``all_gather`` of the d x k
+factors over ICI: the same payload the reference serialized as JSON text
+(``distributed.py:51``), minus the broker, the text, and the d x d matrix.
 
 Scheduling note: the reference assigns batches to workers dynamically (LIFO
 work queue, ``distributed.py:132-137``). The merge is a permutation-invariant
@@ -191,7 +192,7 @@ class WorkerPool:
       - ``"local"``: single-device, workers vmapped over a leading axis — the
         TPU equivalent of the notebook's ``for l in range(m)`` loop (cell 16).
       - ``"shard_map"``: workers spread over the ``workers`` mesh axis; the
-        projector merge is a ``pmean`` over ICI. ``m`` must be a multiple of
+        projector merge gathers factors over ICI. ``m`` must be a multiple of
         the mesh's worker-axis size (each device carries ``m / axis_size``
         workers, vmapped).
       - ``"auto"``: ``shard_map`` when >1 device is visible, else ``local``.
