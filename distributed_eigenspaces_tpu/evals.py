@@ -46,6 +46,7 @@ class EvalSpec:
     subspace_iters: int = 12
     backend: str = "local"  # "local" | "shard_map" | "feature_sharded"
     streaming: str = "memory"  # "memory" | "bin" (out-of-core file)
+    trainer: str = "scan"  # "scan" (whole fit, one program) | "step"
     description: str = ""
 
     def replace(self, **kw) -> "EvalSpec":
@@ -68,12 +69,12 @@ EVAL_SPECS: dict[str, EvalSpec] = {
                              "(config 3)"),
         EvalSpec("imagenet12288", dim=12288, k=50, num_workers=4,
                  rows_per_worker=2048, steps=10,
-                 backend="feature_sharded",
+                 backend="feature_sharded", trainer="step",
                  description="ImageNet 64x64 patches 12288-d, top-50, "
                              "feature-sharded (config 4)"),
         EvalSpec("clip768", dim=768, k=256, num_workers=8,
                  rows_per_worker=2048, steps=10, subspace_iters=8,
-                 streaming="bin",
+                 streaming="bin", trainer="step",
                  description="CLIP ViT-L 768-d embeddings, top-256, "
                              "out-of-core streaming (config 5)"),
     ]
@@ -282,27 +283,82 @@ def run_eval(
             for s in range(spec.steps):
                 yield device_blocks[s % n_distinct]
 
+    # whole-fit scan trainer: the T-step loop as ONE program, so the number
+    # measures the chip instead of per-step dispatch over the host link
+    # (bench.py methodology) — the per-step ("step") trainer remains for
+    # the out-of-core and feature-sharded configs, whose point is the
+    # full pipeline / the 2-D mesh step
+    use_scan = (
+        spec.trainer == "scan"
+        and spec.streaming == "memory"
+        and backend_used in ("local", "shard_map")
+    )
+    trainer_used = "scan" if use_scan else "step"
     try:
-        # --- warm-up (compile) ---------------------------------------------
-        warm = jnp.asarray(host_blocks[0])
-        out = step_fn(state, warm)
-        state_w = out[0]
-        # value fetch, not block_until_ready: the tunneled dev backend does
-        # not fence on block_until_ready (BASELINE.md timing methodology)
-        float(jnp.sum(jax.tree_util.tree_leaves(state_w)[0]))
+        if use_scan:
+            from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
 
-        # --- timed run -----------------------------------------------------
-        if backend_used == "feature_sharded":
-            state = fstep.init_state()
+            scan_mesh = mesh if backend_used == "shard_map" else None
+            stacked = jnp.stack(device_blocks)
+            del device_blocks  # the stack is the only staged copy needed
+
+            # accuracy run: exactly the spec's T-step workload
+            fit = make_scan_fit(cfg, mesh=scan_mesh, gather=True)
+            idx = jnp.arange(spec.steps, dtype=jnp.int32) % n_distinct
+            state, _ = fit(OnlineState.initial(d), stacked, idx)
+            float(jnp.sum(state.sigma_tilde))  # honest fence (see below)
+
+            # throughput run: the SAME per-step workload on a longer
+            # schedule, as ONE program with one fetch — a single spec-T fit
+            # is mostly the tunnel's fixed ~100 ms dispatch+RPC cost, and
+            # every extra execution pays that cost again (they serialize),
+            # so amortize inside the program instead of across calls.
+            # CI-shrunk runs (steps overridden below 10) keep the short
+            # schedule: their throughput number isn't asserted on, and the
+            # extra 240-step compile would be pure wasted wall clock.
+            timed_T = spec.steps if spec.steps < 10 else max(240, spec.steps)
+            fit_t = make_scan_fit(
+                cfg.replace(num_steps=timed_T), mesh=scan_mesh, gather=True
+            )
+            idx_t = jnp.arange(timed_T, dtype=jnp.int32) % n_distinct
+            # warm-up must use DIFFERENT operand values (salted state,
+            # rolled schedule): the tunneled dev backend serves identical
+            # (executable, operands) pairs from a cache without executing
+            # — verified behavior, see BASELINE.md "Timing methodology"
+            warm = OnlineState.initial(d)
+            warm = warm._replace(sigma_tilde=warm.sigma_tilde + 1e-20)
+            st, _ = fit_t(warm, stacked, jnp.roll(idx_t, 1))
+            float(jnp.sum(st.sigma_tilde))
+
+            t0 = time.perf_counter()
+            st, _ = fit_t(OnlineState.initial(d), stacked, idx_t)
+            float(jnp.sum(st.sigma_tilde))
+            dt = time.perf_counter() - t0
+            steps_run = spec.steps  # the accuracy workload (reported)
+            timed_steps = timed_T
         else:
-            state = OnlineState.initial(d)
-        t0 = time.perf_counter()
-        steps_run = 0
-        for x in stream():
-            state, _ = step_fn(state, x)
-            steps_run += 1
-        float(jnp.sum(jax.tree_util.tree_leaves(state)[0]))  # honest fence
-        dt = time.perf_counter() - t0
+            # --- warm-up (compile) -----------------------------------------
+            warm = jnp.asarray(host_blocks[0])
+            out = step_fn(state, warm)
+            state_w = out[0]
+            # value fetch, not block_until_ready: the tunneled dev backend
+            # does not fence on block_until_ready (BASELINE.md timing
+            # methodology)
+            float(jnp.sum(jax.tree_util.tree_leaves(state_w)[0]))
+
+            # --- timed run -------------------------------------------------
+            if backend_used == "feature_sharded":
+                state = fstep.init_state()
+            else:
+                state = OnlineState.initial(d)
+            t0 = time.perf_counter()
+            steps_run = 0
+            for x in stream():
+                state, _ = step_fn(state, x)
+                steps_run += 1
+            float(jnp.sum(jax.tree_util.tree_leaves(state)[0]))
+            dt = time.perf_counter() - t0
+            timed_steps = steps_run
     finally:
         if bin_path is not None:
             os.unlink(bin_path)
@@ -318,12 +374,14 @@ def run_eval(
         "k": k,
         "num_workers": m,
         "rows_per_worker": n,
-        "steps": steps_run,
+        "steps": steps_run,  # the accuracy workload's step count
+        "timed_steps": timed_steps,  # throughput schedule (scan: >= 240)
         "backend": backend_used,
+        "trainer": trainer_used,
         "solver": spec.solver,
         "data": data_kind,
         "streaming": spec.streaming,
-        "samples_per_sec": round(steps_run * step_rows / dt, 1),
+        "samples_per_sec": round(timed_steps * step_rows / dt, 1),
         "principal_angle_deg": round(angle, 4),
         "accuracy_ok": bool(angle <= 1.0),
     }
