@@ -237,3 +237,49 @@ def test_merged_top_k_lowrank_masked(rng):
         np.asarray(principal_angles(jnp.asarray(got), jnp.asarray(want)))
     )
     assert ang.max() < 0.1
+
+
+def test_merged_top_k_lowrank_cost_dispatch(rng):
+    """The two internal routes of merged_top_k_lowrank (factor Gram vs
+    dense mean projector) agree on the SAME inputs, and the public
+    dispatch picks the dense route once m*k_f >= d (the clip768 regime,
+    where the (m*k)^2 factor Gram would be larger than d^2)."""
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        _merged_top_k_dense,
+        _merged_top_k_factor_gram,
+        merged_top_k_lowrank,
+    )
+
+    m, d, k = 6, 16, 3  # m*k = 18 >= d = 16 -> public API goes dense
+    base = rng.standard_normal((d, k))
+    vs = jnp.asarray(
+        np.stack(
+            [
+                np.linalg.qr(base + 0.05 * rng.standard_normal((d, k)))[0]
+                for _ in range(m)
+            ]
+        ).astype(np.float32)
+    )
+    w = jnp.ones((m,), jnp.float32)
+    cnt = jnp.asarray(float(m))
+    dense = np.asarray(_merged_top_k_dense(vs, k, w, cnt))
+    lowrank = np.asarray(_merged_top_k_factor_gram(vs, k, w, cnt))
+    ang = np.degrees(
+        np.asarray(
+            principal_angles(jnp.asarray(dense), jnp.asarray(lowrank))
+        )
+    )
+    assert ang.max() < 0.1
+    public = np.asarray(merged_top_k_lowrank(vs, k))
+    np.testing.assert_allclose(public, dense, atol=1e-5)
+
+    # masked agreement across the boundary too
+    mask = jnp.asarray([1.0, 0.0, 1.0, 1.0, 1.0, 1.0])
+    wm = mask.astype(jnp.float32)
+    cm = jnp.sum(wm)
+    dm = np.asarray(_merged_top_k_dense(vs, k, wm, cm))
+    lm = np.asarray(_merged_top_k_factor_gram(vs, k, wm, cm))
+    ang2 = np.degrees(
+        np.asarray(principal_angles(jnp.asarray(dm), jnp.asarray(lm)))
+    )
+    assert ang2.max() < 0.1
