@@ -107,9 +107,11 @@ def online_distributed_pca(
       worker_masks: optional iterator of ``(m,)`` {0,1} masks for fault
         injection (SURVEY.md §5.3).
       max_steps: ``"auto"`` caps the *total* step count (including resumed
-        state) at ``cfg.num_steps``; ``None`` consumes the whole stream
-        (``partial_fit`` semantics — fold extra rounds past T); an int is an
-        explicit total cap.
+        state) at ``cfg.num_steps`` — except under ``discount="1/t"``,
+        where the auto cap is open-ended (a running mean only improves by
+        folding more rounds); ``None`` consumes the whole stream
+        (``partial_fit`` semantics — fold extra rounds past T); an int is
+        an explicit total cap, honored under every discount rule.
 
     Returns:
       ``(w, state)`` — ``w`` the final (dim, k) principal subspace estimate
@@ -181,10 +183,14 @@ def _drive_stream(stream, cfg, *, place, step, state, on_step, max_steps):
         stream = prefetch_stream(stream, depth=cfg.prefetch_depth, place=place)
 
     cap = cfg.num_steps if max_steps == "auto" else max_steps
+    # the "auto" cap is open-ended for a 1/t running mean (folding extra
+    # rounds only improves the estimate); an EXPLICIT integer cap is a
+    # contract and is honored under every discount rule
+    open_ended = max_steps == "auto" and cfg.discount == "1/t"
     steps_done = int(state.step)
     try:
         for x_blocks in stream:
-            if cap is not None and steps_done >= cap and cfg.discount != "1/t":
+            if cap is not None and steps_done >= cap and not open_ended:
                 break
             state, v_bar = step(state, x_blocks)
             steps_done += 1
@@ -219,11 +225,6 @@ def _fit_feature_sharded(
         make_feature_sharded_step,
     )
 
-    if worker_masks is not None:
-        raise NotImplementedError(
-            "worker_masks is not supported on the feature_sharded backend "
-            "yet — use backend='shard_map' for fault-injection runs"
-        )
     mesh = auto_feature_mesh(cfg)
     fstep = make_feature_sharded_step(
         cfg, mesh, seed=cfg.seed, collectives=cfg.collectives
@@ -234,9 +235,16 @@ def _fit_feature_sharded(
     place = lambda x: jax.device_put(  # noqa: E731
         jnp.asarray(x), fstep.x_sharding
     )
+
+    def step(st, x):
+        # masked survivor merge on the 2-D mesh: the same §5.3 fault
+        # mechanism the DP backends have (weighted exclusion of failed
+        # workers), on the path where failures matter most
+        mask = next(worker_masks) if worker_masks is not None else None
+        return fstep(st, place(x), worker_mask=mask)
+
     state = _drive_stream(
-        stream, cfg, place=place,
-        step=lambda st, x: fstep(st, place(x)),
+        stream, cfg, place=place, step=step,
         state=state, on_step=on_step, max_steps=max_steps,
     )
     w = canonicalize_signs(state.u[:, : cfg.k])

@@ -172,7 +172,11 @@ def _merged_top_k_dense(v_stack, k, w, cnt):
         preferred_element_type=jnp.float32,
         precision=_precision(v_stack),
     )
-    return top_k_eigvecs(p, k)
+    # all workers masked out -> p == 0; eigh of 0 returns arbitrary basis
+    # vectors, so zero the result to match the factor-Gram route (where the
+    # inv guard yields zeros and the fold becomes a no-op)
+    alive = (jnp.sum(w) > 0).astype(jnp.float32)
+    return top_k_eigvecs(p, k) * alive
 
 
 def _merged_top_k_factor_gram(v_stack, k, w, cnt):

@@ -98,7 +98,8 @@ def _small_eigh_desc(g):
 
 
 def worker_subspace_sharded(
-    x, k, iters, n_total_rows, key, collectives="xla", v0=None
+    x, k, iters, n_total_rows, key, collectives="xla", v0=None,
+    compute_dtype=None,
 ):
     """Per-worker top-k eigenspaces with the feature dim sharded.
 
@@ -110,8 +111,15 @@ def worker_subspace_sharded(
     neighbor-only traffic per hop. ``v0`` (d_local, k) warm-starts every
     worker's iteration (blended with scaled noise, so a zero ``v0`` — the
     cold first online step — degrades gracefully to the random init).
+    ``compute_dtype`` (e.g. bfloat16) casts the data operand of the two
+    tall-skinny matvec contractions — the FLOP load of this path — to run
+    at full MXU rate; accumulation and all solver state stay fp32, and the
+    CholeskyQR2 / Rayleigh-Ritz Grams stay at fp32 HIGHEST (they are k-wide
+    and accuracy-critical, not throughput-critical).
     """
     m_local, n, d_local = x.shape
+    xc = x.astype(compute_dtype) if compute_dtype is not None else x
+    prec = HP if xc.dtype == jnp.float32 else None
 
     if collectives == "ring":
         from distributed_eigenspaces_tpu.parallel.ring import ring_psum
@@ -124,10 +132,17 @@ def worker_subspace_sharded(
 
     def matvec(v):
         # v: (m_local, d_local, k). X V reduces over the sharded d axis.
-        xv = jnp.einsum("mnd,mdk->mnk", x, v, precision=HP)
+        xv = jnp.einsum(
+            "mnd,mdk->mnk", xc, v.astype(xc.dtype), precision=prec,
+            preferred_element_type=jnp.float32,
+        )
         xv = reduce_features(xv)
         return (
-            jnp.einsum("mnd,mnk->mdk", x, xv, precision=HP) / n_total_rows
+            jnp.einsum(
+                "mnd,mnk->mdk", xc, xv.astype(xc.dtype), precision=prec,
+                preferred_element_type=jnp.float32,
+            )
+            / n_total_rows
         )
 
     # deterministic, feature-shard-distinct init: fold in the shard index
@@ -157,33 +172,65 @@ def worker_subspace_sharded(
     return jnp.einsum("mdk,mkl->mdl", v, q, precision=HP)
 
 
-def merged_lowrank_sharded(v_workers, k):
-    """EXACT top-k of the mean projector ``(1/m) sum_l V_l V_l^T`` from its
-    factors, fully sharded — the feature-sharded twin of
-    :func:`~..ops.linalg.merged_top_k_lowrank`.
+def merged_lowrank_sharded(v_workers, k, mask=None, dim_total=None):
+    """EXACT top-k of the (masked) mean projector
+    ``(1/sum w) sum_l w_l V_l V_l^T`` from its factors, fully sharded — the
+    feature-sharded twin of :func:`~..ops.linalg.merged_top_k_lowrank`.
 
     ``v_workers``: (m_local, d_local, k) shards over ``(workers, features)``.
-    The mean projector is ``C C^T`` for ``C = [V_1 .. V_m] / sqrt(m)``, so
-    its top-k eigenvectors are C's top-k left singular vectors: all_gather
-    the factors over ``workers`` (m*d_local*k floats — the only worker-axis
-    traffic), form the (m*k, m*k) Gram with a ``features`` psum, eigensolve
-    it replicated, and map back. No iteration, no d x d, and ~6 kernels
-    instead of the ~50-collective subspace-iteration chain this replaces
-    (BASELINE.md "what makes it fast" item 4).
+    The mean projector is ``C C^T`` for ``C = [sqrt(w_1) V_1 ..] / sqrt(sum
+    w)``, so its top-k eigenvectors are C's top-k left singular vectors:
+    all_gather the factors over ``workers`` (m*d_local*k floats — the only
+    worker-axis traffic), form the (m*k, m*k) Gram with a ``features``
+    psum, eigensolve it replicated, and map back. No iteration, no d x d,
+    and ~6 kernels instead of the ~50-collective subspace-iteration chain
+    this replaces (BASELINE.md "what makes it fast" item 4).
+
+    ``mask``: optional (m_local,) {0,1} shard over ``workers`` — failed
+    workers are excluded from the merge exactly (same algebra as the DP
+    backends' ``worker_mask``; SURVEY.md §5.3 on the scale-out path).
+
+    ``dim_total``: the global feature dimension, when known statically.
+    With it, the same cost dispatch as the unsharded merge applies: once
+    ``m_total * k_f >= dim_total`` the dense d x d mean projector is the
+    strictly smaller eigenproblem, so the factors are gathered over
+    ``features`` (d*m*k_f floats — ALSO less traffic than the (m*k_f)^2
+    psum in this regime) and solved densely, returning this device's row
+    shard.
 
     Returns (d_local, k), replicated over ``workers``, descending order.
     """
     c = jax.lax.all_gather(
         v_workers, WORKER_AXIS, axis=0, tiled=True
     )  # (m_total, d_local, k)
-    m_total, d_local = c.shape[0], c.shape[1]  # static — no collective
-    c = jnp.transpose(c, (1, 0, 2)).reshape(d_local, -1) * (
-        1.0 / m_total**0.5
-    )
+    m_total, d_local, kf = c.shape  # static — no collective
+    if mask is None:
+        w = jnp.ones((m_total,), jnp.float32)
+    else:
+        w = jax.lax.all_gather(
+            mask, WORKER_AXIS, axis=0, tiled=True
+        ).astype(jnp.float32)
+    cnt = jnp.maximum(jnp.sum(w), 1.0)
+    c = c * jnp.sqrt(w / cnt)[:, None, None]
+    c = jnp.transpose(c, (1, 0, 2)).reshape(d_local, -1)  # (d_local, m*kf)
+    if dim_total is not None and m_total * kf >= dim_total:
+        from distributed_eigenspaces_tpu.ops.linalg import top_k_eigvecs
+
+        cf = jax.lax.all_gather(
+            c, FEATURE_AXIS, axis=0, tiled=True
+        )  # (dim_total, m*kf)
+        p = jnp.matmul(cf, cf.T, precision=HP)
+        # all workers masked out -> p == 0 and eigh returns arbitrary
+        # basis vectors; zero the result like the factor-Gram route's
+        # inv guard does
+        alive = (jnp.sum(w) > 0).astype(jnp.float32)
+        v = top_k_eigvecs(p, k) * alive
+        fidx = jax.lax.axis_index(FEATURE_AXIS)
+        return jax.lax.dynamic_slice_in_dim(v, fidx * d_local, d_local, 0)
     b = jnp.matmul(c.T, c, precision=HP)
     b = jax.lax.psum(b, FEATURE_AXIS)
-    w, q = _small_eigh_desc(b)
-    wk = jnp.maximum(w[:k], 0.0)
+    w_ev, q = _small_eigh_desc(b)
+    wk = jnp.maximum(w_ev[:k], 0.0)
     inv = jnp.where(wk > 1e-12, jax.lax.rsqrt(jnp.maximum(wk, 1e-30)), 0.0)
     return jnp.einsum("dc,ck,k->dk", c, q[:, :k], inv, precision=HP)
 
@@ -218,43 +265,9 @@ def _lowrank_update(state, v_bar, weight, keep, axis_name):
     return LowRankState(u=u_new, s=w[:r], step=step + 1)
 
 
-def make_feature_sharded_step(
-    cfg: PCAConfig,
-    mesh: Mesh,
-    *,
-    rank: int | None = None,
-    seed: int = 0,
-    collectives: str = "xla",
-):
-    """Build the fully-sharded training step for the ``(workers, features)``
-    mesh: ``step(state, x_blocks) -> (state, v_bar)``.
-
-    ``x_blocks`` (m, n, d) is sharded ``P(workers, None, features)``;
-    ``state.u`` (d, r) is sharded ``P(features, None)``; ``v_bar`` (d, k)
-    comes back sharded ``P(features, None)``. One jit, zero host hops.
-    ``collectives="ring"`` swaps the matvec reduction onto the explicit
-    ``ppermute`` ring schedule (``parallel/ring.py``).
-
-    Worker solves warm-start from the running estimate's top-k every step
-    (free accuracy); with ``cfg.warm_start_iters`` set, the first step runs
-    the full ``cfg.subspace_iters`` cold and later steps run the short
-    count (scan-trainer contract — the dispatch reads the replicated step
-    counter on the host).
-    """
-    if collectives not in ("xla", "ring"):
-        raise ValueError(f"unknown collectives mode: {collectives!r}")
-    if rank is not None and rank < cfg.k:
-        raise ValueError(
-            f"rank={rank} must be >= k={cfg.k} (the warm start and the "
-            "final top-k both read state.u[:, :k])"
-        )
-    k, iters = cfg.k, cfg.subspace_iters
-    r = rank if rank is not None else min(cfg.dim, 2 * k + 8)
-    m, n = cfg.num_workers, cfg.rows_per_worker
-    key = jax.random.PRNGKey(seed)
-
-    # (add_weight, keep_scale) per 1-based step t = state.step + 1, matching
-    # algo.online._discount semantics for each rule
+def _discount_weights(cfg: PCAConfig):
+    """(add_weight, keep_scale) per 1-based step ``t = state.step + 1``,
+    matching ``algo.online._discount`` semantics for each rule."""
     if cfg.discount == "1/T":
         def weights(step):
             return jnp.asarray(1.0 / cfg.num_steps, jnp.float32), 1.0
@@ -265,31 +278,94 @@ def make_feature_sharded_step(
     else:  # "notebook": additive 1/(t+1) (SURVEY.md §2.2-B6)
         def weights(step):
             return 1.0 / (step.astype(jnp.float32) + 2.0), 1.0
+    return weights
+
+
+def _resolve_rank(cfg: PCAConfig, rank: int | None) -> int:
+    if rank is not None and rank < cfg.k:
+        raise ValueError(
+            f"rank={rank} must be >= k={cfg.k} (the warm start and the "
+            "final top-k both read state.u[:, :k])"
+        )
+    return rank if rank is not None else min(cfg.dim, 2 * cfg.k + 8)
+
+
+def _make_step_core(cfg: PCAConfig, *, collectives: str, key):
+    """ONE definition of the per-step sharded body (worker solve -> masked
+    exact merge -> discounted low-rank fold), shared by the per-step and
+    whole-fit factories so their tested equivalence cannot drift.
+
+    ``step_core(state, x, step_iters, mask=None) -> (state, v_bar)`` —
+    call inside ``shard_map`` over the ``(workers, features)`` mesh.
+    """
+    k, n = cfg.k, cfg.rows_per_worker
+    weights = _discount_weights(cfg)
+
+    def step_core(st, x, step_iters, mask=None):
+        # warm-start worker solves from the running estimate's top-k (zero
+        # on the cold first step -> graceful fallback to random init); the
+        # online subspace moves slowly, so warm steps converge in far
+        # fewer iterations
+        vws = worker_subspace_sharded(
+            x, k, step_iters, n, key, collectives,
+            v0=st.u[:, :k], compute_dtype=cfg.compute_dtype,
+        )
+        v_bar = merged_lowrank_sharded(vws, k, mask=mask, dim_total=cfg.dim)
+        w, keep = weights(st.step)
+        new_st = _lowrank_update(st, v_bar, w, keep, axis_name=FEATURE_AXIS)
+        return new_st, v_bar
+
+    return step_core
+
+
+def make_feature_sharded_step(
+    cfg: PCAConfig,
+    mesh: Mesh,
+    *,
+    rank: int | None = None,
+    seed: int = 0,
+    collectives: str = "xla",
+):
+    """Build the fully-sharded training step for the ``(workers, features)``
+    mesh: ``step(state, x_blocks, worker_mask=None) -> (state, v_bar)``.
+
+    ``x_blocks`` (m, n, d) is sharded ``P(workers, None, features)``;
+    ``state.u`` (d, r) is sharded ``P(features, None)``; ``v_bar`` (d, k)
+    comes back sharded ``P(features, None)``; ``worker_mask`` (m,) {0,1}
+    excludes failed workers from the merge exactly (SURVEY.md §5.3). One
+    jit, zero host hops. ``collectives="ring"`` swaps the matvec reduction
+    onto the explicit ``ppermute`` ring schedule (``parallel/ring.py``).
+    ``cfg.compute_dtype`` casts the matvec contractions (bf16 -> full MXU
+    rate, fp32 accumulation).
+
+    Worker solves warm-start from the running estimate's top-k every step
+    (free accuracy); with ``cfg.warm_start_iters`` set, the first step runs
+    the full ``cfg.subspace_iters`` cold and later steps run the short
+    count (scan-trainer contract — the dispatch reads the replicated step
+    counter on the host).
+    """
+    if collectives not in ("xla", "ring"):
+        raise ValueError(f"unknown collectives mode: {collectives!r}")
+    iters = cfg.subspace_iters
+    r = _resolve_rank(cfg, rank)
+    m = cfg.num_workers
+    key = jax.random.PRNGKey(seed)
+    step_core = _make_step_core(cfg, collectives=collectives, key=key)
 
     def make_sharded(step_iters):
-        def sharded(state, x):
+        def sharded(state, x, mask):
             # x: (m_local, n, d_local); state.u: (d_local_f, r)
-            # warm-start worker solves from the running estimate's top-k
-            # (zero on the cold first step -> graceful fallback to random
-            # init); the online subspace moves slowly, so warm steps
-            # converge in far fewer iterations
-            vws = worker_subspace_sharded(
-                x, k, step_iters, n, key, collectives, v0=state.u[:, :k]
-            )
-            v_bar = merged_lowrank_sharded(vws, k)
-            w, keep = weights(state.step)
-            new_state = _lowrank_update(
-                state, v_bar, w, keep, axis_name=FEATURE_AXIS
-            )
-            return new_state, v_bar
+            return step_core(state, x, step_iters, mask=mask)
 
         return sharded
 
     x_spec = P(WORKER_AXIS, None, FEATURE_AXIS)
     u_spec = P(FEATURE_AXIS, None)
+    mask_spec = P(WORKER_AXIS)
     state_specs = LowRankState(u=u_spec, s=P(), step=P())
 
     x_sharding = NamedSharding(mesh, x_spec)
+    mask_sharding = NamedSharding(mesh, mask_spec)
     state_shardings = LowRankState(
         u=NamedSharding(mesh, u_spec),
         s=NamedSharding(mesh, P()),
@@ -301,13 +377,13 @@ def make_feature_sharded_step(
         inner = jax.shard_map(
             make_sharded(step_iters),
             mesh=mesh,
-            in_specs=(state_specs, x_spec),
+            in_specs=(state_specs, x_spec, mask_spec),
             out_specs=(state_specs, u_spec),
             check_vma=False,
         )
         return jax.jit(
             inner,
-            in_shardings=(state_shardings, x_sharding),
+            in_shardings=(state_shardings, x_sharding, mask_sharding),
             out_shardings=(state_shardings, v_sharding),
         )
 
@@ -322,10 +398,20 @@ def make_feature_sharded_step(
         else None
     )
 
-    def step(state, x_blocks):
+    # placed once: the common unmasked call must not pay a host->device
+    # mask transfer per step
+    default_mask = jax.device_put(jnp.ones((m,), jnp.float32), mask_sharding)
+
+    def step(state, x_blocks, worker_mask=None):
+        if worker_mask is None:
+            worker_mask = default_mask
+        else:
+            worker_mask = jax.device_put(
+                jnp.asarray(worker_mask, jnp.float32), mask_sharding
+            )
         if warm is not None and int(state.step) > 0:
-            return warm(state, x_blocks)
-        return cold(state, x_blocks)
+            return warm(state, x_blocks, worker_mask)
+        return cold(state, x_blocks, worker_mask)
 
     def init_state():
         return jax.device_put(
@@ -337,6 +423,103 @@ def make_feature_sharded_step(
     step.x_sharding = x_sharding  # for input pipelines / prefetch placement
     step.state_shardings = state_shardings
     return step
+
+
+def make_feature_sharded_scan_fit(
+    cfg: PCAConfig,
+    mesh: Mesh,
+    *,
+    rank: int | None = None,
+    seed: int = 0,
+    collectives: str = "xla",
+):
+    """Whole-fit trainer for the feature-sharded backend: the T-step online
+    loop as ONE XLA program over the ``(workers, features)`` mesh —
+    ``fit(state, blocks, idx) -> state``.
+
+    The scan-carry state is the rank-r factorization (``(d/f) * r`` floats
+    per device — tiny), so unlike the dense scan trainer this path scans
+    without ever materializing d x d; it is the large-d twin of
+    :func:`~..algo.scan.make_scan_fit` with ``gather=True`` semantics:
+    ``blocks`` is (B, m, n, d) distinct staged blocks sharded
+    ``P(None, workers, None, features)`` and ``idx`` a (T,) int32 schedule
+    — each scan step gathers ``blocks[idx[t]]`` in the body, so device
+    memory stays O(B).
+
+    With ``cfg.warm_start_iters`` set (subspace solver — this backend's
+    only solver), step 1 runs the full ``cfg.subspace_iters`` cold and
+    every later scan step runs the short count warm-started from the
+    running estimate — the same per-step semantics as
+    :func:`make_feature_sharded_step` (tested equivalent), compiled as one
+    program so zero host dispatches separate the T steps.
+    """
+    if collectives not in ("xla", "ring"):
+        raise ValueError(f"unknown collectives mode: {collectives!r}")
+    iters = cfg.subspace_iters
+    r = _resolve_rank(cfg, rank)
+    key = jax.random.PRNGKey(seed)
+    step_core = _make_step_core(cfg, collectives=collectives, key=key)
+    warm_iters = (
+        cfg.warm_start_iters
+        if cfg.warm_start_iters is not None and cfg.solver == "subspace"
+        else None
+    )
+
+    def sharded_fit(state, blocks, idx):
+        def step_at(st, x, step_iters):
+            return step_core(st, x, step_iters)[0]
+
+        if warm_iters is None:
+            def body(st, i):
+                return step_at(st, blocks[i], iters), None
+
+            state, _ = jax.lax.scan(body, state, idx)
+            return state
+        # step 1 cold at the full iteration count (resume-safe: a restored
+        # state's u warm-starts it anyway), later steps short
+        state = step_at(state, blocks[idx[0]], iters)
+
+        def body(st, i):
+            return step_at(st, blocks[i], warm_iters), None
+
+        state, _ = jax.lax.scan(body, state, idx[1:])
+        return state
+
+    blocks_spec = P(None, WORKER_AXIS, None, FEATURE_AXIS)
+    u_spec = P(FEATURE_AXIS, None)
+    state_specs = LowRankState(u=u_spec, s=P(), step=P())
+    blocks_sharding = NamedSharding(mesh, blocks_spec)
+    state_shardings = LowRankState(
+        u=NamedSharding(mesh, u_spec),
+        s=NamedSharding(mesh, P()),
+        step=NamedSharding(mesh, P()),
+    )
+
+    inner = jax.shard_map(
+        sharded_fit,
+        mesh=mesh,
+        in_specs=(state_specs, blocks_spec, P()),
+        out_specs=state_specs,
+        check_vma=False,
+    )
+    fit = jax.jit(
+        inner,
+        in_shardings=(
+            state_shardings, blocks_sharding, NamedSharding(mesh, P()),
+        ),
+        out_shardings=state_shardings,
+    )
+
+    def init_state():
+        return jax.device_put(
+            LowRankState.initial(cfg.dim, r), state_shardings
+        )
+
+    fit.init_state = init_state
+    fit.rank = r
+    fit.blocks_sharding = blocks_sharding
+    fit.state_shardings = state_shardings
+    return fit
 
 
 def auto_feature_mesh(cfg: PCAConfig) -> Mesh:

@@ -233,3 +233,194 @@ def test_warm_started_steps_converge_with_few_iters(mesh, devices):
 def test_rank_below_k_rejected(mesh):
     with pytest.raises(ValueError):
         make_feature_sharded_step(_cfg(), mesh, rank=K - 1)
+
+def test_compute_dtype_bf16_matches_fp32(mesh, devices):
+    """bf16 matvec contractions (fp32 accumulation) land on the same
+    subspace as the fp32 step — the accuracy gate for the large-d perf
+    lever (VERDICT round 1, weak #1)."""
+    spec = _spec()
+    x = spec.sample(jax.random.PRNGKey(3), M * N).reshape(M, N, D)
+    f32 = make_feature_sharded_step(_cfg(), mesh, seed=4)
+    bf16 = make_feature_sharded_step(
+        _cfg(compute_dtype="bfloat16"), mesh, seed=4
+    )
+    _, v_f32 = f32(f32.init_state(), x)
+    _, v_bf16 = bf16(bf16.init_state(), x)
+    ang = np.asarray(
+        principal_angles_degrees(
+            jnp.asarray(np.asarray(v_bf16)), jnp.asarray(np.asarray(v_f32))
+        )
+    )
+    assert ang.max() < 1.0, f"bf16 vs fp32 step: {ang}"
+
+
+def test_worker_mask_excludes_failed_worker(mesh, devices, rng):
+    """A masked-out worker is excluded exactly: feed it garbage, mask it,
+    and the merge must match the dense WorkerPool round over the
+    survivors (the §5.3 fault mechanism on the scale-out backend)."""
+    spec = _spec()
+    cfg = _cfg()
+    x = np.asarray(
+        spec.sample(jax.random.PRNGKey(0), M * N).reshape(M, N, D)
+    ).copy()
+    x[1] = rng.standard_normal((N, D)).astype(np.float32) * 100.0  # junk
+    mask = np.array([1.0, 0.0, 1.0, 1.0], np.float32)
+
+    step = make_feature_sharded_step(cfg, mesh, seed=4)
+    _, v_bar = step(step.init_state(), jnp.asarray(x), worker_mask=mask)
+
+    dense = WorkerPool(M, backend="local", solver="eigh")
+    _, v_dense = dense.round(jnp.asarray(x), K, worker_mask=jnp.asarray(mask))
+    ang = np.asarray(
+        principal_angles_degrees(jnp.asarray(np.asarray(v_bar)), v_dense)
+    )
+    assert ang.max() < 1.0, f"masked sharded vs masked dense: {ang}"
+
+
+def test_fit_feature_sharded_with_worker_masks(devices):
+    """End-to-end online fit on the feature_sharded backend with a fault
+    mask stream — the NotImplementedError is gone and accuracy holds with
+    a worker dropped every step."""
+    import itertools
+
+    from distributed_eigenspaces_tpu.algo.online import (
+        online_distributed_pca,
+    )
+
+    spec = _spec()
+    cfg = _cfg(backend="feature_sharded", prefetch_depth=0)
+    key = jax.random.PRNGKey(9)
+    blocks = []
+    for _ in range(cfg.num_steps):
+        key, sub = jax.random.split(key)
+        blocks.append(spec.sample(sub, M * N).reshape(M, N, D))
+    masks = itertools.cycle(
+        [jnp.asarray([1.0, 1.0, 0.0, 1.0]), jnp.asarray([0.0, 1.0, 1.0, 1.0])]
+    )
+    w, state = online_distributed_pca(
+        iter(blocks), cfg, worker_masks=masks
+    )
+    ang = np.asarray(
+        principal_angles_degrees(jnp.asarray(np.asarray(w)), spec.top_k(K))
+    )
+    assert ang.max() < 2.0, f"masked fit accuracy: {ang}"
+    assert int(state.step) == cfg.num_steps
+
+
+def test_merged_lowrank_sharded_dense_dispatch(mesh, devices, rng):
+    """With dim_total known and m*k_f >= d, the sharded merge takes the
+    dense route — and it must agree with the factor-Gram route."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        merged_lowrank_sharded,
+    )
+
+    d_small, kf = 8, 3  # M*kf = 12 >= d = 8 -> dense route
+    base = rng.standard_normal((d_small, kf))
+    vs = np.stack(
+        [
+            np.linalg.qr(base + 0.05 * rng.standard_normal((d_small, kf)))[0]
+            for _ in range(M)
+        ]
+    ).astype(np.float32)
+
+    def run(dim_total):
+        return jax.jit(
+            jax.shard_map(
+                lambda v: merged_lowrank_sharded(
+                    v, kf, dim_total=dim_total
+                ),
+                mesh=mesh,
+                in_specs=(P("workers", "features", None),),
+                out_specs=P("features", None),
+                check_vma=False,
+            )
+        )(jnp.asarray(vs))
+
+    dense = np.asarray(run(d_small))       # dispatches dense
+    lowrank = np.asarray(run(None))        # factor-Gram route
+    ang = np.asarray(
+        principal_angles_degrees(jnp.asarray(dense), jnp.asarray(lowrank))
+    )
+    assert ang.max() < 0.1, ang
+
+
+def test_scan_fit_matches_per_step(mesh, devices):
+    """The whole-fit feature-sharded scan == T calls of the per-step
+    trainer (same cfg/seed/data), warm start included."""
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        make_feature_sharded_scan_fit,
+    )
+
+    spec = _spec()
+    T = 4
+    cfg = _cfg(num_steps=T, warm_start_iters=3, solver="subspace")
+    key = jax.random.PRNGKey(7)
+    blocks = []
+    for _ in range(T):
+        key, sub = jax.random.split(key)
+        blocks.append(np.asarray(spec.sample(sub, M * N).reshape(M, N, D)))
+
+    step = make_feature_sharded_step(cfg, mesh, seed=4)
+    st = step.init_state()
+    for b in blocks:
+        st, _ = step(st, jnp.asarray(b))
+
+    fit = make_feature_sharded_scan_fit(cfg, mesh, seed=4)
+    stacked = jax.device_put(
+        jnp.asarray(np.stack(blocks)), fit.blocks_sharding
+    )
+    idx = jnp.arange(T, dtype=jnp.int32)
+    st_scan = fit(fit.init_state(), stacked, idx)
+
+    assert int(st_scan.step) == T
+    ang = np.asarray(
+        principal_angles_degrees(
+            jnp.asarray(np.asarray(st_scan.u[:, :K])),
+            jnp.asarray(np.asarray(st.u[:, :K])),
+        )
+    )
+    assert ang.max() < 0.5, f"scan vs per-step: {ang}"
+    # and both recover the planted subspace
+    ang_truth = np.asarray(
+        principal_angles_degrees(
+            jnp.asarray(np.asarray(st_scan.u[:, :K])), spec.top_k(K)
+        )
+    )
+    assert ang_truth.max() < 2.0, f"scan fit accuracy: {ang_truth}"
+
+
+def test_scan_fit_no_warm_start(mesh, devices):
+    """Scan fit without warm_start_iters (all steps at full iters) also
+    matches the per-step trainer."""
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        make_feature_sharded_scan_fit,
+    )
+
+    spec = _spec()
+    T = 3
+    cfg = _cfg(num_steps=T)
+    key = jax.random.PRNGKey(5)
+    blocks = []
+    for _ in range(T):
+        key, sub = jax.random.split(key)
+        blocks.append(np.asarray(spec.sample(sub, M * N).reshape(M, N, D)))
+
+    step = make_feature_sharded_step(cfg, mesh, seed=4)
+    st = step.init_state()
+    for b in blocks:
+        st, _ = step(st, jnp.asarray(b))
+
+    fit = make_feature_sharded_scan_fit(cfg, mesh, seed=4)
+    stacked = jax.device_put(
+        jnp.asarray(np.stack(blocks)), fit.blocks_sharding
+    )
+    st_scan = fit(fit.init_state(), stacked, jnp.arange(T, dtype=jnp.int32))
+    ang = np.asarray(
+        principal_angles_degrees(
+            jnp.asarray(np.asarray(st_scan.u[:, :K])),
+            jnp.asarray(np.asarray(st.u[:, :K])),
+        )
+    )
+    assert ang.max() < 0.5, f"scan vs per-step (cold): {ang}"
