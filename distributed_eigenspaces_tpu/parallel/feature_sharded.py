@@ -97,9 +97,93 @@ def _small_eigh_desc(g):
     return w[..., ::-1], q[..., ::-1]
 
 
+def ns_orth(v, axis_name=None, iters=4, eps=1e-20):
+    """Orthonormalize tall-skinny ``v (..., d, k)`` by column scaling +
+    Newton-Schulz iteration — pure matmuls end to end.
+
+    Why it exists: on TPU every Cholesky / triangular-solve / eigh call
+    costs ~0.5-1.8 ms of *latency* at k-sized shapes (measured; the ops are
+    long sequential chains XLA can't tile onto the MXU), so a CholeskyQR2
+    per warm step dominates the whole step. NS needs only Grams and
+    matmuls. Composite form: ONE d-sized Gram + ONE d-sized matmul; the
+    iteration itself runs on k x k matrices (``G`` and the polynomial
+    transform commute, so ``V_i = V_0 M_i`` with ``M`` accumulated in k^3
+    ops).
+
+    Converges for inputs with bounded condition number (the warm regime:
+    bases one power step away from orthonormal ``v0``); columns are
+    norm-scaled first (covariance-scaled matvec outputs have column norms
+    spread like the top-k eigenvalues), then the whole basis is scaled by
+    the inf-norm bound so every singular value is <= 1. NOT a
+    general-purpose QR — cold starts keep :func:`chol_qr2`.
+    """
+    g = jnp.einsum("...dk,...dl->...kl", v, v, precision=HP)
+    g = _psum_if(g, axis_name)
+    dscale = jax.lax.rsqrt(
+        jnp.maximum(jnp.diagonal(g, axis1=-2, axis2=-1), eps)
+    )
+    g = g * dscale[..., :, None] * dscale[..., None, :]
+    # sigma_max^2 <= max abs row sum; after column normalization the diag
+    # is 1 so the bound is >= 1 and alpha <= 1
+    alpha2 = 1.0 / jnp.maximum(
+        jnp.max(jnp.sum(jnp.abs(g), axis=-1), axis=-1), 1.0
+    )
+    g = g * alpha2[..., None, None]
+    k = g.shape[-1]
+    eye = jnp.eye(k, dtype=g.dtype)
+    m_acc = eye * jnp.sqrt(alpha2)[..., None, None]
+
+    for _ in range(iters):
+        a = 1.5 * eye - 0.5 * g
+        m_acc = m_acc @ a
+        g = g @ (a @ a)  # G and a (a polynomial in G) commute
+
+    return jnp.einsum(
+        "...dk,...kl->...dl", v * dscale[..., None, :], m_acc, precision=HP
+    )
+
+
+def _reduce_features(collectives):
+    if collectives == "ring":
+        from distributed_eigenspaces_tpu.parallel.ring import ring_psum
+
+        return lambda t: ring_psum(t, FEATURE_AXIS)
+    return lambda t: jax.lax.psum(t, FEATURE_AXIS)
+
+
+def _make_matvec(x, n_total_rows, collectives="xla", compute_dtype=None):
+    """``matvec(v) = X^T (X v) / n`` with the feature dim sharded, batched
+    over the leading worker axis — the FLOP load of every solve on this
+    path. ``x`` is (m_local, n, d_local); ``v`` (m_local, d_local, k). The
+    inner (n, k) product reduces over ``features`` with a psum (k-wide —
+    the same wire shape as the reference's JSON eigenspace messages,
+    ``distributed.py:51``, but over ICI). ``compute_dtype`` (bf16) runs the
+    two tall-skinny contractions at full MXU rate with fp32 accumulation.
+    """
+    xc = x.astype(compute_dtype) if compute_dtype is not None else x
+    prec = HP if xc.dtype == jnp.float32 else None
+    reduce_features = _reduce_features(collectives)
+
+    def matvec(v):
+        xv = jnp.einsum(
+            "mnd,mdk->mnk", xc, v.astype(xc.dtype), precision=prec,
+            preferred_element_type=jnp.float32,
+        )
+        xv = reduce_features(xv)
+        return (
+            jnp.einsum(
+                "mnd,mnk->mdk", xc, xv.astype(xc.dtype), precision=prec,
+                preferred_element_type=jnp.float32,
+            )
+            / n_total_rows
+        )
+
+    return matvec
+
+
 def worker_subspace_sharded(
     x, k, iters, n_total_rows, key, collectives="xla", v0=None,
-    compute_dtype=None,
+    compute_dtype=None, ritz=True,
 ):
     """Per-worker top-k eigenspaces with the feature dim sharded.
 
@@ -118,32 +202,7 @@ def worker_subspace_sharded(
     and accuracy-critical, not throughput-critical).
     """
     m_local, n, d_local = x.shape
-    xc = x.astype(compute_dtype) if compute_dtype is not None else x
-    prec = HP if xc.dtype == jnp.float32 else None
-
-    if collectives == "ring":
-        from distributed_eigenspaces_tpu.parallel.ring import ring_psum
-
-        reduce_features = lambda t: ring_psum(t, FEATURE_AXIS)  # noqa: E731
-    else:
-        reduce_features = lambda t: jax.lax.psum(  # noqa: E731
-            t, FEATURE_AXIS
-        )
-
-    def matvec(v):
-        # v: (m_local, d_local, k). X V reduces over the sharded d axis.
-        xv = jnp.einsum(
-            "mnd,mdk->mnk", xc, v.astype(xc.dtype), precision=prec,
-            preferred_element_type=jnp.float32,
-        )
-        xv = reduce_features(xv)
-        return (
-            jnp.einsum(
-                "mnd,mnk->mdk", xc, xv.astype(xc.dtype), precision=prec,
-                preferred_element_type=jnp.float32,
-            )
-            / n_total_rows
-        )
+    matvec = _make_matvec(x, n_total_rows, collectives, compute_dtype)
 
     # deterministic, feature-shard-distinct init: fold in the shard index
     fidx = jax.lax.axis_index(FEATURE_AXIS)
@@ -164,6 +223,14 @@ def worker_subspace_sharded(
         return chol_qr2(matvec(v), FEATURE_AXIS)
 
     v = jax.lax.fori_loop(0, iters, body, v)
+    if not ritz:
+        # ``ritz=False`` skips the Rayleigh-Ritz rotation: the merged
+        # pipeline consumes only the worker *projectors* ``V V^T``, which
+        # are invariant to any orthonormal rotation of V's columns — so
+        # the final matvec (two more full passes over X) and the small
+        # eigh buy nothing there. Standalone callers that need
+        # descending-order eigenvector columns keep the default.
+        return v
     # Rayleigh-Ritz within each worker for descending-order columns
     av = matvec(v)
     small = jnp.einsum("mdk,mdl->mkl", v, av, precision=HP)
@@ -309,6 +376,7 @@ def _make_step_core(cfg: PCAConfig, *, collectives: str, key):
         vws = worker_subspace_sharded(
             x, k, step_iters, n, key, collectives,
             v0=st.u[:, :k], compute_dtype=cfg.compute_dtype,
+            ritz=False,  # the merge below is rotation-invariant
         )
         v_bar = merged_lowrank_sharded(vws, k, mask=mask, dim_total=cfg.dim)
         w, keep = weights(st.step)
@@ -517,6 +585,204 @@ def make_feature_sharded_scan_fit(
 
     fit.init_state = init_state
     fit.rank = r
+    fit.blocks_sharding = blocks_sharding
+    fit.state_shardings = state_shardings
+    return fit
+
+
+class SketchState(NamedTuple):
+    """Carry of the sketched trainer: ``y`` the Nystrom sketch
+    ``sigma_tilde @ omega`` (d, p), ``v`` the previous merged top-k basis
+    (d, k, orthonormal), ``step`` the 1-based round count. Both ``y`` and
+    ``v`` are row-sharded over ``features`` in the distributed fit."""
+
+    y: jax.Array
+    v: jax.Array
+    step: jax.Array
+
+    @classmethod
+    def initial(cls, dim: int, k: int, p: int, dtype=jnp.float32):
+        return cls(
+            y=jnp.zeros((dim, p), dtype=dtype),
+            v=jnp.zeros((dim, k), dtype=dtype),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+
+def _nystrom_top_k(y, omega, k, axis_name=None):
+    """Top-k eigenvectors of the PSD matrix behind a single-pass Nystrom
+    sketch ``y = A @ omega``: ``A ~= Y B^{-1} Y^T`` with ``B = omega^T Y``
+    (= ``omega^T A omega``), factored as ``F F^T`` for ``F = Y L^{-T}``,
+    ``B = L L^T``. One Cholesky + one small eigh, run ONCE at extraction —
+    the whole point of the sketch is that no spectral solve runs per step.
+    ``y``/``omega`` are (d_local, p) row shards when ``axis_name`` is set.
+    """
+    b = jnp.einsum("dp,dq->pq", omega, y, precision=HP)
+    b = _psum_if(b, axis_name)
+    b = 0.5 * (b + b.T)
+    p = b.shape[0]
+    shift = 1e-6 * jnp.maximum(jnp.trace(b), 0.0) / p + 1e-30
+    ell = jnp.linalg.cholesky(b + shift * jnp.eye(p, dtype=b.dtype))
+    f = jax.lax.linalg.triangular_solve(
+        ell, y, left_side=False, lower=True, transpose_a=True
+    )
+    gf = jnp.einsum("dp,dq->pq", f, f, precision=HP)
+    gf = _psum_if(gf, axis_name)
+    w, q = _small_eigh_desc(gf)
+    wk = jnp.maximum(w[:k], 0.0)
+    inv = jnp.where(wk > 1e-12, jax.lax.rsqrt(jnp.maximum(wk, 1e-30)), 0.0)
+    return jnp.einsum("dp,pk,k->dk", f, q[:, :k], inv, precision=HP)
+
+
+def make_feature_sharded_sketch_fit(
+    cfg: PCAConfig,
+    mesh: Mesh,
+    *,
+    oversample: int = 16,
+    seed: int = 0,
+    collectives: str = "xla",
+):
+    """Sketched whole-fit trainer for the feature-sharded backend:
+    ``fit(state, blocks, idx) -> state`` with a steady-state loop that is
+    pure MXU work — no eigh, no Cholesky, no triangular solve per step.
+
+    Why: on TPU the exact scan trainer's warm step is latency-bound, not
+    FLOP-bound — the (m k)^2 merge eigh, the (r+k)^2 update eigh, and each
+    CholeskyQR2's Cholesky+solve pair cost ~0.5-1.8 ms EACH (measured;
+    they lower to long sequential chains the MXU can't help with), which
+    dwarfs the ~0.5 ms of actual matvec work per warm step. This trainer
+    restructures the steady state so nothing sequential remains:
+
+    - worker solves: ``warm_start_iters`` application(s) of each worker's
+      covariance to the previous merged basis (batched bf16 matvecs),
+      orthonormalized by :func:`ns_orth` (pure matmuls);
+    - merge: one power step of the projector mean applied to the previous
+      basis — ``z = sum_l V_l (V_l^T v_prev)`` (thin matmuls + the k-wide
+      psums), then :func:`ns_orth`. In the warm regime the projector
+      mean's top-k eigenvalues cluster near 1 with a large gap, so one
+      power step from the previous (already-converged) basis tracks the
+      exact merge to within the online drift;
+    - online state: a single-pass Nystrom sketch ``y += w_t * v_bar
+      (v_bar^T omega)`` against a fixed (d, k+oversample) test matrix —
+      two thin matmuls replace the exact rank-r eigendecomposition update.
+      All spectral work happens ONCE, in :func:`_nystrom_top_k` at
+      extraction (``fit.extract``).
+
+    The first step (and a resumed first step) runs the full cold machinery:
+    ``cfg.subspace_iters`` CholeskyQR2 iterations + the EXACT factor merge
+    (:func:`merged_lowrank_sharded`). Accuracy is gated end-to-end (<= 1
+    degree vs the planted subspace) by the evals/bench that use this path.
+
+    Trade vs :func:`make_feature_sharded_scan_fit`: per-step state is not
+    an exact truncated eigendecomposition (semantics differ from the
+    per-step trainer beyond the first step), and worker fault masks are
+    not supported — use the exact trainers for those.
+    """
+    if collectives not in ("xla", "ring"):
+        raise ValueError(f"unknown collectives mode: {collectives!r}")
+    d, k, n, m = cfg.dim, cfg.k, cfg.rows_per_worker, cfg.num_workers
+    p = min(d, k + oversample)
+    iters = cfg.subspace_iters
+    warm_iters = cfg.warm_start_iters if cfg.warm_start_iters else 2
+    weights = _discount_weights(cfg)
+    key = jax.random.PRNGKey(seed)
+    omega_key, solve_key = jax.random.split(key)
+
+    def _omega(d_local):
+        fidx = jax.lax.axis_index(FEATURE_AXIS)
+        return jax.random.normal(
+            jax.random.fold_in(omega_key, fidx), (d_local, p), jnp.float32
+        )
+
+    def _fold(st, v_bar, omega):
+        w_t, keep = weights(st.step)
+        g = jax.lax.psum(
+            jnp.einsum("dk,dp->kp", v_bar, omega, precision=HP),
+            FEATURE_AXIS,
+        )
+        y = keep * st.y + w_t * jnp.einsum(
+            "dk,kp->dp", v_bar, g, precision=HP
+        )
+        return SketchState(y=y, v=v_bar, step=st.step + 1)
+
+    def cold_step(st, x, omega):
+        vws = worker_subspace_sharded(
+            x, k, iters, n, solve_key, collectives,
+            v0=st.v, compute_dtype=cfg.compute_dtype, ritz=False,
+        )
+        v_bar = merged_lowrank_sharded(vws, k, dim_total=d)
+        return _fold(st, v_bar, omega)
+
+    def warm_step(st, x, omega):
+        matvec = _make_matvec(x, n, collectives, cfg.compute_dtype)
+        v = jnp.broadcast_to(st.v[None], (x.shape[0],) + st.v.shape)
+        for _ in range(warm_iters):
+            v = matvec(v)
+        v = ns_orth(v, FEATURE_AXIS)
+        # projector-mean power step (scale-free: ns_orth renormalizes)
+        yl = jax.lax.psum(
+            jnp.einsum("mdk,dl->mkl", v, st.v, precision=HP), FEATURE_AXIS
+        )
+        z = jax.lax.psum(
+            jnp.einsum("mdk,mkl->dl", v, yl, precision=HP), WORKER_AXIS
+        )
+        v_bar = ns_orth(z, FEATURE_AXIS)
+        return _fold(st, v_bar, omega)
+
+    def sharded_fit(state, blocks, idx):
+        omega = _omega(state.y.shape[0])
+        state = cold_step(state, blocks[idx[0]], omega)
+
+        def body(st, i):
+            return warm_step(st, blocks[i], omega), None
+
+        state, _ = jax.lax.scan(body, state, idx[1:])
+        return state
+
+    def sharded_extract(state):
+        return _nystrom_top_k(state.y, _omega(state.y.shape[0]), k,
+                              FEATURE_AXIS)
+
+    blocks_spec = P(None, WORKER_AXIS, None, FEATURE_AXIS)
+    row_spec = P(FEATURE_AXIS, None)
+    state_specs = SketchState(y=row_spec, v=row_spec, step=P())
+    blocks_sharding = NamedSharding(mesh, blocks_spec)
+    state_shardings = SketchState(
+        y=NamedSharding(mesh, row_spec),
+        v=NamedSharding(mesh, row_spec),
+        step=NamedSharding(mesh, P()),
+    )
+
+    fit = jax.jit(
+        jax.shard_map(
+            sharded_fit,
+            mesh=mesh,
+            in_specs=(state_specs, blocks_spec, P()),
+            out_specs=state_specs,
+            check_vma=False,
+        ),
+        in_shardings=(
+            state_shardings, blocks_sharding, NamedSharding(mesh, P()),
+        ),
+        out_shardings=state_shardings,
+    )
+
+    def init_state():
+        return jax.device_put(SketchState.initial(d, k, p), state_shardings)
+
+    fit.init_state = init_state
+    fit.extract = jax.jit(
+        jax.shard_map(
+            sharded_extract,
+            mesh=mesh,
+            in_specs=(state_specs,),
+            out_specs=row_spec,
+            check_vma=False,
+        ),
+        in_shardings=(state_shardings,),
+        out_shardings=NamedSharding(mesh, row_spec),
+    )
+    fit.sketch_width = p
     fit.blocks_sharding = blocks_sharding
     fit.state_shardings = state_shardings
     return fit
