@@ -147,10 +147,24 @@ def online_distributed_pca(
         )
     )
 
+    # online warm start (cfg.warm_start_iters): after the cold first round,
+    # warm-start each worker's subspace iteration from the previous merged
+    # estimate at the short iteration count — the same lever the scan
+    # trainer has, threaded through the loop instead of a scan carry
+    warm = cfg.warm_start_iters is not None and cfg.solver == "subspace"
+    v_prev = None
+
     def step(st, x_blocks):
+        nonlocal v_prev
         mask = next(worker_masks) if worker_masks is not None else None
         # pool.shard is idempotent, so prefetch-placed blocks pass through
-        _, v_bar = pool.round(pool.shard(x_blocks), cfg.k, worker_mask=mask)
+        _, v_bar = pool.round(
+            pool.shard(x_blocks), cfg.k, worker_mask=mask,
+            v0=v_prev,
+            iters=cfg.warm_start_iters if v_prev is not None else None,
+        )
+        if warm:
+            v_prev = v_bar
         return update(st, v_bar), v_bar
 
     state = _drive_stream(

@@ -70,12 +70,19 @@ def make_round_core(cfg: PCAConfig, iters: int | None = None):
 def make_train_step(
     cfg: PCAConfig, mesh: Mesh | None = None, *, donate: bool = True
 ):
-    """Build ``step(state, x_blocks) -> (state, v_bar)``, jitted.
+    """Build ``step(state, x_blocks, v_prev=None) -> (state, v_bar)``, jitted.
 
     ``mesh=None`` gives the single-device (vmap-over-workers) step;
     with a mesh, worker compute runs under ``shard_map`` over the
     ``workers`` axis, the merge is a ``psum`` over ICI, and the returned
     state/eigenspace are replicated.
+
+    With ``cfg.warm_start_iters`` set (subspace solver), passing ``v_prev``
+    — the previous round's merged eigenspace — runs the short
+    warm-started solver core instead of the full-iteration cold core:
+    the per-step/streaming trainers get the same online warm-start lever
+    the scan trainer has (callers thread the returned ``v_bar`` back in).
+    Without ``v_prev`` (or without the config knob) every step runs cold.
 
     ``donate=True`` donates the state argument (reuses the d*d buffer —
     right for training loops that thread the state). Pass ``donate=False``
@@ -83,6 +90,10 @@ def make_train_step(
     calls on fixed example args).
     """
     round_core = make_round_core(cfg)
+    warm = cfg.warm_start_iters is not None and cfg.solver == "subspace"
+    warm_core = (
+        make_round_core(cfg, iters=cfg.warm_start_iters) if warm else None
+    )
     donate_args = (0,) if donate else ()
 
     def fold(state, v_bar):
@@ -96,29 +107,59 @@ def make_train_step(
     if mesh is None:
 
         @partial(jax.jit, donate_argnums=donate_args)
-        def step(state: OnlineState, x_blocks):
+        def cold(state: OnlineState, x_blocks):
             return fold(state, round_core(x_blocks))
 
-        return step
+        if warm:
 
-    x_sharding = NamedSharding(mesh, P(WORKER_AXIS))
-    rep = NamedSharding(mesh, P())
+            @partial(jax.jit, donate_argnums=donate_args)
+            def warm_step(state: OnlineState, x_blocks, v_prev):
+                return fold(state, warm_core(x_blocks, v0=v_prev))
 
-    inner = jax.shard_map(
-        partial(round_core, axis_name=WORKER_AXIS),
-        mesh=mesh,
-        in_specs=(P(WORKER_AXIS),),
-        out_specs=P(),
-        check_vma=False,
-    )
+    else:
+        x_sharding = NamedSharding(mesh, P(WORKER_AXIS))
+        rep = NamedSharding(mesh, P())
 
-    @partial(
-        jax.jit,
-        in_shardings=(rep, x_sharding),
-        out_shardings=(rep, rep),
-        donate_argnums=donate_args,
-    )
-    def step(state: OnlineState, x_blocks):
-        return fold(state, inner(x_blocks))
+        inner = jax.shard_map(
+            partial(round_core, axis_name=WORKER_AXIS),
+            mesh=mesh,
+            in_specs=(P(WORKER_AXIS),),
+            out_specs=P(),
+            check_vma=False,
+        )
+
+        @partial(
+            jax.jit,
+            in_shardings=(rep, x_sharding),
+            out_shardings=(rep, rep),
+            donate_argnums=donate_args,
+        )
+        def cold(state: OnlineState, x_blocks):
+            return fold(state, inner(x_blocks))
+
+        if warm:
+            inner_warm = jax.shard_map(
+                lambda x, v0: warm_core(
+                    x, axis_name=WORKER_AXIS, v0=v0
+                ),
+                mesh=mesh,
+                in_specs=(P(WORKER_AXIS), P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+
+            @partial(
+                jax.jit,
+                in_shardings=(rep, x_sharding, rep),
+                out_shardings=(rep, rep),
+                donate_argnums=donate_args,
+            )
+            def warm_step(state: OnlineState, x_blocks, v_prev):
+                return fold(state, inner_warm(x_blocks, v_prev))
+
+    def step(state: OnlineState, x_blocks, v_prev=None):
+        if warm and v_prev is not None:
+            return warm_step(state, x_blocks, v_prev)
+        return cold(state, x_blocks)
 
     return step

@@ -39,15 +39,20 @@ class PCAConfig:
         ``"subspace"`` (block power iteration; never materializes d x d in the
         streaming path).
       subspace_iters: power-iteration steps when ``solver="subspace"``.
-      warm_start_iters: online warm start for the whole-fit scan trainer
-        (``algo/scan.py``): when set and ``solver="subspace"``, step 1 runs
-        the full ``subspace_iters`` cold, and every later step initializes
-        each worker's subspace iteration from the previous merged estimate
-        and runs only this many iterations (the previous ``v_bar`` is an
-        excellent initializer for a slowly-varying online stream — same
-        converged subspace, ~3x shorter per-step solver chain). ``None``
-        disables (every step runs cold). The per-step trainer ignores it
-        (its API carries no cross-step solver state).
+      warm_start_iters: online warm start: when set and
+        ``solver="subspace"``, step 1 runs the full ``subspace_iters``
+        cold, and every later step initializes each worker's subspace
+        iteration from the previous merged estimate and runs only this
+        many iterations (the previous ``v_bar`` is an excellent
+        initializer for a slowly-varying online stream — same converged
+        subspace, ~3x shorter per-step solver chain). Honored by the scan
+        trainer (``algo/scan.py``, scan carry), the per-step trainers
+        (``algo/step.py`` / ``online_distributed_pca``, threaded through
+        the loop), and the feature-sharded trainers. ``None`` disables
+        (every step runs cold) — except on the sketch trainer
+        (``make_feature_sharded_sketch_fit``), which is warm by
+        construction and treats ``None`` as its default of 2 warm
+        matvecs per step.
       orth_method: orthonormalization inside the subspace solver:
         ``"cholqr2"`` (CholeskyQR2 — MXU matmuls with a shallow dependency
         chain, the TPU default) or ``"qr"`` (Householder — bulletproof but a

@@ -8,11 +8,18 @@ double-buffered :class:`..runtime.native.ChunkReader` (C++ read-ahead
 thread overlapping disk latency with host->device transfer), so host
 memory holds only ~2 in-flight steps regardless of dataset size.
 
-File format: flat rows, ``dtype`` (float32 / bfloat16 / uint8), row length
-``dim`` — i.e. exactly ``array.tobytes()`` of an ``(N, dim)`` matrix.
+File format: flat rows, ``dtype`` (float32 / bfloat16 / uint8 / int8), row
+length ``dim`` — i.e. exactly ``array.tobytes()`` of an ``(N, dim)`` matrix.
 ``write_rows`` produces it; uint8 rows are widened to float32 by the native
 conversion kernel, bfloat16 rows are bit-extended (uint16 -> high half of a
 float32 word — a reinterpretation, not a value cast) on the way in.
+
+Quantized wire format: with an integer ``out_dtype`` (e.g. ``jnp.int8``
+over an int8 file), blocks pass through UNCONVERTED — 4x fewer bytes cross
+host->device than fp32, and the widening happens on-device as part of the
+compute-dtype cast. For symmetric (zero-offset) int8 quantization the
+global scale cancels in eigenvectors, so the PCA subspace needs no
+dequantization at all; see ``evals.py`` config 5.
 """
 
 from __future__ import annotations
@@ -65,6 +72,14 @@ def bin_block_stream(
         raise ValueError(f"unknown remainder policy: {remainder!r}")
     in_dt = np.dtype(dtype)
     is_bf16 = in_dt.name == "bfloat16"
+    out_is_int = jnp.issubdtype(jnp.dtype(out_dtype), jnp.integer)
+    if out_is_int and (is_bf16 or in_dt != np.dtype(out_dtype)):
+        raise ValueError(
+            f"integer out_dtype={jnp.dtype(out_dtype).name} requires the "
+            f"same on-disk dtype (got {in_dt.name}) — the passthrough "
+            "path ships the stored bytes to the device unconverted"
+        )
+    host_dt = in_dt if out_is_int else np.float32
     step_rows = num_workers * rows_per_worker
     chunk_bytes = step_rows * dim * in_dt.itemsize
     total = num_rows(path, dim, dtype)
@@ -77,6 +92,8 @@ def bin_block_stream(
             bits = np.frombuffer(buf, dtype=np.uint16)
             return (bits.astype(np.uint32) << 16).view(np.float32)
         arr = np.frombuffer(buf, dtype=in_dt)
+        if out_is_int:
+            return arr  # quantized passthrough: device widens during compute
         if in_dt == np.uint8:
             arr = to_f32(arr)  # native widen kernel
         return np.asarray(arr, np.float32)
@@ -95,7 +112,7 @@ def bin_block_stream(
                         f"{tail_rows} remainder rows (step={step_rows}); "
                         "set remainder='drop'/'pad' or adjust sizes"
                     )
-                block = np.zeros((step_rows, dim), np.float32)
+                block = np.zeros((step_rows, dim), host_dt)
                 block[:tail_rows] = convert(
                     chunk[: tail_rows * dim * in_dt.itemsize]
                 ).reshape(tail_rows, dim)

@@ -160,6 +160,10 @@ def _make_matvec(x, n_total_rows, collectives="xla", compute_dtype=None):
     ``distributed.py:51``, but over ICI). ``compute_dtype`` (bf16) runs the
     two tall-skinny contractions at full MXU rate with fp32 accumulation.
     """
+    if compute_dtype is None and jnp.issubdtype(x.dtype, jnp.integer):
+        # integer einsums accumulate in the integer dtype and wrap
+        # silently — widen quantized wire blocks (see bin_stream int8)
+        compute_dtype = jnp.float32
     xc = x.astype(compute_dtype) if compute_dtype is not None else x
     prec = HP if xc.dtype == jnp.float32 else None
     reduce_features = _reduce_features(collectives)
@@ -683,7 +687,13 @@ def make_feature_sharded_sketch_fit(
     d, k, n, m = cfg.dim, cfg.k, cfg.rows_per_worker, cfg.num_workers
     p = min(d, k + oversample)
     iters = cfg.subspace_iters
-    warm_iters = cfg.warm_start_iters if cfg.warm_start_iters else 2
+    # this trainer is warm BY CONSTRUCTION (the steady-state restructure is
+    # its whole point): warm_start_iters sets the per-step matvec count and
+    # defaults to 2 when the config leaves it None — it cannot "disable"
+    # warm starts here the way it does on the exact trainers
+    warm_iters = (
+        cfg.warm_start_iters if cfg.warm_start_iters is not None else 2
+    )
     weights = _discount_weights(cfg)
     key = jax.random.PRNGKey(seed)
     omega_key, solve_key = jax.random.split(key)

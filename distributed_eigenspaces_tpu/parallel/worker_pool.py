@@ -116,6 +116,15 @@ def _local_eigenspaces(
     use_pallas = os.environ.get("DET_NO_PALLAS", "0") != "1"
     fused_xtxv = resolve_fused(fused_xtxv)
 
+    if jnp.issubdtype(x_blocks.dtype, jnp.integer):
+        # quantized wire blocks (bin_stream int8 passthrough): integer
+        # einsums accumulate in the integer dtype and WRAP silently — always
+        # widen, to the compute dtype when set (the free-dequant contract:
+        # a symmetric quantization scale cancels in eigenvectors) else fp32
+        x_blocks = x_blocks.astype(
+            compute_dtype if compute_dtype is not None else jnp.float32
+        )
+
     d = x_blocks.shape[2]
     # Streaming subspace solves apply the covariance as X^T (X v) / n and
     # never materialize the d x d Gram (SURVEY.md §7 hard part (a)):
@@ -250,14 +259,21 @@ class WorkerPool:
 
     # -- public API ---------------------------------------------------------
 
-    def round(self, x_blocks: jax.Array, k: int, worker_mask=None):
+    def round(
+        self, x_blocks: jax.Array, k: int, worker_mask=None,
+        v0: jax.Array | None = None, iters: int | None = None,
+    ):
         """One merge round: ``(m, n, d) -> (sigma_bar (d, d), v_bar (d, k))``.
 
         ``sigma_bar`` is the mean projector (what the reference master
         computes and then discards, ``distributed.py:126-131`` / B4);
         ``v_bar`` is its top-k eigenspace (what the pseudocode actually
         needs). ``worker_mask`` (m,) of {0,1} excludes failed workers from
-        the merge.
+        the merge. ``v0`` (d, k) warm-starts every worker's subspace
+        iteration (online callers pass the previous round's merged
+        estimate) and ``iters`` overrides the pool's iteration count for
+        this round — together they are the per-step trainer's warm-start
+        lever (``cfg.warm_start_iters``); both ignored by the eigh solver.
         """
         m = x_blocks.shape[0]
         if m != self.num_workers:
@@ -267,7 +283,9 @@ class WorkerPool:
             )
         if worker_mask is None:
             worker_mask = jnp.ones((m,), dtype=jnp.float32)
-        return self._round_fn(x_blocks, worker_mask, k)
+        return self._round_fn(
+            x_blocks, worker_mask, k=k, v0=v0, step_iters=iters
+        )
 
     def shard(self, x_blocks: jax.Array) -> jax.Array:
         """Place ``(m, n, d)`` host data onto the pool's devices with the
@@ -313,11 +331,12 @@ class WorkerPool:
 
         if self.backend == "local":
 
-            @partial(jax.jit, static_argnames=("k",))
-            def round_local(x_blocks, mask, k):
+            @partial(jax.jit, static_argnames=("k", "step_iters"))
+            def round_local(x_blocks, mask, k, v0=None, step_iters=None):
                 vs = _local_eigenspaces(
-                    x_blocks, k, solver, iters, orth, cdtype,
-                    fused_xtxv=fused,
+                    x_blocks, k, solver,
+                    iters if step_iters is None else step_iters,
+                    orth, cdtype, v0=v0, fused_xtxv=fused,
                 )
                 return merge(vs, mask, k)
 
@@ -326,12 +345,14 @@ class WorkerPool:
         mesh = self.mesh
         in_spec = P(WORKER_AXIS)
 
-        @partial(jax.jit, static_argnames=("k",))
-        def round_sharded(x_blocks, mask, k):
-            def shard_fn(xs, mask_s):
+        @partial(jax.jit, static_argnames=("k", "step_iters"))
+        def round_sharded(x_blocks, mask, k, v0=None, step_iters=None):
+            def shard_fn(xs, mask_s, v0_s):
                 # xs: (m_local, n, d) on this device's worker slot(s)
                 vs = _local_eigenspaces(
-                    xs, k, solver, iters, orth, cdtype, fused_xtxv=fused
+                    xs, k, solver,
+                    iters if step_iters is None else step_iters,
+                    orth, cdtype, v0=v0_s, fused_xtxv=fused,
                 )
                 # ICI gather of the d x k factors — the entire reference
                 # wire protocol (C11) collapses to these two lines, moving
@@ -345,10 +366,10 @@ class WorkerPool:
             return jax.shard_map(
                 partial(shard_fn),
                 mesh=mesh,
-                in_specs=(in_spec, in_spec),
+                in_specs=(in_spec, in_spec, P()),
                 out_specs=(P(), P()),
                 check_vma=False,
-            )(x_blocks, mask)
+            )(x_blocks, mask, v0)
 
         return round_sharded
 

@@ -155,3 +155,64 @@ def test_estimator_api(rng):
     step_before = int(est.state.step)
     est.partial_fit(np.asarray(spec.sample(jax.random.PRNGKey(2), 8 * 64)).reshape(8, 64, D))
     assert int(est.state.step) == step_before + 1
+
+
+def test_per_step_warm_start_matches_cold_accuracy(devices):
+    """cfg.warm_start_iters on the per-step trainer: after the cold first
+    round, workers warm-start from the previous merged estimate at the
+    short iteration count — accuracy must match the cold full-iteration
+    run (the scan trainer's measured contract, now on the per-step path)."""
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=3)
+
+    def run(**kw):
+        cfg = _cfg(solver="subspace", subspace_iters=24,
+                   backend="shard_map", **kw)
+        stream = synthetic_stream(
+            spec, num_workers=8, rows_per_worker=64, num_steps=6, seed=5
+        )
+        w, _ = online_distributed_pca(stream, cfg)
+        return np.asarray(principal_angles_degrees(w, spec.top_k(K))).max()
+
+    cold = run()
+    warm = run(warm_start_iters=2)
+    assert warm < 2.0, f"warm-start accuracy: {warm}"
+    assert warm <= cold + 1.0, f"warm {warm} vs cold {cold}"
+
+
+def test_train_step_v_prev_warm_start():
+    """make_train_step's optional v_prev: the warm core runs short
+    iterations from the previous estimate and stays on-subspace."""
+    from distributed_eigenspaces_tpu.algo.step import make_train_step
+
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=7)
+    cfg = _cfg(solver="subspace", subspace_iters=24, warm_start_iters=2,
+               num_steps=5)
+    step = make_train_step(cfg, donate=False)
+    state = OnlineState.initial(D)
+    key = jax.random.PRNGKey(2)
+    v_prev = None
+    for _ in range(5):
+        key, sub = jax.random.split(key)
+        x = spec.sample(sub, 8 * 64).reshape(8, 64, D)
+        if v_prev is None:
+            state, v_prev = step(state, x)
+        else:
+            state, v_prev = step(state, x, v_prev)
+    w = top_k_eigvecs(state.sigma_tilde, K)
+    ang = np.asarray(principal_angles_degrees(w, spec.top_k(K)))
+    assert ang.max() < 2.0, f"v_prev-threaded trainer: {ang}"
+
+
+def test_worker_pool_round_iters_override():
+    """WorkerPool.round(v0=..., iters=...): the warm-start override gives
+    the same subspace as a full cold solve when started at the answer."""
+    from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
+
+    spec = planted_spectrum(D, k_planted=K, gap=25.0, noise=0.005, seed=1)
+    x = spec.sample(jax.random.PRNGKey(0), 8 * 128).reshape(8, 128, D)
+    pool = WorkerPool(8, backend="local", solver="subspace",
+                      subspace_iters=24)
+    _, v_cold = pool.round(x, K)
+    _, v_warm = pool.round(x, K, v0=v_cold, iters=2)
+    ang = np.asarray(principal_angles_degrees(v_warm, v_cold))
+    assert ang.max() < 0.5, f"warm round vs cold round: {ang}"
