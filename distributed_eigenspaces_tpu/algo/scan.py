@@ -14,11 +14,13 @@ per-step path with ``runtime.prefetch``.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_eigenspaces_tpu.algo.online import update_state
+from distributed_eigenspaces_tpu.algo.online import OnlineState, update_state
 from distributed_eigenspaces_tpu.algo.step import make_round_core
 from distributed_eigenspaces_tpu.config import PCAConfig
 from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
@@ -133,3 +135,125 @@ def make_scan_fit(
     return jax.jit(
         inner, in_shardings=in_shardings, out_shardings=(rep, rep)
     )
+
+
+class SegmentState(NamedTuple):
+    """Checkpointable carry of the segmented scan trainer: the online state
+    PLUS the warm-start carry (the last merged estimate), so a resumed run
+    continues bit-for-bit — without ``v_prev`` the first post-resume step
+    would have to run cold and diverge from the unkilled run.
+    """
+
+    sigma_tilde: jax.Array
+    step: jax.Array  # int32 scalar, 1-based rounds folded in
+    v_prev: jax.Array  # (d, k) last merged estimate; zeros before step 1
+
+    @classmethod
+    def initial(cls, dim: int, k: int, dtype=jnp.float32) -> "SegmentState":
+        return cls(
+            sigma_tilde=jnp.zeros((dim, dim), dtype=dtype),
+            step=jnp.zeros((), jnp.int32),
+            v_prev=jnp.zeros((dim, k), dtype=jnp.float32),
+        )
+
+
+def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
+                       segment: int = 50):
+    """Checkpointable whole-fit trainer: T steps run as ceil(T/S)
+    ``lax.scan`` programs of S steps each, with a host hook between
+    segments — ``fit(state, x_steps, on_segment=None) -> SegmentState``.
+
+    This closes the round-1 gap "the fastest trainer can't checkpoint":
+    per-segment dispatch costs 1/S of the per-step trainer's (S=50 keeps
+    it ~2% on the tunneled dev host), while ``on_segment(steps_done,
+    state)`` runs on the host between programs for checkpoint/metrics
+    (utils/checkpoint.py saves ``SegmentState`` like any other state).
+
+    Semantics are identical to :func:`make_scan_fit` on the same workload
+    (same ``make_round_core``; with ``cfg.warm_start_iters`` the cold
+    first step runs only when ``state.step == 0``, and the warm carry
+    crosses segment AND checkpoint boundaries via ``state.v_prev``) —
+    a killed-and-resumed run is bit-for-bit the unkilled run.
+
+    ``x_steps`` may be a host array: each segment's slice is transferred
+    as its program runs (O(S) device memory, not O(T)).
+    """
+    if segment < 1:
+        raise ValueError(f"segment must be >= 1, got {segment}")
+    round_core = make_round_core(cfg)
+    warm = cfg.warm_start_iters is not None and cfg.solver == "subspace"
+    warm_core = (
+        make_round_core(cfg, iters=cfg.warm_start_iters) if warm else None
+    )
+
+    def update(st, v_bar):
+        return update_state(
+            st, v_bar, discount=cfg.discount, num_steps=cfg.num_steps
+        )
+
+    def make_seg(axis_name, first):
+        core = warm_core if warm else round_core
+
+        def body(carry, x):
+            st, vp = carry
+            v = (
+                core(x, axis_name=axis_name, v0=vp) if warm
+                else core(x, axis_name=axis_name)
+            )
+            return (update(st, v), v), None
+
+        def seg(sstate, x_steps):
+            st = OnlineState(sstate.sigma_tilde, sstate.step)
+            vp = sstate.v_prev
+            if warm and first:
+                # cold first step at the full iteration count
+                vp = round_core(x_steps[0], axis_name=axis_name)
+                st = update(st, vp)
+                x_steps = x_steps[1:]
+            (st, vp), _ = jax.lax.scan(body, (st, vp), x_steps)
+            return SegmentState(st.sigma_tilde, st.step, vp)
+
+        return seg
+
+    if mesh is None:
+        def build(first):
+            return jax.jit(make_seg(None, first))
+    else:
+        rep = NamedSharding(mesh, P())
+        x_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
+
+        def build(first):
+            inner = jax.shard_map(
+                make_seg(WORKER_AXIS, first),
+                mesh=mesh,
+                in_specs=(P(), P(None, WORKER_AXIS)),
+                out_specs=P(),
+                check_vma=False,
+            )
+            return jax.jit(
+                inner, in_shardings=(rep, x_sharding), out_shardings=rep
+            )
+
+    compiled = {}
+
+    def _get(first):
+        if first not in compiled:
+            compiled[first] = build(first)
+        return compiled[first]
+
+    def fit(state: SegmentState, x_steps, on_segment=None) -> SegmentState:
+        total = x_steps.shape[0]
+        t = 0
+        while t < total:
+            s = min(segment, total - t)
+            # without warm start the "first" program is identical to the
+            # continuation program — never compile it twice
+            first = warm and int(state.step) == 0
+            state = _get(first)(state, jnp.asarray(x_steps[t : t + s]))
+            t += s
+            if on_segment is not None:
+                on_segment(int(state.step), state)
+        return state
+
+    fit.segment = segment
+    return fit

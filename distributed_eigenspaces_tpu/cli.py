@@ -72,14 +72,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bfloat16 runs the Gram contraction at full MXU "
                    "rate (fp32 accumulation)")
     p.add_argument("--trainer", choices=["step", "scan"], default="step",
-                   help="step: one dispatch per online step (streams, "
-                   "checkpoints); scan: the whole T-step loop as ONE XLA "
-                   "program (fastest; in-memory data, no per-step "
-                   "checkpointing)")
+                   help="step: one dispatch per online step (streams); "
+                   "scan: the T-step loop as one XLA program per "
+                   "--checkpoint-every-step segment (fastest; in-memory "
+                   "data; checkpoints at segment boundaries)")
     p.add_argument("--warm-start-iters", type=int, default=None,
-                   help="scan trainer only: after a cold first step, run "
-                   "this many solver iterations warm-started from the "
-                   "previous merged estimate")
+                   help="after a cold first step, run this many solver "
+                   "iterations warm-started from the previous merged "
+                   "estimate (requires --solver subspace; honored by both "
+                   "trainers)")
     p.add_argument("--dim", type=int, default=1024,
                    help="feature dim for --data synthetic")
     p.add_argument("--checkpoint-dir", default=None)
@@ -116,35 +117,115 @@ def _load(args):
     return data, None
 
 
-def _fit_scan(args, cfg, data, truth) -> int:
-    """``--trainer scan``: the whole T-step loop as one XLA program
-    (algo/scan.py) — the fastest path when the data fits in memory.
-
-    Per-step checkpoint/metrics callbacks don't exist inside one program;
-    the summary reports totals (and the final principal angle when the
-    synthetic truth is known).
+def _coerce_resumed_state(state, want: str, k: int):
+    """Cross-trainer checkpoint compatibility: a scan checkpoint carries
+    the warm carry (SegmentState), a per-step one doesn't (OnlineState).
+    Converting between them is lossless except that an upgraded per-step
+    checkpoint has no ``v_prev`` — the next step runs cold (noted).
+    Returns (state, note) or raises SystemExit-style by returning None on
+    a genuinely incompatible state (the low-rank feature-sharded kind).
     """
-    import jax
     import jax.numpy as jnp
 
     from distributed_eigenspaces_tpu.algo.online import OnlineState
-    from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+    from distributed_eigenspaces_tpu.algo.scan import SegmentState
+
+    if want == "segment":
+        if isinstance(state, SegmentState):
+            return state, None
+        if isinstance(state, OnlineState):
+            return (
+                SegmentState(
+                    sigma_tilde=state.sigma_tilde,
+                    step=state.step,
+                    v_prev=jnp.zeros(
+                        (state.sigma_tilde.shape[0], k), jnp.float32
+                    ),
+                ),
+                "resumed from a per-step checkpoint: no warm carry saved, "
+                "the first post-resume step runs cold",
+            )
+        return None, None
+    if isinstance(state, SegmentState):
+        return (
+            OnlineState(sigma_tilde=state.sigma_tilde, step=state.step),
+            "resumed from a scan checkpoint (warm carry dropped: the "
+            "per-step loop re-threads it from the next round)",
+        )
+    return state, None
+
+
+def _scan_mesh(cfg):
+    import jax
+
+    if cfg.backend in ("shard_map", "tpu") or (
+        cfg.backend == "auto" and len(jax.devices()) > 1
+    ):
+        from distributed_eigenspaces_tpu.parallel.mesh import (
+            largest_divisor_leq,
+            make_mesh,
+        )
+
+        return make_mesh(
+            num_workers=largest_divisor_leq(
+                cfg.num_workers, len(jax.devices())
+            )
+        )
+    return None
+
+
+def _scan_result(args, cfg, state, truth, elapsed, extra):
+    """Final extraction + summary JSON shared by both scan paths."""
+    import jax.numpy as jnp
+
     from distributed_eigenspaces_tpu.ops.linalg import (
         merged_top_k,
         principal_angles_degrees,
     )
 
-    for flag, on in (
-        ("--checkpoint-dir", args.checkpoint_dir),
-        ("--resume", args.resume),
-        ("--metrics", args.metrics),
-    ):
-        if on:
-            print(
-                f"note: {flag} is unavailable with --trainer scan (all "
-                "steps run inside one program; use --trainer step)",
-                file=sys.stderr,
-            )
+    w = merged_top_k(
+        state.sigma_tilde, cfg.k, cfg.solver, max(cfg.subspace_iters, 16),
+        cfg.orth_method,
+    )
+    w_host = np.asarray(w)  # materialization fence + result
+    out = {
+        "mode": "fit",
+        "trainer": "scan",
+        **extra,
+        # authoritative fields AFTER extra: metrics.summary() also carries
+        # a "steps" (its record count — segments, not online steps)
+        "steps": int(state.step),
+        "seconds": round(elapsed, 3),
+        "dim": cfg.dim,
+        "k": cfg.k,
+    }
+    if truth is not None:
+        out["principal_angle_deg"] = round(
+            float(jnp.max(principal_angles_degrees(w, truth))), 4
+        )
+    print(json.dumps(out))
+    if args.save:
+        np.save(args.save, w_host)
+    return 0
+
+
+def _fit_scan(args, cfg, data, truth) -> int:
+    """``--trainer scan``: the whole T-step loop as one XLA program
+    (algo/scan.py) — the fastest path when the data fits in memory.
+
+    With ``--checkpoint-dir``/``--resume``/``--metrics`` the loop runs as
+    ``--checkpoint-every``-step segments (one program each) with the
+    checkpoint/metrics hook between segments — same semantics, resumable
+    (``algo.scan.make_segmented_fit``).
+    """
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.algo.online import OnlineState
+    from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+
+    if args.checkpoint_dir or args.resume or args.metrics:
+        return _fit_scan_segmented(args, cfg, data, truth)
+
     m, n, T, dim = (
         cfg.num_workers, cfg.rows_per_worker, cfg.num_steps, cfg.dim,
     )
@@ -160,46 +241,110 @@ def _fit_scan(args, cfg, data, truth) -> int:
         np.ascontiguousarray(data[:need]).reshape(T, m, n, dim)
     )
 
-    mesh = None
-    if cfg.backend in ("shard_map", "tpu") or (
-        cfg.backend == "auto" and len(jax.devices()) > 1
-    ):
-        from distributed_eigenspaces_tpu.parallel.mesh import (
-            largest_divisor_leq,
-            make_mesh,
-        )
-
-        mesh = make_mesh(
-            num_workers=largest_divisor_leq(m, len(jax.devices()))
-        )
-
-    fit = make_scan_fit(cfg, mesh=mesh)
+    fit = make_scan_fit(cfg, mesh=_scan_mesh(cfg))
     t0 = time.time()
     state, _v_bars = fit(OnlineState.initial(dim), x_steps)
-    w = merged_top_k(
-        state.sigma_tilde, cfg.k, cfg.solver, max(cfg.subspace_iters, 16),
-        cfg.orth_method,
-    )
-    w_host = np.asarray(w)  # materialization fence + result
     elapsed = time.time() - t0
+    return _scan_result(
+        args, cfg, state, truth, elapsed,
+        {
+            # one fit call: compile time is included (evals.py/bench.py
+            # warm up on salted operands instead; a CLI run has nothing
+            # to amortize against, so the honest label is this flag)
+            "includes_compile": True,
+            "samples_per_sec": round(need / elapsed, 1),
+        },
+    )
 
-    out = {
-        "mode": "fit",
-        "trainer": "scan",
-        "steps": int(state.step),
-        "seconds": round(elapsed, 3),
-        "samples_per_sec": round(need / elapsed, 1),
-        "dim": dim,
-        "k": cfg.k,
-    }
-    if truth is not None:
-        out["principal_angle_deg"] = round(
-            float(jnp.max(principal_angles_degrees(w, truth))), 4
+
+def _fit_scan_segmented(args, cfg, data, truth) -> int:
+    """Segmented scan: checkpoint/resume/metrics between S-step programs."""
+    from distributed_eigenspaces_tpu.algo.scan import (
+        SegmentState,
+        make_segmented_fit,
+    )
+    from distributed_eigenspaces_tpu.utils.checkpoint import Checkpointer
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    m, n, T, dim = (
+        cfg.num_workers, cfg.rows_per_worker, cfg.num_steps, cfg.dim,
+    )
+    rows_per_step = m * n
+    state = SegmentState.initial(dim, cfg.k)
+    cursor = 0
+    ckpt = None
+    if args.checkpoint_dir:
+        # every=1 in SEGMENT units: each boundary (already spaced
+        # --checkpoint-every steps apart) commits a checkpoint
+        ckpt = Checkpointer(
+            args.checkpoint_dir, every=1, rows_per_step=rows_per_step
         )
-    print(json.dumps(out))
-    if args.save:
-        np.save(args.save, w_host)
-    return 0
+        if args.resume:
+            restored = ckpt.latest()
+            if restored is not None:
+                state, cursor = restored
+                state, note = _coerce_resumed_state(state, "segment", cfg.k)
+                if state is None:
+                    print(
+                        "error: checkpoint holds a feature-sharded "
+                        "low-rank state; --trainer scan resumes dense "
+                        "OnlineState/SegmentState checkpoints only",
+                        file=sys.stderr,
+                    )
+                    return 2
+                if note:
+                    print(f"note: {note}", file=sys.stderr)
+                print(
+                    json.dumps(
+                        {"resumed_step": int(state.step), "cursor": cursor}
+                    ),
+                    file=sys.stderr,
+                )
+
+    done = int(state.step)
+    remaining = max(0, T - done)
+    need = remaining * rows_per_step
+    if len(data) - cursor < need:
+        print(
+            f"error: --trainer scan needs {need} unseen rows "
+            f"({remaining} steps x {m} x {n}), have {len(data) - cursor}",
+            file=sys.stderr,
+        )
+        return 2
+    x_steps = np.ascontiguousarray(
+        data[cursor : cursor + need]
+    ).reshape(remaining, m, n, dim)
+
+    metrics = MetricsLogger(
+        samples_per_step=rows_per_step,
+        stream=sys.stderr if args.metrics else None,
+        reference_subspace=truth,
+    ).start()
+    fit = make_segmented_fit(
+        cfg, mesh=_scan_mesh(cfg), segment=args.checkpoint_every
+    )
+    last_t = {"t": done}
+
+    def on_segment(t, st):
+        # one metrics record per segment (t advances by the segment size)
+        metrics.samples_per_step = rows_per_step * (t - last_t["t"])
+        last_t["t"] = t
+        metrics.on_step(t, st, st.v_prev)
+        if ckpt is not None:
+            ckpt.on_step(t, st)
+
+    t0 = time.time()
+    state = fit(state, x_steps, on_segment=on_segment)
+    elapsed = time.time() - t0
+    return _scan_result(
+        args, cfg, state, truth, elapsed,
+        {
+            "includes_compile": True,
+            "segment": fit.segment,
+            "resumed_step": done,
+            **metrics.summary(),
+        },
+    )
 
 
 def main(argv=None) -> int:
@@ -229,6 +374,14 @@ def main(argv=None) -> int:
             "collectives ride ICI)",
             file=sys.stderr,
         )
+    if args.warm_start_iters is not None and args.solver != "subspace":
+        print(
+            "error: --warm-start-iters requires --solver subspace "
+            "(warm start initializes the iterative solver; eigh has "
+            "nothing to warm-start)",
+            file=sys.stderr,
+        )
+        return 2
 
     import jax.numpy as jnp
 
@@ -307,14 +460,6 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
-        if args.warm_start_iters is not None and args.solver != "subspace":
-            print(
-                "error: --warm-start-iters requires --solver subspace "
-                "(warm start initializes the iterative solver; eigh has "
-                "nothing to warm-start)",
-                file=sys.stderr,
-            )
-            return 2
         return _fit_scan(args, cfg, data, truth)
 
     est = OnlineDistributedPCA(cfg)
@@ -339,6 +484,11 @@ def main(argv=None) -> int:
             restored = ckpt.latest()
             if restored is not None:
                 est.state, cursor = restored
+                est.state, note = _coerce_resumed_state(
+                    est.state, "online", cfg.k
+                )
+                if note:
+                    print(f"note: {note}", file=sys.stderr)
                 print(
                     json.dumps(
                         {

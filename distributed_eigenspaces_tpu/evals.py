@@ -485,7 +485,12 @@ def run_eval(
             t0 = time.perf_counter()
             steps_run = 0
             for x in stream():
-                state, v_bar = step_fn(state, x, v_prev)
+                # keyword arg: the feature-sharded step's third positional
+                # is worker_mask, not v_prev (thread_v excludes it)
+                state, v_bar = (
+                    step_fn(state, x, v_prev=v_prev) if thread_v
+                    else step_fn(state, x)
+                )
                 v_prev = v_bar if thread_v else None
                 steps_run += 1
             fence(state)
@@ -529,7 +534,11 @@ def run_eval(
                     else OnlineState.initial(d)
                 )
                 t0 = time.perf_counter()
-                out2 = step_fn(st0, xb, v_prev)
+                out2 = (
+                    step_fn(st0, xb, v_prev=v_prev)
+                    if thread_v and v_prev is not None
+                    else step_fn(st0, xb)
+                )
                 fence(out2[0])
                 compute_ms = (time.perf_counter() - t0) * 1e3
                 stage_ms = {

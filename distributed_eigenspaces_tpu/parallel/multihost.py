@@ -167,19 +167,22 @@ def fetch_replicated(x: jax.Array) -> np.ndarray:
 
 
 def make_multihost_train_step(cfg, mesh: Mesh):
-    """Build ``step(state, x_local) -> (state, v_bar)`` where ``x_local`` is
-    this host's ``(m_local, n, d)`` block stack.
+    """Build ``step(state, x_local, v_prev=None) -> (state, v_bar)`` where
+    ``x_local`` is this host's ``(m_local, n, d)`` block stack.
 
     Thin wrapper over :func:`algo.step.make_train_step` (the compiled program
     is identical — SPMD doesn't care how many hosts run it); the wrapper only
-    handles the host-local -> global array assembly each step.
+    handles the host-local -> global array assembly each step. ``v_prev``
+    (the previous round's merged estimate, replicated — it comes back
+    replicated from the step) forwards the ``cfg.warm_start_iters``
+    warm-start lever unchanged.
     """
     from distributed_eigenspaces_tpu.algo.step import make_train_step
 
     inner = make_train_step(cfg, mesh=mesh)
 
-    def step(state, x_local):
+    def step(state, x_local, v_prev=None):
         x_global = host_local_blocks_to_global(x_local, mesh)
-        return inner(state, x_global)
+        return inner(state, x_global, v_prev)
 
     return step

@@ -5,8 +5,10 @@ The reference keeps everything in process memory — ``sigma_tilde``,
 process (``distributed.py:88-91``; notebook cell 16 locals). Here the
 complete resumable state is tiny and explicit:
 
-  - dense path:    ``OnlineState``  = sigma_tilde (d, d) + step
-  - low-rank path: ``LowRankState`` = U (d, r) + S (r,) + step
+  - dense path:      ``OnlineState``   = sigma_tilde (d, d) + step
+  - low-rank path:   ``LowRankState``  = U (d, r) + S (r,) + step
+  - segmented scan:  ``SegmentState``  = OnlineState + the warm carry
+    ``v_prev`` (d, k), so a resumed scan run is bit-for-bit the unkilled run
   - plus the data-stream cursor (an integer row offset)
 
 Storage is a plain ``state.npz`` plus an atomically-renamed ``meta.json``
@@ -28,9 +30,14 @@ import jax
 import numpy as np
 
 from distributed_eigenspaces_tpu.algo.online import OnlineState
+from distributed_eigenspaces_tpu.algo.scan import SegmentState
 from distributed_eigenspaces_tpu.parallel.feature_sharded import LowRankState
 
-_STATE_TYPES = {"online": OnlineState, "lowrank": LowRankState}
+_STATE_TYPES = {
+    "online": OnlineState,
+    "lowrank": LowRankState,
+    "scan_segment": SegmentState,
+}
 
 
 def _to_host(tree):
@@ -47,7 +54,9 @@ def save_checkpoint(
 ) -> None:
     """Write a self-describing checkpoint directory at ``path``."""
     os.makedirs(path, exist_ok=True)
-    kind = "online" if isinstance(state, OnlineState) else "lowrank"
+    kind = next(
+        name for name, cls in _STATE_TYPES.items() if isinstance(state, cls)
+    )
     host = _to_host(state)
     # Invalidate any previous commit marker BEFORE touching state.npz, and
     # write the payload via tmp+rename: a crash at any point leaves either

@@ -150,3 +150,140 @@ def test_warm_start_sharded(devices):
 def test_warm_start_iters_validation():
     with pytest.raises(ValueError):
         PCAConfig(dim=8, k=2, warm_start_iters=0)
+
+
+def _planted_xs(T, m, n, d, seed=0):
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+
+    spec = planted_spectrum(d, k_planted=3, gap=20.0, noise=0.01, seed=11)
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for _ in range(T):
+        key, sub = jax.random.split(key)
+        out.append(np.asarray(spec.sample(sub, m * n).reshape(m, n, d)))
+    return np.stack(out), spec
+
+
+@pytest.mark.parametrize("warm", [None, 2])
+def test_segmented_fit_matches_scan_fit(warm):
+    """The segmented trainer folds the same rounds as the one-program scan
+    (same round cores, warm carry crossing segment boundaries)."""
+    from distributed_eigenspaces_tpu.algo.scan import (
+        SegmentState,
+        make_segmented_fit,
+    )
+
+    T, m, n, d, k = 6, 4, 64, 32, 3
+    cfg = PCAConfig(dim=d, k=k, num_workers=m, rows_per_worker=n,
+                    num_steps=T, solver="subspace", subspace_iters=20,
+                    warm_start_iters=warm)
+    xs, _ = _planted_xs(T, m, n, d)
+
+    fit_one = make_scan_fit(cfg)
+    st_one, _ = fit_one(OnlineState.initial(d), jnp.asarray(xs))
+
+    seen = []
+    fit_seg = make_segmented_fit(cfg, segment=2)
+    st_seg = fit_seg(
+        SegmentState.initial(d, k), xs,
+        on_segment=lambda t, st: seen.append(t),
+    )
+    assert seen == [2, 4, 6]
+    assert int(st_seg.step) == T
+    np.testing.assert_allclose(
+        np.asarray(st_seg.sigma_tilde), np.asarray(st_one.sigma_tilde),
+        atol=2e-5,
+    )
+
+
+def test_segmented_fit_resume_bit_exact(tmp_path):
+    """Kill-and-resume == unkilled, BIT FOR BIT: the checkpointed
+    SegmentState carries the warm v_prev, and the resumed run replays the
+    same segment schedule (same executables, same operands)."""
+    from distributed_eigenspaces_tpu.algo.scan import (
+        SegmentState,
+        make_segmented_fit,
+    )
+    from distributed_eigenspaces_tpu.utils.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    T, m, n, d, k = 6, 4, 64, 32, 3
+    cfg = PCAConfig(dim=d, k=k, num_workers=m, rows_per_worker=n,
+                    num_steps=T, solver="subspace", subspace_iters=20,
+                    warm_start_iters=2)
+    xs, _ = _planted_xs(T, m, n, d)
+    fit = make_segmented_fit(cfg, segment=2)
+
+    # unkilled run
+    st_full = fit(SegmentState.initial(d, k), xs)
+
+    # killed after segment 2 (step 4): checkpoint, restore, continue
+    ckpt_dir = str(tmp_path / "ckpt")
+    st_half = fit(SegmentState.initial(d, k), xs[:4])
+    save_checkpoint(ckpt_dir, st_half, cursor=4 * m * n)
+    restored, cursor = restore_checkpoint(ckpt_dir)
+    assert cursor == 4 * m * n and int(restored.step) == 4
+    st_resumed = fit(restored, xs[4:])
+
+    assert int(st_resumed.step) == T
+    for field in SegmentState._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st_resumed, field)),
+            np.asarray(getattr(st_full, field)),
+            err_msg=f"resume not bit-exact in {field}",
+        )
+
+
+def test_cli_scan_checkpoint_resume(tmp_path):
+    """--trainer scan --checkpoint-dir + --resume end-to-end: the resumed
+    run continues from the checkpoint and matches a straight run's saved
+    subspace bit-for-bit (1/t discount: weights don't depend on T)."""
+    from distributed_eigenspaces_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    out_resumed = str(tmp_path / "resumed.npy")
+    out_straight = str(tmp_path / "straight.npy")
+    common = [
+        "--data", "synthetic", "--dim", "48", "--rank", "3",
+        "--workers", "4", "--rows-per-worker", "32",
+        "--trainer", "scan", "--solver", "subspace",
+        "--subspace-iters", "16", "--warm-start-iters", "2",
+        "--discount", "1/t", "--checkpoint-every", "2",
+        "--backend", "local",
+    ]
+    # straight 6-step run (segmented path, its own checkpoint dir)
+    assert main(common + ["--steps", "6", "--save", out_straight,
+                          "--checkpoint-dir", str(tmp_path / "ck2")]) == 0
+    # "killed" after 4 steps, then resumed to 6
+    assert main(common + ["--steps", "4", "--checkpoint-dir", ckpt]) == 0
+    assert main(common + ["--steps", "6", "--checkpoint-dir", ckpt,
+                          "--resume", "--save", out_resumed]) == 0
+    np.testing.assert_array_equal(
+        np.load(out_resumed), np.load(out_straight),
+        err_msg="CLI resume is not bit-for-bit",
+    )
+
+
+def test_cli_cross_trainer_resume(tmp_path):
+    """A per-step checkpoint resumes under --trainer scan (cold first
+    post-resume step) and a scan checkpoint resumes under --trainer step."""
+    from distributed_eigenspaces_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    common = [
+        "--data", "synthetic", "--dim", "48", "--rank", "3",
+        "--workers", "4", "--rows-per-worker", "32",
+        "--solver", "subspace", "--subspace-iters", "16",
+        "--discount", "1/t", "--checkpoint-every", "2",
+        "--backend", "local", "--checkpoint-dir", ckpt,
+    ]
+    # per-step run writes OnlineState checkpoints
+    assert main(common + ["--trainer", "step", "--steps", "4"]) == 0
+    # scan resume coerces it to SegmentState
+    assert main(common + ["--trainer", "scan", "--steps", "6",
+                          "--resume"]) == 0
+    # and the scan checkpoint (SegmentState) resumes under step
+    assert main(common + ["--trainer", "step", "--steps", "8",
+                          "--resume"]) == 0
