@@ -1,0 +1,27 @@
+# Deployment image (reference C20 parity: /root/reference/Dockerfile:1-17
+# bundles RabbitMQ + gcc/gfortran/OpenBLAS + a repo checkout; here there is
+# no broker to bundle — the merge rides XLA collectives — so the image is
+# just toolchain + package).
+#
+# CPU image (CI / laptops; JAX runs on the host CPU, multi-device tests via
+#   XLA_FLAGS=--xla_force_host_platform_device_count=8):
+#   docker build -t det-tpu .
+# TPU hosts: build with --build-arg JAX_EXTRA=tpu on a TPU VM base image.
+FROM python:3.12-slim
+
+# g++ builds the native loader on first use (runtime/native.py); everything
+# still works without it via the numpy fallbacks.
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+ARG JAX_EXTRA=""
+WORKDIR /opt/det
+COPY pyproject.toml README.md ./
+COPY distributed_eigenspaces_tpu ./distributed_eigenspaces_tpu
+RUN pip install --no-cache-dir . \
+    && if [ -n "$JAX_EXTRA" ]; then \
+         pip install --no-cache-dir "jax[$JAX_EXTRA]"; fi
+
+ENTRYPOINT ["det-pca"]
+CMD ["--data", "synthetic", "--dim", "1024", "--rank", "8", \
+     "--solver", "subspace", "--trainer", "scan"]

@@ -247,8 +247,14 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
         while t < total:
             s = min(segment, total - t)
             # without warm start the "first" program is identical to the
-            # continuation program — never compile it twice
-            first = warm and int(state.step) == 0
+            # continuation program — never compile it twice. A ZERO carry
+            # must also run cold: zeros are a fixed point of the warm
+            # solver (orth(0) = 0), so warm-starting from a restored state
+            # that lacks v_prev (cross-trainer resume) would silently
+            # discard every subsequent step.
+            first = warm and (
+                int(state.step) == 0 or not bool(jnp.any(state.v_prev))
+            )
             state = _get(first)(state, jnp.asarray(x_steps[t : t + s]))
             t += s
             if on_segment is not None:

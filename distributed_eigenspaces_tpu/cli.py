@@ -155,6 +155,33 @@ def _coerce_resumed_state(state, want: str, k: int):
     return state, None
 
 
+def _resume_from(ckpt, want: str, k: int):
+    """Shared resume path: newest checkpoint + cross-trainer coercion +
+    stderr diagnostics. Returns ``(state, cursor, exit_code)`` — state is
+    None when there is nothing to restore (exit_code 0) or the checkpoint
+    is incompatible (exit_code 2)."""
+    restored = ckpt.latest()
+    if restored is None:
+        return None, 0, 0
+    state, cursor = restored
+    state, note = _coerce_resumed_state(state, want, k)
+    if state is None:
+        print(
+            "error: checkpoint holds a feature-sharded low-rank state; "
+            "only dense OnlineState/SegmentState checkpoints resume on "
+            "this path",
+            file=sys.stderr,
+        )
+        return None, 0, 2
+    if note:
+        print(f"note: {note}", file=sys.stderr)
+    print(
+        json.dumps({"resumed_step": int(state.step), "cursor": cursor}),
+        file=sys.stderr,
+    )
+    return state, cursor, 0
+
+
 def _scan_mesh(cfg):
     import jax
 
@@ -280,26 +307,11 @@ def _fit_scan_segmented(args, cfg, data, truth) -> int:
             args.checkpoint_dir, every=1, rows_per_step=rows_per_step
         )
         if args.resume:
-            restored = ckpt.latest()
+            restored, cursor, err = _resume_from(ckpt, "segment", cfg.k)
+            if err:
+                return err
             if restored is not None:
-                state, cursor = restored
-                state, note = _coerce_resumed_state(state, "segment", cfg.k)
-                if state is None:
-                    print(
-                        "error: checkpoint holds a feature-sharded "
-                        "low-rank state; --trainer scan resumes dense "
-                        "OnlineState/SegmentState checkpoints only",
-                        file=sys.stderr,
-                    )
-                    return 2
-                if note:
-                    print(f"note: {note}", file=sys.stderr)
-                print(
-                    json.dumps(
-                        {"resumed_step": int(state.step), "cursor": cursor}
-                    ),
-                    file=sys.stderr,
-                )
+                state = restored
 
     done = int(state.step)
     remaining = max(0, T - done)
@@ -379,6 +391,13 @@ def main(argv=None) -> int:
             "error: --warm-start-iters requires --solver subspace "
             "(warm start initializes the iterative solver; eigh has "
             "nothing to warm-start)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.resume and not args.checkpoint_dir:
+        print(
+            "error: --resume needs --checkpoint-dir (nowhere to restore "
+            "from)",
             file=sys.stderr,
         )
         return 2
@@ -481,23 +500,11 @@ def main(argv=None) -> int:
         )
         callbacks.append(ckpt.on_step)
         if args.resume:
-            restored = ckpt.latest()
+            restored, cursor, err = _resume_from(ckpt, "online", cfg.k)
+            if err:
+                return err
             if restored is not None:
-                est.state, cursor = restored
-                est.state, note = _coerce_resumed_state(
-                    est.state, "online", cfg.k
-                )
-                if note:
-                    print(f"note: {note}", file=sys.stderr)
-                print(
-                    json.dumps(
-                        {
-                            "resumed_step": int(est.state.step),
-                            "cursor": cursor,
-                        }
-                    ),
-                    file=sys.stderr,
-                )
+                est.state = restored
 
     def on_step(t, state, v_bar):
         for cb in callbacks:

@@ -18,7 +18,7 @@ from distributed_eigenspaces_tpu.runtime import native as native_mod
 
 def test_native_builds():
     """The toolchain is present in this image; the lib must compile."""
-    assert native_available(), "g++ build of native/loader.cc failed"
+    assert native_available(), "g++ build of distributed_eigenspaces_tpu/native/loader.cc failed"
 
 
 def test_gray_matches_numpy(rng):

@@ -266,9 +266,13 @@ def test_cli_scan_checkpoint_resume(tmp_path):
     )
 
 
-def test_cli_cross_trainer_resume(tmp_path):
+def test_cli_cross_trainer_resume(tmp_path, capsys):
     """A per-step checkpoint resumes under --trainer scan (cold first
-    post-resume step) and a scan checkpoint resumes under --trainer step."""
+    post-resume step — the coerced zero carry must NOT be warm-started:
+    zeros are a fixed point of the warm solver) and a scan checkpoint
+    resumes under --trainer step."""
+    import json as _json
+
     from distributed_eigenspaces_tpu.cli import main
 
     ckpt = str(tmp_path / "ck")
@@ -276,14 +280,27 @@ def test_cli_cross_trainer_resume(tmp_path):
         "--data", "synthetic", "--dim", "48", "--rank", "3",
         "--workers", "4", "--rows-per-worker", "32",
         "--solver", "subspace", "--subspace-iters", "16",
+        "--warm-start-iters", "2",
         "--discount", "1/t", "--checkpoint-every", "2",
         "--backend", "local", "--checkpoint-dir", ckpt,
     ]
     # per-step run writes OnlineState checkpoints
     assert main(common + ["--trainer", "step", "--steps", "4"]) == 0
-    # scan resume coerces it to SegmentState
+    capsys.readouterr()
+    # scan resume coerces it to SegmentState (zero carry -> cold restart
+    # of the warm chain; post-resume steps must still be folded)
     assert main(common + ["--trainer", "scan", "--steps", "6",
                           "--resume"]) == 0
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["steps"] == 6
+    assert out["principal_angle_deg"] < 2.0, out
     # and the scan checkpoint (SegmentState) resumes under step
     assert main(common + ["--trainer", "step", "--steps", "8",
                           "--resume"]) == 0
+
+
+def test_cli_resume_requires_checkpoint_dir():
+    from distributed_eigenspaces_tpu.cli import main
+
+    assert main(["--data", "synthetic", "--dim", "32", "--rank", "2",
+                 "--trainer", "scan", "--resume"]) == 2
