@@ -38,7 +38,7 @@ import numpy as np
 # Workload (per step): m workers x n rows of dimension d, top-k.
 M, N, D, K = 8, 4096, 1024, 8
 TPU_STEPS = 600  # long enough that fixed dispatch/RPC overhead is <15%
-CPU_STEPS = 2
+CPU_STEPS = 5  # >= 5 per-step timings, median-aggregated (VERDICT r1 #9)
 DISTINCT_BLOCKS = 4  # pre-staged device blocks cycled during timing
 
 import os as _os
@@ -65,14 +65,17 @@ def numpy_reference_step(blocks, k):
 
 
 def measure_cpu_baseline(blocks):
-    t0 = time.perf_counter()
+    # median of per-step timings: the denominator of the headline ratio
+    # must not rest on one or two noisy NumPy steps
     sigma_tilde = np.zeros((D, D), np.float32)
+    times = []
     for s in range(CPU_STEPS):
+        t0 = time.perf_counter()
         sigma_tilde += numpy_reference_step(
             blocks[s % len(blocks)], K
         ) / CPU_STEPS
-    dt = time.perf_counter() - t0
-    return (CPU_STEPS * M * N) / dt
+        times.append(time.perf_counter() - t0)
+    return (M * N) / float(np.median(times))
 
 
 def _bench_cfg():
@@ -181,7 +184,7 @@ def measure_tpu(blocks_host, spectrum):
     return (steps * M * N) / dt, _gate_angle(state, spectrum)
 
 
-def measure_tpu_scan(blocks_host, spectrum):
+def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
     """Headline measurement: the whole T-step online loop compiled as ONE
     lax.scan program (algo/scan.py), timed as a single execution with a
     value-fetch fence.
@@ -227,17 +230,26 @@ def measure_tpu_scan(blocks_host, spectrum):
 
     rpc = _rpc_overhead()
 
+    from distributed_eigenspaces_tpu.utils.tracing import profile_to
+
     t0 = time.perf_counter()
-    state, _ = fit(OnlineState.initial(D), stacked, idx)
-    _sync(state.sigma_tilde)
-    dt = time.perf_counter() - t0
+    with profile_to(profile_dir):  # --profile-dir: capture the timed fit
+        state, _ = fit(OnlineState.initial(D), stacked, idx)
+        _sync(state.sigma_tilde)
+    dt_raw = time.perf_counter() - t0
     # subtract the link cost, capped at 25% of the raw time: exact when the
     # device time dominates (dt >= 4*rpc), continuous (no threshold cliff),
     # and bounded so a tiny CI smoke workload can't be inflated more than
-    # 1.33x — smoke numbers stay order-of-magnitude honest
-    dt -= min(rpc, 0.25 * dt)
+    # 1.33x — smoke numbers stay order-of-magnitude honest. Both the
+    # adjusted AND raw numbers are reported (advisor r1 item 2: the JSON
+    # must let readers reconstruct the unadjusted measurement).
+    dt = dt_raw - min(rpc, 0.25 * dt_raw)
 
-    return (TPU_STEPS * M * N) / dt, _gate_angle(state, spectrum)
+    extras = {
+        "raw_samples_per_sec": round((TPU_STEPS * M * N) / dt_raw, 1),
+        "rpc_seconds_subtracted": round(min(rpc, 0.25 * dt_raw), 4),
+    }
+    return (TPU_STEPS * M * N) / dt, _gate_angle(state, spectrum), extras
 
 
 def main():
@@ -257,6 +269,11 @@ def main():
     # per online step instead, which on a tunneled dev host measures the
     # host->device link more than the chip.
     use_scan = "--steploop" not in args
+    # --profile-dir DIR: capture a jax.profiler trace of the timed scan run
+    # (the named det_* regions from the round cores show in the timeline)
+    profile_dir = None
+    if "--profile-dir" in args:
+        profile_dir = args[args.index("--profile-dir") + 1]
 
     # persistent compile cache: TPU eigh at d=1024 is minutes to compile via
     # a remote-compile path; cache makes reruns start in seconds
@@ -275,9 +292,12 @@ def main():
         )
 
     if use_scan:
-        tpu_sps, angle_deg = measure_tpu_scan(blocks_host, spectrum)
+        tpu_sps, angle_deg, extras = measure_tpu_scan(
+            blocks_host, spectrum, profile_dir=profile_dir
+        )
     else:
         tpu_sps, angle_deg = measure_tpu(blocks_host, spectrum)
+        extras = {}
     cpu_sps = measure_cpu_baseline(blocks_host)
 
     result = {
@@ -285,6 +305,7 @@ def main():
         "value": round(tpu_sps, 1),
         "unit": "samples/s",
         "vs_baseline": round(tpu_sps / cpu_sps, 2),
+        **extras,
     }
     if angle_deg > 1.0:
         # fast-but-wrong is a FAIL: flag it and exit nonzero so harnesses

@@ -196,6 +196,10 @@ def _drive_stream(stream, cfg, *, place, step, state, on_step, max_steps):
 
         stream = prefetch_stream(stream, depth=cfg.prefetch_depth, place=place)
 
+    # function-level import: utils.__init__ pulls checkpoint, which imports
+    # this module — a top-level import would cycle
+    from distributed_eigenspaces_tpu.utils.tracing import annotate_step
+
     cap = cfg.num_steps if max_steps == "auto" else max_steps
     # the "auto" cap is open-ended for a 1/t running mean (folding extra
     # rounds only improves the estimate); an EXPLICIT integer cap is a
@@ -206,7 +210,8 @@ def _drive_stream(stream, cfg, *, place, step, state, on_step, max_steps):
         for x_blocks in stream:
             if cap is not None and steps_done >= cap and not open_ended:
                 break
-            state, v_bar = step(state, x_blocks)
+            with annotate_step(steps_done + 1):
+                state, v_bar = step(state, x_blocks)
             steps_done += 1
             if on_step is not None:
                 on_step(steps_done, state, v_bar)

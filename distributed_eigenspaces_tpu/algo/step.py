@@ -53,16 +53,24 @@ def make_round_core(cfg: PCAConfig, iters: int | None = None):
     # cache — resolving here makes the contract explicit)
     fused = resolve_fused()
 
+    # profiler annotation (§5.1): these named regions are the units a
+    # captured trace shows — worker solve vs gather vs merge
+    from distributed_eigenspaces_tpu.utils.tracing import named_scope
+
     def round_core(x_blocks, axis_name=None, v0=None):
-        vs = _local_eigenspaces(
-            x_blocks, k, solver, iters, orth, cdtype, v0, fused_xtxv=fused
-        )
+        with named_scope("det_worker_solve"):
+            vs = _local_eigenspaces(
+                x_blocks, k, solver, iters, orth, cdtype, v0,
+                fused_xtxv=fused,
+            )
         if axis_name is not None:
             # the entire reference wire protocol (C11) is this one gather
             # of d x k factors — m*d*k floats over ICI, vs the d*d psum a
             # dense merge would need
-            vs = jax.lax.all_gather(vs, axis_name, axis=0, tiled=True)
-        return merged_top_k_lowrank(vs, k)
+            with named_scope("det_factor_gather"):
+                vs = jax.lax.all_gather(vs, axis_name, axis=0, tiled=True)
+        with named_scope("det_merge"):
+            return merged_top_k_lowrank(vs, k)
 
     return round_core
 

@@ -90,6 +90,10 @@ def build_parser() -> argparse.ArgumentParser:
                    "--checkpoint-dir")
     p.add_argument("--metrics", action="store_true",
                    help="print per-step JSON metrics to stderr")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the fit into this "
+                   "dir (TensorBoard-viewable; the det_* named regions "
+                   "mark worker solve / gather / merge / state update)")
     p.add_argument("--save", default=None,
                    help="write the final (d, k) subspace to this .npy")
     return p
@@ -119,17 +123,25 @@ def _load(args):
 
 def _coerce_resumed_state(state, want: str, k: int):
     """Cross-trainer checkpoint compatibility: a scan checkpoint carries
-    the warm carry (SegmentState), a per-step one doesn't (OnlineState).
-    Converting between them is lossless except that an upgraded per-step
-    checkpoint has no ``v_prev`` — the next step runs cold (noted).
-    Returns (state, note) or raises SystemExit-style by returning None on
-    a genuinely incompatible state (the low-rank feature-sharded kind).
+    the warm carry (SegmentState), a per-step one doesn't (OnlineState),
+    and the feature-sharded backend uses the low-rank kind. Dense kinds
+    convert between each other losslessly (an upgraded per-step checkpoint
+    has no ``v_prev``, so the next step runs cold — noted); the low-rank
+    kind is incompatible with dense paths and vice versa. Returns
+    ``(state, note)``; ``state=None`` means incompatible.
     """
     import jax.numpy as jnp
 
     from distributed_eigenspaces_tpu.algo.online import OnlineState
     from distributed_eigenspaces_tpu.algo.scan import SegmentState
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        LowRankState,
+    )
 
+    if want == "lowrank":  # feature-sharded per-step resume
+        return (state, None) if isinstance(state, LowRankState) else (
+            None, None
+        )
     if want == "segment":
         if isinstance(state, SegmentState):
             return state, None
@@ -146,13 +158,16 @@ def _coerce_resumed_state(state, want: str, k: int):
                 "the first post-resume step runs cold",
             )
         return None, None
+    # want == "online" (dense per-step)
+    if isinstance(state, OnlineState):
+        return state, None
     if isinstance(state, SegmentState):
         return (
             OnlineState(sigma_tilde=state.sigma_tilde, step=state.step),
             "resumed from a scan checkpoint (warm carry dropped: the "
             "per-step loop re-threads it from the next round)",
         )
-    return state, None
+    return None, None
 
 
 def _resume_from(ckpt, want: str, k: int):
@@ -164,12 +179,14 @@ def _resume_from(ckpt, want: str, k: int):
     if restored is None:
         return None, 0, 0
     state, cursor = restored
+    kind = type(state).__name__
     state, note = _coerce_resumed_state(state, want, k)
     if state is None:
         print(
-            "error: checkpoint holds a feature-sharded low-rank state; "
-            "only dense OnlineState/SegmentState checkpoints resume on "
-            "this path",
+            f"error: checkpoint holds a {kind}, incompatible with this "
+            "trainer/backend (dense trainers resume OnlineState/"
+            "SegmentState; --backend feature_sharded resumes "
+            "LowRankState)",
             file=sys.stderr,
         )
         return None, 0, 2
@@ -268,9 +285,13 @@ def _fit_scan(args, cfg, data, truth) -> int:
         np.ascontiguousarray(data[:need]).reshape(T, m, n, dim)
     )
 
+    from distributed_eigenspaces_tpu.utils.tracing import profile_to
+
     fit = make_scan_fit(cfg, mesh=_scan_mesh(cfg))
     t0 = time.time()
-    state, _v_bars = fit(OnlineState.initial(dim), x_steps)
+    with profile_to(args.profile_dir):
+        state, _v_bars = fit(OnlineState.initial(dim), x_steps)
+        float(jnp.sum(state.step))  # fence inside the capture
     elapsed = time.time() - t0
     return _scan_result(
         args, cfg, state, truth, elapsed,
@@ -345,8 +366,11 @@ def _fit_scan_segmented(args, cfg, data, truth) -> int:
         if ckpt is not None:
             ckpt.on_step(t, st)
 
+    from distributed_eigenspaces_tpu.utils.tracing import profile_to
+
     t0 = time.time()
-    state = fit(state, x_steps, on_segment=on_segment)
+    with profile_to(args.profile_dir):
+        state = fit(state, x_steps, on_segment=on_segment)
     elapsed = time.time() - t0
     return _scan_result(
         args, cfg, state, truth, elapsed,
@@ -500,7 +524,10 @@ def main(argv=None) -> int:
         )
         callbacks.append(ckpt.on_step)
         if args.resume:
-            restored, cursor, err = _resume_from(ckpt, "online", cfg.k)
+            want = (
+                "lowrank" if cfg.backend == "feature_sharded" else "online"
+            )
+            restored, cursor, err = _resume_from(ckpt, want, cfg.k)
             if err:
                 return err
             if restored is not None:
@@ -527,7 +554,10 @@ def main(argv=None) -> int:
         )
     else:
         stream = iter(())  # budget exhausted or no unseen data left
-    est.fit_stream(stream, on_step=on_step, max_steps=None)
+    from distributed_eigenspaces_tpu.utils.tracing import profile_to
+
+    with profile_to(args.profile_dir):
+        est.fit_stream(stream, on_step=on_step, max_steps=None)
 
     out = {"mode": "fit", **metrics.summary(), "dim": dim, "k": args.rank}
     print(json.dumps(out))

@@ -377,14 +377,21 @@ def _make_step_core(cfg: PCAConfig, *, collectives: str, key):
         # on the cold first step -> graceful fallback to random init); the
         # online subspace moves slowly, so warm steps converge in far
         # fewer iterations
-        vws = worker_subspace_sharded(
-            x, k, step_iters, n, key, collectives,
-            v0=st.u[:, :k], compute_dtype=cfg.compute_dtype,
-            ritz=False,  # the merge below is rotation-invariant
-        )
-        v_bar = merged_lowrank_sharded(vws, k, mask=mask, dim_total=cfg.dim)
+        with jax.named_scope("det_worker_solve"):
+            vws = worker_subspace_sharded(
+                x, k, step_iters, n, key, collectives,
+                v0=st.u[:, :k], compute_dtype=cfg.compute_dtype,
+                ritz=False,  # the merge below is rotation-invariant
+            )
+        with jax.named_scope("det_merge"):
+            v_bar = merged_lowrank_sharded(
+                vws, k, mask=mask, dim_total=cfg.dim
+            )
         w, keep = weights(st.step)
-        new_st = _lowrank_update(st, v_bar, w, keep, axis_name=FEATURE_AXIS)
+        with jax.named_scope("det_state_update"):
+            new_st = _lowrank_update(
+                st, v_bar, w, keep, axis_name=FEATURE_AXIS
+            )
         return new_st, v_bar
 
     return step_core
@@ -725,19 +732,24 @@ def make_feature_sharded_sketch_fit(
 
     def warm_step(st, x, omega):
         matvec = _make_matvec(x, n, collectives, cfg.compute_dtype)
-        v = jnp.broadcast_to(st.v[None], (x.shape[0],) + st.v.shape)
-        for _ in range(warm_iters):
-            v = matvec(v)
-        v = ns_orth(v, FEATURE_AXIS)
+        with jax.named_scope("det_warm_matvec"):
+            v = jnp.broadcast_to(st.v[None], (x.shape[0],) + st.v.shape)
+            for _ in range(warm_iters):
+                v = matvec(v)
+        with jax.named_scope("det_ns_orth"):
+            v = ns_orth(v, FEATURE_AXIS)
         # projector-mean power step (scale-free: ns_orth renormalizes)
-        yl = jax.lax.psum(
-            jnp.einsum("mdk,dl->mkl", v, st.v, precision=HP), FEATURE_AXIS
-        )
-        z = jax.lax.psum(
-            jnp.einsum("mdk,mkl->dl", v, yl, precision=HP), WORKER_AXIS
-        )
-        v_bar = ns_orth(z, FEATURE_AXIS)
-        return _fold(st, v_bar, omega)
+        with jax.named_scope("det_merge_power"):
+            yl = jax.lax.psum(
+                jnp.einsum("mdk,dl->mkl", v, st.v, precision=HP),
+                FEATURE_AXIS,
+            )
+            z = jax.lax.psum(
+                jnp.einsum("mdk,mkl->dl", v, yl, precision=HP), WORKER_AXIS
+            )
+            v_bar = ns_orth(z, FEATURE_AXIS)
+        with jax.named_scope("det_sketch_fold"):
+            return _fold(st, v_bar, omega)
 
     def sharded_fit(state, blocks, idx):
         omega = _omega(state.y.shape[0])

@@ -31,12 +31,16 @@ import numpy as np
 
 from distributed_eigenspaces_tpu.algo.online import OnlineState
 from distributed_eigenspaces_tpu.algo.scan import SegmentState
-from distributed_eigenspaces_tpu.parallel.feature_sharded import LowRankState
+from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+    LowRankState,
+    SketchState,
+)
 
 _STATE_TYPES = {
     "online": OnlineState,
     "lowrank": LowRankState,
     "scan_segment": SegmentState,
+    "sketch": SketchState,
 }
 
 
@@ -55,8 +59,14 @@ def save_checkpoint(
     """Write a self-describing checkpoint directory at ``path``."""
     os.makedirs(path, exist_ok=True)
     kind = next(
-        name for name, cls in _STATE_TYPES.items() if isinstance(state, cls)
+        (n for n, cls in _STATE_TYPES.items() if isinstance(state, cls)),
+        None,
     )
+    if kind is None:
+        raise ValueError(
+            f"unsupported checkpoint state type {type(state).__name__}; "
+            f"known: {sorted(_STATE_TYPES)}"
+        )
     host = _to_host(state)
     # Invalidate any previous commit marker BEFORE touching state.npz, and
     # write the payload via tmp+rename: a crash at any point leaves either

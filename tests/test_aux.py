@@ -369,3 +369,36 @@ def test_estimator_feature_sharded_backend(devices):
         )
     )
     assert ang_m <= 2.0, ang_m
+
+
+def test_profile_capture_shows_named_regions(tmp_path):
+    """§5.1 wired end-to-end: a jax.profiler capture around a fit contains
+    the det_* named regions the round cores annotate."""
+    import glob
+
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.algo.online import OnlineState
+    from distributed_eigenspaces_tpu.algo.step import make_train_step
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.utils.tracing import profile_to
+
+    cfg = PCAConfig(dim=32, k=2, num_workers=4, rows_per_worker=16,
+                    num_steps=2, solver="subspace", subspace_iters=4)
+    step = make_train_step(cfg, donate=False)
+    x = jnp.ones((4, 16, 32), jnp.float32)
+    state = OnlineState.initial(32)
+    step(state, x)  # compile outside the capture
+    with profile_to(str(tmp_path)):
+        st, _ = step(state, x)
+        float(jnp.sum(st.sigma_tilde))
+    files = glob.glob(str(tmp_path / "**" / "*"), recursive=True)
+    blobs = [f for f in files if f.endswith((".pb", ".json.gz", ".trace"))]
+    assert blobs, f"no trace artifacts captured: {files}"
+    found = False
+    for f in blobs:
+        with open(f, "rb") as fh:
+            if b"det_worker_solve" in fh.read():
+                found = True
+                break
+    assert found, f"det_* named regions not present in {blobs}"

@@ -304,3 +304,47 @@ def test_cli_resume_requires_checkpoint_dir():
 
     assert main(["--data", "synthetic", "--dim", "32", "--rank", "2",
                  "--trainer", "scan", "--resume"]) == 2
+
+
+def test_cli_incompatible_checkpoint_rejected(tmp_path):
+    """A low-rank (feature-sharded) checkpoint must be rejected loudly by
+    the dense trainers, and vice versa — not crash mid-run."""
+    from distributed_eigenspaces_tpu.cli import main
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        LowRankState,
+    )
+    from distributed_eigenspaces_tpu.utils.checkpoint import save_checkpoint
+
+    ckpt = str(tmp_path / "ck" / "step_00000002")
+    save_checkpoint(ckpt, LowRankState.initial(48, 6), cursor=0)
+    common = [
+        "--data", "synthetic", "--dim", "48", "--rank", "3",
+        "--workers", "4", "--rows-per-worker", "32", "--steps", "4",
+        "--solver", "subspace", "--checkpoint-dir", str(tmp_path / "ck"),
+        "--resume", "--backend", "local",
+    ]
+    assert main(common + ["--trainer", "scan"]) == 2
+    assert main(common + ["--trainer", "step"]) == 2
+
+
+def test_checkpoint_sketch_state_roundtrip(tmp_path):
+    """SketchState is a registered checkpoint kind; unknown types raise a
+    clear ValueError (not a bare StopIteration)."""
+    import pytest
+
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        SketchState,
+    )
+    from distributed_eigenspaces_tpu.utils.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    st = SketchState.initial(32, 4, 12)
+    save_checkpoint(str(tmp_path / "s"), st, cursor=7)
+    back, cursor = restore_checkpoint(str(tmp_path / "s"))
+    assert isinstance(back, SketchState) and cursor == 7
+    assert back.y.shape == (32, 12) and back.v.shape == (32, 4)
+
+    with pytest.raises(ValueError, match="unsupported checkpoint state"):
+        save_checkpoint(str(tmp_path / "bad"), ("not", "a", "state"))

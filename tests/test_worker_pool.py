@@ -166,3 +166,21 @@ def test_local_eigenspaces_streaming_matches_gram(rng):
         dense = top_k_eigvecs(gram(x[i]), k)
         ang = np.asarray(principal_angles_degrees(vs[i], dense))
         assert ang.max() < 0.5, (i, ang)
+
+
+def test_local_eigenspaces_reuses_jit_cache(rng):
+    """local_eigenspaces must not rebuild its jit wrapper per call (round-1
+    weak item 4: a fresh jax.jit(partial(...)) per invocation never hits
+    the trace cache)."""
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
+
+    pool = WorkerPool(4, backend="local", solver="subspace",
+                      subspace_iters=4)
+    x = jnp.asarray(rng.standard_normal((4, 32, 16)).astype(np.float32))
+    a = pool.local_eigenspaces(x, 2)
+    b = pool.local_eigenspaces(x + 1.0, 2)
+    assert a.shape == b.shape == (4, 16, 2)
+    # one trace for one (shape, k): the wrapper is shared across calls
+    assert pool._local_fn._cache_size() == 1
