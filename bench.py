@@ -149,7 +149,7 @@ def _rpc_overhead():
     return (time.perf_counter() - t0) / reps
 
 
-def measure_tpu(blocks_host, spectrum):
+def measure_tpu(blocks_host, spectrum, profile_dir=None):
     """Per-step-dispatch variant (one device program per online step).
 
     NOTE: when the host drives the device over a network tunnel (the axon
@@ -174,11 +174,14 @@ def measure_tpu(blocks_host, spectrum):
     state, _ = step(state, blocks[0])
     _sync(state.sigma_tilde)
 
+    from distributed_eigenspaces_tpu.utils.tracing import profile_to
+
     state = OnlineState.initial(D)
     t0 = time.perf_counter()
-    for s in range(steps):
-        state, _ = step(state, blocks[s % len(blocks)])
-    _sync(state.sigma_tilde)
+    with profile_to(profile_dir):
+        for s in range(steps):
+            state, _ = step(state, blocks[s % len(blocks)])
+        _sync(state.sigma_tilde)
     dt = time.perf_counter() - t0
 
     return (steps * M * N) / dt, _gate_angle(state, spectrum)
@@ -273,7 +276,12 @@ def main():
     # (the named det_* regions from the round cores show in the timeline)
     profile_dir = None
     if "--profile-dir" in args:
-        profile_dir = args[args.index("--profile-dir") + 1]
+        i = args.index("--profile-dir")
+        if i + 1 >= len(args) or args[i + 1].startswith("--"):
+            print("usage: bench.py [--steploop] [--profile-dir DIR]",
+                  file=sys.stderr)
+            return 2
+        profile_dir = args[i + 1]
 
     # persistent compile cache: TPU eigh at d=1024 is minutes to compile via
     # a remote-compile path; cache makes reruns start in seconds
@@ -296,7 +304,9 @@ def main():
             blocks_host, spectrum, profile_dir=profile_dir
         )
     else:
-        tpu_sps, angle_deg = measure_tpu(blocks_host, spectrum)
+        tpu_sps, angle_deg = measure_tpu(
+            blocks_host, spectrum, profile_dir=profile_dir
+        )
         extras = {}
     cpu_sps = measure_cpu_baseline(blocks_host)
 

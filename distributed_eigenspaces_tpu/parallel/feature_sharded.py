@@ -478,8 +478,12 @@ def make_feature_sharded_step(
     )
 
     # placed once: the common unmasked call must not pay a host->device
-    # mask transfer per step
-    default_mask = jax.device_put(jnp.ones((m,), jnp.float32), mask_sharding)
+    # mask transfer per step. jit-created (not device_put) so the same
+    # code works when the mesh spans processes — device_put cannot write
+    # non-addressable shards.
+    default_mask = jax.jit(
+        lambda: jnp.ones((m,), jnp.float32), out_shardings=mask_sharding
+    )()
 
     def step(state, x_blocks, worker_mask=None):
         if worker_mask is None:
@@ -493,9 +497,10 @@ def make_feature_sharded_step(
         return cold(state, x_blocks, worker_mask)
 
     def init_state():
-        return jax.device_put(
-            LowRankState.initial(cfg.dim, r), state_shardings
-        )
+        return jax.jit(
+            lambda: LowRankState.initial(cfg.dim, r),
+            out_shardings=state_shardings,
+        )()
 
     step.init_state = init_state
     step.rank = r
@@ -590,9 +595,10 @@ def make_feature_sharded_scan_fit(
     )
 
     def init_state():
-        return jax.device_put(
-            LowRankState.initial(cfg.dim, r), state_shardings
-        )
+        return jax.jit(
+            lambda: LowRankState.initial(cfg.dim, r),
+            out_shardings=state_shardings,
+        )()
 
     fit.init_state = init_state
     fit.rank = r
@@ -790,7 +796,10 @@ def make_feature_sharded_sketch_fit(
     )
 
     def init_state():
-        return jax.device_put(SketchState.initial(d, k, p), state_shardings)
+        return jax.jit(
+            lambda: SketchState.initial(d, k, p),
+            out_shardings=state_shardings,
+        )()
 
     fit.init_state = init_state
     fit.extract = jax.jit(

@@ -133,6 +133,86 @@ def global_mesh(
     )
 
 
+@dataclasses.dataclass(frozen=True)
+class HostRect:
+    """This process's rectangle of the 2-D ``(workers, features)`` mesh —
+    which global workers AND which feature-dimension slice it owns."""
+
+    w_lo: int
+    w_hi: int  # exclusive, in units of mesh worker-axis slots
+    f_lo: int
+    f_hi: int  # exclusive, in units of mesh feature-axis slots
+    mesh_workers: int
+    mesh_features: int
+
+    def block_slice(self, num_workers: int, dim: int):
+        """Numpy slices of the global ``(m, n, d)`` block this host loads:
+        worker rows for its mesh rows, feature columns for its mesh
+        columns. The multi-host version of "load only what you own"
+        (contrast reference ``distributed.py:169``)."""
+        if num_workers % self.mesh_workers or dim % self.mesh_features:
+            raise ValueError(
+                f"(m={num_workers}, d={dim}) not divisible by mesh "
+                f"({self.mesh_workers}, {self.mesh_features})"
+            )
+        wper = num_workers // self.mesh_workers
+        fper = dim // self.mesh_features
+        return (
+            slice(self.w_lo * wper, self.w_hi * wper),
+            slice(self.f_lo * fper, self.f_hi * fper),
+        )
+
+
+def host_block_rect(mesh: Mesh, *, process_index: int | None = None):
+    """This process's contiguous rectangle of a ``(workers, features)``
+    mesh. The default device order makes each process's devices a
+    contiguous sub-grid; anything else (interleaved ownership) is rejected
+    loudly — the data-loading contract would be wrong for it.
+    """
+    pi = jax.process_index() if process_index is None else process_index
+    grid = np.asarray(mesh.devices)
+    own = np.array(
+        [[d.process_index == pi for d in row] for row in grid], dtype=bool
+    )
+    if not own.any():
+        raise ValueError(f"process {pi} owns no devices of this mesh")
+    wrows = np.nonzero(own.any(axis=1))[0]
+    fcols = np.nonzero(own.any(axis=0))[0]
+    rect_ok = (
+        np.array_equal(wrows, np.arange(wrows[0], wrows[-1] + 1))
+        and np.array_equal(fcols, np.arange(fcols[0], fcols[-1] + 1))
+        and own[np.ix_(wrows, fcols)].all()
+        and own.sum() == len(wrows) * len(fcols)
+    )
+    if not rect_ok:
+        raise ValueError(
+            f"process {pi}'s devices are not a contiguous rectangle of "
+            "the (workers, features) grid — re-order the mesh devices"
+        )
+    return HostRect(
+        w_lo=int(wrows[0]), w_hi=int(wrows[-1]) + 1,
+        f_lo=int(fcols[0]), f_hi=int(fcols[-1]) + 1,
+        mesh_workers=grid.shape[0], mesh_features=grid.shape[1],
+    )
+
+
+def feature_blocks_to_global(
+    x_local: np.ndarray | jax.Array, mesh: Mesh, global_shape
+) -> jax.Array:
+    """Assemble per-host ``(m_local, n, d_local)`` blocks into the global
+    ``(m, n, d)`` array sharded ``P(workers, None, features)`` — the 2-D
+    twin of :func:`host_local_blocks_to_global` for the feature-sharded
+    backend. Each process passes exactly the chunk its
+    :func:`host_block_rect` owns (``HostRect.block_slice``).
+    """
+    from distributed_eigenspaces_tpu.parallel.mesh import FEATURE_AXIS
+
+    sharding = NamedSharding(mesh, P(WORKER_AXIS, None, FEATURE_AXIS))
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(x_local), tuple(global_shape)
+    )
+
+
 def host_local_blocks_to_global(
     x_local: np.ndarray | jax.Array, mesh: Mesh
 ) -> jax.Array:
