@@ -143,6 +143,16 @@ def ns_orth(v, axis_name=None, iters=4, eps=1e-20):
     )
 
 
+
+def _jit_init(factory, shardings):
+    """Zero-arg jitted state initializer, built ONCE per trainer: a fresh
+    jax.jit wrapper per init_state() call would recompile (and pay a
+    compile RPC) every time — measured 3x whole-fit slowdown when an init
+    landed inside a timed region. jit (not device_put) so the same code
+    works when the mesh spans processes."""
+    return jax.jit(factory, out_shardings=shardings)
+
+
 def _reduce_features(collectives):
     if collectives == "ring":
         from distributed_eigenspaces_tpu.parallel.ring import ring_psum
@@ -496,13 +506,9 @@ def make_feature_sharded_step(
             return warm(state, x_blocks, worker_mask)
         return cold(state, x_blocks, worker_mask)
 
-    def init_state():
-        return jax.jit(
-            lambda: LowRankState.initial(cfg.dim, r),
-            out_shardings=state_shardings,
-        )()
-
-    step.init_state = init_state
+    step.init_state = _jit_init(
+        lambda: LowRankState.initial(cfg.dim, r), state_shardings
+    )
     step.rank = r
     step.x_sharding = x_sharding  # for input pipelines / prefetch placement
     step.state_shardings = state_shardings
@@ -594,13 +600,9 @@ def make_feature_sharded_scan_fit(
         out_shardings=state_shardings,
     )
 
-    def init_state():
-        return jax.jit(
-            lambda: LowRankState.initial(cfg.dim, r),
-            out_shardings=state_shardings,
-        )()
-
-    fit.init_state = init_state
+    fit.init_state = _jit_init(
+        lambda: LowRankState.initial(cfg.dim, r), state_shardings
+    )
     fit.rank = r
     fit.blocks_sharding = blocks_sharding
     fit.state_shardings = state_shardings
@@ -628,21 +630,28 @@ class SketchState(NamedTuple):
 
 def _nystrom_top_k(y, omega, k, axis_name=None):
     """Top-k eigenvectors of the PSD matrix behind a single-pass Nystrom
-    sketch ``y = A @ omega``: ``A ~= Y B^{-1} Y^T`` with ``B = omega^T Y``
-    (= ``omega^T A omega``), factored as ``F F^T`` for ``F = Y L^{-T}``,
-    ``B = L L^T``. One Cholesky + one small eigh, run ONCE at extraction —
-    the whole point of the sketch is that no spectral solve runs per step.
+    sketch ``y = A @ omega``: ``A ~= Y B^+ Y^T`` with ``B = omega^T Y``
+    (= ``omega^T A omega``), factored as ``F F^T`` for ``F = Y Q_B
+    diag(lam_B)^{-1/2}`` from B's eigendecomposition. Two small eighs, run
+    ONCE at extraction — the whole point of the sketch is that no spectral
+    solve runs per step.
+
+    The pseudo-inverse square root (NOT a Cholesky of ``B + shift``): a
+    converged sketch makes ``B`` exactly rank-deficient, and fp32
+    round-off then puts small NEGATIVE eigenvalues in the null space —
+    larger than any safe shift, so a Cholesky route emits NaN columns
+    (observed at d=1024/T=600 on TPU). Dropping the numerically-null tail
+    is exact for the top-k and unconditionally finite.
+
     ``y``/``omega`` are (d_local, p) row shards when ``axis_name`` is set.
     """
     b = jnp.einsum("dp,dq->pq", omega, y, precision=HP)
     b = _psum_if(b, axis_name)
     b = 0.5 * (b + b.T)
-    p = b.shape[0]
-    shift = 1e-6 * jnp.maximum(jnp.trace(b), 0.0) / p + 1e-30
-    ell = jnp.linalg.cholesky(b + shift * jnp.eye(p, dtype=b.dtype))
-    f = jax.lax.linalg.triangular_solve(
-        ell, y, left_side=False, lower=True, transpose_a=True
-    )
+    wb, qb = _small_eigh_desc(b)
+    tol = 1e-7 * jnp.maximum(wb[0], 0.0) + 1e-30
+    inv_b = jnp.where(wb > tol, jax.lax.rsqrt(jnp.maximum(wb, 1e-30)), 0.0)
+    f = jnp.einsum("dp,pq,q->dq", y, qb, inv_b, precision=HP)
     gf = jnp.einsum("dp,dq->pq", f, f, precision=HP)
     gf = _psum_if(gf, axis_name)
     w, q = _small_eigh_desc(gf)
@@ -795,13 +804,9 @@ def make_feature_sharded_sketch_fit(
         out_shardings=state_shardings,
     )
 
-    def init_state():
-        return jax.jit(
-            lambda: SketchState.initial(d, k, p),
-            out_shardings=state_shardings,
-        )()
-
-    fit.init_state = init_state
+    fit.init_state = _jit_init(
+        lambda: SketchState.initial(d, k, p), state_shardings
+    )
     fit.extract = jax.jit(
         jax.shard_map(
             sharded_extract,

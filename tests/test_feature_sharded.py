@@ -529,3 +529,29 @@ def test_sketch_fit_resumes_from_state(mesh, devices):
         )
     )
     assert ang.max() < 1.0, f"resumed sketch fit: {ang}"
+
+
+def test_nystrom_extraction_rank_deficient(rng):
+    """_nystrom_top_k must stay finite and exact on a CONVERGED sketch:
+    B = omega^T A omega is then exactly rank-deficient and fp32 round-off
+    puts small negative eigenvalues in its null space — a Cholesky-based
+    route emits NaNs there (observed on TPU at d=1024/T=600)."""
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        _nystrom_top_k,
+    )
+
+    d, k, p = 96, 5, 21
+    u = np.linalg.qr(rng.standard_normal((d, k)))[0].astype(np.float32)
+    vals = np.array([5.0, 4.0, 3.0, 2.0, 1.0], np.float32)
+    a = (u * vals) @ u.T  # exactly rank k < p
+    omega = rng.standard_normal((d, p)).astype(np.float32)
+    y = (a @ omega).astype(np.float32)
+    # adversarial round-off: a tiny perturbation that pushes B's null
+    # space slightly negative
+    y = y + 1e-5 * rng.standard_normal((d, p)).astype(np.float32)
+
+    w = np.asarray(_nystrom_top_k(jnp.asarray(y), jnp.asarray(omega), k))
+    assert np.all(np.isfinite(w)), "NaN in Nystrom extraction"
+    ang = np.asarray(principal_angles_degrees(jnp.asarray(w), jnp.asarray(u)))
+    assert ang.max() < 1.0, f"rank-deficient extraction off: {ang}"
+    np.testing.assert_allclose(w.T @ w, np.eye(k), atol=5e-3)
