@@ -50,6 +50,10 @@ def make_scan_fit(
     its per-worker solves from the previous merged ``v_bar`` with the short
     iteration count — the online-stream optimization BASELINE.md measures.
     """
+    # function-level import: utils.__init__ pulls checkpoint, which
+    # imports this module — a top-level import would cycle
+    from distributed_eigenspaces_tpu.utils.guards import checked_jit
+
     round_core = make_round_core(cfg)
     warm = cfg.warm_start_iters is not None and cfg.solver == "subspace"
     warm_core = (
@@ -116,7 +120,8 @@ def make_scan_fit(
         return fit_dense
 
     if mesh is None:
-        return jax.jit(make_fit(axis_name=None))
+        # checked_jit == jax.jit unless DET_CHECKIFY=1 (NaN guards, §5.2)
+        return checked_jit(make_fit(axis_name=None))
 
     # one shard_map around the whole scan: the worker axis stays
     # device-resident across all T steps and only the k-width merge
@@ -132,7 +137,7 @@ def make_scan_fit(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(
+    return checked_jit(
         inner, in_shardings=in_shardings, out_shardings=(rep, rep)
     )
 
@@ -180,6 +185,8 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
     """
     if segment < 1:
         raise ValueError(f"segment must be >= 1, got {segment}")
+    from distributed_eigenspaces_tpu.utils.guards import checked_jit
+
     round_core = make_round_core(cfg)
     warm = cfg.warm_start_iters is not None and cfg.solver == "subspace"
     warm_core = (
@@ -217,7 +224,7 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
 
     if mesh is None:
         def build(first):
-            return jax.jit(make_seg(None, first))
+            return checked_jit(make_seg(None, first))
     else:
         rep = NamedSharding(mesh, P())
         x_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
@@ -230,7 +237,7 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
                 out_specs=P(),
                 check_vma=False,
             )
-            return jax.jit(
+            return checked_jit(
                 inner, in_shardings=(rep, x_sharding), out_shardings=rep
             )
 

@@ -97,6 +97,8 @@ def make_train_step(
     if the same state object will be passed again (e.g. repeated timing
     calls on fixed example args).
     """
+    from distributed_eigenspaces_tpu.utils.guards import checked_jit
+
     round_core = make_round_core(cfg)
     warm = cfg.warm_start_iters is not None and cfg.solver == "subspace"
     warm_core = (
@@ -112,58 +114,64 @@ def make_train_step(
             v_bar,
         )
 
+    # checked_jit == jax.jit unless DET_CHECKIFY=1 arms the §5.2 NaN/inf
+    # guards (resolved here, at build time)
     if mesh is None:
 
-        @partial(jax.jit, donate_argnums=donate_args)
-        def cold(state: OnlineState, x_blocks):
+        def cold_fn(state: OnlineState, x_blocks):
             return fold(state, round_core(x_blocks))
+
+        cold = checked_jit(cold_fn, donate_argnums=donate_args)
 
         if warm:
 
-            @partial(jax.jit, donate_argnums=donate_args)
-            def warm_step(state: OnlineState, x_blocks, v_prev):
+            def warm_fn(state: OnlineState, x_blocks, v_prev):
                 return fold(state, warm_core(x_blocks, v0=v_prev))
+
+            warm_step = checked_jit(warm_fn, donate_argnums=donate_args)
 
     else:
         x_sharding = NamedSharding(mesh, P(WORKER_AXIS))
         rep = NamedSharding(mesh, P())
 
+        # fold lives INSIDE the shard_map (replicated compute, out_specs
+        # P()): checkify's error plumbing composes with
+        # jit(shard_map(whole_step)) but not with float ops split across
+        # the shard_map boundary (sharded vs replicated error payloads)
+        state_specs = OnlineState(sigma_tilde=P(), step=P())
+
         inner = jax.shard_map(
-            partial(round_core, axis_name=WORKER_AXIS),
+            lambda state, x: fold(
+                state, round_core(x, axis_name=WORKER_AXIS)
+            ),
             mesh=mesh,
-            in_specs=(P(WORKER_AXIS),),
-            out_specs=P(),
+            in_specs=(state_specs, P(WORKER_AXIS)),
+            out_specs=(state_specs, P()),
             check_vma=False,
         )
-
-        @partial(
-            jax.jit,
+        cold = checked_jit(
+            inner,
             in_shardings=(rep, x_sharding),
             out_shardings=(rep, rep),
             donate_argnums=donate_args,
         )
-        def cold(state: OnlineState, x_blocks):
-            return fold(state, inner(x_blocks))
 
         if warm:
             inner_warm = jax.shard_map(
-                lambda x, v0: warm_core(
-                    x, axis_name=WORKER_AXIS, v0=v0
+                lambda state, x, v0: fold(
+                    state, warm_core(x, axis_name=WORKER_AXIS, v0=v0)
                 ),
                 mesh=mesh,
-                in_specs=(P(WORKER_AXIS), P()),
-                out_specs=P(),
+                in_specs=(state_specs, P(WORKER_AXIS), P()),
+                out_specs=(state_specs, P()),
                 check_vma=False,
             )
-
-            @partial(
-                jax.jit,
+            warm_step = checked_jit(
+                inner_warm,
                 in_shardings=(rep, x_sharding, rep),
                 out_shardings=(rep, rep),
                 donate_argnums=donate_args,
             )
-            def warm_step(state: OnlineState, x_blocks, v_prev):
-                return fold(state, inner_warm(x_blocks, v_prev))
 
     def step(state: OnlineState, x_blocks, v_prev=None):
         if warm and v_prev is not None:

@@ -76,7 +76,13 @@ class PCAConfig:
       collectives: cross-device reduction schedule for the feature-sharded
         backend: ``"xla"`` (``lax.psum``/``all_gather`` — XLA already lowers
         these to ICI rings) or ``"ring"`` (explicit ``ppermute``
-        neighbor-exchange schedules, ``parallel/ring.py``).
+        neighbor-exchange schedules, ``parallel/ring.py``). ``"ring"``
+        covers the matvec reductions, the merge's factor gather + Gram
+        reductions (both dispatch routes), and the sketch trainer's
+        merge/fold psums; the k-wide Grams inside CholeskyQR2 /
+        Rayleigh-Ritz and the tiny state-update psum stay on XLA
+        collectives (latency-critical k x k reductions where an unrolled
+        ring buys nothing).
       seed: PRNG seed for initialization (subspace solver, synthetic data).
     """
 

@@ -153,12 +153,21 @@ def _jit_init(factory, shardings):
     return jax.jit(factory, out_shardings=shardings)
 
 
-def _reduce_features(collectives):
+def _collective_ops(collectives):
+    """One definition of the ring-vs-xla dispatch: returns
+    ``(psum, gather)`` closures taking ``(tensor, axis_name)`` — every
+    collectives-switchable reduction in this module routes through here."""
     if collectives == "ring":
-        from distributed_eigenspaces_tpu.parallel.ring import ring_psum
+        from distributed_eigenspaces_tpu.parallel.ring import (
+            ring_all_gather,
+            ring_psum,
+        )
 
-        return lambda t: ring_psum(t, FEATURE_AXIS)
-    return lambda t: jax.lax.psum(t, FEATURE_AXIS)
+        return ring_psum, ring_all_gather
+    return (
+        lambda t, ax: jax.lax.psum(t, ax),
+        lambda t, ax: jax.lax.all_gather(t, ax, axis=0, tiled=True),
+    )
 
 
 def _make_matvec(x, n_total_rows, collectives="xla", compute_dtype=None):
@@ -176,7 +185,8 @@ def _make_matvec(x, n_total_rows, collectives="xla", compute_dtype=None):
         compute_dtype = jnp.float32
     xc = x.astype(compute_dtype) if compute_dtype is not None else x
     prec = HP if xc.dtype == jnp.float32 else None
-    reduce_features = _reduce_features(collectives)
+    psum_c, _ = _collective_ops(collectives)
+    reduce_features = lambda t: psum_c(t, FEATURE_AXIS)  # noqa: E731
 
     def matvec(v):
         xv = jnp.einsum(
@@ -253,7 +263,8 @@ def worker_subspace_sharded(
     return jnp.einsum("mdk,mkl->mdl", v, q, precision=HP)
 
 
-def merged_lowrank_sharded(v_workers, k, mask=None, dim_total=None):
+def merged_lowrank_sharded(v_workers, k, mask=None, dim_total=None,
+                           collectives="xla"):
     """EXACT top-k of the (masked) mean projector
     ``(1/sum w) sum_l w_l V_l V_l^T`` from its factors, fully sharded — the
     feature-sharded twin of :func:`~..ops.linalg.merged_top_k_lowrank`.
@@ -281,25 +292,23 @@ def merged_lowrank_sharded(v_workers, k, mask=None, dim_total=None):
 
     Returns (d_local, k), replicated over ``workers``, descending order.
     """
-    c = jax.lax.all_gather(
-        v_workers, WORKER_AXIS, axis=0, tiled=True
-    )  # (m_total, d_local, k)
+    psum_c, gather_c = _collective_ops(collectives)
+    gather_w = lambda t: gather_c(t, WORKER_AXIS)  # noqa: E731
+    gather_f = lambda t: gather_c(t, FEATURE_AXIS)  # noqa: E731
+    psum_f = lambda t: psum_c(t, FEATURE_AXIS)  # noqa: E731
+    c = gather_w(v_workers)  # (m_total, d_local, k)
     m_total, d_local, kf = c.shape  # static — no collective
     if mask is None:
         w = jnp.ones((m_total,), jnp.float32)
     else:
-        w = jax.lax.all_gather(
-            mask, WORKER_AXIS, axis=0, tiled=True
-        ).astype(jnp.float32)
+        w = gather_w(mask).astype(jnp.float32)
     cnt = jnp.maximum(jnp.sum(w), 1.0)
     c = c * jnp.sqrt(w / cnt)[:, None, None]
     c = jnp.transpose(c, (1, 0, 2)).reshape(d_local, -1)  # (d_local, m*kf)
     if dim_total is not None and m_total * kf >= dim_total:
         from distributed_eigenspaces_tpu.ops.linalg import top_k_eigvecs
 
-        cf = jax.lax.all_gather(
-            c, FEATURE_AXIS, axis=0, tiled=True
-        )  # (dim_total, m*kf)
+        cf = gather_f(c)  # (dim_total, m*kf)
         p = jnp.matmul(cf, cf.T, precision=HP)
         # all workers masked out -> p == 0 and eigh returns arbitrary
         # basis vectors; zero the result like the factor-Gram route's
@@ -309,7 +318,7 @@ def merged_lowrank_sharded(v_workers, k, mask=None, dim_total=None):
         fidx = jax.lax.axis_index(FEATURE_AXIS)
         return jax.lax.dynamic_slice_in_dim(v, fidx * d_local, d_local, 0)
     b = jnp.matmul(c.T, c, precision=HP)
-    b = jax.lax.psum(b, FEATURE_AXIS)
+    b = psum_f(b)
     w_ev, q = _small_eigh_desc(b)
     wk = jnp.maximum(w_ev[:k], 0.0)
     inv = jnp.where(wk > 1e-12, jax.lax.rsqrt(jnp.maximum(wk, 1e-30)), 0.0)
@@ -395,7 +404,8 @@ def _make_step_core(cfg: PCAConfig, *, collectives: str, key):
             )
         with jax.named_scope("det_merge"):
             v_bar = merged_lowrank_sharded(
-                vws, k, mask=mask, dim_total=cfg.dim
+                vws, k, mask=mask, dim_total=cfg.dim,
+                collectives=collectives,
             )
         w, keep = weights(st.step)
         with jax.named_scope("det_state_update"):
@@ -462,6 +472,8 @@ def make_feature_sharded_step(
     )
     v_sharding = NamedSharding(mesh, u_spec)
 
+    from distributed_eigenspaces_tpu.utils.guards import checked_jit
+
     def build(step_iters):
         inner = jax.shard_map(
             make_sharded(step_iters),
@@ -470,7 +482,8 @@ def make_feature_sharded_step(
             out_specs=(state_specs, u_spec),
             check_vma=False,
         )
-        return jax.jit(
+        # checked_jit == jax.jit unless DET_CHECKIFY=1 (NaN guards, §5.2)
+        return checked_jit(
             inner,
             in_shardings=(state_shardings, x_sharding, mask_sharding),
             out_shardings=(state_shardings, v_sharding),
@@ -592,7 +605,9 @@ def make_feature_sharded_scan_fit(
         out_specs=state_specs,
         check_vma=False,
     )
-    fit = jax.jit(
+    from distributed_eigenspaces_tpu.utils.guards import checked_jit
+
+    fit = checked_jit(
         inner,
         in_shardings=(
             state_shardings, blocks_sharding, NamedSharding(mesh, P()),
@@ -720,6 +735,10 @@ def make_feature_sharded_sketch_fit(
     key = jax.random.PRNGKey(seed)
     omega_key, solve_key = jax.random.split(key)
 
+    psum_c, _ = _collective_ops(collectives)
+    psum_f = lambda t: psum_c(t, FEATURE_AXIS)  # noqa: E731
+    psum_w = lambda t: psum_c(t, WORKER_AXIS)  # noqa: E731
+
     def _omega(d_local):
         fidx = jax.lax.axis_index(FEATURE_AXIS)
         return jax.random.normal(
@@ -728,9 +747,8 @@ def make_feature_sharded_sketch_fit(
 
     def _fold(st, v_bar, omega):
         w_t, keep = weights(st.step)
-        g = jax.lax.psum(
-            jnp.einsum("dk,dp->kp", v_bar, omega, precision=HP),
-            FEATURE_AXIS,
+        g = psum_f(
+            jnp.einsum("dk,dp->kp", v_bar, omega, precision=HP)
         )
         y = keep * st.y + w_t * jnp.einsum(
             "dk,kp->dp", v_bar, g, precision=HP
@@ -742,7 +760,9 @@ def make_feature_sharded_sketch_fit(
             x, k, iters, n, solve_key, collectives,
             v0=st.v, compute_dtype=cfg.compute_dtype, ritz=False,
         )
-        v_bar = merged_lowrank_sharded(vws, k, dim_total=d)
+        v_bar = merged_lowrank_sharded(
+            vws, k, dim_total=d, collectives=collectives
+        )
         return _fold(st, v_bar, omega)
 
     def warm_step(st, x, omega):
@@ -755,12 +775,11 @@ def make_feature_sharded_sketch_fit(
             v = ns_orth(v, FEATURE_AXIS)
         # projector-mean power step (scale-free: ns_orth renormalizes)
         with jax.named_scope("det_merge_power"):
-            yl = jax.lax.psum(
-                jnp.einsum("mdk,dl->mkl", v, st.v, precision=HP),
-                FEATURE_AXIS,
+            yl = psum_f(
+                jnp.einsum("mdk,dl->mkl", v, st.v, precision=HP)
             )
-            z = jax.lax.psum(
-                jnp.einsum("mdk,mkl->dl", v, yl, precision=HP), WORKER_AXIS
+            z = psum_w(
+                jnp.einsum("mdk,mkl->dl", v, yl, precision=HP)
             )
             v_bar = ns_orth(z, FEATURE_AXIS)
         with jax.named_scope("det_sketch_fold"):
@@ -790,7 +809,9 @@ def make_feature_sharded_sketch_fit(
         step=NamedSharding(mesh, P()),
     )
 
-    fit = jax.jit(
+    from distributed_eigenspaces_tpu.utils.guards import checked_jit
+
+    fit = checked_jit(
         jax.shard_map(
             sharded_fit,
             mesh=mesh,

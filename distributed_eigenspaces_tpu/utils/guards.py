@@ -1,0 +1,58 @@
+"""NaN/inf guards — the sanitizer half of SURVEY.md §5.2.
+
+Races are ruled out by construction in this framework (pure jitted steps;
+no shared mutable state), so the remaining hazard class the survey's
+race/sanitizer plan names is SILENT numerical corruption: a bf16 overflow,
+a degenerate Cholesky, or a zero-norm basis propagating NaN through the
+online state with no error anywhere (the reference would print garbage
+just as silently — it has no guards at all).
+
+``DET_CHECKIFY=1`` arms ``jax.experimental.checkify`` float checks on the
+training steps: the first NaN/inf raised BY ANY PRIMITIVE inside the
+step fails loudly with the offending location, instead of corrupting
+``sigma_tilde`` quietly. Off by default (the checks instrument every op
+— debug tool, not a production mode). Resolved at trainer BUILD time,
+same contract as ``DET_NO_PALLAS`` (an env read under jit would be frozen
+by the trace cache anyway).
+
+In guarded mode ``out_shardings``/donation are dropped from the jit
+(checkify changes the output pytree to (error, out)); fine for a debug
+mode.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def checks_enabled(explicit: bool | None = None) -> bool:
+    """Build-time resolution of the NaN-guard switch: an explicit value
+    wins, else the ``DET_CHECKIFY`` env var."""
+    if explicit is not None:
+        return explicit
+    return os.environ.get("DET_CHECKIFY", "0") == "1"
+
+
+def checked_jit(fn, *, enabled: bool | None = None, **jit_kwargs):
+    """``jax.jit(fn, **jit_kwargs)``, or the checkified equivalent when
+    NaN guards are armed: returns a callable with ``fn``'s signature that
+    raises ``checkify.JaxRuntimeError`` on the first NaN/inf produced
+    inside the step."""
+    if not checks_enabled(enabled):
+        return jax.jit(fn, **jit_kwargs)
+    from jax.experimental import checkify
+
+    jit_kwargs.pop("out_shardings", None)
+    jit_kwargs.pop("donate_argnums", None)
+    cf = jax.jit(
+        checkify.checkify(fn, errors=checkify.float_checks), **jit_kwargs
+    )
+
+    def wrapped(*args, **kw):
+        err, out = cf(*args, **kw)
+        checkify.check_error(err)
+        return out
+
+    return wrapped

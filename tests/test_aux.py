@@ -402,3 +402,151 @@ def test_profile_capture_shows_named_regions(tmp_path):
                 found = True
                 break
     assert found, f"det_* named regions not present in {blobs}"
+
+
+class TestNanGuards:
+    """§5.2 sanitizer: DET_CHECKIFY=1 arms checkify float checks on the
+    trainers — NaN/inf fails loudly instead of corrupting sigma_tilde."""
+
+    def _cfg(self):
+        from distributed_eigenspaces_tpu.config import PCAConfig
+
+        return PCAConfig(dim=32, k=2, num_workers=4, rows_per_worker=16,
+                         num_steps=3, solver="subspace", subspace_iters=6)
+
+    def test_nan_block_raises_when_armed(self, monkeypatch):
+        import jax.numpy as jnp
+        from jax.experimental import checkify
+        import pytest
+
+        from distributed_eigenspaces_tpu.algo.online import OnlineState
+        from distributed_eigenspaces_tpu.algo.step import make_train_step
+
+        monkeypatch.setenv("DET_CHECKIFY", "1")
+        step = make_train_step(self._cfg(), donate=False)
+        x = jnp.ones((4, 16, 32), jnp.float32).at[0, 0, 0].set(jnp.nan)
+        with pytest.raises(checkify.JaxRuntimeError):
+            step(OnlineState.initial(32), x)
+
+    def test_clean_run_matches_unguarded(self, monkeypatch, rng):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from distributed_eigenspaces_tpu.algo.online import OnlineState
+        from distributed_eigenspaces_tpu.algo.step import make_train_step
+
+        x = jnp.asarray(
+            rng.standard_normal((4, 16, 32)).astype(np.float32)
+        )
+        # the plain baseline must really be unguarded, even if the outer
+        # environment exports DET_CHECKIFY=1
+        monkeypatch.delenv("DET_CHECKIFY", raising=False)
+        plain = make_train_step(self._cfg(), donate=False)
+        st_p, v_p = plain(OnlineState.initial(32), x)
+
+        monkeypatch.setenv("DET_CHECKIFY", "1")
+        guarded = make_train_step(self._cfg(), donate=False)
+        st_g, v_g = guarded(OnlineState.initial(32), x)
+        np.testing.assert_allclose(
+            np.asarray(st_g.sigma_tilde), np.asarray(st_p.sigma_tilde),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(v_g), np.asarray(v_p), atol=1e-6
+        )
+
+    def test_guarded_scan_fit(self, monkeypatch, rng):
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import checkify
+        import pytest
+
+        from distributed_eigenspaces_tpu.algo.online import OnlineState
+        from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+
+        monkeypatch.setenv("DET_CHECKIFY", "1")
+        fit = make_scan_fit(self._cfg())
+        xs = rng.standard_normal((3, 4, 16, 32)).astype(np.float32)
+        st, _ = fit(OnlineState.initial(32), jnp.asarray(xs))
+        assert int(st.step) == 3  # clean data passes
+
+        xs[1, 2, 3, 4] = np.inf
+        with pytest.raises(checkify.JaxRuntimeError):
+            fit(OnlineState.initial(32), jnp.asarray(xs))
+
+    def test_guarded_segmented_fit_shard_map(self, monkeypatch, rng,
+                                             devices):
+        """checkify composes with the shard_map + scan segmented trainer."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import checkify
+        import pytest
+
+        from distributed_eigenspaces_tpu.algo.scan import (
+            SegmentState,
+            make_segmented_fit,
+        )
+        from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+        monkeypatch.setenv("DET_CHECKIFY", "1")
+        cfg = self._cfg()
+        fit = make_segmented_fit(
+            cfg, mesh=make_mesh(num_workers=4), segment=2
+        )
+        xs = rng.standard_normal((3, 4, 16, 32)).astype(np.float32)
+        st = fit(SegmentState.initial(32, 2), xs)
+        assert int(st.step) == 3
+
+        xs[2, 1, 0, 0] = np.nan
+        with pytest.raises(checkify.JaxRuntimeError):
+            fit(SegmentState.initial(32, 2), xs)
+
+    def test_guard_fires_through_mesh_step(self, monkeypatch, devices):
+        """checkify composes with the shard_map per-step trainer (fold
+        lives inside the shard_map — split float ops across the boundary
+        and checkify's error payloads shape-mismatch)."""
+        import jax.numpy as jnp
+        from jax.experimental import checkify
+        import pytest
+
+        from distributed_eigenspaces_tpu.algo.online import OnlineState
+        from distributed_eigenspaces_tpu.algo.step import make_train_step
+        from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+        monkeypatch.setenv("DET_CHECKIFY", "1")
+        step = make_train_step(
+            self._cfg(), mesh=make_mesh(num_workers=4), donate=False
+        )
+        clean = jnp.ones((4, 16, 32), jnp.float32) * 0.1
+        st, _ = step(OnlineState.initial(32), clean)
+        assert int(st.step) == 1
+        x = clean.at[1, 2, 3].set(jnp.inf)
+        with pytest.raises(checkify.JaxRuntimeError):
+            step(OnlineState.initial(32), x)
+
+    def test_guard_fires_through_feature_sharded_step(self, monkeypatch,
+                                                      devices):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import checkify
+        import pytest
+
+        from distributed_eigenspaces_tpu.config import PCAConfig
+        from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+            make_feature_sharded_step,
+        )
+        from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+        monkeypatch.setenv("DET_CHECKIFY", "1")
+        cfg = PCAConfig(dim=32, k=2, num_workers=4, rows_per_worker=16,
+                        num_steps=2, solver="subspace", subspace_iters=6,
+                        backend="feature_sharded")
+        fstep = make_feature_sharded_step(
+            cfg, make_mesh(num_workers=4, num_feature_shards=2)
+        )
+        clean = jnp.ones((4, 16, 32), jnp.float32) * 0.1
+        st, _ = fstep(fstep.init_state(), clean)
+        assert int(st.step) == 1
+        with pytest.raises(checkify.JaxRuntimeError):
+            fstep(fstep.init_state(), clean.at[2, 1, 0].set(jnp.nan))
