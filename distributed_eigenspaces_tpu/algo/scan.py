@@ -251,18 +251,22 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
     def fit(state: SegmentState, x_steps, on_segment=None) -> SegmentState:
         total = x_steps.shape[0]
         t = 0
+        # without warm start the "first" program is identical to the
+        # continuation program — never compile it twice. A ZERO carry
+        # must also run cold: zeros are a fixed point of the warm
+        # solver (orth(0) = 0), so warm-starting from a restored state
+        # that lacks v_prev (cross-trainer resume) would silently
+        # discard every subsequent step. Evaluated once up front: after
+        # the first segment ``step > 0`` and ``v_prev`` is nonzero, so
+        # re-fetching these scalars per segment would pay two blocking
+        # device->host round trips for a value that can only be False.
+        first = warm and (
+            int(state.step) == 0 or not bool(jnp.any(state.v_prev))
+        )
         while t < total:
             s = min(segment, total - t)
-            # without warm start the "first" program is identical to the
-            # continuation program — never compile it twice. A ZERO carry
-            # must also run cold: zeros are a fixed point of the warm
-            # solver (orth(0) = 0), so warm-starting from a restored state
-            # that lacks v_prev (cross-trainer resume) would silently
-            # discard every subsequent step.
-            first = warm and (
-                int(state.step) == 0 or not bool(jnp.any(state.v_prev))
-            )
             state = _get(first)(state, jnp.asarray(x_steps[t : t + s]))
+            first = False
             t += s
             if on_segment is not None:
                 on_segment(int(state.step), state)

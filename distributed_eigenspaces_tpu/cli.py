@@ -186,7 +186,9 @@ def _resume_from(ckpt, want: str, k: int):
             f"error: checkpoint holds a {kind}, incompatible with this "
             "trainer/backend (dense trainers resume OnlineState/"
             "SegmentState; --backend feature_sharded resumes "
-            "LowRankState)",
+            "LowRankState; sketch checkpoints resume only via "
+            "make_feature_sharded_sketch_fit's state argument — the "
+            "sketch trainer is not a CLI backend)",
             file=sys.stderr,
         )
         return None, 0, 2

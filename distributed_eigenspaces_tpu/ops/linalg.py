@@ -41,6 +41,16 @@ def _precision(*arrays):
     return None
 
 
+def guarded_inv_sqrt(w: jax.Array, tol=1e-12) -> jax.Array:
+    """``w^{-1/2}`` with zero (not inf/NaN) below ``tol`` — the shared
+    pseudo-inverse-sqrt guard for eigenvalue/weight rescaling: entries at or
+    below the cutoff correspond to dead directions (masked-out workers, rank
+    deficiency) whose numerators are zero too, so zeroing the scale makes
+    the fold a no-op instead of poisoning it. ``tol`` may be a traced value
+    (relative cutoffs welcome)."""
+    return jnp.where(w > tol, lax.rsqrt(jnp.maximum(w, 1e-30)), 0.0)
+
+
 def gram(x: jax.Array, *, normalize: bool = True) -> jax.Array:
     """Sample second-moment matrix ``(1/n) X^T X`` of a row-block ``X (n, d)``.
 
@@ -192,7 +202,7 @@ def _merged_top_k_factor_gram(v_stack, k, w, cnt):
     wk = ew[-k:][::-1]
     uk = u[:, -k:][:, ::-1]
     vb = jnp.matmul(c, uk, precision=lax.Precision.HIGHEST)
-    vb = vb / jnp.sqrt(jnp.maximum(wk, 1e-12))[None, :]
+    vb = vb * guarded_inv_sqrt(wk)[None, :]
     return canonicalize_signs(vb)
 
 

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.ops.linalg import guarded_inv_sqrt
 from distributed_eigenspaces_tpu.parallel.mesh import FEATURE_AXIS, WORKER_AXIS
 
 HP = jax.lax.Precision.HIGHEST
@@ -138,9 +139,29 @@ def ns_orth(v, axis_name=None, iters=4, eps=1e-20):
         m_acc = m_acc @ a
         g = g @ (a @ a)  # G and a (a polynomial in G) commute
 
-    return jnp.einsum(
+    out = jnp.einsum(
         "...dk,...kl->...dl", v * dscale[..., None, :], m_acc, precision=HP
     )
+    from distributed_eigenspaces_tpu.utils.guards import checks_enabled
+
+    if checks_enabled():
+        # NS converges only for bounded condition number (the warm-regime
+        # assumption); a silently broken assumption degrades the basis with
+        # no NaN anywhere, so float checks never fire. Under DET_CHECKIFY=1
+        # assert the orthonormality residual the iteration was supposed to
+        # drive to zero (one extra k x k Gram — debug mode only).
+        from jax.experimental import checkify
+
+        vtv = jnp.einsum("...dk,...dl->...kl", out, out, precision=HP)
+        vtv = _psum_if(vtv, axis_name)
+        resid = jnp.max(jnp.abs(vtv - eye))
+        checkify.check(
+            resid < 5e-2,
+            "ns_orth left ||V^T V - I||_max = {r}: input condition number "
+            "outside the warm regime (use chol_qr2 for cold bases)",
+            r=resid,
+        )
+    return out
 
 
 
@@ -321,7 +342,7 @@ def merged_lowrank_sharded(v_workers, k, mask=None, dim_total=None,
     b = psum_f(b)
     w_ev, q = _small_eigh_desc(b)
     wk = jnp.maximum(w_ev[:k], 0.0)
-    inv = jnp.where(wk > 1e-12, jax.lax.rsqrt(jnp.maximum(wk, 1e-30)), 0.0)
+    inv = guarded_inv_sqrt(wk)
     return jnp.einsum("dc,ck,k->dk", c, q[:, :k], inv, precision=HP)
 
 
@@ -350,7 +371,7 @@ def _lowrank_update(state, v_bar, weight, keep, axis_name):
     w, q = _small_eigh_desc(g)  # (r+k,), (r+k, r+k)
     w = jnp.maximum(w, 0.0)
     # eigenvectors of C C^T: C q / sqrt(w) — guard zero eigenvalues
-    inv = jnp.where(w > 1e-12, jax.lax.rsqrt(jnp.maximum(w, 1e-30)), 0.0)
+    inv = guarded_inv_sqrt(w)
     u_new = jnp.einsum("dc,ck,k->dk", c, q[:, :r], inv[:r], precision=HP)
     return LowRankState(u=u_new, s=w[:r], step=step + 1)
 
@@ -440,8 +461,9 @@ def make_feature_sharded_step(
     Worker solves warm-start from the running estimate's top-k every step
     (free accuracy); with ``cfg.warm_start_iters`` set, the first step runs
     the full ``cfg.subspace_iters`` cold and later steps run the short
-    count (scan-trainer contract — the dispatch reads the replicated step
-    counter on the host).
+    count (scan-trainer contract). The cold/warm dispatch is a
+    ``lax.cond`` on the on-device step counter inside the one executable —
+    no per-step host fetch.
     """
     if collectives not in ("xla", "ring"):
         raise ValueError(f"unknown collectives mode: {collectives!r}")
@@ -450,13 +472,27 @@ def make_feature_sharded_step(
     m = cfg.num_workers
     key = jax.random.PRNGKey(seed)
     step_core = _make_step_core(cfg, collectives=collectives, key=key)
+    warm_iters = (
+        cfg.warm_start_iters
+        if cfg.warm_start_iters is not None and cfg.solver == "subspace"
+        else None
+    )
 
-    def make_sharded(step_iters):
-        def sharded(state, x, mask):
-            # x: (m_local, n, d_local); state.u: (d_local_f, r)
-            return step_core(state, x, step_iters, mask=mask)
-
-        return sharded
+    def sharded(state, x, mask):
+        # x: (m_local, n, d_local); state.u: (d_local_f, r)
+        if warm_iters is None:
+            return step_core(state, x, iters, mask=mask)
+        # cold/warm dispatch ON DEVICE: both iteration counts are static,
+        # so the two cores live as lax.cond branches of ONE executable.
+        # The replicated step counter is the (device-uniform) predicate —
+        # no per-step scalar fetch, which on a tunneled host costs an RPC
+        # per step (round-2 finding).
+        return jax.lax.cond(
+            state.step > 0,
+            lambda st, xx, mm: step_core(st, xx, warm_iters, mask=mm),
+            lambda st, xx, mm: step_core(st, xx, iters, mask=mm),
+            state, x, mask,
+        )
 
     x_spec = P(WORKER_AXIS, None, FEATURE_AXIS)
     u_spec = P(FEATURE_AXIS, None)
@@ -474,30 +510,18 @@ def make_feature_sharded_step(
 
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
-    def build(step_iters):
-        inner = jax.shard_map(
-            make_sharded(step_iters),
-            mesh=mesh,
-            in_specs=(state_specs, x_spec, mask_spec),
-            out_specs=(state_specs, u_spec),
-            check_vma=False,
-        )
-        # checked_jit == jax.jit unless DET_CHECKIFY=1 (NaN guards, §5.2)
-        return checked_jit(
-            inner,
-            in_shardings=(state_shardings, x_sharding, mask_sharding),
-            out_shardings=(state_shardings, v_sharding),
-        )
-
-    cold = build(iters)
-    # cfg.warm_start_iters: cold first step at the full iteration count,
-    # later steps short (same contract as the scan trainer). Dispatching on
-    # the host reads the replicated scalar step counter — one tiny fetch
-    # per call on a path that is host-driven per step anyway.
-    warm = (
-        build(cfg.warm_start_iters)
-        if cfg.warm_start_iters is not None and cfg.solver == "subspace"
-        else None
+    inner = jax.shard_map(
+        sharded,
+        mesh=mesh,
+        in_specs=(state_specs, x_spec, mask_spec),
+        out_specs=(state_specs, u_spec),
+        check_vma=False,
+    )
+    # checked_jit == jax.jit unless DET_CHECKIFY=1 (NaN guards, §5.2)
+    fused = checked_jit(
+        inner,
+        in_shardings=(state_shardings, x_sharding, mask_sharding),
+        out_shardings=(state_shardings, v_sharding),
     )
 
     # placed once: the common unmasked call must not pay a host->device
@@ -515,9 +539,7 @@ def make_feature_sharded_step(
             worker_mask = jax.device_put(
                 jnp.asarray(worker_mask, jnp.float32), mask_sharding
             )
-        if warm is not None and int(state.step) > 0:
-            return warm(state, x_blocks, worker_mask)
-        return cold(state, x_blocks, worker_mask)
+        return fused(state, x_blocks, worker_mask)
 
     step.init_state = _jit_init(
         lambda: LowRankState.initial(cfg.dim, r), state_shardings
@@ -665,13 +687,13 @@ def _nystrom_top_k(y, omega, k, axis_name=None):
     b = 0.5 * (b + b.T)
     wb, qb = _small_eigh_desc(b)
     tol = 1e-7 * jnp.maximum(wb[0], 0.0) + 1e-30
-    inv_b = jnp.where(wb > tol, jax.lax.rsqrt(jnp.maximum(wb, 1e-30)), 0.0)
+    inv_b = guarded_inv_sqrt(wb, tol)
     f = jnp.einsum("dp,pq,q->dq", y, qb, inv_b, precision=HP)
     gf = jnp.einsum("dp,dq->pq", f, f, precision=HP)
     gf = _psum_if(gf, axis_name)
     w, q = _small_eigh_desc(gf)
     wk = jnp.maximum(w[:k], 0.0)
-    inv = jnp.where(wk > 1e-12, jax.lax.rsqrt(jnp.maximum(wk, 1e-30)), 0.0)
+    inv = guarded_inv_sqrt(wk)
     return jnp.einsum("dp,pk,k->dk", f, q[:, :k], inv, precision=HP)
 
 

@@ -46,6 +46,7 @@ class TaskRecord:
     attempts: int = 0
     done: bool = False
     result: Any = None
+    last_exc: Exception | None = None
 
 
 class SchedulerError(RuntimeError):
@@ -156,6 +157,7 @@ class WorkQueue:
             if attempt is not None and lease is not None and lease[1] != attempt:
                 return  # stale: a newer attempt owns this task now
             self._leases.pop(task_id, None)
+            rec.last_exc = exc
             if rec.done:
                 return
             if rec.attempts > self.max_retries:
@@ -188,6 +190,7 @@ class WorkQueue:
                         f"task {tid} leased {rec.attempts} times with no "
                         f"result (lease_timeout={self.lease_timeout}s)"
                     )
+                    self._failed.__cause__ = rec.last_exc
                 elif tid not in self._pending:
                     self._pending.append(tid)  # requeue: liveness recovery
 

@@ -46,8 +46,14 @@ def checked_jit(fn, *, enabled: bool | None = None, **jit_kwargs):
 
     jit_kwargs.pop("out_shardings", None)
     jit_kwargs.pop("donate_argnums", None)
+    # float_checks: NaN/inf from any primitive. user_checks: explicit
+    # checkify.check() sites (e.g. the ns_orth orthonormality residual)
+    # that guard conditions float checks can't see.
     cf = jax.jit(
-        checkify.checkify(fn, errors=checkify.float_checks), **jit_kwargs
+        checkify.checkify(
+            fn, errors=checkify.float_checks | checkify.user_checks
+        ),
+        **jit_kwargs,
     )
 
     def wrapped(*args, **kw):

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# CI entry point — one command reproduces the green state from a fresh
+# checkout (SURVEY.md §4: the reference ships no test strategy; this is
+# ours). Runs entirely on CPU with virtual devices — no TPU needed.
+#
+#   ./scripts/ci.sh            full suite + bench smoke + multichip dryrun
+#   ./scripts/ci.sh --fast     suite only
+#
+# The three stages mirror what the driver checks at end of round:
+#   1. the pytest suite on the 8-virtual-device CPU rig (tests/conftest.py
+#      sets XLA_FLAGS/JAX_PLATFORMS; nothing to export here);
+#   2. bench.py in DET_BENCH_SMALL smoke mode (CPU; asserts the accuracy
+#      gate and prints the one JSON line — value not a perf result);
+#   3. __graft_entry__.py: single-chip entry() compile + the 8-device
+#      sharded dryrun (tp/dp/sp shardings compile AND execute).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== [1/3] pytest suite (CPU rig, 8 virtual devices) =="
+python -m pytest tests/ -q
+
+if [[ "${1:-}" == "--fast" ]]; then
+    echo "ci: fast mode — suite green"
+    exit 0
+fi
+
+echo "== [2/3] bench smoke (DET_BENCH_SMALL=1, CPU) =="
+DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
+
+echo "== [3/3] graft entry + 8-device sharded dryrun =="
+python __graft_entry__.py
+
+echo "ci: all green"
