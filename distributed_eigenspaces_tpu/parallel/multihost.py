@@ -213,6 +213,79 @@ def feature_blocks_to_global(
     )
 
 
+def feature_block_stack_to_global(
+    blocks_local: np.ndarray | jax.Array, mesh: Mesh, global_shape
+) -> jax.Array:
+    """Assemble per-host ``(B, m_local, n, d_local)`` STACKS of staged
+    blocks into the global ``(B, m, n, d)`` array sharded
+    ``P(None, workers, None, features)`` — the input form the whole-fit
+    trainers (:func:`~.feature_sharded.make_feature_sharded_scan_fit` /
+    ``sketch_fit``) consume. The per-stack twin of
+    :func:`feature_blocks_to_global`: each process passes its
+    :func:`host_block_rect` chunk of every staged block (``B`` and ``n``
+    are unsharded)."""
+    from distributed_eigenspaces_tpu.parallel.mesh import FEATURE_AXIS
+
+    sharding = NamedSharding(
+        mesh, P(None, WORKER_AXIS, None, FEATURE_AXIS)
+    )
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(blocks_local), tuple(global_shape)
+    )
+
+
+def make_multihost_feature_fit(
+    cfg,
+    mesh: Mesh,
+    *,
+    trainer: str = "scan",
+    seed: int = 0,
+    collectives: str = "xla",
+):
+    """Multi-host drive for the feature-sharded WHOLE-FIT trainers:
+    ``fit(state, blocks_local, idx, ...) -> state`` where ``blocks_local``
+    is this host's ``(B, m_local, n, d_local)`` rect of the staged stack.
+
+    The compiled program is the single-process one (SPMD doesn't care how
+    many hosts run it — same contract as :func:`make_multihost_train_step`);
+    this wrapper adds only the per-host stack assembly, so the fastest
+    trainers are no longer single-process-input-only (round-2 verdict
+    item 5). ``trainer``: ``"scan"`` (exact rank-r carry) or ``"sketch"``
+    (Nystrom carry; exposes ``fit.extract``). ``init_state`` is jit-placed
+    and works across processes.
+    """
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        make_feature_sharded_scan_fit,
+        make_feature_sharded_sketch_fit,
+    )
+
+    if trainer not in ("scan", "sketch"):
+        raise ValueError(f"unknown trainer {trainer!r} (scan|sketch)")
+    make = (
+        make_feature_sharded_sketch_fit
+        if trainer == "sketch"
+        else make_feature_sharded_scan_fit
+    )
+    inner = make(cfg, mesh, seed=seed, collectives=collectives)
+
+    def fit(state, blocks_local, idx, **kw):
+        b, n = blocks_local.shape[0], blocks_local.shape[2]
+        blocks = feature_block_stack_to_global(
+            blocks_local, mesh, (b, cfg.num_workers, n, cfg.dim)
+        )
+        import jax.numpy as jnp
+
+        return inner(state, blocks, jnp.asarray(idx, jnp.int32), **kw)
+
+    fit.init_state = inner.init_state
+    fit.blocks_sharding = inner.blocks_sharding
+    if hasattr(inner, "extract"):
+        fit.extract = inner.extract
+    if hasattr(inner, "rank"):
+        fit.rank = inner.rank
+    return fit
+
+
 def host_local_blocks_to_global(
     x_local: np.ndarray | jax.Array, mesh: Mesh
 ) -> jax.Array:

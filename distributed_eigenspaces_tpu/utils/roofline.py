@@ -1,0 +1,159 @@
+"""FLOP accounting + measured matmul anchor — makes the perf claims
+auditable (round-2 verdict: "samples/s only" is not checkable without
+re-deriving the arithmetic).
+
+Two halves:
+
+- :func:`step_flop_model` — analytic FLOP counts per online step for the
+  subspace-solver trainers, split into the cold first step (Gram build +
+  full iteration count) and the warm steady state (streaming ``X^T (X v)``
+  passes at ``warm_start_iters``). The model counts the dominant matmul
+  terms only (MAC = 2 FLOPs); orthonormalization, the (m*k)-sized merge
+  eigh and the state fold are O(d*k^2 + (m*k)^3) — <1% at every BASELINE
+  config — and are deliberately excluded so the model is simple enough to
+  check by hand.
+- :func:`measure_matmul_anchor` — the achievable-matmul-rate denominator,
+  measured the same way the benchmark measures the trainer (one chained
+  program, salted operands, value-fetch fence — BASELINE.md "Timing
+  methodology"). Roofline percentages against a *measured* anchor stay
+  honest across hosts: on the axon dev tunnel the same code reports the
+  tunnel-degraded anchor, on a real v5e host the MXU one.
+
+The reference has no analogue (it publishes no numbers at all, SURVEY.md
+§6); this is the framework's own auditability obligation.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def step_flop_model(
+    m: int,
+    n: int,
+    d: int,
+    k: int,
+    cold_iters: int,
+    warm_iters: int | None,
+) -> dict:
+    """Dominant-term FLOPs per online step for the subspace trainers.
+
+    Cold step (the first online step; ``_local_eigenspaces`` Gram route,
+    or the streaming route at large d — same leading terms either way the
+    Gram route is chosen: the n*d^2 contraction dominates):
+      per worker: Gram ``2 n d^2`` + ``cold_iters`` matvecs ``2 d^2 k``.
+      At d >= 4096 the solve streams instead: ``cold_iters * 4 n d k``.
+    Warm step (streaming ``X^T (X v)``): per worker
+      ``warm_iters * 4 n d k`` (two tall-skinny passes per iteration).
+
+    Returns ``{"cold_flops_per_step", "warm_flops_per_step"}``; the warm
+    entry equals the cold one when warm starts are off (every step runs
+    the full count).
+    """
+    streaming_cold = d >= 4096 or (2 * k * cold_iters < d and cold_iters <= 6)
+    if streaming_cold:
+        cold = m * cold_iters * 4 * n * d * k
+    else:
+        cold = m * (2 * n * d * d + cold_iters * 2 * d * d * k)
+    if warm_iters is None:
+        warm = cold
+    else:
+        warm = m * warm_iters * 4 * n * d * k
+    return {"cold_flops_per_step": cold, "warm_flops_per_step": warm}
+
+
+def fit_total_flops(model: dict, steps: int) -> int:
+    """Model FLOPs of a whole fit: one cold step + (steps-1) warm steps."""
+    return model["cold_flops_per_step"] + max(steps - 1, 0) * model[
+        "warm_flops_per_step"
+    ]
+
+
+def measure_matmul_anchor(size: int = 2048, chain: int = 100) -> float:
+    """Measured achievable bf16 matmul rate (TF/s) on the current default
+    device: ``chain`` dependent ``size^3`` matmuls as ONE program, timed
+    with a value-fetch fence on fresh operands (the tunneled dev backend
+    neither fences on ``block_until_ready`` nor re-executes cached
+    (executable, operands) pairs — BASELINE.md).
+
+    The chain is dependent (each matmul consumes the previous result) so
+    XLA cannot elide or batch it; renormalizing by the max element each
+    link keeps bf16 from overflowing to inf over hundreds of links.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def chained(a, b):
+        def body(x, _):
+            y = jnp.matmul(a, x, preferred_element_type=jnp.float32)
+            y = y / jnp.maximum(jnp.max(jnp.abs(y)), 1e-30)
+            return y.astype(jnp.bfloat16), None
+        out, _ = jax.lax.scan(body, b, None, length=chain)
+        return out
+
+    f = jax.jit(chained)
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (size, size), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (size, size), jnp.bfloat16)
+    float(jnp.sum(f(a, b).astype(jnp.float32)))  # compile + warm
+    # fixed dispatch+fetch cost (~100 ms over the axon tunnel): measured
+    # on a trivial program with fresh operands and subtracted (capped at
+    # half the raw time), else the anchor under-reports the chip by the
+    # RPC/chain-time ratio
+    tiny = jax.jit(lambda x: x + 1.0)
+    s = tiny(jnp.zeros(()))
+    float(s)
+    t0 = time.perf_counter()
+    for i in range(3):
+        s = tiny(s + 1.0)
+        float(s)
+    rpc = (time.perf_counter() - t0) / 3
+    a2 = a + jnp.bfloat16(1e-3)  # fresh operands: defeat result caching
+    t0 = time.perf_counter()
+    float(jnp.sum(f(a2, b).astype(jnp.float32)))
+    dt_raw = time.perf_counter() - t0
+    dt = dt_raw - min(rpc, 0.5 * dt_raw)
+    return (chain * 2 * size**3) / dt / 1e12
+
+
+def roofline_fields(
+    model: dict,
+    *,
+    steps: int,
+    fit_seconds: float,
+    warm_seconds_per_step: float | None = None,
+    cold_seconds: float | None = None,
+    anchor_tflops: float | None = None,
+) -> dict:
+    """Assemble the JSON roofline block from a flop model + measured times.
+
+    ``warm_seconds_per_step`` should be a *marginal* time (two fit lengths
+    differenced) so dispatch and the cold step cancel; when given, the
+    warm-phase achieved TF/s and percent-of-anchor are emitted. All rates
+    derive from MODEL flops — stated dominant-term counts, not hardware
+    counters."""
+    total = fit_total_flops(model, steps)
+    out = {
+        "cold_flops_per_step": int(model["cold_flops_per_step"]),
+        "warm_flops_per_step": int(model["warm_flops_per_step"]),
+        "model_flops_total": int(total),
+        "achieved_tflops": round(total / fit_seconds / 1e12, 4),
+    }
+    if anchor_tflops is not None:
+        out["anchor_tflops"] = round(anchor_tflops, 4)
+        out["pct_of_anchor"] = round(
+            100.0 * (total / fit_seconds / 1e12) / anchor_tflops, 2
+        )
+    if warm_seconds_per_step is not None and warm_seconds_per_step > 0:
+        warm_tf = model["warm_flops_per_step"] / warm_seconds_per_step / 1e12
+        out["warm_ms_per_step"] = round(warm_seconds_per_step * 1e3, 4)
+        out["warm_tflops"] = round(warm_tf, 3)
+        if anchor_tflops is not None:
+            out["warm_pct_of_anchor"] = round(100.0 * warm_tf / anchor_tflops, 2)
+    if cold_seconds is not None and cold_seconds > 0:
+        cold_tf = model["cold_flops_per_step"] / cold_seconds / 1e12
+        out["cold_ms"] = round(cold_seconds * 1e3, 2)
+        out["cold_tflops"] = round(cold_tf, 3)
+        if anchor_tflops is not None:
+            out["cold_pct_of_anchor"] = round(100.0 * cold_tf / anchor_tflops, 2)
+    return out

@@ -85,3 +85,19 @@ def test_clip768_bin_streaming_small():
                    rows_per_worker=256, steps=4)
     _check(rep)
     assert rep["streaming"] == "bin"
+    # the out-of-core config gets the windowed whole-fit (one S-step
+    # program per staged window), not per-step dispatch
+    assert rep["trainer"] == "segmented"
+    # machine-checked link-saturation evidence must be in the report
+    assert rep["stage_ms"]["window_steps"] >= 1
+    assert rep["pipeline_rows_per_sec"] > 0
+    assert rep["link_bound_samples_per_sec"] > 0
+    assert rep["link_bound_fraction"] > 0
+    assert rep["bytes_per_step"] == 8 * 256 * 128  # int8: 1 byte/value
+
+
+def test_clip768_per_step_trainer_still_available():
+    rep = run_eval("clip768", dim=64, k=8, subspace_iters=12,
+                   rows_per_worker=128, steps=3, trainer="step")
+    _check(rep)
+    assert rep["trainer"] == "step"

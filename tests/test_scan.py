@@ -348,3 +348,91 @@ def test_checkpoint_sketch_state_roundtrip(tmp_path):
 
     with pytest.raises(ValueError, match="unsupported checkpoint state"):
         save_checkpoint(str(tmp_path / "bad"), ("not", "a", "state"))
+
+
+@pytest.mark.parametrize("warm", [None, 2])
+def test_fit_windows_matches_resident_fit(warm):
+    """The out-of-core window entry (fit_windows) is BIT-IDENTICAL to the
+    resident segmented fit on the same steps: same compiled programs, the
+    window iterator is just a different delivery of the same slices —
+    including a ragged tail window (5 steps through windows of 2)."""
+    from distributed_eigenspaces_tpu.algo.scan import (
+        SegmentState,
+        make_segmented_fit,
+    )
+
+    T, m, n, d, k = 5, 4, 64, 32, 3
+    cfg = PCAConfig(dim=d, k=k, num_workers=m, rows_per_worker=n,
+                    num_steps=T, solver="subspace", subspace_iters=20,
+                    warm_start_iters=warm)
+    xs, _ = _planted_xs(T, m, n, d)
+    fit = make_segmented_fit(cfg, segment=2)
+
+    st_res = fit(SegmentState.initial(d, k), xs)
+
+    windows = (jnp.asarray(xs[t : t + 2]) for t in range(0, T, 2))
+    seen = []
+    st_win = fit.fit_windows(
+        SegmentState.initial(d, k), windows,
+        on_segment=lambda t, st: seen.append(t),
+    )
+    assert seen == [2, 4, 5]
+    assert int(st_win.step) == T
+    np.testing.assert_array_equal(
+        np.asarray(st_win.sigma_tilde), np.asarray(st_res.sigma_tilde)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_win.v_prev), np.asarray(st_res.v_prev)
+    )
+
+
+def test_fit_windows_from_bin_stream(tmp_path):
+    """End-to-end out-of-core: bin file -> window_stream -> fit_windows
+    equals the in-memory fit on the same rows (the clip768 eval path)."""
+    from distributed_eigenspaces_tpu.algo.scan import (
+        SegmentState,
+        make_segmented_fit,
+    )
+    from distributed_eigenspaces_tpu.data.bin_stream import (
+        bin_block_stream,
+        window_stream,
+        write_rows,
+    )
+    from distributed_eigenspaces_tpu.runtime.prefetch import prefetch_stream
+
+    T, m, n, d, k = 4, 2, 32, 16, 2
+    cfg = PCAConfig(dim=d, k=k, num_workers=m, rows_per_worker=n,
+                    num_steps=T, solver="subspace", subspace_iters=16,
+                    warm_start_iters=2)
+    xs, _ = _planted_xs(T, m, n, d, seed=3)
+    path = str(tmp_path / "rows.bin")
+    write_rows(path, xs.reshape(T * m * n, d).astype(np.float32))
+
+    fit = make_segmented_fit(cfg, segment=3)
+    st_mem = fit(SegmentState.initial(d, k), xs)
+
+    windows = window_stream(
+        bin_block_stream(path, dim=d, num_workers=m, rows_per_worker=n,
+                         num_steps=T),
+        3,
+    )
+    st_bin = fit.fit_windows(
+        SegmentState.initial(d, k),
+        prefetch_stream(windows, depth=1, place=lambda w: w),
+    )
+    assert int(st_bin.step) == T
+    np.testing.assert_allclose(
+        np.asarray(st_bin.sigma_tilde), np.asarray(st_mem.sigma_tilde),
+        atol=1e-6,
+    )
+
+
+def test_window_stream_shapes():
+    from distributed_eigenspaces_tpu.data.bin_stream import window_stream
+
+    blocks = [np.full((2, 3), i, np.float32) for i in range(5)]
+    wins = list(window_stream(iter(blocks), 2))
+    assert [w.shape[0] for w in wins] == [2, 2, 1]
+    np.testing.assert_array_equal(np.asarray(wins[2][0]), blocks[4])
+    with pytest.raises(ValueError):
+        list(window_stream(iter(blocks), 0))
