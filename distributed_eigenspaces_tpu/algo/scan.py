@@ -248,30 +248,6 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
             compiled[first] = build(first)
         return compiled[first]
 
-    def fit(state: SegmentState, x_steps, on_segment=None) -> SegmentState:
-        total = x_steps.shape[0]
-        t = 0
-        # without warm start the "first" program is identical to the
-        # continuation program — never compile it twice. A ZERO carry
-        # must also run cold: zeros are a fixed point of the warm
-        # solver (orth(0) = 0), so warm-starting from a restored state
-        # that lacks v_prev (cross-trainer resume) would silently
-        # discard every subsequent step. Evaluated once up front: after
-        # the first segment ``step > 0`` and ``v_prev`` is nonzero, so
-        # re-fetching these scalars per segment would pay two blocking
-        # device->host round trips for a value that can only be False.
-        first = warm and (
-            int(state.step) == 0 or not bool(jnp.any(state.v_prev))
-        )
-        while t < total:
-            s = min(segment, total - t)
-            state = _get(first)(state, jnp.asarray(x_steps[t : t + s]))
-            first = False
-            t += s
-            if on_segment is not None:
-                on_segment(int(state.step), state)
-        return state
-
     def fit_windows(state, windows, on_segment=None) -> SegmentState:
         """Out-of-core variant: consume an ITERATOR of staged
         ``(S, m, n, d)`` windows instead of one resident ``(T, ...)``
@@ -284,8 +260,18 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
         t's device program (the fit only fences at its caller's final
         value fetch). ``S`` may vary (a ragged tail window just
         specializes the jit once more); semantics are identical to
-        :func:`fit` on the concatenation (same compiled programs).
+        :func:`fit` on the concatenation (same compiled programs —
+        ``fit`` IS this function over a slice generator).
         """
+        # without warm start the "first" program is identical to the
+        # continuation program — never compile it twice. A ZERO carry
+        # must also run cold: zeros are a fixed point of the warm
+        # solver (orth(0) = 0), so warm-starting from a restored state
+        # that lacks v_prev (cross-trainer resume) would silently
+        # discard every subsequent step. Evaluated once up front: after
+        # the first window ``step > 0`` and ``v_prev`` is nonzero, so
+        # re-fetching these scalars per window would pay two blocking
+        # device->host round trips for a value that can only be False.
         first = warm and (
             int(state.step) == 0 or not bool(jnp.any(state.v_prev))
         )
@@ -295,6 +281,17 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
             if on_segment is not None:
                 on_segment(int(state.step), state)
         return state
+
+    def fit(state: SegmentState, x_steps, on_segment=None) -> SegmentState:
+        total = x_steps.shape[0]
+        return fit_windows(
+            state,
+            (
+                jnp.asarray(x_steps[t : t + segment])
+                for t in range(0, total, segment)
+            ),
+            on_segment,
+        )
 
     fit.segment = segment
     fit.fit_windows = fit_windows

@@ -174,8 +174,14 @@ class OnlineDistributedPCA:
         (or T/segment) compiled programs — the bench.py throughput path,
         now reachable from the public API (round-2 verdict item 2)."""
         cfg = self.cfg
-        blocks = list(
-            block_stream(
+        # stack on the HOST: stacking device blocks would materialize the
+        # whole (T, m, n, d) array unsharded on one device before the
+        # resharding device_put — an OOM at exactly the large-d sizes the
+        # feature-sharded route exists for. One host stack, ONE transfer,
+        # straight to the fit's sharding.
+        blocks = [
+            np.asarray(b)
+            for b in block_stream(
                 data,
                 num_workers=cfg.num_workers,
                 rows_per_worker=cfg.rows_per_worker,
@@ -185,10 +191,10 @@ class OnlineDistributedPCA:
                     cfg.compute_dtype if cfg.compute_dtype else cfg.dtype
                 ),
             )
-        )
+        ]
         if not blocks:
             raise ValueError("dataset yielded zero full steps")
-        xs = jnp.stack(blocks)
+        xs = np.stack(blocks)
         t = xs.shape[0]
 
         if trainer == "sketch" or (
@@ -247,16 +253,20 @@ class OnlineDistributedPCA:
             fit = make_segmented_fit(cfg, scan_mesh, segment=self.segment)
             on_segment = None
             if self.checkpoint_dir is not None:
+                # Checkpointer, not a hand-rolled save into one dir: each
+                # segment commits a fresh step_{t} subdir with rotation,
+                # so a crash mid-save never destroys the only restorable
+                # checkpoint, and the layout is what Checkpointer.latest
+                # and the CLI resume read
                 from distributed_eigenspaces_tpu.utils.checkpoint import (
-                    save_checkpoint,
+                    Checkpointer,
                 )
 
-                rows = cfg.num_workers * cfg.rows_per_worker
-
-                def on_segment(steps_done, st):
-                    save_checkpoint(
-                        self.checkpoint_dir, st, cursor=steps_done * rows
-                    )
+                ckpt = Checkpointer(
+                    self.checkpoint_dir, every=1,
+                    rows_per_step=cfg.num_workers * cfg.rows_per_worker,
+                )
+                on_segment = ckpt.on_step
 
             state = fit(
                 SegmentState.initial(cfg.dim, cfg.k), xs,
@@ -295,14 +305,17 @@ class OnlineDistributedPCA:
                 "feeding make_feature_sharded_sketch_fit, or refit"
             )
         cfg = self.cfg
-        if isinstance(self.state, LowRankState) and cfg.backend != (
-            "feature_sharded"
+        if cfg.backend != "feature_sharded" and (
+            resolves_feature_sharded(cfg)
+            or isinstance(self.state, LowRankState)
         ):
-            # a whole fit auto-routed to the feature-sharded backend
-            # (resolves_feature_sharded) left a rank-r carry; the
-            # continuation must go down the same backend — the dense path
-            # would crash on the state shape AND materialize the d x d
-            # matrix this backend exists to avoid
+            # two reasons to pin the backend: (a) auto at large d must
+            # never reach the dense per-step path (a 12288^2 sigma_tilde
+            # is the 600 MB anti-pattern this backend exists to avoid —
+            # and hooks/masks routing to trainer='step' would otherwise
+            # flip the backend silently); (b) a whole fit that already
+            # left a rank-r carry must continue down the same backend or
+            # the dense path crashes on the state shape
             cfg = cfg.replace(backend="feature_sharded")
         w, state = online_distributed_pca(
             stream,

@@ -71,11 +71,15 @@ def build_parser() -> argparse.ArgumentParser:
                    default="float32",
                    help="bfloat16 runs the Gram contraction at full MXU "
                    "rate (fp32 accumulation)")
-    p.add_argument("--trainer", choices=["step", "scan"], default="step",
+    p.add_argument("--trainer", choices=["step", "scan", "sketch"],
+                   default="step",
                    help="step: one dispatch per online step (streams); "
                    "scan: the T-step loop as one XLA program per "
                    "--checkpoint-every-step segment (fastest; in-memory "
-                   "data; checkpoints at segment boundaries)")
+                   "data; checkpoints at segment boundaries); "
+                   "sketch: the Nystrom whole-fit on the feature-sharded "
+                   "mesh (requires --backend feature_sharded; the "
+                   "large-d*k throughput path, BASELINE.md)")
     p.add_argument("--warm-start-iters", type=int, default=None,
                    help="after a cold first step, run this many solver "
                    "iterations warm-started from the previous merged "
@@ -138,6 +142,14 @@ def _coerce_resumed_state(state, want: str, k: int):
         LowRankState,
     )
 
+    if want == "sketch":  # sketch whole-fit resume
+        from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+            SketchState,
+        )
+
+        return (state, None) if isinstance(state, SketchState) else (
+            None, None
+        )
     if want == "lowrank":  # feature-sharded per-step resume
         return (state, None) if isinstance(state, LowRankState) else (
             None, None
@@ -186,9 +198,7 @@ def _resume_from(ckpt, want: str, k: int):
             f"error: checkpoint holds a {kind}, incompatible with this "
             "trainer/backend (dense trainers resume OnlineState/"
             "SegmentState; --backend feature_sharded resumes "
-            "LowRankState; sketch checkpoints resume only via "
-            "make_feature_sharded_sketch_fit's state argument — the "
-            "sketch trainer is not a CLI backend)",
+            "LowRankState; --trainer sketch resumes SketchState)",
             file=sys.stderr,
         )
         return None, 0, 2
@@ -385,6 +395,115 @@ def _fit_scan_segmented(args, cfg, data, truth) -> int:
     )
 
 
+def _fit_sketch(args, cfg, data, truth) -> int:
+    """``--trainer sketch``: the Nystrom whole-fit on the feature-sharded
+    ``(workers, features)`` mesh — steady state free of per-step spectral
+    solves (the measured winner above the d*k crossover, BASELINE.md).
+    ``--checkpoint-dir`` saves the final SketchState (resume continues a
+    longer schedule from it); the extraction solve runs once at the end.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        auto_feature_mesh,
+        make_feature_sharded_sketch_fit,
+    )
+    from distributed_eigenspaces_tpu.utils.checkpoint import Checkpointer
+
+    m, n, T, dim = (
+        cfg.num_workers, cfg.rows_per_worker, cfg.num_steps, cfg.dim,
+    )
+    rows_per_step = m * n
+    mesh = auto_feature_mesh(cfg)
+    fit = make_feature_sharded_sketch_fit(cfg, mesh, seed=cfg.seed)
+    state = fit.init_state()
+    cursor = 0
+    ckpt = None
+    if args.checkpoint_dir:
+        ckpt = Checkpointer(
+            args.checkpoint_dir, every=1, rows_per_step=rows_per_step
+        )
+        if args.resume:
+            restored, cursor, err = _resume_from(ckpt, "sketch", cfg.k)
+            if err:
+                return err
+            if restored is not None:
+                if restored.y.shape != (dim, fit.sketch_width) or (
+                    restored.v.shape != (dim, cfg.k)
+                ):
+                    print(
+                        "error: sketch checkpoint shapes "
+                        f"{tuple(restored.y.shape)}/{tuple(restored.v.shape)} "
+                        f"do not match this run (dim={dim}, "
+                        f"k={cfg.k}, sketch width={fit.sketch_width})",
+                        file=sys.stderr,
+                    )
+                    return 2
+                state = jax.device_put(restored, fit.state_shardings)
+
+    done = int(state.step)
+    remaining = max(0, T - done)
+    need = remaining * rows_per_step
+    if len(data) - cursor < need:
+        print(
+            f"error: --trainer sketch needs {need} unseen rows "
+            f"({remaining} steps x {m} x {n}), have {len(data) - cursor}",
+            file=sys.stderr,
+        )
+        return 2
+
+    from distributed_eigenspaces_tpu.utils.tracing import profile_to
+
+    t0 = time.time()
+    with profile_to(args.profile_dir):
+        if remaining:
+            blocks = jax.device_put(
+                jnp.asarray(
+                    np.ascontiguousarray(
+                        data[cursor : cursor + need]
+                    ).reshape(remaining, m, n, dim),
+                    dtype=(cfg.compute_dtype or jnp.float32),
+                ),
+                fit.blocks_sharding,
+            )
+            state = fit(
+                state, blocks, jnp.arange(remaining, dtype=jnp.int32)
+            )
+        w = fit.extract(state)
+        w_host = np.asarray(w)  # materialization fence + result
+    elapsed = time.time() - t0
+    if ckpt is not None:
+        ckpt.on_step(int(state.step), state)
+
+    out = {
+        "mode": "fit",
+        "trainer": "sketch",
+        "includes_compile": True,
+        "backend": "feature_sharded",
+        "mesh": list(mesh.devices.shape),
+        "sketch_width": fit.sketch_width,
+        "resumed_step": done,
+        "steps": int(state.step),
+        "samples_per_sec": round(need / elapsed, 1) if remaining else 0.0,
+        "seconds": round(elapsed, 3),
+        "dim": dim,
+        "k": cfg.k,
+    }
+    if truth is not None:
+        out["principal_angle_deg"] = round(
+            float(jnp.max(principal_angles_degrees(jnp.asarray(w), truth))),
+            4,
+        )
+    print(json.dumps(out))
+    if args.save:
+        np.save(args.save, w_host)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -492,6 +611,17 @@ def main(argv=None) -> int:
         ),
         warm_start_iters=args.warm_start_iters,
     )
+
+    if args.trainer == "sketch":
+        if args.backend != "feature_sharded":
+            print(
+                "error: --trainer sketch runs on the feature-sharded "
+                "mesh (its whole point is the rank-r sharded carry); "
+                "add --backend feature_sharded",
+                file=sys.stderr,
+            )
+            return 2
+        return _fit_sketch(args, cfg, data, truth)
 
     if args.trainer == "scan":
         if args.backend == "feature_sharded":

@@ -38,27 +38,28 @@ def step_flop_model(
 ) -> dict:
     """Dominant-term FLOPs per online step for the subspace trainers.
 
-    Cold step (the first online step; ``_local_eigenspaces`` Gram route,
-    or the streaming route at large d — same leading terms either way the
-    Gram route is chosen: the n*d^2 contraction dominates):
-      per worker: Gram ``2 n d^2`` + ``cold_iters`` matvecs ``2 d^2 k``.
-      At d >= 4096 the solve streams instead: ``cold_iters * 4 n d k``.
-    Warm step (streaming ``X^T (X v)``): per worker
-      ``warm_iters * 4 n d k`` (two tall-skinny passes per iteration).
+    Both phases follow ``_local_eigenspaces``'s ACTUAL route dispatch
+    (``worker_pool.py``): a solve streams (``iters * 4 n d k`` — two
+    tall-skinny passes per iteration) when ``d >= 4096`` or
+    ``2 k iters < d and iters <= 6``; otherwise it takes the Gram route
+    (``2 n d^2`` + ``iters`` matvecs ``2 d^2 k``). Warm steps use the
+    same rule at ``warm_iters`` — small-d/large-k configs (e.g. 768-d
+    top-256) Gram even when warm, and a streaming-only warm formula
+    would overcount their rate by ~``d / (2 k iters)``.
 
     Returns ``{"cold_flops_per_step", "warm_flops_per_step"}``; the warm
     entry equals the cold one when warm starts are off (every step runs
     the full count).
     """
-    streaming_cold = d >= 4096 or (2 * k * cold_iters < d and cold_iters <= 6)
-    if streaming_cold:
-        cold = m * cold_iters * 4 * n * d * k
-    else:
-        cold = m * (2 * n * d * d + cold_iters * 2 * d * d * k)
-    if warm_iters is None:
-        warm = cold
-    else:
-        warm = m * warm_iters * 4 * n * d * k
+
+    def per_step(iters: int) -> int:
+        streams = d >= 4096 or (2 * k * iters < d and iters <= 6)
+        if streams:
+            return m * iters * 4 * n * d * k
+        return m * (2 * n * d * d + iters * 2 * d * d * k)
+
+    cold = per_step(cold_iters)
+    warm = cold if warm_iters is None else per_step(warm_iters)
     return {"cold_flops_per_step": cold, "warm_flops_per_step": warm}
 
 

@@ -113,16 +113,16 @@ def test_scan_fit_matches_step_fit():
 
 
 def test_segmented_fit_writes_checkpoints(tmp_path):
-    from distributed_eigenspaces_tpu.utils.checkpoint import (
-        restore_checkpoint,
-    )
+    from distributed_eigenspaces_tpu.utils.checkpoint import Checkpointer
 
     x, spec = _data()
     cfg = _cfg(num_steps=6, solver="subspace", subspace_iters=16)
     ckpt = str(tmp_path / "ckpt")
     est = OnlineDistributedPCA(cfg, checkpoint_dir=ckpt, segment=2).fit(x)
     assert _angle(est, spec, 3) < 1.0
-    state, cursor = restore_checkpoint(ckpt)
+    # committed as rotated step_{t} subdirs (crash-safe Checkpointer
+    # layout, readable by the CLI resume) — not one rewritten directory
+    state, cursor = Checkpointer(ckpt).latest()
     assert int(state.step) == 6
     assert cursor == 6 * 4 * 64
 
@@ -181,3 +181,24 @@ def test_checkpoint_dir_rejected_off_segmented_route():
     est = OnlineDistributedPCA(cfg, checkpoint_dir="/tmp/nope")
     with pytest.raises(ValueError, match="checkpoint_dir"):
         est.fit(np.zeros((8192 * 2, 8192), np.float32))
+
+
+def test_per_step_hook_on_auto_large_d_stays_feature_sharded(devices):
+    """Hooks route to the per-step trainer, but auto at large d must
+    still resolve to the feature-sharded backend — the dense path would
+    materialize the d x d state the threshold exists to forbid."""
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        LowRankState,
+    )
+
+    d, k, m, n = 4096, 4, 2, 64
+    cfg = _cfg(dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=2,
+               backend="auto", solver="subspace", subspace_iters=8)
+    x = np.random.default_rng(0).standard_normal(
+        (2 * m * n, d)).astype(np.float32)
+    seen = []
+    est = OnlineDistributedPCA(cfg).fit(
+        x, on_step=lambda t, st, v: seen.append(t)
+    )
+    assert seen == [1, 2]
+    assert isinstance(est.state, LowRankState), type(est.state)

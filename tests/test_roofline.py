@@ -84,3 +84,14 @@ def test_roofline_fields_arithmetic():
 def test_measure_matmul_anchor_runs_small():
     tf = measure_matmul_anchor(size=64, chain=4)
     assert tf > 0
+
+
+def test_warm_model_takes_gram_route_at_small_d_large_k():
+    # clip768-like: d=768, k=256, warm_iters=2 -> 2*k*i = 1024 >= d, so
+    # the actual solver Grams even warm; a streaming-only formula would
+    # overcount the rate ~d/(2*k*i)
+    m, n, d, k = 8, 2048, 768, 256
+    model = step_flop_model(m, n, d, k, cold_iters=8, warm_iters=2)
+    assert model["warm_flops_per_step"] == m * (
+        2 * n * d * d + 2 * 2 * d * d * k
+    )

@@ -436,3 +436,57 @@ def test_window_stream_shapes():
     np.testing.assert_array_equal(np.asarray(wins[2][0]), blocks[4])
     with pytest.raises(ValueError):
         list(window_stream(iter(blocks), 0))
+
+
+def test_cli_sketch_trainer(tmp_path, capsys):
+    """--trainer sketch end-to-end: the Nystrom whole-fit runs from the
+    CLI on the feature-sharded mesh, saves the subspace, checkpoints the
+    SketchState, and a resume continues a longer schedule from it."""
+    import json as _json
+
+    from distributed_eigenspaces_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    out_w = str(tmp_path / "w.npy")
+    common = [
+        "--data", "synthetic", "--dim", "64", "--rank", "3",
+        "--workers", "4", "--rows-per-worker", "64",
+        "--trainer", "sketch", "--backend", "feature_sharded",
+        "--solver", "subspace", "--subspace-iters", "24",
+        "--warm-start-iters", "1", "--discount", "1/t",
+    ]
+    assert main(common + ["--steps", "4", "--checkpoint-dir", ckpt,
+                          "--save", out_w]) == 0
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["trainer"] == "sketch" and out["steps"] == 4
+    assert out["principal_angle_deg"] < 2.0, out
+    w = np.load(out_w)
+    assert w.shape == (64, 3)
+
+    # resume: 4 more steps from the saved SketchState
+    assert main(common + ["--steps", "8", "--checkpoint-dir", ckpt,
+                          "--resume"]) == 0
+    err = capsys.readouterr()
+    out2 = _json.loads(err.out.strip().splitlines()[-1])
+    assert out2["resumed_step"] == 4 and out2["steps"] == 8
+
+
+def test_cli_sketch_requires_feature_sharded():
+    from distributed_eigenspaces_tpu.cli import main
+
+    assert main(["--data", "synthetic", "--dim", "32", "--rank", "2",
+                 "--trainer", "sketch", "--backend", "local"]) == 2
+
+
+def test_cli_sketch_rejects_dense_checkpoint(tmp_path):
+    from distributed_eigenspaces_tpu.cli import main
+    from distributed_eigenspaces_tpu.utils.checkpoint import save_checkpoint
+
+    ckpt = str(tmp_path / "ck" / "step_00000002")
+    save_checkpoint(ckpt, OnlineState.initial(64), cursor=0)
+    assert main([
+        "--data", "synthetic", "--dim", "64", "--rank", "3",
+        "--workers", "4", "--rows-per-worker", "64", "--steps", "4",
+        "--trainer", "sketch", "--backend", "feature_sharded",
+        "--checkpoint-dir", str(tmp_path / "ck"), "--resume",
+    ]) == 2
