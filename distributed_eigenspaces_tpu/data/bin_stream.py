@@ -38,6 +38,49 @@ def write_rows(path: str, data: np.ndarray) -> None:
     np.ascontiguousarray(data).tofile(path)
 
 
+def quantize_file_i8(
+    src: str,
+    dst: str,
+    *,
+    dim: int,
+    chunk_rows: int = 65536,
+    scale: float | None = None,
+) -> tuple[float, int]:
+    """Quantize a flat float32 row file into the int8 wire format, out of
+    core: two streaming passes through the double-buffered native reader
+    (pass 1 global absmax unless ``scale`` is given; pass 2 quantize +
+    write), O(chunk) host memory — the prep tool for the 400M-row config
+    (BASELINE.md config 5; the reference has no counterpart because its
+    data model is everything-in-RAM, ``distributed.py:169``).
+
+    Returns ``(scale, rows)``. The symmetric global scale cancels in
+    eigenvectors, so consumers (``bin_block_stream(out_dtype=jnp.int8)``)
+    never dequantize; record it only if reconstructed VALUES are needed.
+    """
+    from distributed_eigenspaces_tpu.runtime.native import (
+        absmax_f32,
+        quantize_i8,
+    )
+
+    total = num_rows(src, dim, np.float32)
+    chunk_bytes = chunk_rows * dim * 4
+    if scale is None:
+        m = 0.0
+        with ChunkReader(src, chunk_bytes) as rd:
+            for chunk in rd:
+                m = max(m, absmax_f32(np.frombuffer(chunk, np.float32)))
+        scale = 127.0 / max(m, 1e-30)
+
+    with ChunkReader(src, chunk_bytes) as rd, open(dst, "wb") as f:
+        for chunk in rd:
+            f.write(
+                quantize_i8(
+                    np.frombuffer(chunk, np.float32), scale
+                ).tobytes()
+            )
+    return float(scale), total
+
+
 def num_rows(path: str, dim: int, dtype=np.float32) -> int:
     itemsize = np.dtype(dtype).itemsize
     size = os.path.getsize(path)

@@ -322,14 +322,18 @@ def run_eval(
             # cancels in eigenvectors, so the subspace needs no dequant —
             # the device casts int8 -> compute dtype and that's the whole
             # decode path. Accuracy cost (quantization noise) is charged
-            # to the reported principal angle.
-            qscale = 127.0 / max(
-                max(float(np.max(np.abs(b))) for b in host_np), 1e-30
+            # to the reported principal angle. Threaded native kernels
+            # (numpy fallback) — the same pair quantize_file_i8 streams a
+            # full corpus through.
+            from distributed_eigenspaces_tpu.runtime.native import (
+                absmax_f32,
+                quantize_i8,
             )
-            host_np = [
-                np.clip(np.round(b * qscale), -127, 127).astype(np.int8)
-                for b in host_np
-            ]
+
+            qscale = 127.0 / max(
+                max(absmax_f32(b) for b in host_np), 1e-30
+            )
+            host_np = [quantize_i8(b, qscale) for b in host_np]
         elif spec.bin_dtype != "float32":
             raise ValueError(f"unknown bin_dtype: {spec.bin_dtype!r}")
         host_bytes = [b.tobytes() for b in host_np]

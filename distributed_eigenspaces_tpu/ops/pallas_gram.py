@@ -100,17 +100,21 @@ def gram_pallas(
     return out
 
 
-def _pick_block(total: int, target: int) -> int:
-    """Largest divisor of ``total`` that is <= target and a multiple of 128
-    (falls back to the largest divisor, then to ``total`` itself)."""
-    best = None
-    for b in range(min(target, total), 0, -1):
-        if total % b == 0:
-            if b % 128 == 0:
-                return b
-            if best is None:
-                best = b
-    return best or total
+def _pick_block(total: int, target: int, align: int) -> int | None:
+    """Largest LEGAL block size for one dimension: the Mosaic lowering
+    requires each block dim to be a multiple of its tile alignment (8 for
+    the sublane axis, 128 for the lane axis) OR equal to the full array
+    dim. Returns ``total`` itself when it fits the target (always legal),
+    else the largest aligned divisor <= target, else None — the caller
+    must fall back to XLA. (Round-3 bug: the old picker fell back to ANY
+    divisor, so n=600 chose block 300 and the TPU lowering raised.)
+    """
+    if total <= target:
+        return total
+    for b in range(target, 0, -1):
+        if total % b == 0 and b % align == 0:
+            return b
+    return None
 
 
 def gram_auto(x: jax.Array, *, normalize: bool = True) -> jax.Array:
@@ -120,11 +124,11 @@ def gram_auto(x: jax.Array, *, normalize: bool = True) -> jax.Array:
 
     n, d = x.shape
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    if not on_tpu or d % 128 or n % 8:
+    # block_d is the sublane AND lane dim of the (bd, bd) output tile, so
+    # it needs the 128 lane alignment (which implies the 8-sublane one)
+    # unless it spans the full d
+    bn = _pick_block(n, 512, 8)
+    bd = _pick_block(d, 256, 128)
+    if not on_tpu or bn is None or bd is None:
         return gram(x, normalize=normalize)
-    return gram_pallas(
-        x,
-        block_n=_pick_block(n, 512),
-        block_d=_pick_block(d, 256),
-        normalize=normalize,
-    )
+    return gram_pallas(x, block_n=bn, block_d=bd, normalize=normalize)

@@ -78,6 +78,14 @@ def _load() -> ctypes.CDLL | None:
             lib.reader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
             lib.reader_next.restype = ctypes.c_int64
             lib.reader_close.argtypes = [ctypes.c_void_p]
+            lib.f32_absmax.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ]
+            lib.f32_absmax.restype = ctypes.c_float
+            lib.f32_quantize_i8.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_float, ctypes.c_int32,
+            ]
             _LIB = lib
         except Exception:
             _LIB_FAILED = True
@@ -118,6 +126,40 @@ def to_f32(flat: np.ndarray) -> np.ndarray:
         return flat.astype(np.float32)
     out = np.empty(flat.shape, np.float32)
     lib.u8_to_f32(flat.ctypes.data, out.ctypes.data, flat.size, _nthreads())
+    return out
+
+
+def absmax_f32(x: np.ndarray) -> float:
+    """Max |x| of a float32 array — pass 1 of symmetric int8 quantization
+    (threaded native kernel; numpy fallback)."""
+    x = np.ascontiguousarray(x, np.float32)
+    lib = _load()
+    if lib is None:
+        return float(np.max(np.abs(x))) if x.size else 0.0
+    return float(lib.f32_absmax(x.ctypes.data, x.size, _nthreads()))
+
+
+def quantize_i8(x: np.ndarray, scale: float) -> np.ndarray:
+    """``clip(round(x * scale), -127, 127)`` as int8 (same shape) — pass 2
+    of the symmetric quantization behind the int8 wire format
+    (``data/bin_stream.py``). Threaded native kernel; numpy fallback.
+
+    Rounding is half-away-from-zero natively vs numpy's half-to-even
+    fallback — the two differ only where ``x * scale`` lands exactly on
+    ``q + 0.5``, inside the quantization noise the accuracy gate already
+    charges.
+    """
+    x = np.ascontiguousarray(x, np.float32)
+    lib = _load()
+    if lib is None:
+        return np.clip(
+            np.round(x * np.float32(scale)), -127, 127
+        ).astype(np.int8)
+    out = np.empty(x.shape, np.int8)
+    lib.f32_quantize_i8(
+        x.ctypes.data, out.ctypes.data, x.size, ctypes.c_float(scale),
+        _nthreads(),
+    )
     return out
 
 

@@ -1,0 +1,123 @@
+"""The full out-of-core pipeline on a corpus that never fits in memory:
+
+1. prep: quantize a flat float32 row file into the int8 wire format
+   (``quantize_file_i8`` — two streaming passes, native threaded kernels,
+   O(chunk) host memory; the symmetric scale cancels in eigenvectors so
+   nothing ever dequantizes);
+2. train: the windowed segmented whole-fit (``fit_windows``) — windows of
+   S steps staged on device and run as ONE program each, while the
+   prefetch thread reads + converts + ships the next window;
+3. validate: principal angles vs the exact top-k of the same rows.
+
+This is the 400M-row CLIP-config workflow (BASELINE.md config 5) at demo
+size. The reference has no counterpart: its data model loads the full
+dataset into every process (``distributed.py:169``).
+
+Run (any host):
+
+    python examples/out_of_core_quantized.py [--dim 256] [--steps 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--rank", type=int, default=16)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--rows-per-worker", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--window", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_eigenspaces_tpu.algo.scan import (
+        SegmentState,
+        make_segmented_fit,
+    )
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.bin_stream import (
+        bin_block_stream,
+        quantize_file_i8,
+        window_stream,
+        write_rows,
+    )
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+        top_k_eigvecs,
+    )
+    from distributed_eigenspaces_tpu.runtime.prefetch import prefetch_stream
+
+    d, k, m, n, t = (
+        args.dim, args.rank, args.workers, args.rows_per_worker, args.steps,
+    )
+    spec = planted_spectrum(d, k_planted=k, gap=20.0, noise=0.01, seed=0)
+    rows = np.asarray(
+        spec.sample(jax.random.PRNGKey(1), m * n * t), np.float32
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        src = os.path.join(tmp, "corpus.f32")
+        dst = os.path.join(tmp, "corpus.i8")
+        write_rows(src, rows)
+
+        t0 = time.perf_counter()
+        scale, total = quantize_file_i8(src, dst, dim=d)
+        prep_s = time.perf_counter() - t0
+        print(json.dumps({
+            "stage": "prep", "rows": total, "scale": round(scale, 4),
+            "seconds": round(prep_s, 3),
+            "rows_per_sec": round(total / prep_s, 1),
+            "wire_bytes": os.path.getsize(dst),
+            "float_bytes": os.path.getsize(src),
+        }))
+
+        cfg = PCAConfig(
+            dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=t,
+            solver="subspace", subspace_iters=12, warm_start_iters=2,
+            compute_dtype="bfloat16",
+        )
+        fit = make_segmented_fit(cfg, segment=args.window)
+        windows = window_stream(
+            bin_block_stream(
+                dst, dim=d, num_workers=m, rows_per_worker=n,
+                num_steps=t, dtype=np.int8, out_dtype=jnp.int8,
+            ),
+            args.window,
+        )
+        t0 = time.perf_counter()
+        state = fit.fit_windows(
+            SegmentState.initial(d, k),
+            prefetch_stream(windows, depth=1, place=lambda w: w),
+        )
+        w = top_k_eigvecs(state.sigma_tilde, k)
+        w_host = np.asarray(w)  # fence
+        train_s = time.perf_counter() - t0
+
+        exact = top_k_eigvecs(jnp.asarray(rows.T @ rows / len(rows)), k)
+        ang = float(jnp.max(principal_angles_degrees(jnp.asarray(w_host),
+                                                     exact)))
+        print(json.dumps({
+            "stage": "fit", "steps": int(state.step),
+            "window_steps": args.window,
+            "seconds": round(train_s, 3),
+            "samples_per_sec": round(t * m * n / train_s, 1),
+            "max_principal_angle_deg": round(ang, 4),
+            "quantization_ok": bool(ang <= 1.0),
+        }))
+        return 0 if ang <= 1.0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -160,3 +160,78 @@ def test_bin_stream_int8_passthrough(tmp_path, rng):
     with pytest.raises(ValueError):
         list(bin_block_stream(path, dim=8, num_workers=2, rows_per_worker=8,
                               dtype=np.float32, out_dtype=jnp.int8))
+
+
+def test_quantize_i8_native_matches_fallback(rng, monkeypatch):
+    """The threaded native quantizer and the numpy fallback agree
+    everywhere except exact .5 ties (different rounding conventions —
+    excluded from the comparison), and absmax agrees exactly."""
+    import distributed_eigenspaces_tpu.runtime.native as nat
+
+    x = rng.standard_normal(5000).astype(np.float32) * 3.7
+    scale = 127.0 / float(np.max(np.abs(x)))
+
+    q_native = nat.quantize_i8(x, scale)
+    m_native = nat.absmax_f32(x)
+
+    monkeypatch.setenv("DET_NO_NATIVE", "1")
+    monkeypatch.setattr(nat, "_LIB", None)
+    monkeypatch.setattr(nat, "_LIB_FAILED", False)
+    q_np = nat.quantize_i8(x, scale)
+    m_np = nat.absmax_f32(x)
+
+    assert m_native == pytest.approx(m_np, rel=1e-6)
+    ties = np.abs((x * scale) - np.round(x * scale)) > 0.499999
+    agree = q_native[~ties] == q_np[~ties]
+    assert agree.all(), f"{(~agree).sum()} non-tie mismatches"
+    # ties differ by at most one quantization level
+    assert np.max(np.abs(q_native.astype(np.int32) - q_np)) <= 1
+
+
+def test_quantize_file_i8_end_to_end(tmp_path, rng):
+    """Out-of-core prep: quantize a float32 row file, stream the int8
+    result through the passthrough path, and land within quantization
+    noise of the float data."""
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.data.bin_stream import (
+        bin_block_stream,
+        quantize_file_i8,
+        write_rows,
+    )
+
+    d, rows = 24, 512
+    data = rng.standard_normal((rows, d)).astype(np.float32) * 2.5
+    src = str(tmp_path / "rows.f32")
+    dst = str(tmp_path / "rows.i8")
+    write_rows(src, data)
+
+    scale, n = quantize_file_i8(src, dst, dim=d, chunk_rows=100)
+    assert n == rows
+    assert scale == pytest.approx(127.0 / np.max(np.abs(data)), rel=1e-6)
+
+    blocks = list(bin_block_stream(
+        dst, dim=d, num_workers=2, rows_per_worker=64,
+        dtype=np.int8, out_dtype=jnp.int8,
+    ))
+    got = np.concatenate(
+        [np.asarray(b).reshape(-1, d) for b in blocks]
+    ).astype(np.float32) / scale
+    assert got.shape == (rows, d)
+    # within one quantization level everywhere
+    assert np.max(np.abs(got - data)) <= 1.01 / scale
+
+
+def test_quantize_file_i8_explicit_scale(tmp_path, rng):
+    from distributed_eigenspaces_tpu.data.bin_stream import (
+        quantize_file_i8,
+        write_rows,
+    )
+
+    data = rng.standard_normal((64, 8)).astype(np.float32)
+    src = str(tmp_path / "r.f32")
+    write_rows(src, data)
+    scale, n = quantize_file_i8(
+        src, str(tmp_path / "r.i8"), dim=8, scale=10.0
+    )
+    assert (scale, n) == (10.0, 64)

@@ -1,0 +1,47 @@
+"""Smoke tests for the example scripts — each is a documented end-to-end
+workflow (the notebook replacement, the large-d mesh path, the out-of-core
+quantized pipeline); a bit-rotted example is worse than none.
+Run as real subprocesses (fresh JAX, CPU) at tiny sizes.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *extra):
+    env = dict(
+        os.environ,
+        PYTHONPATH=_ROOT,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    return subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", script), *extra],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+
+
+@pytest.mark.parametrize(
+    "script,extra",
+    [
+        ("large_d_feature_sharded.py",
+         ["--dim", "256", "--rank", "4", "--rows-per-worker", "64",
+          "--steps", "3"]),
+        ("out_of_core_quantized.py",
+         ["--dim", "64", "--rank", "3", "--rows-per-worker", "64",
+          "--steps", "4", "--window", "2"]),
+        # notebook-scale by design (the reference workload has no size
+        # flags to shrink): ~40 s on CPU, still worth the coverage — it
+        # is the one example that crashed on TPU for two rounds
+        # (gram_auto block-legality bug) without any test noticing
+        ("notebook_workflow.py", []),
+    ],
+)
+def test_example_runs(script, extra):
+    r = _run(script, *extra)
+    assert r.returncode == 0, f"{script} failed:\n{r.stderr[-2000:]}"

@@ -48,3 +48,37 @@ def test_gram_pallas_bf16_input_fp32_out(rng):
 def test_gram_pallas_rejects_misaligned(rng):
     with pytest.raises(ValueError):
         gram_pallas(jnp.zeros((100, 64)), block_n=512, block_d=256)
+
+
+@pytest.mark.parametrize(
+    "total,target,align,expect",
+    [
+        (600, 512, 8, 200),    # the notebook-workload shape that crashed:
+                               # 300 (largest divisor) is NOT 8-aligned;
+                               # 200 is the largest legal block
+        (4096, 512, 8, 512),
+        (1024, 256, 128, 256),
+        (300, 512, 8, 300),    # fits the target -> full dim, always legal
+        (603, 512, 8, None),   # no aligned divisor -> caller must fall back
+        (768, 256, 128, 256),
+        (200, 512, 8, 200),
+    ],
+)
+def test_pick_block_returns_only_legal_blocks(total, target, align, expect):
+    from distributed_eigenspaces_tpu.ops.pallas_gram import _pick_block
+
+    got = _pick_block(total, target, align)
+    assert got == expect
+    if got is not None and got != total:
+        assert got % align == 0 and total % got == 0
+
+
+def test_gram_pallas_block200_interpret(rng):
+    """The n=600 repair path (block_n=200) computes the same Gram as XLA
+    (interpret mode — the lowering legality itself is exercised on TPU by
+    the notebook-workflow example)."""
+    x = jnp.asarray(rng.standard_normal((600, 256)).astype(np.float32))
+    got = gram_pallas(x, block_n=200, block_d=128, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(gram(x)), atol=2e-5
+    )
