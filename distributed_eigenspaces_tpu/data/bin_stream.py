@@ -123,6 +123,7 @@ def bin_block_stream(
     dtype=np.float32,
     out_dtype=jnp.float32,
     remainder: str = "drop",
+    worker_range: tuple[int, int] | None = None,
 ) -> Iterator[jnp.ndarray]:
     """Yield ``(num_workers, rows_per_worker, dim)`` blocks from a binary
     row file without ever materializing the dataset.
@@ -131,6 +132,16 @@ def bin_block_stream(
     explicit remainder policy) but O(step) memory: one step's bytes are
     read per chunk, with the next chunk prefetched by the native reader's
     background thread.
+
+    ``worker_range=(lo, hi)``: multi-host mode — yield only workers
+    ``[lo, hi)`` of each ``num_workers``-worker step, shape
+    ``(hi - lo, rows_per_worker, dim)``. The strided reader seeks past
+    the other hosts' rows, so each host reads ONLY the bytes of the
+    workers it owns from one shared file (the out-of-core twin of
+    ``multihost.host_worker_range`` — contrast the reference, where every
+    process reads the full dataset, ``distributed.py:169``). A ragged
+    final step is dropped (only ``remainder="drop"`` is meaningful: a
+    partial step may cut mid-stride, so other policies are rejected).
     """
     if remainder not in ("drop", "pad", "error"):
         raise ValueError(f"unknown remainder policy: {remainder!r}")
@@ -145,10 +156,37 @@ def bin_block_stream(
         )
     host_dt = in_dt if out_is_int else np.float32
     step_rows = num_workers * rows_per_worker
-    chunk_bytes = step_rows * dim * in_dt.itemsize
     total = num_rows(path, dim, dtype)
     if step_rows > total:
         raise ValueError(f"one step needs {step_rows} rows, file has {total}")
+
+    row_bytes = dim * in_dt.itemsize
+    offset = 0
+    skip = 0
+    out_workers = num_workers
+    if worker_range is not None:
+        lo, hi = worker_range
+        if not (0 <= lo < hi <= num_workers):
+            raise ValueError(
+                f"worker_range {worker_range} outside [0, {num_workers})"
+            )
+        if remainder != "drop":
+            raise ValueError(
+                "worker_range supports remainder='drop' only (a partial "
+                "final step may cut mid-stride)"
+            )
+        out_workers = hi - lo
+        offset = lo * rows_per_worker * row_bytes
+        skip = (num_workers - out_workers) * rows_per_worker * row_bytes
+        # every host must agree on the step count: a ragged final step may
+        # be complete for low worker ranges but missing for high ones, so
+        # cap at the number of FULL steps in the file
+        full_steps = total // step_rows
+        num_steps = (
+            full_steps if num_steps is None else min(num_steps, full_steps)
+        )
+    chunk_bytes = out_workers * rows_per_worker * row_bytes
+    num_workers = out_workers
 
     def convert(buf: bytes) -> np.ndarray:
         if is_bf16:
@@ -163,9 +201,16 @@ def bin_block_stream(
         return np.asarray(arr, np.float32)
 
     steps = 0
-    with ChunkReader(path, chunk_bytes) as reader:
-        for chunk in reader:
+    with ChunkReader(path, chunk_bytes, offset=offset, skip=skip) as reader:
+        it = iter(reader)
+        while True:
+            # check the cap BEFORE pulling: past it, a chunk would be read
+            # only to be discarded (and in strided mode the per-host
+            # "reads ONLY its own bytes" contract would leak one chunk)
             if num_steps is not None and steps >= num_steps:
+                return
+            chunk = next(it, None)
+            if chunk is None:
                 return
             if len(chunk) < chunk_bytes:  # ragged tail
                 tail_rows = len(chunk) // (dim * in_dt.itemsize)

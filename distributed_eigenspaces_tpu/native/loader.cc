@@ -177,6 +177,7 @@ void f32_quantize_i8(const float* in, int8_t* out, int64_t count,
 struct Reader {
   FILE* f = nullptr;
   int64_t chunk = 0;
+  int64_t skip = 0;             // bytes to skip after each chunk (stride)
   std::vector<uint8_t> ahead;   // read-ahead buffer
   int64_t ahead_len = 0;        // bytes valid in `ahead`
   bool ahead_ready = false;
@@ -194,25 +195,45 @@ struct Reader {
       lk.unlock();
       int64_t got = static_cast<int64_t>(
           fread(ahead.data(), 1, static_cast<size_t>(chunk), f));
+      bool hit_eof = got < chunk;
+      if (!hit_eof && skip > 0 && fseeko(f, skip, SEEK_CUR) != 0) {
+        // NOTE: on regular files fseeko past EOF SUCCEEDS (POSIX), so a
+        // stride overrun terminates via the next fread returning 0, not
+        // here — this branch only fires for non-seekable streams
+        hit_eof = true;
+      }
       lk.lock();
       ahead_len = got;
       ahead_ready = true;
-      if (got < chunk) eof = true;
+      if (hit_eof) eof = true;
       cv.notify_all();
       if (eof) return;
     }
   }
 };
 
-void* reader_open(const char* path, int64_t chunk_bytes) {
+// ``offset``: initial seek; ``skip``: bytes skipped after EVERY chunk —
+// the strided access a multi-host reader needs when each host owns a
+// contiguous row slice of every step in one shared file.
+void* reader_open_strided(const char* path, int64_t chunk_bytes,
+                          int64_t offset, int64_t skip) {
   FILE* f = fopen(path, "rb");
   if (!f) return nullptr;
+  if (offset > 0 && fseeko(f, offset, SEEK_SET) != 0) {
+    fclose(f);
+    return nullptr;
+  }
   Reader* r = new Reader();
   r->f = f;
   r->chunk = chunk_bytes;
+  r->skip = skip;
   r->ahead.resize(static_cast<size_t>(chunk_bytes));
   r->th = std::thread([r] { r->loop(); });
   return r;
+}
+
+void* reader_open(const char* path, int64_t chunk_bytes) {
+  return reader_open_strided(path, chunk_bytes, 0, 0);
 }
 
 // Copy the next chunk into buf; returns bytes delivered (0 at EOF).

@@ -94,7 +94,10 @@ class HostShard:
     def row_range(self, rows_per_worker: int) -> tuple[int, int]:
         """Global row range [lo, hi) this host should load for one step —
         the multi-host fix for the reference loading everything everywhere
-        (``distributed.py:169``)."""
+        (``distributed.py:169``). For out-of-core files pass
+        ``worker_range=(shard.lo, shard.hi)`` to
+        :func:`~..data.bin_stream.bin_block_stream` instead: its strided
+        reader seeks past the other hosts' rows of every step."""
         return self.lo * rows_per_worker, self.hi * rows_per_worker
 
 

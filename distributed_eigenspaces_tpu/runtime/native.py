@@ -75,6 +75,11 @@ def _load() -> ctypes.CDLL | None:
             ]
             lib.reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int64]
             lib.reader_open.restype = ctypes.c_void_p
+            lib.reader_open_strided.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64,
+            ]
+            lib.reader_open_strided.restype = ctypes.c_void_p
             lib.reader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
             lib.reader_next.restype = ctypes.c_int64
             lib.reader_close.argtypes = [ctypes.c_void_p]
@@ -171,25 +176,39 @@ class ChunkReader:
 
         for chunk in ChunkReader(path, 1 << 20):
             ...
+
+    ``offset`` seeks before the first chunk and ``skip`` bytes are skipped
+    after EVERY chunk — the strided access pattern of a multi-host bin
+    stream where each host owns a contiguous row slice of every step in
+    one shared file (``bin_block_stream(worker_range=...)``). When the
+    stride runs past EOF the final (possibly short) chunk is still
+    delivered, then iteration ends.
     """
 
-    def __init__(self, path: str, chunk_bytes: int):
+    def __init__(self, path: str, chunk_bytes: int, *, offset: int = 0,
+                 skip: int = 0):
         if chunk_bytes <= 0:
             raise ValueError("chunk_bytes must be positive")
+        if offset < 0 or skip < 0:
+            raise ValueError("offset/skip must be >= 0")
         self.path = path
         self.chunk_bytes = chunk_bytes
+        self._skip = skip
         self._lib = _load()
         self._handle = None
         self._file = None
         if self._lib is not None:
-            h = self._lib.reader_open(
-                path.encode(), ctypes.c_int64(chunk_bytes)
+            h = self._lib.reader_open_strided(
+                path.encode(), ctypes.c_int64(chunk_bytes),
+                ctypes.c_int64(offset), ctypes.c_int64(skip),
             )
             if not h:
                 raise FileNotFoundError(path)
             self._handle = h
         else:
             self._file = open(path, "rb")
+            if offset:
+                self._file.seek(offset)
 
     def __iter__(self):
         buf = np.empty(self.chunk_bytes, np.uint8)
@@ -208,6 +227,8 @@ class ChunkReader:
                 yield data
                 if len(data) < self.chunk_bytes:
                     return
+                if self._skip:
+                    self._file.seek(self._skip, 1)
 
     def close(self):
         if self._handle is not None:

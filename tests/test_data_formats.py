@@ -235,3 +235,79 @@ def test_quantize_file_i8_explicit_scale(tmp_path, rng):
         src, str(tmp_path / "r.i8"), dim=8, scale=10.0
     )
     assert (scale, n) == (10.0, 64)
+
+
+@pytest.mark.parametrize("no_native", [False, True])
+def test_bin_stream_worker_range_tiles_full_read(tmp_path, rng,
+                                                 monkeypatch, no_native):
+    """Multi-host strided reads: per-range streams tile the full stream
+    exactly — each host reads ONLY its workers' bytes of every step
+    (native strided reader and pure-Python seek fallback)."""
+    if no_native:
+        monkeypatch.setenv("DET_NO_NATIVE", "1")
+        import distributed_eigenspaces_tpu.runtime.native as nat
+
+        monkeypatch.setattr(nat, "_LIB", None)
+        monkeypatch.setattr(nat, "_LIB_FAILED", False)
+    from distributed_eigenspaces_tpu.data.bin_stream import (
+        bin_block_stream,
+        write_rows,
+    )
+
+    m, n, d, t = 4, 8, 16, 3
+    data = rng.standard_normal((t * m * n, d)).astype(np.float32)
+    path = str(tmp_path / "rows.bin")
+    write_rows(path, data)
+
+    full = list(bin_block_stream(
+        path, dim=d, num_workers=m, rows_per_worker=n))
+    assert len(full) == t
+
+    for lo, hi in ((0, 2), (2, 4), (1, 3), (0, 4)):
+        part = list(bin_block_stream(
+            path, dim=d, num_workers=m, rows_per_worker=n,
+            worker_range=(lo, hi)))
+        assert len(part) == t
+        for s in range(t):
+            np.testing.assert_array_equal(
+                np.asarray(part[s]), np.asarray(full[s])[lo:hi]
+            )
+
+
+def test_bin_stream_worker_range_ragged_tail_consistent(tmp_path, rng):
+    """A ragged final step must be dropped by EVERY worker range — even
+    ranges whose slice of it is complete — or a multi-host job would
+    desync on the step count."""
+    from distributed_eigenspaces_tpu.data.bin_stream import (
+        bin_block_stream,
+        write_rows,
+    )
+
+    m, n, d = 4, 8, 16
+    # 2 full steps + worker 0's rows of a third
+    data = np.arange((2 * m * n + n) * d, dtype=np.float32).reshape(-1, d)
+    path = str(tmp_path / "rows.bin")
+    write_rows(path, data)
+
+    for rng_ in ((0, 1), (3, 4), (0, 4)):
+        got = list(bin_block_stream(
+            path, dim=d, num_workers=m, rows_per_worker=n,
+            worker_range=rng_))
+        assert len(got) == 2, (rng_, len(got))
+
+
+def test_bin_stream_worker_range_validation(tmp_path, rng):
+    from distributed_eigenspaces_tpu.data.bin_stream import (
+        bin_block_stream,
+        write_rows,
+    )
+
+    path = str(tmp_path / "rows.bin")
+    write_rows(path, rng.standard_normal((64, 8)).astype(np.float32))
+    with pytest.raises(ValueError, match="worker_range"):
+        list(bin_block_stream(path, dim=8, num_workers=4,
+                              rows_per_worker=4, worker_range=(2, 2)))
+    with pytest.raises(ValueError, match="drop"):
+        list(bin_block_stream(path, dim=8, num_workers=4,
+                              rows_per_worker=4, worker_range=(0, 2),
+                              remainder="pad"))

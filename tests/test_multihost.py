@@ -446,3 +446,114 @@ def test_two_process_whole_fit_trainers():
         leaf = st.u if name == "SCAN" else st.y
         ref = float(jnp.sum(jnp.abs(leaf)))
         assert abs(ref - sums[name][0]) < 1e-3, (name, ref, sums[name])
+
+
+def test_two_process_bin_stream_worker_range(tmp_path):
+    """Multi-host OUT-OF-CORE: two OS processes share one bin file, each
+    reading only its own workers' rows per step (strided reader), and the
+    assembled per-step training produces identical results to the
+    single-process full read."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    from distributed_eigenspaces_tpu.data.bin_stream import write_rows
+
+    m, n, d, t = 4, 32, 16, 3
+    rng = np.random.default_rng(11)
+    rows = rng.standard_normal((t * m * n, d)).astype(np.float32)
+    path = str(tmp_path / "shared.bin")
+    write_rows(path, rows)
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    script = textwrap.dedent(
+        """
+        import sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        pid = int(sys.argv[1])
+        jax.distributed.initialize(coordinator_address=sys.argv[2],
+                                   num_processes=2, process_id=pid)
+        import numpy as np
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        import distributed_eigenspaces_tpu.parallel.multihost as mh
+        from distributed_eigenspaces_tpu.algo.online import OnlineState
+        from distributed_eigenspaces_tpu.config import PCAConfig
+        from distributed_eigenspaces_tpu.data.bin_stream import (
+            bin_block_stream,
+        )
+        M, N, D, T = 4, 32, 16, 3
+        CFG = PCAConfig(dim=D, k=2, num_workers=M, rows_per_worker=N,
+                        num_steps=T, solver="subspace", subspace_iters=20)
+        mesh = mh.global_mesh(num_workers=M)
+        shard = mh.host_worker_range(M)
+        step = mh.make_multihost_train_step(CFG, mesh)
+        st = mh.replicate_to_hosts(OnlineState.initial(D), mesh)
+        # each host streams ONLY its workers' rows from the shared file
+        for x_local in bin_block_stream(
+            sys.argv[3], dim=D, num_workers=M, rows_per_worker=N,
+            num_steps=T, worker_range=(shard.lo, shard.hi),
+        ):
+            st, v = step(st, np.asarray(x_local))
+        print("CHECKSUM %.8f" % float(
+            np.sum(np.abs(mh.fetch_replicated(st.sigma_tilde)))))
+        """
+    )
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        JAX_PLATFORMS="cpu",
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(i), f"127.0.0.1:{port}",
+             path],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    sums = []
+    try:
+        for i, p in enumerate(procs):
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
+            line = [ln for ln in out.splitlines()
+                    if ln.startswith("CHECKSUM")][-1]
+            sums.append(float(line.split()[1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert sums[0] == sums[1], sums
+
+    # single-process reference: full read, same step code
+    from distributed_eigenspaces_tpu.algo.online import OnlineState
+    from distributed_eigenspaces_tpu.algo.step import make_train_step
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.bin_stream import bin_block_stream
+    from distributed_eigenspaces_tpu.parallel.mesh import (
+        make_mesh,
+        replicated_sharding,
+        worker_sharding,
+    )
+
+    cfg = PCAConfig(dim=d, k=2, num_workers=m, rows_per_worker=n,
+                    num_steps=t, solver="subspace", subspace_iters=20)
+    mesh = make_mesh(num_workers=m)
+    step = make_train_step(cfg, mesh=mesh)
+    st = jax.device_put(OnlineState.initial(d), replicated_sharding(mesh))
+    for x in bin_block_stream(path, dim=d, num_workers=m,
+                              rows_per_worker=n, num_steps=t):
+        st, _ = step(st, jax.device_put(x, worker_sharding(mesh)))
+    ref = float(np.sum(np.abs(np.asarray(st.sigma_tilde))))
+    assert abs(ref - sums[0]) < 1e-3, (ref, sums[0])
