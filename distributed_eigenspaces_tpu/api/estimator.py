@@ -27,6 +27,24 @@ from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
 
 TRAINERS = ("auto", "step", "scan", "segmented", "sketch")
 
+
+def _scan_mesh(cfg: PCAConfig):
+    """Worker mesh for the dense whole-fit trainers (None = single-device);
+    mirrors the per-step backend selection: explicit shard_map/tpu, or
+    auto with >1 device."""
+    if cfg.backend in ("shard_map", "tpu") or (
+        cfg.backend == "auto" and len(jax.devices()) > 1
+    ):
+        from distributed_eigenspaces_tpu.parallel.mesh import (
+            largest_divisor_leq,
+            make_mesh,
+        )
+
+        workers = largest_divisor_leq(cfg.num_workers, len(jax.devices()))
+        if workers > 1:
+            return make_mesh(num_workers=workers)
+    return None
+
 # Measured crossover (BASELINE.md "Negative result"): the Nystrom-sketch
 # steady state — zero per-step spectral solves — wins 4x at d=12288/k=50
 # (d*k = 614k; each avoided eigh((m*k)^2) costs ~1.8 ms of latency there)
@@ -35,6 +53,15 @@ TRAINERS = ("auto", "step", "scan", "segmented", "sketch")
 # latency). The boundary is the op-latency wall, parameterized by d*k;
 # the geometric midpoint of the measured win/loss points is ~7e4.
 SKETCH_DK_CROSSOVER = 65536
+
+# Dense whole-fit staging threshold: the scan trainer wants the whole
+# (T, m, n, d) schedule device-resident, which stops being reasonable long
+# before HBM actually fills (one v5e chip has 16 GB, shared with the d x d
+# state and program temps — a 4.3 GB stage measurably RESOURCE_EXHAUSTs
+# alongside a second fit's buffers). Above this, the segmented trainer
+# runs the same programs over host-resident data with O(segment) device
+# staging, at ~1/segment of the per-step dispatch cost.
+SCAN_STAGE_BYTES_MAX = 1 << 31  # 2 GiB
 
 
 def resolves_feature_sharded(cfg: PCAConfig) -> bool:
@@ -63,11 +90,14 @@ def choose_trainer(
       the sketch trainer above the measured ``d*k`` crossover, its exact
       scan fit below;
     - dense workloads get the whole-fit scan — the benchmark's headline
-      path — or its segmented twin when checkpointing is requested
-      (same semantics, host hook every ``segment`` steps). Checkpointing
-      a feature-sharded fit is not auto-routable (the segmented trainer
-      is dense-only today); ``fit`` rejects that combination loudly
-      rather than silently skipping checkpoints.
+      path — or its segmented twin when checkpointing is requested OR
+      the staged ``(T, m, n, d)`` schedule exceeds
+      ``SCAN_STAGE_BYTES_MAX`` (same semantics and compiled programs;
+      the segmented fit keeps the data host-resident and stages
+      O(segment) on device). Checkpointing a feature-sharded fit is not
+      auto-routable (the segmented trainer is dense-only today); ``fit``
+      rejects that combination loudly rather than silently skipping
+      checkpoints.
     """
     if per_step_hooks:
         return "step"
@@ -75,7 +105,14 @@ def choose_trainer(
         if cfg.dim * cfg.k >= SKETCH_DK_CROSSOVER:
             return "sketch"
         return "scan"
-    return "segmented" if checkpointing else "scan"
+    itemsize = jnp.dtype(cfg.compute_dtype or cfg.dtype).itemsize
+    staged = (
+        cfg.num_steps * cfg.num_workers * cfg.rows_per_worker * cfg.dim
+        * itemsize
+    )
+    if checkpointing or staged > SCAN_STAGE_BYTES_MAX:
+        return "segmented"
+    return "scan"
 
 
 class OnlineDistributedPCA:
@@ -174,14 +211,13 @@ class OnlineDistributedPCA:
         (or T/segment) compiled programs — the bench.py throughput path,
         now reachable from the public API (round-2 verdict item 2)."""
         cfg = self.cfg
-        # stack on the HOST: stacking device blocks would materialize the
-        # whole (T, m, n, d) array unsharded on one device before the
-        # resharding device_put — an OOM at exactly the large-d sizes the
-        # feature-sharded route exists for. One host stack, ONE transfer,
-        # straight to the fit's sharding.
-        blocks = [
-            np.asarray(b)
-            for b in block_stream(
+
+        # host-side block source (device=False): a per-block device round
+        # trip would both waste host<->device bandwidth and pile up
+        # transient HBM buffers at exactly the large sizes the
+        # sharded/segmented routes exist for
+        def host_blocks():
+            return block_stream(
                 data,
                 num_workers=cfg.num_workers,
                 rows_per_worker=cfg.rows_per_worker,
@@ -190,8 +226,16 @@ class OnlineDistributedPCA:
                 dtype=(
                     cfg.compute_dtype if cfg.compute_dtype else cfg.dtype
                 ),
+                device=False,
             )
-        ]
+
+        if trainer == "segmented":
+            # stream windows — never materialize the full stack anywhere:
+            # O(segment) host AND device memory, the route the oversized-
+            # stage dispatch (> SCAN_STAGE_BYTES_MAX) relies on
+            return self._fit_segmented(cfg, host_blocks())
+
+        blocks = list(host_blocks())
         if not blocks:
             raise ValueError("dataset yielded zero full steps")
         xs = np.stack(blocks)
@@ -227,60 +271,59 @@ class OnlineDistributedPCA:
             )
             return self
 
+        if trainer != "scan":
+            raise ValueError(f"unknown trainer {trainer!r}")
+        from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+
+        final, _ = make_scan_fit(cfg, mesh=_scan_mesh(cfg))(
+            OnlineState.initial(cfg.dim, cfg.state_dtype), xs
+        )
+        return self._finish_dense(cfg, final)
+
+    def _fit_segmented(self, cfg, host_blocks) -> "OnlineDistributedPCA":
+        """Segmented whole-fit over a HOST block iterator: windows of
+        ``segment`` steps staged on device one at a time (fit_windows) —
+        O(segment) host and device memory, checkpoint every window."""
         from distributed_eigenspaces_tpu.algo.scan import (
             SegmentState,
-            make_scan_fit,
             make_segmented_fit,
         )
+        from distributed_eigenspaces_tpu.data.bin_stream import (
+            window_stream,
+        )
+
+        fit = make_segmented_fit(cfg, _scan_mesh(cfg), segment=self.segment)
+        on_segment = None
+        if self.checkpoint_dir is not None:
+            # Checkpointer, not a hand-rolled save into one dir: each
+            # segment commits a fresh step_{t} subdir with rotation, so a
+            # crash mid-save never destroys the only restorable
+            # checkpoint, and the layout is what Checkpointer.latest and
+            # the CLI resume read
+            from distributed_eigenspaces_tpu.utils.checkpoint import (
+                Checkpointer,
+            )
+
+            ckpt = Checkpointer(
+                self.checkpoint_dir, every=1,
+                rows_per_step=cfg.num_workers * cfg.rows_per_worker,
+            )
+            on_segment = ckpt.on_step
+
+        state = fit.fit_windows(
+            SegmentState.initial(cfg.dim, cfg.k),
+            window_stream(host_blocks, self.segment),
+            on_segment=on_segment,
+        )
+        if int(state.step) == 0:
+            raise ValueError("dataset yielded zero full steps")
+        return self._finish_dense(
+            cfg, OnlineState(sigma_tilde=state.sigma_tilde, step=state.step)
+        )
+
+    def _finish_dense(self, cfg, final: OnlineState) -> "OnlineDistributedPCA":
         from distributed_eigenspaces_tpu.ops.linalg import merged_top_k
 
-        scan_mesh = None
-        if cfg.backend in ("shard_map", "tpu") or (
-            cfg.backend == "auto" and len(jax.devices()) > 1
-        ):
-            from distributed_eigenspaces_tpu.parallel.mesh import (
-                largest_divisor_leq,
-                make_mesh,
-            )
-
-            workers = largest_divisor_leq(
-                cfg.num_workers, len(jax.devices())
-            )
-            if workers > 1:
-                scan_mesh = make_mesh(num_workers=workers)
-
-        if trainer == "segmented":
-            fit = make_segmented_fit(cfg, scan_mesh, segment=self.segment)
-            on_segment = None
-            if self.checkpoint_dir is not None:
-                # Checkpointer, not a hand-rolled save into one dir: each
-                # segment commits a fresh step_{t} subdir with rotation,
-                # so a crash mid-save never destroys the only restorable
-                # checkpoint, and the layout is what Checkpointer.latest
-                # and the CLI resume read
-                from distributed_eigenspaces_tpu.utils.checkpoint import (
-                    Checkpointer,
-                )
-
-                ckpt = Checkpointer(
-                    self.checkpoint_dir, every=1,
-                    rows_per_step=cfg.num_workers * cfg.rows_per_worker,
-                )
-                on_segment = ckpt.on_step
-
-            state = fit(
-                SegmentState.initial(cfg.dim, cfg.k), xs,
-                on_segment=on_segment,
-            )
-            final = OnlineState(
-                sigma_tilde=state.sigma_tilde, step=state.step
-            )
-        elif trainer == "scan":
-            final, _ = make_scan_fit(cfg, mesh=scan_mesh)(
-                OnlineState.initial(cfg.dim, cfg.state_dtype), xs
-            )
-        else:
-            raise ValueError(f"unknown trainer {trainer!r}")
         self.state = final
         # extraction honors the configured solver (a full d x d eigh at
         # large d is the TPU anti-pattern the subspace solver exists for)

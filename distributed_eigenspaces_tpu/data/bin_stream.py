@@ -168,7 +168,8 @@ def bin_block_stream(
         lo, hi = worker_range
         if not (0 <= lo < hi <= num_workers):
             raise ValueError(
-                f"worker_range {worker_range} outside [0, {num_workers})"
+                f"worker_range {worker_range} invalid: need "
+                f"0 <= lo < hi <= num_workers (= {num_workers})"
             )
         if remainder != "drop":
             raise ValueError(

@@ -28,6 +28,12 @@ def make_batches(n_rows: int, batch_size: int, *, keep_tail: bool = True):
     return ranges
 
 
+def _place(block: np.ndarray, dtype, device: bool):
+    if device:
+        return jnp.asarray(block, dtype=dtype)
+    return np.asarray(block, dtype=jnp.dtype(dtype))
+
+
 def block_stream(
     data,
     *,
@@ -37,6 +43,7 @@ def block_stream(
     remainder: str = "drop",
     dtype=jnp.float32,
     wrap: bool = False,
+    device: bool = True,
 ) -> Iterator[jax.Array]:
     """Yield (num_workers, rows_per_worker, d) blocks from (N, d) host data.
 
@@ -46,6 +53,10 @@ def block_stream(
     normalizes by the *unpadded* count upstream, so pad only when callers
     handle weighting), or ``"error"``. ``wrap=True`` restarts from row 0
     instead of stopping (infinite epochs for throughput benchmarking).
+    ``device=False`` yields HOST numpy arrays instead of placing each
+    block on a device — for consumers that stage themselves (the
+    whole-fit trainers), where a per-block device round trip would both
+    waste host<->device bandwidth and pile up transient HBM buffers.
     """
     data = np.asarray(data)
     n_total, d = data.shape
@@ -69,16 +80,16 @@ def block_stream(
                 if tail and remainder == "pad":
                     block = np.zeros((step_rows, d), dtype=data.dtype)
                     block[:tail] = data[cursor:]
-                    yield jnp.asarray(
+                    yield _place(
                         block.reshape(num_workers, rows_per_worker, d),
-                        dtype=dtype,
+                        dtype, device,
                     )
                 break
         block = data[cursor : cursor + step_rows]
         cursor += step_rows
         steps += 1
-        yield jnp.asarray(
-            block.reshape(num_workers, rows_per_worker, d), dtype=dtype
+        yield _place(
+            block.reshape(num_workers, rows_per_worker, d), dtype, device
         )
 
 

@@ -202,3 +202,22 @@ def test_per_step_hook_on_auto_large_d_stays_feature_sharded(devices):
     )
     assert seen == [1, 2]
     assert isinstance(est.state, LowRankState), type(est.state)
+
+
+def test_oversized_stage_routes_to_segmented():
+    """A dense schedule too big to stage device-resident (> 2 GiB) takes
+    the segmented trainer (host-resident data, O(segment) staging) —
+    measured: a 4.3 GB scan stage RESOURCE_EXHAUSTs a 16 GB chip next to
+    a second fit's buffers."""
+    from distributed_eigenspaces_tpu.api.estimator import (
+        SCAN_STAGE_BYTES_MAX,
+    )
+
+    big = _cfg(dim=1024, k=8, num_workers=8, rows_per_worker=4096,
+               num_steps=64, compute_dtype="bfloat16")
+    staged = 64 * 8 * 4096 * 1024 * 2
+    assert staged > SCAN_STAGE_BYTES_MAX
+    assert choose_trainer(big) == "segmented"
+    # same workload at bench length (4 distinct staged blocks) fits fine
+    small = big.replace(num_steps=8)
+    assert choose_trainer(small) == "scan"
