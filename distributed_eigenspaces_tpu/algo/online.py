@@ -79,7 +79,13 @@ def update_state(
         sigma = state.sigma_tilde * (1.0 - w) + p * w
     else:
         sigma = state.sigma_tilde + p * w
-    return OnlineState(sigma_tilde=sigma, step=step)
+    # the f32 discount scalar promotes a non-f32 state (state_dtype =
+    # bfloat16) to f32 — cast back so the state dtype is stable (a scan
+    # carry REQUIRES it; the per-step loop would otherwise promote
+    # silently on the first fold)
+    return OnlineState(
+        sigma_tilde=sigma.astype(state.sigma_tilde.dtype), step=step
+    )
 
 
 def online_distributed_pca(

@@ -254,6 +254,20 @@ class OnlineDistributedPCA:
             )
 
             mesh = auto_feature_mesh(cfg)
+            # the (B, m, n, d) stack shards over the mesh, so the budget
+            # that matters is PER DEVICE; past it, fail loudly with the
+            # streaming alternative (the per-step feature-sharded path)
+            # instead of letting device_put RESOURCE_EXHAUST mid-fit
+            per_device = xs.nbytes // max(mesh.devices.size, 1)
+            if per_device > SCAN_STAGE_BYTES_MAX:
+                raise ValueError(
+                    f"staging {xs.nbytes / 1e9:.1f} GB over "
+                    f"{mesh.devices.size} device(s) puts "
+                    f"{per_device / 1e9:.1f} GB on each — over the "
+                    f"{SCAN_STAGE_BYTES_MAX / 1e9:.1f} GB staging budget. "
+                    "Use trainer='step' (streams block by block), more "
+                    "devices, or fewer steps per fit"
+                )
             make = (
                 make_feature_sharded_sketch_fit
                 if trainer == "sketch"
@@ -292,7 +306,16 @@ class OnlineDistributedPCA:
             window_stream,
         )
 
-        fit = make_segmented_fit(cfg, _scan_mesh(cfg), segment=self.segment)
+        # clamp the window so ONE staged window also respects the device
+        # budget — with the default segment (50) a big schedule would
+        # stage (near) everything in the first window, recreating the
+        # OOM the oversized-stage routing exists to prevent
+        step_bytes = (
+            cfg.num_workers * cfg.rows_per_worker * cfg.dim
+            * jnp.dtype(cfg.compute_dtype or cfg.dtype).itemsize
+        )
+        seg = max(1, min(self.segment, SCAN_STAGE_BYTES_MAX // step_bytes))
+        fit = make_segmented_fit(cfg, _scan_mesh(cfg), segment=seg)
         on_segment = None
         if self.checkpoint_dir is not None:
             # Checkpointer, not a hand-rolled save into one dir: each
@@ -311,8 +334,8 @@ class OnlineDistributedPCA:
             on_segment = ckpt.on_step
 
         state = fit.fit_windows(
-            SegmentState.initial(cfg.dim, cfg.k),
-            window_stream(host_blocks, self.segment),
+            SegmentState.initial(cfg.dim, cfg.k, dtype=cfg.state_dtype),
+            window_stream(host_blocks, seg),
             on_segment=on_segment,
         )
         if int(state.step) == 0:

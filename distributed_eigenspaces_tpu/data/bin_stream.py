@@ -236,3 +236,41 @@ def bin_block_stream(
                 convert(chunk).reshape(num_workers, rows_per_worker, dim),
                 dtype=out_dtype,
             )
+
+
+def main(argv=None) -> int:
+    """``det-pca-quantize``: the out-of-core int8 prep tool as a command —
+    quantize a flat float32 row file into the wire format the streaming
+    trainers consume (``python -m distributed_eigenspaces_tpu.data.bin_stream
+    src.f32 dst.i8 --dim 768``)."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(
+        description="Quantize a flat float32 row file to the int8 wire "
+        "format (symmetric global scale; two streaming passes, O(chunk) "
+        "memory)"
+    )
+    p.add_argument("src", help="flat float32 row file ((N, dim).tobytes())")
+    p.add_argument("dst", help="output int8 file")
+    p.add_argument("--dim", type=int, required=True)
+    p.add_argument("--chunk-rows", type=int, default=65536)
+    p.add_argument("--scale", type=float, default=None,
+                   help="explicit scale (skips the absmax pass)")
+    args = p.parse_args(argv)
+    scale, rows = quantize_file_i8(
+        args.src, args.dst, dim=args.dim, chunk_rows=args.chunk_rows,
+        scale=args.scale,
+    )
+    print(json.dumps({
+        "rows": rows, "dim": args.dim, "scale": scale,
+        "wire_bytes": rows * args.dim,
+        "float_bytes": rows * args.dim * 4,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -311,3 +311,18 @@ def test_bin_stream_worker_range_validation(tmp_path, rng):
         list(bin_block_stream(path, dim=8, num_workers=4,
                               rows_per_worker=4, worker_range=(0, 2),
                               remainder="pad"))
+
+
+def test_quantize_cli_entry(tmp_path, rng, capsys):
+    """det-pca-quantize console entry (bin_stream.main)."""
+    import json
+
+    from distributed_eigenspaces_tpu.data.bin_stream import main, write_rows
+
+    src = str(tmp_path / "in.f32")
+    dst = str(tmp_path / "out.i8")
+    write_rows(src, rng.standard_normal((128, 16)).astype(np.float32))
+    assert main([src, dst, "--dim", "16"]) == 0
+    rep = json.loads(capsys.readouterr().out.strip())
+    assert rep["rows"] == 128 and rep["wire_bytes"] == 128 * 16
+    assert rep["float_bytes"] == 4 * rep["wire_bytes"]

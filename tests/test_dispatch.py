@@ -221,3 +221,41 @@ def test_oversized_stage_routes_to_segmented():
     # same workload at bench length (4 distinct staged blocks) fits fine
     small = big.replace(num_steps=8)
     assert choose_trainer(small) == "scan"
+
+
+def test_segmented_window_clamped_to_staging_budget(monkeypatch):
+    """The auto-routed segmented fit must not stage a near-full-schedule
+    first window: the window size is clamped so one window respects the
+    same budget that triggered the route."""
+    import distributed_eigenspaces_tpu.api.estimator as em
+
+    # shrink the budget so a tiny workload exercises the clamp
+    monkeypatch.setattr(em, "SCAN_STAGE_BYTES_MAX", 64 * 64 * 4 * 2)
+    x, spec = _data()
+    cfg = _cfg(num_steps=6, solver="subspace", subspace_iters=16)
+    assert choose_trainer(cfg) == "segmented"  # over the shrunk budget
+    est = OnlineDistributedPCA(cfg, segment=50).fit(x)
+    assert _angle(est, spec, 3) < 1.0
+    assert int(est.state.step) == 6
+
+
+def test_feature_sharded_stage_over_budget_fails_loudly(monkeypatch,
+                                                        devices):
+    import distributed_eigenspaces_tpu.api.estimator as em
+
+    monkeypatch.setattr(em, "SCAN_STAGE_BYTES_MAX", 1024)
+    x, spec = _data(d=128, k=4, n=8192, seed=2)
+    cfg = _cfg(dim=128, k=4, num_steps=4, backend="feature_sharded",
+               solver="subspace", subspace_iters=16)
+    with pytest.raises(ValueError, match="staging budget"):
+        OnlineDistributedPCA(cfg, trainer="scan").fit(x)
+
+
+def test_segmented_route_honors_state_dtype():
+    import jax.numpy as jnp
+
+    x, spec = _data()
+    cfg = _cfg(num_steps=6, solver="subspace", subspace_iters=16,
+               state_dtype=jnp.bfloat16)
+    est = OnlineDistributedPCA(cfg, trainer="segmented", segment=2).fit(x)
+    assert est.state.sigma_tilde.dtype == jnp.bfloat16
