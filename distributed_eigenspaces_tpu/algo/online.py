@@ -157,7 +157,8 @@ def online_distributed_pca(
     # warm-start each worker's subspace iteration from the previous merged
     # estimate at the short iteration count — the same lever the scan
     # trainer has, threaded through the loop instead of a scan carry
-    warm = cfg.warm_start_iters is not None and cfg.solver == "subspace"
+    warm_iters = cfg.resolved_warm_start()
+    warm = warm_iters is not None
     v_prev = None
 
     def step(st, x_blocks):
@@ -167,7 +168,7 @@ def online_distributed_pca(
         _, v_bar = pool.round(
             pool.shard(x_blocks), cfg.k, worker_mask=mask,
             v0=v_prev,
-            iters=cfg.warm_start_iters if v_prev is not None else None,
+            iters=warm_iters if v_prev is not None else None,
         )
         if warm:
             v_prev = v_bar

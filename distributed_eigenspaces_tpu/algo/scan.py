@@ -55,10 +55,9 @@ def make_scan_fit(
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
     round_core = make_round_core(cfg)
-    warm = cfg.warm_start_iters is not None and cfg.solver == "subspace"
-    warm_core = (
-        make_round_core(cfg, iters=cfg.warm_start_iters) if warm else None
-    )
+    warm_iters = cfg.resolved_warm_start()
+    warm = warm_iters is not None
+    warm_core = make_round_core(cfg, iters=warm_iters) if warm else None
 
     def make_fit(axis_name):
         def update(st, v_bar):
@@ -188,10 +187,9 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
     round_core = make_round_core(cfg)
-    warm = cfg.warm_start_iters is not None and cfg.solver == "subspace"
-    warm_core = (
-        make_round_core(cfg, iters=cfg.warm_start_iters) if warm else None
-    )
+    warm_iters = cfg.resolved_warm_start()
+    warm = warm_iters is not None
+    warm_core = make_round_core(cfg, iters=warm_iters) if warm else None
 
     def update(st, v_bar):
         return update_state(

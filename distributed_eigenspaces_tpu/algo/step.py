@@ -100,10 +100,9 @@ def make_train_step(
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
     round_core = make_round_core(cfg)
-    warm = cfg.warm_start_iters is not None and cfg.solver == "subspace"
-    warm_core = (
-        make_round_core(cfg, iters=cfg.warm_start_iters) if warm else None
-    )
+    warm_iters = cfg.resolved_warm_start()
+    warm = warm_iters is not None
+    warm_core = make_round_core(cfg, iters=warm_iters) if warm else None
     donate_args = (0,) if donate else ()
 
     def fold(state, v_bar):

@@ -83,8 +83,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warm-start-iters", type=int, default=None,
                    help="after a cold first step, run this many solver "
                    "iterations warm-started from the previous merged "
-                   "estimate (requires --solver subspace; honored by both "
-                   "trainers)")
+                   "estimate (requires --solver subspace; honored by all "
+                   "trainers). Unset = the measured-fastest default (2) "
+                   "with --solver subspace; 0 disables (every step cold)")
     p.add_argument("--dim", type=int, default=1024,
                    help="feature dim for --data synthetic")
     p.add_argument("--checkpoint-dir", default=None)
@@ -399,8 +400,11 @@ def _fit_sketch(args, cfg, data, truth) -> int:
     """``--trainer sketch``: the Nystrom whole-fit on the feature-sharded
     ``(workers, features)`` mesh — steady state free of per-step spectral
     solves (the measured winner above the d*k crossover, BASELINE.md).
-    ``--checkpoint-dir`` saves the final SketchState (resume continues a
-    longer schedule from it); the extraction solve runs once at the end.
+    ``--checkpoint-dir`` runs the fit windowed (``fit_windows``, one
+    committed checkpoint every ``--checkpoint-every`` steps — whole-fit
+    checkpointing, round-3 verdict item 3); ``--resume`` continues
+    bit-for-bit from the newest one. The extraction solve runs once at
+    the end.
     """
     import jax
     import jax.numpy as jnp
@@ -461,23 +465,48 @@ def _fit_sketch(args, cfg, data, truth) -> int:
     t0 = time.time()
     with profile_to(args.profile_dir):
         if remaining:
-            blocks = jax.device_put(
-                jnp.asarray(
-                    np.ascontiguousarray(
-                        data[cursor : cursor + need]
-                    ).reshape(remaining, m, n, dim),
-                    dtype=(cfg.compute_dtype or jnp.float32),
-                ),
-                fit.blocks_sharding,
-            )
-            state = fit(
-                state, blocks, jnp.arange(remaining, dtype=jnp.int32)
-            )
+            stage_dtype = jnp.dtype(cfg.compute_dtype or jnp.float32)
+            if ckpt is not None:
+                # windowed: one program + one committed checkpoint per
+                # --checkpoint-every steps (a kill between windows loses
+                # at most one window of work), fed from a per-step
+                # generator — O(window) host memory, no full-dataset
+                # cast copy on exactly the long runs checkpointing is for
+                from distributed_eigenspaces_tpu.data.bin_stream import (
+                    window_stream,
+                )
+
+                def step_blocks():
+                    for t in range(remaining):
+                        lo = cursor + t * rows_per_step
+                        yield np.ascontiguousarray(
+                            data[lo : lo + rows_per_step]
+                        ).reshape(m, n, dim).astype(
+                            stage_dtype, copy=False
+                        )
+
+                state = fit.fit_windows(
+                    state,
+                    window_stream(step_blocks(), args.checkpoint_every),
+                    on_segment=ckpt.on_step,
+                )
+            else:
+                state = fit(
+                    state,
+                    jax.device_put(
+                        jnp.asarray(
+                            np.ascontiguousarray(
+                                data[cursor : cursor + need]
+                            ).reshape(remaining, m, n, dim),
+                            dtype=stage_dtype,
+                        ),
+                        fit.blocks_sharding,
+                    ),
+                    jnp.arange(remaining, dtype=jnp.int32),
+                )
         w = fit.extract(state)
         w_host = np.asarray(w)  # materialization fence + result
     elapsed = time.time() - t0
-    if ckpt is not None:
-        ckpt.on_step(int(state.step), state)
 
     out = {
         "mode": "fit",
@@ -531,7 +560,9 @@ def main(argv=None) -> int:
             "collectives ride ICI)",
             file=sys.stderr,
         )
-    if args.warm_start_iters is not None and args.solver != "subspace":
+    if args.warm_start_iters and args.solver != "subspace":
+        # an explicit 0 ("disable") is solver-independent; a positive
+        # count needs the iterative solver to exist
         print(
             "error: --warm-start-iters requires --solver subspace "
             "(warm start initializes the iterative solver; eigh has "
@@ -609,7 +640,11 @@ def main(argv=None) -> int:
         compute_dtype=(
             None if args.compute_dtype == "float32" else args.compute_dtype
         ),
-        warm_start_iters=args.warm_start_iters,
+        warm_start_iters=(
+            "auto" if args.warm_start_iters is None
+            else (None if args.warm_start_iters == 0
+                  else args.warm_start_iters)
+        ),
     )
 
     if args.trainer == "sketch":

@@ -99,18 +99,28 @@ def window_stream(blocks, window: int):
     one window = one S-step device program, so the per-step dispatch cost
     of the tunnelled per-step trainer drops to 1/S per step.
 
-    Works on device blocks (``jnp.stack`` runs on device) or host arrays.
+    Works on device blocks (``jnp.stack`` runs on device) or host arrays
+    — host (numpy) blocks stack with ``np.stack`` and STAY host-resident,
+    so the consumer (or a ``prefetch_stream`` ``place``) controls the one
+    host->device transfer and its sharding; a ``jnp.stack`` here would
+    silently commit every window to the default device first.
     """
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
+
+    def stack(bs):
+        if all(isinstance(b, np.ndarray) for b in bs):
+            return np.stack(bs)
+        return jnp.stack(bs)
+
     buf = []
     for b in blocks:
         buf.append(b)
         if len(buf) == window:
-            yield jnp.stack(buf)
+            yield stack(buf)
             buf = []
     if buf:
-        yield jnp.stack(buf)
+        yield stack(buf)
 
 
 def bin_block_stream(

@@ -152,12 +152,20 @@ def run_eval(
     *,
     data_dir: str | None = None,
     seed: int = 0,
+    repeats: int | None = None,
     **overrides: Any,
 ) -> dict:
     """Run one BASELINE config end-to-end; returns the JSON-able report.
 
     ``overrides`` patch any EvalSpec field (tests shrink ``dim``/``steps``;
     the TPU bench runs the specs as published).
+
+    ``repeats``: timed-run repetitions — the report quotes the MEDIAN
+    with the IQR (single-shot numbers from a fluctuating tunnel are not
+    auditable; round-3 verdict item 5 measured cifar10 swinging
+    6.8-8.1M run-to-run with nothing in the JSON saying so). ``None``
+    = 3 on full-size runs, 1 on CI-shrunk ones (steps < 10), whose
+    throughput is never asserted on.
     """
     import jax
     import jax.numpy as jnp
@@ -173,6 +181,10 @@ def run_eval(
     spec = EVAL_SPECS[name].replace(**overrides)
     m, n, d, k = spec.num_workers, spec.rows_per_worker, spec.dim, spec.k
     step_rows = m * n
+    if repeats is None:
+        repeats = 3 if spec.steps >= 10 else 1
+    if repeats < 1:
+        raise ValueError(f"repeats must be >= 1, got {repeats}")
 
     real = _real_data(spec, data_dir)
     if real is not None and (real.shape[1] != d or len(real) < step_rows):
@@ -366,9 +378,9 @@ def run_eval(
     def fence(st):
         return float(jnp.sum(jax.tree_util.tree_leaves(st)[0]))
 
-    def salted(st):
+    def salted(st, eps=1e-20):
         leaves, tdef = jax.tree_util.tree_flatten(st)
-        leaves[0] = leaves[0] + 1e-20
+        leaves[0] = leaves[0] + eps
         return jax.tree_util.tree_unflatten(tdef, leaves)
 
     # throughput schedule: a single spec-T fit is mostly the tunnel's
@@ -389,14 +401,23 @@ def run_eval(
         """ONE copy of the whole-fit throughput methodology: build the fit
         at ``timed_T``, warm up on salted operands with a rolled schedule
         (the tunneled dev backend serves identical (executable, operands)
-        pairs from a cache), then time a fenced run. ``call(fit, st, idx)``
-        runs the fit and returns its final state."""
+        pairs from a cache), then time ``repeats`` fenced runs — each on
+        a DIFFERENTLY-salted state, for the same cache reason — and
+        return the list of seconds. ``call(fit, st, idx)`` runs the fit
+        and returns its final state."""
         fit_t = make_fit_at(cfg.replace(num_steps=timed_T))
         idx_t = jnp.arange(timed_T, dtype=jnp.int32) % n_distinct
         fence(call(fit_t, salted(init_state()), jnp.roll(idx_t, 1)))
-        t0 = time.perf_counter()
-        fence(call(fit_t, init_state(), idx_t))
-        return time.perf_counter() - t0
+        out = []
+        for r in range(repeats):
+            st = (
+                init_state() if r == 0
+                else salted(init_state(), (r + 2) * 1e-20)
+            )
+            t0 = time.perf_counter()
+            fence(call(fit_t, st, idx_t))
+            out.append(time.perf_counter() - t0)
+        return out
 
     def stream():
         if spec.streaming == "bin":
@@ -448,7 +469,7 @@ def run_eval(
             fence(state)  # accuracy run: exactly the spec's T-step workload
 
             # throughput run on the longer one-program schedule
-            dt = timed_whole_fit(
+            dts = timed_whole_fit(
                 lambda c: make_fs_fit(c, mesh, seed=seed),
                 fit.init_state,
                 lambda f, st, ix: f(st, stacked, ix),
@@ -470,7 +491,7 @@ def run_eval(
 
             # throughput run: the SAME per-step workload on the longer
             # one-program schedule
-            dt = timed_whole_fit(
+            dts = timed_whole_fit(
                 lambda c: make_scan_fit(c, mesh=scan_mesh, gather=True),
                 lambda: OnlineState.initial(d),
                 lambda f, st, ix: f(st, stacked, ix)[0],
@@ -522,16 +543,27 @@ def run_eval(
                     seg,
                 )
 
-            # timed run = the full out-of-core pipeline: window t's S-step
-            # program runs while the prefetch thread reads, converts and
-            # ships window t+1 (fit_windows only fences at the final fetch)
-            t0 = time.perf_counter()
-            state = fit.fit_windows(
-                SegmentState.initial(d, k),
-                prefetch_stream(bin_windows(), depth=1, place=lambda w: w),
-            )
-            fence(state)
-            dt = time.perf_counter() - t0
+            # timed runs = the full out-of-core pipeline: window t's
+            # S-step program runs while the prefetch thread reads,
+            # converts and ships window t+1 (fit_windows only fences at
+            # the final fetch). Each repeat re-reads the file end to end
+            # on a differently-salted state (tunnel-cache honesty).
+            dts = []
+            for r in range(repeats):
+                st0 = SegmentState.initial(d, k)
+                if r:
+                    st0 = st0._replace(
+                        sigma_tilde=st0.sigma_tilde + (r + 1) * 7e-20
+                    )
+                t0 = time.perf_counter()
+                state = fit.fit_windows(
+                    st0,
+                    prefetch_stream(
+                        bin_windows(), depth=1, place=lambda w: w
+                    ),
+                )
+                fence(state)
+                dts.append(time.perf_counter() - t0)
             steps_run = int(state.step)
             timed_steps = steps_run
 
@@ -585,8 +617,7 @@ def run_eval(
             # step warm-starts internally from state.u instead)
             thread_v = (
                 backend_used != "feature_sharded"
-                and cfg.warm_start_iters is not None
-                and spec.solver == "subspace"
+                and cfg.resolved_warm_start() is not None
             )
             # --- warm-up (compile) -----------------------------------------
             if spec.streaming == "bin":
@@ -611,27 +642,36 @@ def run_eval(
                 # it outside the timed region too
                 fence(step_fn(out[0], warm_blk, out[1])[0])
 
-            # --- timed run -------------------------------------------------
-            if backend_used == "feature_sharded":
-                state = fstep.init_state()
-            else:
-                state = OnlineState.initial(d)
-            # the step dispatcher selects the cold executable itself when
-            # v_prev is None, so one call form covers both phases
-            v_prev = None
-            t0 = time.perf_counter()
-            steps_run = 0
-            for x in stream():
-                # keyword arg: the feature-sharded step's third positional
-                # is worker_mask, not v_prev (thread_v excludes it)
-                state, v_bar = (
-                    step_fn(state, x, v_prev=v_prev) if thread_v
-                    else step_fn(state, x)
-                )
-                v_prev = v_bar if thread_v else None
-                steps_run += 1
-            fence(state)
-            dt = time.perf_counter() - t0
+            # --- timed runs ------------------------------------------------
+            # repeats on differently-salted initial states: the state
+            # operand then differs at every step of every repeat, so the
+            # tunnel's (executable, operands) cache can never serve a
+            # timed step without executing it
+            dts = []
+            for r in range(repeats):
+                if backend_used == "feature_sharded":
+                    state = fstep.init_state()
+                else:
+                    state = OnlineState.initial(d)
+                if r:
+                    state = salted(state, (r + 1) * 5e-20)
+                # the step dispatcher selects the cold executable itself
+                # when v_prev is None, so one call form covers both phases
+                v_prev = None
+                t0 = time.perf_counter()
+                steps_run = 0
+                for x in stream():
+                    # keyword arg: the feature-sharded step's third
+                    # positional is worker_mask, not v_prev (thread_v
+                    # excludes it)
+                    state, v_bar = (
+                        step_fn(state, x, v_prev=v_prev) if thread_v
+                        else step_fn(state, x)
+                    )
+                    v_prev = v_bar if thread_v else None
+                    steps_run += 1
+                fence(state)
+                dts.append(time.perf_counter() - t0)
             timed_steps = steps_run
 
             if spec.streaming == "bin":
@@ -695,7 +735,26 @@ def run_eval(
         np.max(np.asarray(principal_angles_degrees(w, truth)))
     )
     report_extra = {}
+    # median + IQR over the repeats: the headline samples_per_sec IS the
+    # median (a single shot from a fluctuating tunnel is not auditable —
+    # round-3 verdict item 5); the spread fields make run-to-run variance
+    # machine-readable instead of folklore
+    dt = float(np.median(dts))
     samples_per_sec = timed_steps * step_rows / dt
+    sps_all = sorted(timed_steps * step_rows / t for t in dts)
+    report_extra["timing"] = {
+        "n_repeats": len(dts),
+        "seconds_median": round(dt, 4),
+        "seconds_iqr": [
+            round(float(q), 4) for q in np.percentile(dts, [25, 75])
+        ],
+        "samples_per_sec_iqr": [
+            round(float(q), 1) for q in np.percentile(sps_all, [25, 75])
+        ],
+        "samples_per_sec_spread_pct": round(
+            100.0 * (sps_all[-1] - sps_all[0]) / sps_all[-1], 2
+        ) if len(sps_all) > 1 else 0.0,
+    }
     if spec.streaming == "bin":
         report_extra["bin_dtype"] = spec.bin_dtype
         if stage_ms is not None:
@@ -776,13 +835,17 @@ def main(argv=None) -> int:
     p.add_argument("--data-dir", default=None)
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--repeats", type=int, default=None,
+                   help="timed-run repetitions (report = median + IQR); "
+                   "default 3 on full-size runs, 1 on shrunk ones")
     args = p.parse_args(argv)
 
     names = args.configs or sorted(EVAL_SPECS)
     ok = True
     for name in names:
         over = {} if args.steps is None else {"steps": args.steps}
-        rep = run_eval(name, data_dir=args.data_dir, seed=args.seed, **over)
+        rep = run_eval(name, data_dir=args.data_dir, seed=args.seed,
+                       repeats=args.repeats, **over)
         print(json.dumps(rep))
         ok = ok and rep["accuracy_ok"]
     return 0 if ok else 1

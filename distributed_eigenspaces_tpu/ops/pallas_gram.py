@@ -124,10 +124,14 @@ def gram_auto(x: jax.Array, *, normalize: bool = True) -> jax.Array:
 
     n, d = x.shape
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    # block_d is the sublane AND lane dim of the (bd, bd) output tile, so
-    # it needs the 128 lane alignment (which implies the 8-sublane one)
-    # unless it spans the full d
-    bn = _pick_block(n, 512, 8)
+    # the sublane tile is DTYPE-dependent (fp32: 8, bf16: 16, int8: 32 —
+    # 32 bytes of sublane either way), so n's alignment comes from the
+    # input itemsize; a bf16 n=600 with the fp32 align would pick 200
+    # (multiple of 8, not 16) and still hit the lowering-legality error
+    # (round-3 advisor finding). block_d is the sublane AND lane dim of
+    # the (bd, bd) fp32 output tile, so it needs the 128 lane alignment
+    # (which implies every sublane one) unless it spans the full d.
+    bn = _pick_block(n, 512, (8 * 4) // jnp.dtype(x.dtype).itemsize)
     bd = _pick_block(d, 256, 128)
     if not on_tpu or bn is None or bd is None:
         return gram(x, normalize=normalize)

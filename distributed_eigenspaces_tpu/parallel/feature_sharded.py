@@ -472,11 +472,7 @@ def make_feature_sharded_step(
     m = cfg.num_workers
     key = jax.random.PRNGKey(seed)
     step_core = _make_step_core(cfg, collectives=collectives, key=key)
-    warm_iters = (
-        cfg.warm_start_iters
-        if cfg.warm_start_iters is not None and cfg.solver == "subspace"
-        else None
-    )
+    warm_iters = cfg.resolved_warm_start()
 
     def sharded(state, x, mask):
         # x: (m_local, n, d_local); state.u: (d_local_f, r)
@@ -550,6 +546,71 @@ def make_feature_sharded_step(
     return step
 
 
+def _windowed_whole_fit(
+    mesh, make_sharded_fit, key_of_first, *, blocks_spec, blocks_sharding,
+    state_specs, state_shardings, carry_leaf,
+):
+    """ONE copy of the windowed whole-fit machinery shared by the exact
+    scan and sketch trainers (round-3 verdict item 3): a lazily-compiled
+    {first: program} cache over ``make_sharded_fit(first)`` and the host
+    window loop. Returns ``(get_program, fit_windows)``.
+
+    ``fit_windows(state, windows, on_segment=None)`` runs each host
+    ``(S, m, n, d)`` window as one S-step program staged on the mesh
+    (O(S) device memory) with ``on_segment(steps_done, state)`` between
+    programs for checkpoint/metrics. A ZERO carry (``carry_leaf(state)``
+    — the trainer's warm basis, saved as part of every checkpoint) runs
+    the cold first-step program; every later window — and a resume from
+    any committed checkpoint — runs the all-warm continuation program,
+    so a killed-and-resumed run is bit-for-bit the unkilled windowed
+    run. Wrap the window source in
+    ``runtime.prefetch.prefetch_stream(place=...)`` with the trainer's
+    ``blocks_sharding`` and window t+1's host stack + host->device
+    transfer overlap window t's device program. The reference defect
+    class this fixes: all state dies with the master process
+    (``distributed.py:88-91``).
+    """
+    from distributed_eigenspaces_tpu.utils.guards import checked_jit
+
+    compiled = {}
+
+    def _get(first):
+        key = key_of_first(first)
+        if key not in compiled:
+            compiled[key] = checked_jit(
+                jax.shard_map(
+                    make_sharded_fit(key),
+                    mesh=mesh,
+                    in_specs=(state_specs, blocks_spec, P()),
+                    out_specs=state_specs,
+                    check_vma=False,
+                ),
+                in_shardings=(
+                    state_shardings, blocks_sharding,
+                    NamedSharding(mesh, P()),
+                ),
+                out_shardings=state_shardings,
+            )
+        return compiled[key]
+
+    def fit_windows(state, windows, on_segment=None):
+        first = (
+            int(state.step) == 0 or not bool(jnp.any(carry_leaf(state)))
+        )
+        for w in windows:
+            blocks = jax.device_put(w, blocks_sharding)
+            steps = int(blocks.shape[0])
+            state = _get(first)(
+                state, blocks, jnp.arange(steps, dtype=jnp.int32)
+            )
+            first = False
+            if on_segment is not None:
+                on_segment(int(state.step), state)
+        return state
+
+    return _get, fit_windows
+
+
 def make_feature_sharded_scan_fit(
     cfg: PCAConfig,
     mesh: Mesh,
@@ -584,31 +645,39 @@ def make_feature_sharded_scan_fit(
     r = _resolve_rank(cfg, rank)
     key = jax.random.PRNGKey(seed)
     step_core = _make_step_core(cfg, collectives=collectives, key=key)
-    warm_iters = (
-        cfg.warm_start_iters
-        if cfg.warm_start_iters is not None and cfg.solver == "subspace"
-        else None
-    )
+    warm_iters = cfg.resolved_warm_start()
 
-    def sharded_fit(state, blocks, idx):
-        def step_at(st, x, step_iters):
-            return step_core(st, x, step_iters)[0]
+    def make_sharded_fit(first):
+        """``first=True``: step 1 cold at the full iteration count, later
+        steps short (the whole-fit program). ``first=False``: every step
+        warm — the continuation program the windowed/resumed entry runs
+        once a prior window (or a restored checkpoint) has left a nonzero
+        ``state.u`` to warm-start from."""
 
-        if warm_iters is None:
+        def sharded_fit(state, blocks, idx):
+            def step_at(st, x, step_iters):
+                return step_core(st, x, step_iters)[0]
+
+            if warm_iters is None:
+                def body(st, i):
+                    return step_at(st, blocks[i], iters), None
+
+                state, _ = jax.lax.scan(body, state, idx)
+                return state
+            if first:
+                # step 1 cold at the full iteration count (resume-safe: a
+                # restored state's u warm-starts it anyway), later steps
+                # short
+                state = step_at(state, blocks[idx[0]], iters)
+                idx = idx[1:]
+
             def body(st, i):
-                return step_at(st, blocks[i], iters), None
+                return step_at(st, blocks[i], warm_iters), None
 
             state, _ = jax.lax.scan(body, state, idx)
             return state
-        # step 1 cold at the full iteration count (resume-safe: a restored
-        # state's u warm-starts it anyway), later steps short
-        state = step_at(state, blocks[idx[0]], iters)
 
-        def body(st, i):
-            return step_at(st, blocks[i], warm_iters), None
-
-        state, _ = jax.lax.scan(body, state, idx[1:])
-        return state
+        return sharded_fit
 
     blocks_spec = P(None, WORKER_AXIS, None, FEATURE_AXIS)
     u_spec = P(FEATURE_AXIS, None)
@@ -620,22 +689,21 @@ def make_feature_sharded_scan_fit(
         step=NamedSharding(mesh, P()),
     )
 
-    inner = jax.shard_map(
-        sharded_fit,
-        mesh=mesh,
-        in_specs=(state_specs, blocks_spec, P()),
-        out_specs=state_specs,
-        check_vma=False,
-    )
-    from distributed_eigenspaces_tpu.utils.guards import checked_jit
-
-    fit = checked_jit(
-        inner,
-        in_shardings=(
-            state_shardings, blocks_sharding, NamedSharding(mesh, P()),
+    _get, fit_windows = _windowed_whole_fit(
+        mesh, make_sharded_fit,
+        # without warm start the first and continuation programs are the
+        # same all-cold scan — never compile it twice
+        key_of_first=(
+            (lambda first: first) if warm_iters is not None
+            else (lambda first: True)
         ),
-        out_shardings=state_shardings,
+        blocks_spec=blocks_spec, blocks_sharding=blocks_sharding,
+        state_specs=state_specs, state_shardings=state_shardings,
+        carry_leaf=lambda st: st.u,  # the warm basis (rows [:, :k])
     )
+
+    def fit(state, blocks, idx):
+        return _get(True)(state, blocks, idx)
 
     fit.init_state = _jit_init(
         lambda: LowRankState.initial(cfg.dim, r), state_shardings
@@ -643,6 +711,7 @@ def make_feature_sharded_scan_fit(
     fit.rank = r
     fit.blocks_sharding = blocks_sharding
     fit.state_shardings = state_shardings
+    fit.fit_windows = fit_windows
     return fit
 
 
@@ -760,10 +829,13 @@ def make_feature_sharded_sketch_fit(
     iters = cfg.subspace_iters
     # this trainer is warm BY CONSTRUCTION (the steady-state restructure is
     # its whole point): warm_start_iters sets the per-step matvec count and
-    # defaults to 2 when the config leaves it None — it cannot "disable"
-    # warm starts here the way it does on the exact trainers
+    # defaults to 2 when the config leaves it None/"auto" — it cannot
+    # "disable" warm starts here the way it does on the exact trainers,
+    # and it is solver-independent (the sketch has no eigh alternative)
     warm_iters = (
-        cfg.warm_start_iters if cfg.warm_start_iters is not None else 2
+        2
+        if cfg.warm_start_iters in (None, "auto")
+        else cfg.warm_start_iters
     )
     weights = _discount_weights(cfg)
     key = jax.random.PRNGKey(seed)
@@ -850,18 +922,27 @@ def make_feature_sharded_sketch_fit(
         with jax.named_scope("det_sketch_fold"):
             return _skip_if_dead(st, _fold(st, v_bar, omega), alive)
 
-    def sharded_fit(state, blocks, idx):
+    def make_sharded_fit(first):
         """Unmasked fast path: the exact pre-mask program (plain warm
         scan body — no lax.cond, no mask algebra) so the throughput
-        configs pay nothing for the fault machinery."""
-        omega = _omega(state.y.shape[0])
-        state = cold_step(state, blocks[idx[0]], omega)
+        configs pay nothing for the fault machinery. ``first=False`` is
+        the all-warm continuation program for the windowed/resumed entry
+        (``state.v`` — part of every committed checkpoint — is the warm
+        carry)."""
 
-        def body(st, i):
-            return warm_step(st, blocks[i], omega), None
+        def sharded_fit(state, blocks, idx):
+            omega = _omega(state.y.shape[0])
+            if first:
+                state = cold_step(state, blocks[idx[0]], omega)
+                idx = idx[1:]
 
-        state, _ = jax.lax.scan(body, state, idx[1:])
-        return state
+            def body(st, i):
+                return warm_step(st, blocks[i], omega), None
+
+            state, _ = jax.lax.scan(body, state, idx)
+            return state
+
+        return sharded_fit
 
     def sharded_fit_masked(state, blocks, idx, masks):
         omega = _omega(state.y.shape[0])
@@ -903,18 +984,12 @@ def make_feature_sharded_sketch_fit(
 
     masks_spec = P(None, WORKER_AXIS)
     masks_sharding = NamedSharding(mesh, masks_spec)
-    fused = checked_jit(
-        jax.shard_map(
-            sharded_fit,
-            mesh=mesh,
-            in_specs=(state_specs, blocks_spec, P()),
-            out_specs=state_specs,
-            check_vma=False,
-        ),
-        in_shardings=(
-            state_shardings, blocks_sharding, NamedSharding(mesh, P()),
-        ),
-        out_shardings=state_shardings,
+
+    _get, fit_windows = _windowed_whole_fit(
+        mesh, make_sharded_fit, key_of_first=lambda first: first,
+        blocks_spec=blocks_spec, blocks_sharding=blocks_sharding,
+        state_specs=state_specs, state_shardings=state_shardings,
+        carry_leaf=lambda st: st.v,  # the warm basis
     )
     fused_masked = checked_jit(
         jax.shard_map(
@@ -933,12 +1008,13 @@ def make_feature_sharded_sketch_fit(
 
     def fit(state, blocks, idx, worker_masks=None):
         if worker_masks is None:
-            return fused(state, blocks, idx)
+            return _get(True)(state, blocks, idx)
         worker_masks = jax.device_put(
             jnp.asarray(worker_masks, jnp.float32), masks_sharding
         )
         return fused_masked(state, blocks, idx, worker_masks)
 
+    fit.fit_windows = fit_windows  # windowed (unmasked) checkpointable fit
     fit.init_state = _jit_init(
         lambda: SketchState.initial(d, k, p), state_shardings
     )

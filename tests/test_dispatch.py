@@ -176,11 +176,45 @@ def test_partial_fit_continues_feature_sharded_auto_backend(devices):
     assert int(est.state.step) == 5
 
 
-def test_checkpoint_dir_rejected_off_segmented_route():
-    cfg = _cfg(dim=8192, k=16, backend="auto")
-    est = OnlineDistributedPCA(cfg, checkpoint_dir="/tmp/nope")
+def test_checkpoint_dir_rejected_on_per_step_override():
+    """Only trainers that cannot checkpoint whole fits reject
+    checkpoint_dir — and only via explicit override ('auto' always picks
+    a checkpointable route: segmented for dense, windowed scan/sketch for
+    feature-sharded)."""
+    est = OnlineDistributedPCA(
+        _cfg(), trainer="step", checkpoint_dir="/tmp/nope"
+    )
     with pytest.raises(ValueError, match="checkpoint_dir"):
-        est.fit(np.zeros((8192 * 2, 8192), np.float32))
+        est.fit(np.zeros((2048, 64), np.float32))
+
+
+def test_checkpoint_dir_on_feature_sharded_writes_checkpoints(
+    tmp_path, devices
+):
+    """Round-3 verdict item 3: a checkpointed feature-sharded whole fit
+    runs windowed (committed checkpoint per window) instead of raising —
+    the exact config class (large d, longest runs) that previously
+    couldn't checkpoint its fast trainer."""
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        LowRankState,
+    )
+    from distributed_eigenspaces_tpu.utils.checkpoint import Checkpointer
+
+    x, spec = _data(d=128, k=4, n=8192, seed=2)
+    cfg = _cfg(dim=128, k=4, num_steps=6, backend="feature_sharded",
+               solver="subspace", subspace_iters=16)
+    ckpt = str(tmp_path / "ck")
+    est = OnlineDistributedPCA(
+        cfg, trainer="scan", checkpoint_dir=ckpt, segment=2
+    ).fit(x)
+    assert est.trainer_used_ == "scan"
+    assert isinstance(est.state, LowRankState)
+    assert int(est.state.step) == 6
+    assert _angle(est, spec, 4) < 1.5
+    state, cursor = Checkpointer(ckpt).latest()
+    assert isinstance(state, LowRankState)
+    assert int(state.step) == 6
+    assert cursor == 6 * 4 * 64
 
 
 def test_per_step_hook_on_auto_large_d_stays_feature_sharded(devices):
@@ -239,16 +273,22 @@ def test_segmented_window_clamped_to_staging_budget(monkeypatch):
     assert int(est.state.step) == 6
 
 
-def test_feature_sharded_stage_over_budget_fails_loudly(monkeypatch,
-                                                        devices):
+def test_feature_sharded_stage_over_budget_streams_windows(monkeypatch,
+                                                           devices):
+    """An over-budget feature-sharded whole fit streams windows (O(window)
+    host AND device staging) instead of raising after duplicating the
+    dataset on host — the round-3 advisor's medium finding. Same trainer,
+    same result quality; never a mid-fit ValueError."""
     import distributed_eigenspaces_tpu.api.estimator as em
 
-    monkeypatch.setattr(em, "SCAN_STAGE_BYTES_MAX", 1024)
+    monkeypatch.setattr(em, "SCAN_STAGE_BYTES_MAX", 128 * 64 * 4 * 2)
     x, spec = _data(d=128, k=4, n=8192, seed=2)
     cfg = _cfg(dim=128, k=4, num_steps=4, backend="feature_sharded",
                solver="subspace", subspace_iters=16)
-    with pytest.raises(ValueError, match="staging budget"):
-        OnlineDistributedPCA(cfg, trainer="scan").fit(x)
+    est = OnlineDistributedPCA(cfg, trainer="scan").fit(x)
+    assert est.trainer_used_ == "scan"
+    assert int(est.state.step) == 4
+    assert _angle(est, spec, 4) < 1.5
 
 
 def test_segmented_route_honors_state_dtype():

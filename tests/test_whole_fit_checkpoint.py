@@ -1,0 +1,207 @@
+"""Whole-fit checkpointing on the feature-sharded trainers (round-3
+verdict item 3).
+
+The windowed entries (``fit_windows`` on the exact scan fit and the
+Nystrom sketch fit) run the T-step schedule as ceil(T/S) programs over the
+(workers, features) mesh with a host hook between windows. The carry —
+``LowRankState`` (``u`` doubles as the warm basis) / ``SketchState``
+(``v`` doubles as the warm basis) — is the COMPLETE resumable state, so a
+killed-and-resumed run must be bit-for-bit the unkilled run. Reference
+defect class being fixed: all state dies with the master process
+(``/root/reference/distributed.py:88-91``), at its worst on exactly the
+long large-d runs these trainers exist for.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.ops.linalg import principal_angles_degrees
+from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+    LowRankState,
+    SketchState,
+    make_feature_sharded_scan_fit,
+    make_feature_sharded_sketch_fit,
+)
+from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+D, K, M, N = 64, 3, 4, 128
+T = 6
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(num_workers=4, num_feature_shards=2)
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=T,
+        solver="subspace", subspace_iters=24, warm_start_iters=2,
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def blocks():
+    spec = planted_spectrum(D, k_planted=K, gap=25.0, noise=0.01, seed=11)
+    key = jax.random.PRNGKey(3)
+    out = []
+    for _ in range(T):
+        key, sub = jax.random.split(key)
+        out.append(np.asarray(spec.sample(sub, M * N).reshape(M, N, D)))
+    return np.stack(out), spec
+
+
+def _windows(xs, s):
+    for t in range(0, xs.shape[0], s):
+        yield xs[t : t + s]
+
+
+@pytest.mark.parametrize("maker,state_cls", [
+    (make_feature_sharded_scan_fit, LowRankState),
+    (make_feature_sharded_sketch_fit, SketchState),
+])
+def test_fit_windows_matches_staged_fit(mesh, devices, blocks, maker,
+                                        state_cls):
+    """The windowed entry equals the one-program staged fit on the same
+    steps (same step math delivered as 3 programs instead of 1),
+    including a ragged tail window (6 steps through windows of 4)."""
+    xs, _spec = blocks
+    fit = maker(_cfg(), mesh, seed=4)
+
+    staged = fit(
+        fit.init_state(),
+        jax.device_put(jnp.asarray(xs), fit.blocks_sharding),
+        jnp.arange(T, dtype=jnp.int32),
+    )
+
+    seen = []
+    windowed = fit.fit_windows(
+        fit.init_state(), _windows(xs, 4),
+        on_segment=lambda t, st: seen.append(t),
+    )
+    assert seen == [4, 6]
+    assert isinstance(windowed, state_cls)
+    assert int(windowed.step) == T
+    for f in state_cls._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(windowed, f)),
+            np.asarray(getattr(staged, f)),
+            atol=1e-5,
+            err_msg=f"field {f}",
+        )
+
+
+@pytest.mark.parametrize("maker,state_cls", [
+    (make_feature_sharded_scan_fit, LowRankState),
+    (make_feature_sharded_sketch_fit, SketchState),
+])
+def test_kill_resume_bit_for_bit(tmp_path, mesh, devices, blocks, maker,
+                                 state_cls):
+    """Kill after window 2 of 3, restore from the committed checkpoint
+    (through disk, in a FRESH trainer instance), finish — every state
+    field is bit-for-bit the unkilled windowed run's."""
+    from distributed_eigenspaces_tpu.utils.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    xs, _spec = blocks
+    cfg = _cfg()
+
+    fit = maker(cfg, mesh, seed=4)
+    unkilled = fit.fit_windows(fit.init_state(), _windows(xs, 2))
+    assert int(unkilled.step) == T
+
+    # killed run: two windows, checkpoint, process "dies"
+    fit1 = maker(cfg, mesh, seed=4)
+    half = fit1.fit_windows(fit1.init_state(), _windows(xs[:4], 2))
+    save_checkpoint(str(tmp_path / "ck"), half, cursor=4 * M * N)
+
+    # fresh process: new trainer instance, state restored from disk;
+    # the restored carry (u / v) warm-starts the continuation program
+    fit2 = maker(cfg, mesh, seed=4)
+    restored, cursor = restore_checkpoint(str(tmp_path / "ck"))
+    assert cursor == 4 * M * N
+    resumed = fit2.fit_windows(
+        jax.device_put(restored, fit2.state_shardings),
+        _windows(xs[4:], 2),
+    )
+    assert int(resumed.step) == T
+    for f in state_cls._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(resumed, f)),
+            np.asarray(getattr(unkilled, f)),
+            err_msg=f"field {f} diverged across kill/resume",
+        )
+
+
+def test_estimator_sketch_checkpointed_fit(tmp_path, devices, blocks):
+    """estimator.fit(checkpoint_dir=...) on a sketch-trainer workload
+    runs windowed and commits rotated checkpoints — the combination that
+    raised ValueError before round 4 (api/estimator.py:186-196 then)."""
+    from distributed_eigenspaces_tpu.api.estimator import (
+        OnlineDistributedPCA,
+    )
+    from distributed_eigenspaces_tpu.utils.checkpoint import Checkpointer
+
+    xs, spec = blocks
+    cfg = _cfg(backend="feature_sharded")
+    est = OnlineDistributedPCA(
+        cfg, trainer="sketch", checkpoint_dir=str(tmp_path / "ck"),
+        segment=2,
+    ).fit(xs.reshape(T * M * N, D))
+    assert est.trainer_used_ == "sketch"
+    assert isinstance(est.state, SketchState)
+    assert int(est.state.step) == T
+    ang = np.asarray(
+        principal_angles_degrees(est.components_, spec.top_k(K))
+    )
+    assert ang.max() < 1.5, ang
+
+    state, cursor = Checkpointer(str(tmp_path / "ck")).latest()
+    assert isinstance(state, SketchState)
+    assert int(state.step) == T
+    assert cursor == T * M * N
+
+
+def test_estimator_records_trainer_used(devices, blocks):
+    xs, _spec = blocks
+    from distributed_eigenspaces_tpu.api.estimator import (
+        OnlineDistributedPCA,
+    )
+
+    data = xs.reshape(T * M * N, D)
+    est = OnlineDistributedPCA(_cfg(backend="local"))
+    assert est.trainer_used_ is None
+    est.fit(data)
+    assert est.trainer_used_ == "scan"
+    est.fit(data, on_step=lambda *a: None)
+    assert est.trainer_used_ == "step"
+
+
+def test_auto_sketch_dispatch_warns_once(devices):
+    """Default-config results silently switching from exact to sketched
+    was the round-3 advisor's semantics finding: auto dispatch above the
+    d*k crossover now says so (and records trainer_used_)."""
+    import warnings as _warnings
+
+    from distributed_eigenspaces_tpu.api.estimator import (
+        OnlineDistributedPCA,
+    )
+
+    d, k, m, n = 4096, 16, 2, 64
+    cfg = PCAConfig(dim=d, k=k, num_workers=m, rows_per_worker=n,
+                    num_steps=2, solver="subspace", subspace_iters=6)
+    x = np.random.default_rng(0).standard_normal(
+        (2 * m * n, d)).astype(np.float32)
+    with _warnings.catch_warnings(record=True) as got:
+        _warnings.simplefilter("always")
+        est = OnlineDistributedPCA(cfg).fit(x)
+    assert est.trainer_used_ == "sketch"
+    assert any("Nystrom-sketch" in str(w.message) for w in got)
