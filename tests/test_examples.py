@@ -45,3 +45,32 @@ def _run(script, *extra):
 def test_example_runs(script, extra):
     r = _run(script, *extra)
     assert r.returncode == 0, f"{script} failed:\n{r.stderr[-2000:]}"
+
+
+def test_committed_notebook_is_executed():
+    """The L7 parity artifact (reference: `Online Distributed PCA.ipynb`)
+    must be a committed, EXECUTED notebook: valid nbformat, every code
+    cell carrying outputs, no error outputs, the angle gate printed and
+    the A/B scatter rendered inline. Regenerate with
+    examples/make_notebook.py."""
+    nbformat = pytest.importorskip("nbformat")
+
+    path = os.path.join(
+        _ROOT, "examples", "Online_Distributed_PCA_TPU.ipynb"
+    )
+    nb = nbformat.read(path, as_version=4)
+    code = [c for c in nb.cells if c.cell_type == "code"]
+    assert len(code) >= 5
+    assert all(c.get("outputs") for c in code), "unexecuted code cell"
+    errs = [
+        o for c in code for o in c["outputs"] if o.output_type == "error"
+    ]
+    assert not errs, errs
+    text = "".join(
+        o.get("text", "") for c in code for o in c["outputs"]
+    )
+    assert "principal_angle_vs_exact_deg" in text
+    assert any(
+        "image/png" in o.get("data", {})
+        for c in code for o in c["outputs"]
+    ), "no inline scatter figure"
