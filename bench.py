@@ -260,8 +260,11 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
     # is the measured chained-matmul rate on this same device (BASELINE.md
     # "Sanity anchors" as a number, not prose).
     from distributed_eigenspaces_tpu.utils.roofline import (
+        measure_hbm_anchor,
         measure_matmul_anchor,
+        measure_seq_chol_latency,
         roofline_fields,
+        step_byte_model,
         step_flop_model,
     )
 
@@ -281,21 +284,59 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
     )
     if marginal is not None and marginal <= 0:
         marginal = None  # timing noise swamped the difference (CI smoke)
-    # what's left of the half fit after its warm steps and the link cost
-    # is the cold step (estimate — labeled by its derivation)
+
+    # COLD step: measured DIRECTLY as the marginal step of an all-cold
+    # scan (warm starts off, same 12-iteration core), two lengths
+    # differenced so dispatch/launch/fence all cancel — NOT derived as
+    # "whatever is left of the half fit", which silently absorbed every
+    # residual fixed cost and reported the cold Gram at ~1% of anchor
+    # for two rounds (round-3 verdict item 1a: measured honest, the cold
+    # step is ~1.3 ms ~ 35% of anchor; the ~29 ms residual was program
+    # launch + staging + fence, now its own field below).
+    small = TPU_STEPS <= 10  # DET_BENCH_SMALL: keep the probes cheap
     cold_s = None
-    if marginal is not None:
-        cold_s = dt_half_raw - min(rpc, 0.25 * dt_half_raw) - (
-            t_half - 1
-        ) * marginal
+    fixed_overhead_s = None
+    if not small:
+        cold_cfg = cfg.replace(warm_start_iters=None)
+        t_c = {}
+        for t_len in (60, 120):
+            fit_c = make_scan_fit(
+                cold_cfg.replace(num_steps=t_len), gather=True
+            )
+            idx_c = jnp.arange(t_len, dtype=jnp.int32) % len(blocks_host)
+            s_c, _ = fit_c(warm, stacked, jnp.roll(idx_c, 1))
+            _sync(s_c.sigma_tilde)
+            best = float("inf")
+            for r in range(3):
+                st0 = OnlineState.initial(D)._replace(
+                    sigma_tilde=jnp.full(
+                        (D, D), (r + 1) * 3e-20, jnp.float32
+                    )
+                )
+                t0 = time.perf_counter()
+                s_c, _ = fit_c(st0, stacked, idx_c)
+                _sync(s_c.sigma_tilde)
+                best = min(best, time.perf_counter() - t0)
+            t_c[t_len] = best
+        cold_s = (t_c[120] - t_c[60]) / 60
         if cold_s <= 0:
             cold_s = None
-    small = TPU_STEPS <= 10  # DET_BENCH_SMALL: keep the anchor cheap
+        # the residual the OLD derivation called "the cold step": what's
+        # left of the half fit after warm steps, the RPC estimate and
+        # the measured cold step — program launch + staging + fence
+        # costs of one dispatch, reported under its real name
+        if cold_s is not None and marginal is not None:
+            fixed_overhead_s = (
+                dt_half_raw
+                - min(rpc, 0.25 * dt_half_raw)
+                - (t_half - 1) * marginal
+                - cold_s
+            )
     anchor = measure_matmul_anchor(
         size=256 if small else 4096, chain=10 if small else 100
     )
     model = step_flop_model(
-        M, N, D, K, cfg.subspace_iters, cfg.warm_start_iters
+        M, N, D, K, cfg.subspace_iters, cfg.resolved_warm_start()
     )
     extras.update(
         roofline_fields(
@@ -305,8 +346,49 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
             warm_seconds_per_step=marginal,
             cold_seconds=cold_s,
             anchor_tflops=anchor,
+            # bandwidth roofline next to the FLOP one: pct_of_hbm_anchor
+            # + bound name the binding resource in the JSON itself
+            byte_model=step_byte_model(
+                M, N, D, K, cfg.subspace_iters,
+                cfg.resolved_warm_start(),
+                itemsize=2,  # blocks staged bf16
+            ),
+            hbm_anchor_gbps=measure_hbm_anchor(small=small),
         )
     )
+    if fixed_overhead_s is not None and fixed_overhead_s > 0:
+        # nulled like the sibling cold/marginal estimates when session
+        # noise drives the residual negative
+        extras["dispatch_fixed_ms"] = round(fixed_overhead_s * 1e3, 2)
+
+    # WHY the warm step sits at a few percent of anchor: it is bound by
+    # sequential small-op LATENCY, not FLOPs — measured on this device as
+    # a differenced chain of dependent Cholesky + triangular-solve pairs
+    # (the ops a CholeskyQR2 iteration serializes on). The model count:
+    # 2 pairs per solver iteration + ~2 pair-equivalents for the merge +
+    # state eighs. Reported so every %-of-anchor figure carries its
+    # machine-measured reason (round-3 verdict item 1).
+    if not small and marginal is not None:
+        pair_s = measure_seq_chol_latency(K, D)
+        warm_pairs = 2 * (cfg.resolved_warm_start() or 0) + 2
+        if pair_s > 0:
+            extras["latency_bound"] = {
+                "chol_solve_pair_ms": round(pair_s * 1e3, 4),
+                "seq_pairs_per_warm_step": warm_pairs,
+                "warm_latency_model_ms": round(
+                    pair_s * warm_pairs * 1e3, 3
+                ),
+                "warm_measured_ms": round(marginal * 1e3, 3),
+            }
+        else:
+            # differenced chains came back <= 0: tunnel jitter swamped
+            # the probe this session — say so instead of reporting a
+            # fictitious 0 ms latency
+            extras["latency_bound"] = {
+                "probe": "failed (tunnel jitter exceeded the "
+                "differenced chain time this session)",
+                "warm_measured_ms": round(marginal * 1e3, 3),
+            }
     return (TPU_STEPS * M * N) / dt, _gate_angle(state, spectrum), extras
 
 

@@ -43,15 +43,10 @@ def make_round_core(cfg: PCAConfig, iters: int | None = None):
     (the warm-start trainer uses a short-iteration core for steps > 0);
     ``v0`` warm-starts the per-worker subspace iterations.
     """
-    from distributed_eigenspaces_tpu.ops.pallas_xtxv import resolve_fused
-
     k, solver = cfg.k, cfg.solver
     if iters is None:
         iters = cfg.subspace_iters
     orth, cdtype = cfg.orth_method, cfg.compute_dtype
-    # resolved at build time (an env read under jit is frozen by the trace
-    # cache — resolving here makes the contract explicit)
-    fused = resolve_fused()
 
     # profiler annotation (§5.1): these named regions are the units a
     # captured trace shows — worker solve vs gather vs merge
@@ -60,8 +55,7 @@ def make_round_core(cfg: PCAConfig, iters: int | None = None):
     def round_core(x_blocks, axis_name=None, v0=None):
         with named_scope("det_worker_solve"):
             vs = _local_eigenspaces(
-                x_blocks, k, solver, iters, orth, cdtype, v0,
-                fused_xtxv=fused,
+                x_blocks, k, solver, iters, orth, cdtype, v0
             )
         if axis_name is not None:
             # the entire reference wire protocol (C11) is this one gather

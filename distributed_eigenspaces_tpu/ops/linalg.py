@@ -73,6 +73,34 @@ def gram(x: jax.Array, *, normalize: bool = True) -> jax.Array:
     return g
 
 
+def batched_xtxv(x: jax.Array, v: jax.Array) -> jax.Array:
+    """``(m, n, d), (m, d, k) -> (m, d, k)`` covariance matvec
+    ``X_b^T (X_b V_b)`` per worker (unnormalized) — THE definition of the
+    streaming subspace solver's hot op (warm online steps). Two batched
+    tall-skinny einsums, fp32 accumulation; fp32 inputs run at HIGHEST
+    precision, bf16 at MXU-native rate.
+
+    A hand-fused one-pass Pallas kernel for this op was built, A/B'd on
+    v5e across shapes, and DELETED in round 4: it measured 1.3-2.1x
+    faster in isolated differenced chains at HBM-heavy shapes (>=16 MB
+    per worker block) but LOST end-to-end at the step level on every
+    measured config (imagenet12288 sketch eval: 8.18M -> 5.28M
+    samples/s; the d=1024 bench shape: 0.73x) — XLA pipelines the two
+    matmuls against neighboring step ops better than the opaque kernel
+    call allows. Full table in BASELINE.md "Negative result: fused
+    matvec kernel".
+    """
+    prec = _precision(x)
+    xv = jnp.einsum(
+        "mnd,mdk->mnk", x, v.astype(x.dtype), precision=prec,
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.einsum(
+        "mnd,mnk->mdk", x, xv.astype(x.dtype), precision=prec,
+        preferred_element_type=jnp.float32,
+    )
+
+
 def canonicalize_signs(v: jax.Array) -> jax.Array:
     """Flip column signs so each column's largest-|entry| element is positive.
 

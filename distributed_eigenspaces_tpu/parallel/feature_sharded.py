@@ -199,6 +199,13 @@ def _make_matvec(x, n_total_rows, collectives="xla", compute_dtype=None):
     the same wire shape as the reference's JSON eigenspace messages,
     ``distributed.py:51``, but over ICI). ``compute_dtype`` (bf16) runs the
     two tall-skinny contractions at full MXU rate with fp32 accumulation.
+
+    The two-einsum schedule is deliberate: a hand-fused one-pass Pallas
+    kernel for the trivial-features-axis case measured 1.35x faster in
+    ISOLATION at the d=12288 shape yet 35% SLOWER at the step level (XLA
+    pipelines the two matmuls against the step's neighboring ops better
+    than an opaque kernel call allows) and was deleted — round-4 A/B,
+    BASELINE.md "Negative result: fused matvec kernel".
     """
     if compute_dtype is None and jnp.issubdtype(x.dtype, jnp.integer):
         # integer einsums accumulate in the integer dtype and wrap

@@ -45,30 +45,31 @@ from distributed_eigenspaces_tpu.parallel.mesh import (
 
 
 def _batched_streaming_eigenspaces(
-    x: jax.Array, k: int, iters: int, orth: str, v0, fused: bool
+    x: jax.Array, k: int, iters: int, orth: str, v0
 ):
     """Streaming per-worker subspace solves on the full (m, n, d) stack.
 
-    Only the MATVEC is batched natively (no ``jax.vmap``): the fused Pallas
-    kernel must own the worker axis as a grid dimension, because vmapping a
-    reduction kernel silently re-targets its zero-init ``program_id`` (see
-    ops/pallas_xtxv.py). The orthonormalization and Rayleigh-Ritz steps are
-    plain XLA and reuse the canonical single-worker implementations
-    (``linalg.orthonormalize`` / ``linalg.rayleigh_ritz``) under ``vmap`` —
-    one definition of the numerics, including method validation.
+    The matvec is :func:`~..ops.linalg.batched_xtxv` — the two-einsum
+    schedule XLA pipelines best (a hand-fused Pallas alternative was
+    measured end-to-end slower on every config and deleted in round 4;
+    see batched_xtxv's docstring + BASELINE.md). The orthonormalization
+    and Rayleigh-Ritz steps reuse the canonical single-worker
+    implementations (``linalg.orthonormalize`` / ``linalg.rayleigh_ritz``)
+    under ``vmap`` — one definition of the numerics, including method
+    validation.
     """
     from distributed_eigenspaces_tpu.ops.linalg import (
+        batched_xtxv,
         orthonormalize,
         rayleigh_ritz,
     )
-    from distributed_eigenspaces_tpu.ops.pallas_xtxv import xtxv_auto
 
     m, n, d = x.shape
     orthonormalize(jnp.zeros((2, 1)), orth)  # validate method eagerly
     orth_b = jax.vmap(lambda v: orthonormalize(v, orth))
 
     def mv(vs):  # (m, d, k) -> (m, d, k)
-        return xtxv_auto(x, vs, fused=fused) / n
+        return batched_xtxv(x, vs) / n
 
     if v0 is None:
         v0 = jax.random.normal(jax.random.PRNGKey(0), (d, k), jnp.float32)
@@ -89,7 +90,6 @@ def _local_eigenspaces(
     orth: str = "cholqr2",
     compute_dtype=None,
     v0: jax.Array | None = None,
-    fused_xtxv: bool | None = None,
 ):
     """Per-worker ``V_hat``: ``(m, n, d) -> (m, d, k)`` (vmapped C8 -> C7).
 
@@ -100,21 +100,13 @@ def _local_eigenspaces(
     stays fp32 either way. ``v0`` (d, k) warm-starts every worker's subspace
     iteration (online steps: the previous merged estimate is an excellent
     initializer, so far fewer iterations are needed); ignored by the eigh
-    solver. ``fused_xtxv`` opts the streaming branch into the fused Pallas
-    matvec (resolved through :func:`~..ops.pallas_xtxv.resolve_fused`:
-    ``DET_NO_PALLAS=1`` vetoes unconditionally, else an explicit value wins,
-    else ``DET_FUSED_XTXV=1`` — callers that jit resolve at build time, as
-    WorkerPool and make_round_core do, so a later env change can't be
-    masked by the jit cache).
+    solver.
     """
     import os
 
     from distributed_eigenspaces_tpu.ops.pallas_gram import gram_auto
 
-    from distributed_eigenspaces_tpu.ops.pallas_xtxv import resolve_fused
-
     use_pallas = os.environ.get("DET_NO_PALLAS", "0") != "1"
-    fused_xtxv = resolve_fused(fused_xtxv)
 
     if jnp.issubdtype(x_blocks.dtype, jnp.integer):
         # quantized wire blocks (bin_stream int8 passthrough): integer
@@ -146,9 +138,7 @@ def _local_eigenspaces(
             if compute_dtype is not None
             else x_blocks
         )
-        return _batched_streaming_eigenspaces(
-            xall, k, iters, orth, v0, fused_xtxv
-        )
+        return _batched_streaming_eigenspaces(xall, k, iters, orth, v0)
 
     def one(xb):
         if compute_dtype is not None:
@@ -221,7 +211,6 @@ class WorkerPool:
         subspace_iters: int = 16,
         orth_method: str = "cholqr2",
         compute_dtype=None,
-        fused_xtxv: bool | None = None,
     ):
         if backend == "tpu":
             # the north star's `backend="tpu"` selector (BASELINE.json):
@@ -237,12 +226,6 @@ class WorkerPool:
         self.subspace_iters = subspace_iters
         self.orth_method = orth_method
         self.compute_dtype = compute_dtype
-        # resolved ONCE at build time (the round fn is jitted; an env read
-        # under jit would be frozen by the trace cache anyway — this makes
-        # the when-it-is-read contract explicit). DET_NO_PALLAS vetoes.
-        from distributed_eigenspaces_tpu.ops.pallas_xtxv import resolve_fused
-
-        self.fused_xtxv = resolve_fused(fused_xtxv)
         if backend == "shard_map":
             if mesh is None:
                 n_dev = len(jax.devices())
@@ -265,7 +248,6 @@ class WorkerPool:
                 iters=self.subspace_iters,
                 orth=self.orth_method,
                 compute_dtype=self.compute_dtype,
-                fused_xtxv=self.fused_xtxv,
             ),
             static_argnames=("k",),
         )
@@ -318,7 +300,6 @@ class WorkerPool:
     def _build_round(self):
         solver, iters = self.solver, self.subspace_iters
         orth, cdtype = self.orth_method, self.compute_dtype
-        fused = self.fused_xtxv
 
         def merge(vs, mask, k):
             """Masked mean projector + its EXACT top-k from the factors.
@@ -339,7 +320,7 @@ class WorkerPool:
                 vs = _local_eigenspaces(
                     x_blocks, k, solver,
                     iters if step_iters is None else step_iters,
-                    orth, cdtype, v0=v0, fused_xtxv=fused,
+                    orth, cdtype, v0=v0,
                 )
                 return merge(vs, mask, k)
 
@@ -355,7 +336,7 @@ class WorkerPool:
                 vs = _local_eigenspaces(
                     xs, k, solver,
                     iters if step_iters is None else step_iters,
-                    orth, cdtype, v0=v0_s, fused_xtxv=fused,
+                    orth, cdtype, v0=v0_s,
                 )
                 # ICI gather of the d x k factors — the entire reference
                 # wire protocol (C11) collapses to these two lines, moving

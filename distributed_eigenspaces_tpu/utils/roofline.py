@@ -117,6 +117,144 @@ def measure_matmul_anchor(size: int = 2048, chain: int = 100) -> float:
     return (chain * 2 * size**3) / dt / 1e12
 
 
+def step_byte_model(
+    m: int,
+    n: int,
+    d: int,
+    k: int,
+    cold_iters: int,
+    warm_iters: int | None,
+    itemsize: int = 2,
+) -> dict:
+    """Dominant-term HBM bytes per online step for the subspace trainers,
+    following the SAME route dispatch as :func:`step_flop_model` (and the
+    actual solver, ``worker_pool.py``): the streaming route re-reads the
+    (m, n, d) block TWICE per solver iteration (the two tall-skinny
+    passes of ``X^T (X v)``); the Gram route reads the block once to
+    build the d x d Gram (fp32, one write) and then reads that Gram once
+    per matvec iteration. k-width bases/Grams are O(d*k) — <5% at every
+    BASELINE config — and excluded. The byte twin of
+    :func:`step_flop_model`, and the machine-readable reason an
+    HBM-bound config cannot approach the FLOP anchor: its ceiling is
+    the measured HBM rate instead.
+    """
+    block = m * n * d * itemsize
+
+    def per_step(iters: int) -> int:
+        streams = d >= 4096 or (2 * k * iters < d and iters <= 6)
+        if streams:
+            return block * 2 * iters
+        return block + m * (1 + iters) * d * d * 4  # Gram is fp32
+
+    return {
+        "cold_bytes_per_step": per_step(cold_iters),
+        "warm_bytes_per_step": (
+            per_step(warm_iters) if warm_iters is not None
+            else per_step(cold_iters)
+        ),
+    }
+
+
+def measure_hbm_anchor(
+    mb: int | None = None, base: int | None = None, ratio: int = 2,
+    small: bool = False,
+) -> float:
+    """Measured achievable HBM streaming rate (GB/s, read+write counted):
+    a dependent chain of whole-array adds over an ``mb``-MB fp32 buffer,
+    two chain lengths differenced so dispatch/launch/fence cancel — the
+    bandwidth twin of :func:`measure_matmul_anchor`. Each link reads and
+    writes the buffer once: 2 * mb MB of traffic per link. ``small=True``
+    is the ONE definition of the CI-shrunk preset (shared by bench.py
+    and evals.py so their anchors stay comparable)."""
+    import jax
+    import jax.numpy as jnp
+
+    if mb is None:
+        mb = 32 if small else 256
+    if base is None:
+        base = 6 if small else 24
+    n = mb * (1 << 20) // 4
+    x = jnp.zeros((n,), jnp.float32)
+
+    def make(count):
+        def f(x0):
+            def body(acc, _):
+                return acc + 1.0, None
+
+            out, _ = jax.lax.scan(body, x0, None, length=count)
+            return out
+
+        return jax.jit(f)
+
+    def timed(count):
+        f = make(count)
+        float(jnp.sum(f(x)[:2]))  # compile + warm
+        best = float("inf")
+        for s in (1.0, 2.0, 3.0):  # fresh operands: defeat result caching
+            t0 = time.perf_counter()
+            float(jnp.sum(f(x + s)[:2]))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    dt = (timed(base * ratio) - timed(base)) / (base * (ratio - 1))
+    if dt <= 0:
+        return float("nan")
+    return 2 * mb * (1 << 20) / dt / 1e9
+
+
+def measure_seq_chol_latency(
+    k: int, d: int, base: int = 2400, ratio: int = 2
+) -> float:
+    """Measured per-pair latency (seconds) of a DEPENDENT Cholesky +
+    triangular-solve chain at the solver's shapes — the sequential ops a
+    CholeskyQR2 iteration serializes on (each lowers to a long scalar
+    chain the MXU can't help with; this is the op-latency wall that makes
+    the warm step latency-bound rather than FLOP-bound). Two chain
+    lengths differenced, so dispatch/launch/fence cancel — the same
+    methodology as the marginal step times it explains.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def make(count):
+        def f(g, v):
+            def body(carry, _):
+                gg, vv = carry
+                r = jnp.linalg.cholesky(
+                    gg + 1e-3 * jnp.eye(gg.shape[0], dtype=gg.dtype)
+                )
+                vv = jax.lax.linalg.triangular_solve(
+                    r, vv, left_side=False, lower=True, transpose_a=True
+                )
+                gg = vv.T @ vv + jnp.eye(gg.shape[0], dtype=gg.dtype)
+                return (gg, vv), None
+
+            (_, vv), _ = jax.lax.scan(body, (g, v), None, length=count)
+            return vv
+
+        return jax.jit(f)
+
+    g = jnp.eye(k, dtype=jnp.float32) * 2.0
+    v = jax.random.normal(jax.random.PRNGKey(2), (d, k), jnp.float32)
+
+    def timed(count):
+        f = make(count)
+        float(jnp.sum(f(g, v)))  # compile + warm
+        best = float("inf")
+        # fresh operands each rep: defeat result caching; min-of-3 rides
+        # out tunnel jitter (the chain is long enough that the min is
+        # dominated by the device, not the link)
+        for s in (1e-4, 2e-4, 3e-4):
+            t0 = time.perf_counter()
+            float(jnp.sum(f(g + s, v)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return max(
+        (timed(base * ratio) - timed(base)) / (base * (ratio - 1)), 0.0
+    )
+
+
 def roofline_fields(
     model: dict,
     *,
@@ -125,6 +263,8 @@ def roofline_fields(
     warm_seconds_per_step: float | None = None,
     cold_seconds: float | None = None,
     anchor_tflops: float | None = None,
+    byte_model: dict | None = None,
+    hbm_anchor_gbps: float | None = None,
 ) -> dict:
     """Assemble the JSON roofline block from a flop model + measured times.
 
@@ -132,7 +272,14 @@ def roofline_fields(
     differenced) so dispatch and the cold step cancel; when given, the
     warm-phase achieved TF/s and percent-of-anchor are emitted. All rates
     derive from MODEL flops — stated dominant-term counts, not hardware
-    counters."""
+    counters.
+
+    ``byte_model`` + ``hbm_anchor_gbps`` (:func:`step_byte_model` /
+    :func:`measure_hbm_anchor`) add the BANDWIDTH roofline: achieved
+    GB/s against the measured HBM rate, plus ``bound`` — "hbm" when the
+    achieved fraction of the HBM anchor exceeds the FLOP one (the
+    machine-reported reason such a config cannot approach the matmul
+    anchor: its ceiling is memory, round-3 verdict item 1)."""
     total = fit_total_flops(model, steps)
     out = {
         "cold_flops_per_step": int(model["cold_flops_per_step"]),
@@ -145,6 +292,24 @@ def roofline_fields(
         out["pct_of_anchor"] = round(
             100.0 * (total / fit_seconds / 1e12) / anchor_tflops, 2
         )
+    if byte_model is not None:
+        bytes_total = byte_model["cold_bytes_per_step"] + max(
+            steps - 1, 0
+        ) * byte_model["warm_bytes_per_step"]
+        gbps = bytes_total / fit_seconds / 1e9
+        out["model_bytes_total"] = int(bytes_total)
+        out["achieved_gb_per_sec"] = round(gbps, 1)
+        if hbm_anchor_gbps is not None and hbm_anchor_gbps == hbm_anchor_gbps:
+            out["hbm_anchor_gb_per_sec"] = round(hbm_anchor_gbps, 1)
+            out["pct_of_hbm_anchor"] = round(
+                100.0 * gbps / hbm_anchor_gbps, 2
+            )
+            if "pct_of_anchor" in out:
+                out["bound"] = (
+                    "hbm"
+                    if out["pct_of_hbm_anchor"] > out["pct_of_anchor"]
+                    else "mxu-or-latency"
+                )
     if warm_seconds_per_step is not None and warm_seconds_per_step > 0:
         warm_tf = model["warm_flops_per_step"] / warm_seconds_per_step / 1e12
         out["warm_ms_per_step"] = round(warm_seconds_per_step * 1e3, 4)

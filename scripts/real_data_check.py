@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Opt-in real-data integration check (round-3 verdict item: turn the
+evals' "synthetic stand-in" caveat into a choice, not the only path).
+
+Fetches CIFAR-10 (python pickles) and/or MNIST (IDX) into ``--data-dir``,
+verifies checksums, then runs the matching BASELINE configs (1: cifar10,
+3: mnist784) through the eval harness ON THE REAL DATA and asserts the
+reports say ``"data": "real"``. One JSON line per config, like
+``det-pca-evals``.
+
+Zero-egress environments: downloads fail fast with a clear message and
+exit code 3 (distinct from an accuracy failure, 1); ``--offline`` skips
+fetching and only checks what is already on disk. Already-downloaded
+archives are verified and reused, so the fetch is idempotent.
+
+The reference's data story is "the CIFAR pickles sit next to the scripts"
+(``load_data.py:6``; the committed copies are stripped upstream —
+``.MISSING_LARGE_BLOBS``) — this script is the reproducible version of
+that arrangement.
+
+Usage::
+
+    python scripts/real_data_check.py --data-dir ~/det-data [cifar10 mnist784]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tarfile
+import urllib.error
+import urllib.request
+
+CIFAR_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+CIFAR_MD5 = "c58f30108f718f92721af3b95e74349a"  # published on the page
+# ossci-datasets is the maintained mirror of Yann LeCun's originals
+MNIST_BASE = "https://ossci-datasets.s3.amazonaws.com/mnist"
+MNIST_FILES = {
+    # file -> md5 (the canonical values the torchvision loader pins)
+    "train-images-idx3-ubyte.gz": "f68b3c2dcbeaaa9fbdd348bbdeb94873",
+    "train-labels-idx1-ubyte.gz": "d53e105ee54ea40749a09fcbcd1e9432",
+}
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fetch(url: str, dst: str, md5: str | None) -> None:
+    if os.path.exists(dst) and (md5 is None or _md5(dst) == md5):
+        print(f"# reusing {dst}", file=sys.stderr)
+        return
+    print(f"# fetching {url}", file=sys.stderr)
+    tmp = dst + ".part"
+    urllib.request.urlretrieve(url, tmp)  # noqa: S310 (https, pinned hosts)
+    if md5 is not None and _md5(tmp) != md5:
+        os.unlink(tmp)
+        raise RuntimeError(f"checksum mismatch for {url}")
+    os.replace(tmp, dst)
+
+
+def prepare_cifar10(data_dir: str, offline: bool) -> str:
+    """Ensure ``cifar-10-batches-py/`` exists under data_dir; return it."""
+    out = os.path.join(data_dir, "cifar-10-batches-py")
+    if os.path.isdir(out) and any(
+        n.startswith("data_batch") for n in os.listdir(out)
+    ):
+        return out
+    if offline:
+        raise FileNotFoundError(f"{out} missing and --offline set")
+    arc = os.path.join(data_dir, "cifar-10-python.tar.gz")
+    _fetch(CIFAR_URL, arc, CIFAR_MD5)
+    with tarfile.open(arc, "r:gz") as t:
+        t.extractall(data_dir, filter="data")
+    return out
+
+
+def prepare_mnist(data_dir: str, offline: bool) -> str:
+    """Ensure the MNIST train IDX files exist (decompressed); return dir."""
+    import gzip
+    import shutil
+
+    out = os.path.join(data_dir, "mnist")
+    os.makedirs(out, exist_ok=True)
+    for name, md5 in MNIST_FILES.items():
+        raw = os.path.join(out, name[: -len(".gz")])
+        if os.path.exists(raw):
+            continue
+        if offline:
+            raise FileNotFoundError(f"{raw} missing and --offline set")
+        gz = os.path.join(out, name)
+        _fetch(f"{MNIST_BASE}/{name}", gz, md5)
+        with gzip.open(gz, "rb") as f_in, open(raw + ".part", "wb") as f_out:
+            shutil.copyfileobj(f_in, f_out)
+        os.replace(raw + ".part", raw)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    p.add_argument("configs", nargs="*", default=[],
+                   help="cifar10 and/or mnist784 (default: both)")
+    p.add_argument("--data-dir", default="det-data",
+                   help="where archives + extracted datasets live")
+    p.add_argument("--offline", action="store_true",
+                   help="never fetch; use (and require) what's on disk")
+    p.add_argument("--steps", type=int, default=None,
+                   help="override the config's step count (quick checks)")
+    args = p.parse_args(argv)
+
+    names = args.configs or ["cifar10", "mnist784"]
+    bad = set(names) - {"cifar10", "mnist784"}
+    if bad:
+        print(f"error: real-data configs are cifar10/mnist784, got {bad}",
+              file=sys.stderr)
+        return 2
+    os.makedirs(args.data_dir, exist_ok=True)
+
+    prep = {"cifar10": prepare_cifar10, "mnist784": prepare_mnist}
+    dirs = {}
+    for name in names:
+        try:
+            dirs[name] = prep[name](args.data_dir, args.offline)
+        except (urllib.error.URLError, OSError, RuntimeError) as e:
+            print(
+                f"error: could not obtain real data for {name}: {e}\n"
+                "(no network egress? re-run where downloads work, or "
+                "place the files under --data-dir and pass --offline)",
+                file=sys.stderr,
+            )
+            return 3
+
+    from distributed_eigenspaces_tpu.evals import run_eval
+
+    ok = True
+    for name in names:
+        over = {} if args.steps is None else {"steps": args.steps}
+        rep = run_eval(name, data_dir=dirs[name], **over)
+        print(json.dumps(rep))
+        if rep["data"] != "real":
+            # the whole point of this script — never silently fall back
+            print(f"error: {name} fell back to synthetic data "
+                  f"(dir: {dirs[name]})", file=sys.stderr)
+            ok = False
+        # real-data gate: uncentered real covariances are dominated by
+        # the mean direction, so the planted-subspace <=1 degree gate
+        # does not apply — require a finite sane angle instead (the same
+        # criterion tests/test_evals.py::test_mnist784_real_data pins)
+        if not (0.0 <= rep["principal_angle_deg"] <= 90.0):
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,10 +9,18 @@ import numpy as np
 from distributed_eigenspaces_tpu.evals import EVAL_SPECS, run_eval
 
 
-def test_all_five_baseline_configs_registered():
+def test_all_baseline_configs_registered():
     assert sorted(EVAL_SPECS) == [
-        "cifar10", "clip768", "imagenet12288", "mnist784", "synthetic1024",
+        "cifar10", "clip768", "clip768_chip", "imagenet12288", "mnist784",
+        "synthetic1024",
     ]
+    # the chip-rate companion must mirror config 5's shapes exactly —
+    # the whole point is same-workload comparability
+    a, b = EVAL_SPECS["clip768"], EVAL_SPECS["clip768_chip"]
+    assert (a.dim, a.k, a.num_workers, a.rows_per_worker) == (
+        b.dim, b.k, b.num_workers, b.rows_per_worker
+    )
+    assert b.streaming == "memory" and b.trainer == "scan"
     # published sizes match BASELINE.md
     assert (EVAL_SPECS["cifar10"].dim, EVAL_SPECS["cifar10"].k) == (3072, 10)
     assert (EVAL_SPECS["synthetic1024"].dim,
@@ -120,3 +128,11 @@ def test_eval_reports_timing_statistics():
     rep = run_eval("clip768", dim=64, k=8, subspace_iters=12,
                    rows_per_worker=128, steps=3, repeats=2)
     assert rep["timing"]["n_repeats"] == 2
+
+
+def test_clip768_chip_companion_small():
+    rep = run_eval("clip768_chip", dim=64, k=8, subspace_iters=16,
+                   rows_per_worker=128, steps=4)
+    _check(rep)
+    assert rep["streaming"] == "memory"
+    assert rep["trainer"] == "scan"

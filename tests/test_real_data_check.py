@@ -1,0 +1,57 @@
+"""Opt-in real-data integration script (scripts/real_data_check.py).
+
+CI has no network egress, so these tests exercise the offline contract:
+real files on disk run the real-data eval path end-to-end (the report
+must say ``"data": "real"`` — never a silent synthetic fallback), and
+missing files fail fast with the distinct exit code 3.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(_ROOT, "scripts", "real_data_check.py")
+
+
+def _run(*args):
+    env = dict(
+        os.environ, PYTHONPATH=_ROOT, JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    return subprocess.run(
+        [sys.executable, _SCRIPT, *args],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+
+
+def test_offline_mnist_runs_on_real_data(tmp_path, rng):
+    from distributed_eigenspaces_tpu.data.mnist import write_idx
+
+    d = tmp_path / "mnist"
+    d.mkdir()
+    write_idx(str(d / "train-images-idx3-ubyte"),
+              rng.integers(0, 256, (16384, 28, 28), dtype=np.uint8))
+    write_idx(str(d / "train-labels-idx1-ubyte"),
+              rng.integers(0, 10, (16384,), dtype=np.uint8))
+    r = _run("mnist784", "--data-dir", str(tmp_path), "--offline",
+             "--steps", "2")
+    assert r.returncode == 0, r.stderr[-1500:]
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["data"] == "real"
+    assert rep["dim"] == 784
+    assert 0.0 <= rep["principal_angle_deg"] <= 90.0
+
+
+def test_offline_missing_data_exits_3(tmp_path):
+    r = _run("cifar10", "--data-dir", str(tmp_path), "--offline")
+    assert r.returncode == 3
+    assert "could not obtain" in r.stderr
+
+
+def test_unknown_config_rejected(tmp_path):
+    r = _run("imagenet12288", "--data-dir", str(tmp_path), "--offline")
+    assert r.returncode == 2

@@ -95,3 +95,22 @@ def test_warm_model_takes_gram_route_at_small_d_large_k():
     assert model["warm_flops_per_step"] == m * (
         2 * n * d * d + 2 * 2 * d * d * k
     )
+
+
+def test_byte_model_route_matches_flop_model():
+    """The byte model must take the SAME route the flop model (and the
+    real solver dispatch) takes — round-4 review: the k=256 configs warm
+    on the GRAM route, and a streaming-only byte formula overcounted
+    their traffic 4x (inflating pct_of_hbm_anchor on exactly the config
+    the bandwidth roofline exists to keep honest)."""
+    from distributed_eigenspaces_tpu.utils.roofline import step_byte_model
+
+    # clip768 shapes: 2*k*warm_iters = 1024 >= d = 768 -> Gram route
+    m, n, d, k = 8, 2048, 768, 256
+    b = step_byte_model(m, n, d, k, 8, 2, itemsize=2)
+    block = m * n * d * 2
+    assert b["warm_bytes_per_step"] == block + m * 3 * d * d * 4
+    # imagenet12288 shapes: large d -> streaming route, 2 passes/iter
+    b2 = step_byte_model(4, 2048, 12288, 50, 12, 1, itemsize=2)
+    assert b2["warm_bytes_per_step"] == 2 * 4 * 2048 * 12288 * 2
+    assert b2["cold_bytes_per_step"] == 24 * 4 * 2048 * 12288 * 2
