@@ -276,10 +276,12 @@ def roofline_fields(
 
     ``byte_model`` + ``hbm_anchor_gbps`` (:func:`step_byte_model` /
     :func:`measure_hbm_anchor`) add the BANDWIDTH roofline: achieved
-    GB/s against the measured HBM rate, plus ``bound`` — "hbm" when the
-    achieved fraction of the HBM anchor exceeds the FLOP one (the
-    machine-reported reason such a config cannot approach the matmul
-    anchor: its ceiling is memory, round-3 verdict item 1)."""
+    GB/s against the measured HBM rate, plus ``bound`` — the
+    machine-reported reason a config sits where it does (round-3 verdict
+    item 1): "hbm" / "mxu" when the achieved fraction of that anchor
+    exceeds half the roof, else "latency" (neither resource near its
+    roof: the time goes to sequential small-op chains / dispatch — the
+    regime the warm-start and sketch designs attack)."""
     total = fit_total_flops(model, steps)
     out = {
         "cold_flops_per_step": int(model["cold_flops_per_step"]),
@@ -305,11 +307,15 @@ def roofline_fields(
                 100.0 * gbps / hbm_anchor_gbps, 2
             )
             if "pct_of_anchor" in out:
-                out["bound"] = (
-                    "hbm"
-                    if out["pct_of_hbm_anchor"] > out["pct_of_anchor"]
-                    else "mxu-or-latency"
+                hbm_pct, flop_pct = (
+                    out["pct_of_hbm_anchor"], out["pct_of_anchor"],
                 )
+                if hbm_pct >= 50 and hbm_pct >= flop_pct:
+                    out["bound"] = "hbm"
+                elif flop_pct >= 50:
+                    out["bound"] = "mxu"
+                else:
+                    out["bound"] = "latency"
     if warm_seconds_per_step is not None and warm_seconds_per_step > 0:
         warm_tf = model["warm_flops_per_step"] / warm_seconds_per_step / 1e12
         out["warm_ms_per_step"] = round(warm_seconds_per_step * 1e3, 4)

@@ -59,7 +59,17 @@ def _fetch(url: str, dst: str, md5: str | None) -> None:
         return
     print(f"# fetching {url}", file=sys.stderr)
     tmp = dst + ".part"
-    urllib.request.urlretrieve(url, tmp)  # noqa: S310 (https, pinned hosts)
+    # bounded socket timeout: a blackholed egress (packets dropped, not
+    # refused) must still reach the exit-3 path instead of hanging —
+    # the timeout governs each socket op, so slow-but-alive downloads
+    # of the 160 MB CIFAR archive are not cut off
+    with urllib.request.urlopen(url, timeout=30) as r:  # noqa: S310
+        with open(tmp, "wb") as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
     if md5 is not None and _md5(tmp) != md5:
         os.unlink(tmp)
         raise RuntimeError(f"checksum mismatch for {url}")
@@ -93,10 +103,15 @@ def prepare_mnist(data_dir: str, offline: bool) -> str:
         raw = os.path.join(out, name[: -len(".gz")])
         if os.path.exists(raw):
             continue
-        if offline:
-            raise FileNotFoundError(f"{raw} missing and --offline set")
         gz = os.path.join(out, name)
-        _fetch(f"{MNIST_BASE}/{name}", gz, md5)
+        if not os.path.exists(gz):
+            # decompressing an already-present archive needs no network,
+            # so --offline only forbids the fetch itself
+            if offline:
+                raise FileNotFoundError(
+                    f"{raw} (or {gz}) missing and --offline set"
+                )
+            _fetch(f"{MNIST_BASE}/{name}", gz, md5)
         with gzip.open(gz, "rb") as f_in, open(raw + ".part", "wb") as f_out:
             shutil.copyfileobj(f_in, f_out)
         os.replace(raw + ".part", raw)

@@ -55,3 +55,31 @@ def test_offline_missing_data_exits_3(tmp_path):
 def test_unknown_config_rejected(tmp_path):
     r = _run("imagenet12288", "--data-dir", str(tmp_path), "--offline")
     assert r.returncode == 2
+
+
+def test_offline_accepts_gz_archives(tmp_path, rng):
+    """--offline must accept pre-placed .gz archives (decompression needs
+    no network) — the script's own error message tells users to do
+    exactly this."""
+    import gzip
+
+    from distributed_eigenspaces_tpu.data.mnist import write_idx
+
+    d = tmp_path / "mnist"
+    d.mkdir()
+    raw = tmp_path / "raw"
+    raw.mkdir()
+    write_idx(str(raw / "train-images-idx3-ubyte"),
+              rng.integers(0, 256, (16384, 28, 28), dtype=np.uint8))
+    write_idx(str(raw / "train-labels-idx1-ubyte"),
+              rng.integers(0, 10, (16384,), dtype=np.uint8))
+    for n in ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"):
+        with open(raw / n, "rb") as f_in, gzip.open(
+            d / (n + ".gz"), "wb"
+        ) as f_out:
+            f_out.write(f_in.read())
+    r = _run("mnist784", "--data-dir", str(tmp_path), "--offline",
+             "--steps", "2")
+    assert r.returncode == 0, r.stderr[-1500:]
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["data"] == "real"

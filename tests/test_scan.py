@@ -490,3 +490,35 @@ def test_cli_sketch_rejects_dense_checkpoint(tmp_path):
         "--trainer", "sketch", "--backend", "feature_sharded",
         "--checkpoint-dir", str(tmp_path / "ck"), "--resume",
     ]) == 2
+
+
+def test_resolved_warm_start_one_definition():
+    """'auto' = the measured optimum (2) iff the subspace solver is in
+    play; None disables; explicit ints pass through; eigh never warms
+    (round-3 verdict item 4 — ONE resolution for every dispatch site)."""
+    base = PCAConfig(dim=32, k=2, solver="subspace")
+    assert base.warm_start_iters == "auto"  # the default
+    assert base.resolved_warm_start() == 2
+    assert base.replace(warm_start_iters=None).resolved_warm_start() is None
+    assert base.replace(warm_start_iters=4).resolved_warm_start() == 4
+    assert base.replace(solver="eigh").resolved_warm_start() is None
+    with pytest.raises(ValueError, match="warm_start_iters"):
+        PCAConfig(dim=32, k=2, warm_start_iters="sometimes")
+
+
+def test_cli_warm_start_mapping(capsys):
+    """CLI: unset -> 'auto' (the fast default), 0 -> disabled, int -> int;
+    a positive count still demands the iterative solver."""
+    from distributed_eigenspaces_tpu.cli import main
+
+    # 0 (disable) is accepted with any solver: exercises the mapping via
+    # a tiny synthetic fit
+    rc = main(["--data", "synthetic", "--dim", "32", "--rank", "2",
+               "--workers", "2", "--rows-per-worker", "16", "--steps", "2",
+               "--warm-start-iters", "0"])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["--data", "synthetic", "--dim", "32", "--rank", "2",
+               "--warm-start-iters", "3"])  # eigh solver -> loud error
+    assert rc == 2
+    assert "subspace" in capsys.readouterr().err
