@@ -951,10 +951,7 @@ def make_feature_sharded_sketch_fit(
 
         return sharded_fit
 
-    def sharded_fit_masked(state, blocks, idx, masks):
-        omega = _omega(state.y.shape[0])
-        state = cold_step(state, blocks[idx[0]], omega, masks[0])
-
+    def _masked_cond_body(blocks, omega):
         def body(st, im):
             i, mk = im
             # the carry stays all-zero until a cold step has SUCCEEDED
@@ -970,7 +967,27 @@ def make_feature_sharded_sketch_fit(
             )
             return st_next, None
 
-        state, _ = jax.lax.scan(body, state, (idx[1:], masks[1:]))
+        return body
+
+    def sharded_fit_masked(state, blocks, idx, masks):
+        omega = _omega(state.y.shape[0])
+        state = cold_step(state, blocks[idx[0]], omega, masks[0])
+        state, _ = jax.lax.scan(
+            _masked_cond_body(blocks, omega), state,
+            (idx[1:], masks[1:]),
+        )
+        return state
+
+    def sharded_fit_masked_windowed(state, blocks, idx, masks):
+        """One program for EVERY masked window, first or continuation:
+        the cond body dispatches cold-vs-warm per step on the carry
+        itself, so a restored checkpoint resumes bit-for-bit (the
+        unkilled windowed run took the same per-step branches — no
+        unconditional cold step to diverge on)."""
+        omega = _omega(state.y.shape[0])
+        state, _ = jax.lax.scan(
+            _masked_cond_body(blocks, omega), state, (idx, masks)
+        )
         return state
 
     def sharded_extract(state):
@@ -992,26 +1009,33 @@ def make_feature_sharded_sketch_fit(
     masks_spec = P(None, WORKER_AXIS)
     masks_sharding = NamedSharding(mesh, masks_spec)
 
-    _get, fit_windows = _windowed_whole_fit(
+    _get, fit_windows_unmasked = _windowed_whole_fit(
         mesh, make_sharded_fit, key_of_first=lambda first: first,
         blocks_spec=blocks_spec, blocks_sharding=blocks_sharding,
         state_specs=state_specs, state_shardings=state_shardings,
         carry_leaf=lambda st: st.v,  # the warm basis
     )
-    fused_masked = checked_jit(
-        jax.shard_map(
-            sharded_fit_masked,
-            mesh=mesh,
-            in_specs=(state_specs, blocks_spec, P(), masks_spec),
-            out_specs=state_specs,
-            check_vma=False,
-        ),
-        in_shardings=(
-            state_shardings, blocks_sharding, NamedSharding(mesh, P()),
-            masks_sharding,
-        ),
-        out_shardings=state_shardings,
-    )
+
+    def _compile_masked(fn):
+        return checked_jit(
+            jax.shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=(state_specs, blocks_spec, P(), masks_spec),
+                out_specs=state_specs,
+                check_vma=False,
+            ),
+            in_shardings=(
+                state_shardings, blocks_sharding,
+                NamedSharding(mesh, P()), masks_sharding,
+            ),
+            out_shardings=state_shardings,
+        )
+
+    fused_masked = _compile_masked(sharded_fit_masked)
+    # jax.jit defers tracing/compilation to the first call, so binding
+    # here costs nothing for callers that never pass masks
+    masked_windowed = _compile_masked(sharded_fit_masked_windowed)
 
     def fit(state, blocks, idx, worker_masks=None):
         if worker_masks is None:
@@ -1021,7 +1045,33 @@ def make_feature_sharded_sketch_fit(
         )
         return fused_masked(state, blocks, idx, worker_masks)
 
-    fit.fit_windows = fit_windows  # windowed (unmasked) checkpointable fit
+    def fit_windows(state, windows, on_segment=None, worker_masks=None):
+        """Windowed checkpointable fit; ``worker_masks`` (an iterable of
+        ``(S, m)`` {0,1} arrays parallel to ``windows``) adds the §5.3
+        fault machinery to the long checkpointed runs: each masked
+        window runs the one cond-dispatch program (cold while the carry
+        is zero / after an all-masked wipeout, warm otherwise), so
+        kill/resume stays bit-for-bit — the per-step branch depends only
+        on the restored carry. Unmasked calls keep the plain first/
+        continuation programs (no cond, no mask algebra)."""
+        if worker_masks is None:
+            return fit_windows_unmasked(state, windows, on_segment)
+        # strict: a mask stream shorter than the windows would otherwise
+        # silently DROP the trailing data windows (and vice versa)
+        for w, mk in zip(windows, worker_masks, strict=True):
+            blocks_w = jax.device_put(w, blocks_sharding)
+            steps = int(blocks_w.shape[0])
+            mk = jax.device_put(
+                jnp.asarray(mk, jnp.float32), masks_sharding
+            )
+            state = masked_windowed(
+                state, blocks_w, jnp.arange(steps, dtype=jnp.int32), mk
+            )
+            if on_segment is not None:
+                on_segment(int(state.step), state)
+        return state
+
+    fit.fit_windows = fit_windows
     fit.init_state = _jit_init(
         lambda: SketchState.initial(d, k, p), state_shardings
     )

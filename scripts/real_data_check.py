@@ -104,13 +104,16 @@ def prepare_mnist(data_dir: str, offline: bool) -> str:
         if os.path.exists(raw):
             continue
         gz = os.path.join(out, name)
-        if not os.path.exists(gz):
+        if offline:
             # decompressing an already-present archive needs no network,
             # so --offline only forbids the fetch itself
-            if offline:
+            if not os.path.exists(gz):
                 raise FileNotFoundError(
                     f"{raw} (or {gz}) missing and --offline set"
                 )
+        else:
+            # unconditional: _fetch reuses a checksum-valid file and
+            # re-downloads a truncated/corrupt one
             _fetch(f"{MNIST_BASE}/{name}", gz, md5)
         with gzip.open(gz, "rb") as f_in, open(raw + ".part", "wb") as f_out:
             shutil.copyfileobj(f_in, f_out)
@@ -143,7 +146,9 @@ def main(argv=None) -> int:
     for name in names:
         try:
             dirs[name] = prep[name](args.data_dir, args.offline)
-        except (urllib.error.URLError, OSError, RuntimeError) as e:
+        # EOFError: gzip raises it on a truncated pre-placed archive
+        except (urllib.error.URLError, OSError, RuntimeError,
+                EOFError) as e:
             print(
                 f"error: could not obtain real data for {name}: {e}\n"
                 "(no network egress? re-run where downloads work, or "

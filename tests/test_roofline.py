@@ -114,3 +114,39 @@ def test_byte_model_route_matches_flop_model():
     b2 = step_byte_model(4, 2048, 12288, 50, 12, 1, itemsize=2)
     assert b2["warm_bytes_per_step"] == 2 * 4 * 2048 * 12288 * 2
     assert b2["cold_bytes_per_step"] == 24 * 4 * 2048 * 12288 * 2
+
+
+def test_bound_tristate():
+    """The machine-reported bound names a resource only when the
+    achieved fraction clears half its measured roof; otherwise
+    'latency' (round-4 review: a config at 5% of the HBM anchor was
+    labeled hbm just because its FLOP fraction was lower)."""
+    from distributed_eigenspaces_tpu.utils.roofline import roofline_fields
+
+    def bound(seconds, *, cold_f, warm_f, cold_b, warm_b, anchor, hbm):
+        return roofline_fields(
+            {"cold_flops_per_step": cold_f, "warm_flops_per_step": warm_f},
+            steps=2, fit_seconds=seconds, anchor_tflops=anchor,
+            byte_model={"cold_bytes_per_step": cold_b,
+                        "warm_bytes_per_step": warm_b},
+            hbm_anchor_gbps=hbm,
+        )["bound"]
+
+    # 1 TF/s flop anchor, 100 GB/s hbm anchor; 2 steps in 1 s
+    common = dict(cold_f=10**11, warm_f=10**11, anchor=1.0, hbm=100.0)
+    # 92 GB/s achieved, 0.2 TF/s -> hbm
+    assert bound(1.0, cold_b=46 * 10**9, warm_b=46 * 10**9,
+                 **common) == "hbm"
+    # 5 GB/s, 0.2 TF/s -> neither near its roof -> latency
+    assert bound(1.0, cold_b=25 * 10**8, warm_b=25 * 10**8,
+                 **common) == "latency"
+    # 0.8 TF/s, 5 GB/s -> mxu
+    out = roofline_fields(
+        {"cold_flops_per_step": 4 * 10**11,
+         "warm_flops_per_step": 4 * 10**11},
+        steps=2, fit_seconds=1.0, anchor_tflops=1.0,
+        byte_model={"cold_bytes_per_step": 25 * 10**8,
+                    "warm_bytes_per_step": 25 * 10**8},
+        hbm_anchor_gbps=100.0,
+    )
+    assert out["bound"] == "mxu"
