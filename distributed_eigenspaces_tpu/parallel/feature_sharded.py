@@ -556,60 +556,88 @@ def make_feature_sharded_step(
 def _windowed_whole_fit(
     mesh, make_sharded_fit, key_of_first, *, blocks_spec, blocks_sharding,
     state_specs, state_shardings, carry_leaf,
+    make_masked_fit=None, masked_key_of_first=None,
 ):
     """ONE copy of the windowed whole-fit machinery shared by the exact
     scan and sketch trainers (round-3 verdict item 3): a lazily-compiled
-    {first: program} cache over ``make_sharded_fit(first)`` and the host
-    window loop. Returns ``(get_program, fit_windows)``.
+    {(first, masked): program} cache over ``make_sharded_fit(first)`` /
+    ``make_masked_fit(first)`` and the host window loop. Returns
+    ``(get_program, fit_windows)``; ``get_program(first, masked=False)``.
 
-    ``fit_windows(state, windows, on_segment=None)`` runs each host
-    ``(S, m, n, d)`` window as one S-step program staged on the mesh
-    (O(S) device memory) with ``on_segment(steps_done, state)`` between
-    programs for checkpoint/metrics. A ZERO carry (``carry_leaf(state)``
-    — the trainer's warm basis, saved as part of every checkpoint) runs
-    the cold first-step program; every later window — and a resume from
-    any committed checkpoint — runs the all-warm continuation program,
-    so a killed-and-resumed run is bit-for-bit the unkilled windowed
-    run. Wrap the window source in
-    ``runtime.prefetch.prefetch_stream(place=...)`` with the trainer's
-    ``blocks_sharding`` and window t+1's host stack + host->device
-    transfer overlap window t's device program. The reference defect
-    class this fixes: all state dies with the master process
-    (``distributed.py:88-91``).
+    ``fit_windows(state, windows, on_segment=None, worker_masks=None)``
+    runs each host ``(S, m, n, d)`` window as one S-step program staged
+    on the mesh (O(S) device memory) with ``on_segment(steps_done,
+    state)`` between programs for checkpoint/metrics. A ZERO carry
+    (``carry_leaf(state)`` — the trainer's warm basis, saved as part of
+    every checkpoint) runs the cold first-step program; every later
+    window — and a resume from any committed checkpoint — runs the
+    all-warm continuation program, so a killed-and-resumed run is
+    bit-for-bit the unkilled windowed run. ``worker_masks`` (an iterable
+    of ``(S, m)`` {0,1} arrays parallel to ``windows``, zipped strict so
+    a short mask stream can never silently drop data windows) threads
+    the §5.3 fault exclusion through the trainer's masked programs —
+    available when the trainer supplies ``make_masked_fit``. Wrap the
+    window source in ``runtime.prefetch.prefetch_stream(place=...)``
+    with the trainer's ``blocks_sharding`` and window t+1's host stack +
+    host->device transfer overlap window t's device program. The
+    reference defect class this fixes: all state dies with the master
+    process (``distributed.py:88-91``).
     """
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
+    rep = NamedSharding(mesh, P())
+    masks_spec = P(None, WORKER_AXIS)
+    masks_sharding = NamedSharding(mesh, masks_spec)
     compiled = {}
 
-    def _get(first):
-        key = key_of_first(first)
+    def _get(first, masked=False):
+        key = (
+            (masked_key_of_first if masked else key_of_first)(first),
+            masked,
+        )
         if key not in compiled:
+            make = make_masked_fit if masked else make_sharded_fit
+            extra_specs = (masks_spec,) if masked else ()
+            extra_shards = (masks_sharding,) if masked else ()
             compiled[key] = checked_jit(
                 jax.shard_map(
-                    make_sharded_fit(key),
+                    make(key[0]),
                     mesh=mesh,
-                    in_specs=(state_specs, blocks_spec, P()),
+                    in_specs=(
+                        (state_specs, blocks_spec, P()) + extra_specs
+                    ),
                     out_specs=state_specs,
                     check_vma=False,
                 ),
                 in_shardings=(
-                    state_shardings, blocks_sharding,
-                    NamedSharding(mesh, P()),
+                    (state_shardings, blocks_sharding, rep)
+                    + extra_shards
                 ),
                 out_shardings=state_shardings,
             )
         return compiled[key]
 
-    def fit_windows(state, windows, on_segment=None):
+    def fit_windows(state, windows, on_segment=None, worker_masks=None):
+        if worker_masks is not None and make_masked_fit is None:
+            raise ValueError("this trainer has no masked programs")
         first = (
             int(state.step) == 0 or not bool(jnp.any(carry_leaf(state)))
         )
-        for w in windows:
+        pairs = (
+            ((w, None) for w in windows)
+            if worker_masks is None
+            else zip(windows, worker_masks, strict=True)
+        )
+        for w, mk in pairs:
             blocks = jax.device_put(w, blocks_sharding)
-            steps = int(blocks.shape[0])
-            state = _get(first)(
-                state, blocks, jnp.arange(steps, dtype=jnp.int32)
-            )
+            idx = jnp.arange(int(blocks.shape[0]), dtype=jnp.int32)
+            if mk is None:
+                state = _get(first)(state, blocks, idx)
+            else:
+                mk = jax.device_put(
+                    jnp.asarray(mk, jnp.float32), masks_sharding
+                )
+                state = _get(first, masked=True)(state, blocks, idx, mk)
             first = False
             if on_segment is not None:
                 on_segment(int(state.step), state)
@@ -654,35 +682,52 @@ def make_feature_sharded_scan_fit(
     step_core = _make_step_core(cfg, collectives=collectives, key=key)
     warm_iters = cfg.resolved_warm_start()
 
-    def make_sharded_fit(first):
+    def make_sharded_fit(first, masked=False):
         """``first=True``: step 1 cold at the full iteration count, later
         steps short (the whole-fit program). ``first=False``: every step
         warm — the continuation program the windowed/resumed entry runs
         once a prior window (or a restored checkpoint) has left a nonzero
-        ``state.u`` to warm-start from."""
+        ``state.u`` to warm-start from. ``masked=True`` threads a (T, m)
+        worker-mask schedule through the exact merge (§5.3) — the exact
+        trainer needs no cold-recovery cond machinery: a masked-out
+        worker is excluded from the merge algebra exactly, and an
+        all-masked round folds a zero ``v_bar`` while ``state.u``
+        survives the rank-r update untouched (same semantics as the
+        per-step trainer under the same masks)."""
 
-        def sharded_fit(state, blocks, idx):
-            def step_at(st, x, step_iters):
-                return step_core(st, x, step_iters)[0]
+        def sharded_fit(state, blocks, idx, masks=None):
+            def step_at(st, x, step_iters, mk):
+                return step_core(st, x, step_iters, mask=mk)[0]
+
+            def scan_steps(st, step_iters, idx_s, masks_s):
+                if masked:
+                    def body(s, im):
+                        i, mk = im
+                        return step_at(s, blocks[i], step_iters, mk), None
+
+                    st, _ = jax.lax.scan(body, st, (idx_s, masks_s))
+                    return st
+
+                def body(s, i):
+                    return step_at(s, blocks[i], step_iters, None), None
+
+                st, _ = jax.lax.scan(body, st, idx_s)
+                return st
 
             if warm_iters is None:
-                def body(st, i):
-                    return step_at(st, blocks[i], iters), None
-
-                state, _ = jax.lax.scan(body, state, idx)
-                return state
+                return scan_steps(state, iters, idx, masks)
             if first:
                 # step 1 cold at the full iteration count (resume-safe: a
                 # restored state's u warm-starts it anyway), later steps
                 # short
-                state = step_at(state, blocks[idx[0]], iters)
+                state = step_at(
+                    state, blocks[idx[0]], iters,
+                    masks[0] if masked else None,
+                )
                 idx = idx[1:]
-
-            def body(st, i):
-                return step_at(st, blocks[i], warm_iters), None
-
-            state, _ = jax.lax.scan(body, state, idx)
-            return state
+                if masked:
+                    masks = masks[1:]
+            return scan_steps(state, warm_iters, idx, masks)
 
         return sharded_fit
 
@@ -696,21 +741,41 @@ def make_feature_sharded_scan_fit(
         step=NamedSharding(mesh, P()),
     )
 
+    # without warm start the first and continuation programs are the
+    # same all-cold scan — never compile it twice. Kill/resume with
+    # masks stays bit-for-bit whenever at least one pre-kill step
+    # survived its mask (the normal case — the warm carry ``u`` is then
+    # nonzero and both the unkilled and resumed runs take the all-warm
+    # continuation program); resuming a checkpoint whose EVERY prior
+    # step was all-masked re-runs the cold first-step program on a
+    # still-zero carry, which strictly improves on the unkilled run's
+    # warm-from-noise steps rather than reproducing them.
+    key_of_first = (
+        (lambda first: first) if warm_iters is not None
+        else (lambda first: True)
+    )
     _get, fit_windows = _windowed_whole_fit(
         mesh, make_sharded_fit,
-        # without warm start the first and continuation programs are the
-        # same all-cold scan — never compile it twice
-        key_of_first=(
-            (lambda first: first) if warm_iters is not None
-            else (lambda first: True)
-        ),
+        key_of_first=key_of_first,
         blocks_spec=blocks_spec, blocks_sharding=blocks_sharding,
         state_specs=state_specs, state_shardings=state_shardings,
         carry_leaf=lambda st: st.u,  # the warm basis (rows [:, :k])
+        make_masked_fit=lambda first: make_sharded_fit(
+            first, masked=True
+        ),
+        masked_key_of_first=key_of_first,
     )
 
-    def fit(state, blocks, idx):
-        return _get(True)(state, blocks, idx)
+    def fit(state, blocks, idx, worker_masks=None):
+        if worker_masks is None:
+            return _get(True)(state, blocks, idx)
+        worker_masks = jax.device_put(
+            jnp.asarray(worker_masks, jnp.float32),
+            NamedSharding(mesh, P(None, WORKER_AXIS)),
+        )
+        return _get(True, masked=True)(
+            state, blocks, idx, worker_masks
+        )
 
     fit.init_state = _jit_init(
         lambda: LowRankState.initial(cfg.dim, r), state_shardings
@@ -1004,38 +1069,42 @@ def make_feature_sharded_sketch_fit(
         step=NamedSharding(mesh, P()),
     )
 
-    from distributed_eigenspaces_tpu.utils.guards import checked_jit
-
-    masks_spec = P(None, WORKER_AXIS)
-    masks_sharding = NamedSharding(mesh, masks_spec)
-
-    _get, fit_windows_unmasked = _windowed_whole_fit(
+    # windowed entry, masked and unmasked: unmasked windows keep the
+    # plain first/continuation programs (no cond, no mask algebra);
+    # masked windows run the one cond-dispatch program (cold while the
+    # carry is zero / after an all-masked wipeout, warm otherwise), so
+    # kill/resume stays bit-for-bit — the per-step branch depends only
+    # on the restored carry, with no unconditional cold step to diverge
+    # on. The staged masked `fit` keeps its own program (cold first step
+    # at idx[0] — the §5.3 semantics the r3 tests pin).
+    _get, fit_windows = _windowed_whole_fit(
         mesh, make_sharded_fit, key_of_first=lambda first: first,
         blocks_spec=blocks_spec, blocks_sharding=blocks_sharding,
         state_specs=state_specs, state_shardings=state_shardings,
         carry_leaf=lambda st: st.v,  # the warm basis
+        make_masked_fit=lambda first: sharded_fit_masked_windowed,
+        masked_key_of_first=lambda first: True,  # ONE cond program
     )
 
-    def _compile_masked(fn):
-        return checked_jit(
-            jax.shard_map(
-                fn,
-                mesh=mesh,
-                in_specs=(state_specs, blocks_spec, P(), masks_spec),
-                out_specs=state_specs,
-                check_vma=False,
-            ),
-            in_shardings=(
-                state_shardings, blocks_sharding,
-                NamedSharding(mesh, P()), masks_sharding,
-            ),
-            out_shardings=state_shardings,
-        )
+    from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
-    fused_masked = _compile_masked(sharded_fit_masked)
-    # jax.jit defers tracing/compilation to the first call, so binding
-    # here costs nothing for callers that never pass masks
-    masked_windowed = _compile_masked(sharded_fit_masked_windowed)
+    masks_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
+    fused_masked = checked_jit(
+        jax.shard_map(
+            sharded_fit_masked,
+            mesh=mesh,
+            in_specs=(
+                state_specs, blocks_spec, P(), P(None, WORKER_AXIS),
+            ),
+            out_specs=state_specs,
+            check_vma=False,
+        ),
+        in_shardings=(
+            state_shardings, blocks_sharding, NamedSharding(mesh, P()),
+            masks_sharding,
+        ),
+        out_shardings=state_shardings,
+    )
 
     def fit(state, blocks, idx, worker_masks=None):
         if worker_masks is None:
@@ -1044,32 +1113,6 @@ def make_feature_sharded_sketch_fit(
             jnp.asarray(worker_masks, jnp.float32), masks_sharding
         )
         return fused_masked(state, blocks, idx, worker_masks)
-
-    def fit_windows(state, windows, on_segment=None, worker_masks=None):
-        """Windowed checkpointable fit; ``worker_masks`` (an iterable of
-        ``(S, m)`` {0,1} arrays parallel to ``windows``) adds the §5.3
-        fault machinery to the long checkpointed runs: each masked
-        window runs the one cond-dispatch program (cold while the carry
-        is zero / after an all-masked wipeout, warm otherwise), so
-        kill/resume stays bit-for-bit — the per-step branch depends only
-        on the restored carry. Unmasked calls keep the plain first/
-        continuation programs (no cond, no mask algebra)."""
-        if worker_masks is None:
-            return fit_windows_unmasked(state, windows, on_segment)
-        # strict: a mask stream shorter than the windows would otherwise
-        # silently DROP the trailing data windows (and vice versa)
-        for w, mk in zip(windows, worker_masks, strict=True):
-            blocks_w = jax.device_put(w, blocks_sharding)
-            steps = int(blocks_w.shape[0])
-            mk = jax.device_put(
-                jnp.asarray(mk, jnp.float32), masks_sharding
-            )
-            state = masked_windowed(
-                state, blocks_w, jnp.arange(steps, dtype=jnp.int32), mk
-            )
-            if on_segment is not None:
-                on_segment(int(state.step), state)
-        return state
 
     fit.fit_windows = fit_windows
     fit.init_state = _jit_init(
