@@ -262,7 +262,6 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
     from distributed_eigenspaces_tpu.utils.roofline import (
         measure_hbm_anchor,
         measure_matmul_anchor,
-        measure_seq_chol_latency,
         roofline_fields,
         step_byte_model,
         step_flop_model,
@@ -361,34 +360,16 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
         # noise drives the residual negative
         extras["dispatch_fixed_ms"] = round(fixed_overhead_s * 1e3, 2)
 
-    # WHY the warm step sits at a few percent of anchor: it is bound by
-    # sequential small-op LATENCY, not FLOPs — measured on this device as
-    # a differenced chain of dependent Cholesky + triangular-solve pairs
-    # (the ops a CholeskyQR2 iteration serializes on). The model count:
-    # 2 pairs per solver iteration + ~2 pair-equivalents for the merge +
-    # state eighs. Reported so every %-of-anchor figure carries its
-    # machine-measured reason (round-3 verdict item 1).
-    if not small and marginal is not None:
-        pair_s = measure_seq_chol_latency(K, D)
-        warm_pairs = 2 * (cfg.resolved_warm_start() or 0) + 2
-        if pair_s > 0:
-            extras["latency_bound"] = {
-                "chol_solve_pair_ms": round(pair_s * 1e3, 4),
-                "seq_pairs_per_warm_step": warm_pairs,
-                "warm_latency_model_ms": round(
-                    pair_s * warm_pairs * 1e3, 3
-                ),
-                "warm_measured_ms": round(marginal * 1e3, 3),
-            }
-        else:
-            # differenced chains came back <= 0: tunnel jitter swamped
-            # the probe this session — say so instead of reporting a
-            # fictitious 0 ms latency
-            extras["latency_bound"] = {
-                "probe": "failed (tunnel jitter exceeded the "
-                "differenced chain time this session)",
-                "warm_measured_ms": round(marginal * 1e3, 3),
-            }
+    # WHY the warm step sits at a few percent of the FLOP anchor: the
+    # bandwidth roofline above answers it — the modeled X re-reads alone
+    # put the warm step at ~80-90% of the measured HBM rate (bound:
+    # "hbm"), i.e. its floor is memory traffic, with the k-wide
+    # eigh/Cholesky chain largely hidden behind it. (A per-op latency
+    # probe was tried and REMOVED: a dependent Cholesky+solve chain
+    # measures ~0.098 ms/pair at 240-480 links but ~0.003 ms/pair at
+    # 2400+ links — XLA software-pipelines long chains — so no single
+    # chain length honestly models the ~6 sequential pairs inside a real
+    # warm step; the byte model needs no such scale assumption.)
     return (TPU_STEPS * M * N) / dt, _gate_angle(state, spectrum), extras
 
 

@@ -643,6 +643,11 @@ def _windowed_whole_fit(
                 on_segment(int(state.step), state)
         return state
 
+    # the ONE definition of the mask layout, reused by the trainers'
+    # staged masked programs (a second inline copy per factory would
+    # drift from the windowed one)
+    _get.masks_spec = masks_spec
+    _get.masks_sharding = masks_sharding
     return _get, fit_windows
 
 
@@ -770,8 +775,7 @@ def make_feature_sharded_scan_fit(
         if worker_masks is None:
             return _get(True)(state, blocks, idx)
         worker_masks = jax.device_put(
-            jnp.asarray(worker_masks, jnp.float32),
-            NamedSharding(mesh, P(None, WORKER_AXIS)),
+            jnp.asarray(worker_masks, jnp.float32), _get.masks_sharding
         )
         return _get(True, masked=True)(
             state, blocks, idx, worker_masks
@@ -1088,20 +1092,19 @@ def make_feature_sharded_sketch_fit(
 
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
-    masks_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
     fused_masked = checked_jit(
         jax.shard_map(
             sharded_fit_masked,
             mesh=mesh,
             in_specs=(
-                state_specs, blocks_spec, P(), P(None, WORKER_AXIS),
+                state_specs, blocks_spec, P(), _get.masks_spec,
             ),
             out_specs=state_specs,
             check_vma=False,
         ),
         in_shardings=(
             state_shardings, blocks_sharding, NamedSharding(mesh, P()),
-            masks_sharding,
+            _get.masks_sharding,
         ),
         out_shardings=state_shardings,
     )
@@ -1110,7 +1113,7 @@ def make_feature_sharded_sketch_fit(
         if worker_masks is None:
             return _get(True)(state, blocks, idx)
         worker_masks = jax.device_put(
-            jnp.asarray(worker_masks, jnp.float32), masks_sharding
+            jnp.asarray(worker_masks, jnp.float32), _get.masks_sharding
         )
         return fused_masked(state, blocks, idx, worker_masks)
 

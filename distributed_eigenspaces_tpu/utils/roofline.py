@@ -196,63 +196,31 @@ def measure_hbm_anchor(
             best = min(best, time.perf_counter() - t0)
         return best
 
-    dt = (timed(base * ratio) - timed(base)) / (base * (ratio - 1))
-    if dt <= 0:
+    dt = _consistent_marginal(timed, base, ratio)
+    if dt != dt or dt <= 0:
         return float("nan")
     return 2 * mb * (1 << 20) / dt / 1e9
 
 
-def measure_seq_chol_latency(
-    k: int, d: int, base: int = 2400, ratio: int = 2
-) -> float:
-    """Measured per-pair latency (seconds) of a DEPENDENT Cholesky +
-    triangular-solve chain at the solver's shapes — the sequential ops a
-    CholeskyQR2 iteration serializes on (each lowers to a long scalar
-    chain the MXU can't help with; this is the op-latency wall that makes
-    the warm step latency-bound rather than FLOP-bound). Two chain
-    lengths differenced, so dispatch/launch/fence cancel — the same
-    methodology as the marginal step times it explains.
-    """
-    import jax
-    import jax.numpy as jnp
-
-    def make(count):
-        def f(g, v):
-            def body(carry, _):
-                gg, vv = carry
-                r = jnp.linalg.cholesky(
-                    gg + 1e-3 * jnp.eye(gg.shape[0], dtype=gg.dtype)
-                )
-                vv = jax.lax.linalg.triangular_solve(
-                    r, vv, left_side=False, lower=True, transpose_a=True
-                )
-                gg = vv.T @ vv + jnp.eye(gg.shape[0], dtype=gg.dtype)
-                return (gg, vv), None
-
-            (_, vv), _ = jax.lax.scan(body, (g, v), None, length=count)
-            return vv
-
-        return jax.jit(f)
-
-    g = jnp.eye(k, dtype=jnp.float32) * 2.0
-    v = jax.random.normal(jax.random.PRNGKey(2), (d, k), jnp.float32)
-
-    def timed(count):
-        f = make(count)
-        float(jnp.sum(f(g, v)))  # compile + warm
-        best = float("inf")
-        # fresh operands each rep: defeat result caching; min-of-3 rides
-        # out tunnel jitter (the chain is long enough that the min is
-        # dominated by the device, not the link)
-        for s in (1e-4, 2e-4, 3e-4):
-            t0 = time.perf_counter()
-            float(jnp.sum(f(g + s, v)))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    return max(
-        (timed(base * ratio) - timed(base)) / (base * (ratio - 1)), 0.0
-    )
+def _consistent_marginal(timed, base: int, ratio: int) -> float:
+    """Differenced per-unit time from THREE chain lengths, accepted only
+    when the two independent estimates agree within 2x — a single
+    differenced pair on a jittery tunnel can silently produce a
+    wildly-wrong number (observed: an HBM "anchor" 3x below the same
+    chip's earlier sessions, an op latency 30x below), and a wrong
+    denominator poisons every percentage derived from it. NaN = probe
+    failed this session; callers must report that, not a fiction."""
+    t1 = timed(base)
+    t2 = timed(base * ratio)
+    t3 = timed(base * (2 * ratio - 1))
+    per = base * (ratio - 1)
+    est1 = (t2 - t1) / per
+    est2 = (t3 - t2) / per
+    if est1 <= 0 or est2 <= 0:
+        return float("nan")
+    if max(est1, est2) > 2.0 * min(est1, est2):
+        return float("nan")
+    return 0.5 * (est1 + est2)
 
 
 def roofline_fields(
@@ -306,6 +274,11 @@ def roofline_fields(
             out["pct_of_hbm_anchor"] = round(
                 100.0 * gbps / hbm_anchor_gbps, 2
             )
+            if out["pct_of_hbm_anchor"] > 110:
+                # modeled traffic cannot exceed the physical rate: the
+                # anchor under-measured this session (or the byte model
+                # overcounts) — say so next to the number
+                out["hbm_anchor_suspect"] = True
             if "pct_of_anchor" in out:
                 hbm_pct, flop_pct = (
                     out["pct_of_hbm_anchor"], out["pct_of_anchor"],
