@@ -139,7 +139,14 @@ def _hbm_anchor(small: bool) -> float:
             measure_hbm_anchor,
         )
 
-        _HBM_CACHE[small] = measure_hbm_anchor(small=small)
+        out = measure_hbm_anchor(small=small)
+        if out != out:
+            # NaN = the consistency check rejected this session's
+            # estimates; do NOT cache — the next eval re-measures
+            # instead of silently dropping the bandwidth block for the
+            # whole process (roofline_fields reports hbm_probe_failed)
+            return out
+        _HBM_CACHE[small] = out
     return _HBM_CACHE[small]
 
 

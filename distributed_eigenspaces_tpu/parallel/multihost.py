@@ -271,17 +271,44 @@ def make_multihost_feature_fit(
     )
     inner = make(cfg, mesh, seed=seed, collectives=collectives)
 
-    def fit(state, blocks_local, idx, **kw):
+    def _assemble(blocks_local):
         b, n = blocks_local.shape[0], blocks_local.shape[2]
-        blocks = feature_block_stack_to_global(
+        return feature_block_stack_to_global(
             blocks_local, mesh, (b, cfg.num_workers, n, cfg.dim)
         )
+
+    def fit(state, blocks_local, idx, **kw):
         import jax.numpy as jnp
 
-        return inner(state, blocks, jnp.asarray(idx, jnp.int32), **kw)
+        return inner(
+            state, _assemble(blocks_local),
+            jnp.asarray(idx, jnp.int32), **kw
+        )
 
+    def fit_windows(state, windows_local, on_segment=None,
+                    worker_masks=None):
+        """Windowed checkpointable multi-host fit: ``windows_local``
+        yields this host's ``(S, m_local, n, d_local)`` rect of each
+        window; each is assembled to the global sharded stack and run
+        through the single-process windowed programs (the inner
+        ``fit_windows`` device_put is a no-op on the already-global
+        array). ``worker_masks`` windows are the full global ``(S, m)``
+        schedules, identical on every host (they are tiny; the global
+        device_put shards them). ``on_segment`` runs on every process —
+        pair it with ``utils.checkpoint`` (collective gather, process-0
+        write) for multi-host checkpoint/resume of exactly the runs
+        long enough to need it."""
+        return inner.fit_windows(
+            state,
+            (_assemble(w) for w in windows_local),
+            on_segment=on_segment,
+            worker_masks=worker_masks,
+        )
+
+    fit.fit_windows = fit_windows
     fit.init_state = inner.init_state
     fit.blocks_sharding = inner.blocks_sharding
+    fit.state_shardings = inner.state_shardings
     if hasattr(inner, "extract"):
         fit.extract = inner.extract
     if hasattr(inner, "rank"):

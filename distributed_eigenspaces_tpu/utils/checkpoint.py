@@ -45,8 +45,24 @@ _STATE_TYPES = {
 
 
 def _to_host(tree):
-    """Fully materialize on host (gathers sharded leaves)."""
-    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    """Fully materialize on host (gathers sharded leaves).
+
+    Multi-host: a leaf sharded across processes is not fully addressable,
+    so it is gathered with a COLLECTIVE (``process_allgather``) — every
+    process must therefore reach ``save_checkpoint`` together (the same
+    SPMD discipline as the training step itself); single-process leaves
+    take the plain device_get path."""
+
+    def get(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True)
+            )
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(get, tree)
 
 
 def save_checkpoint(
@@ -56,8 +72,13 @@ def save_checkpoint(
     cursor: int = 0,
     extra: dict[str, Any] | None = None,
 ) -> None:
-    """Write a self-describing checkpoint directory at ``path``."""
-    os.makedirs(path, exist_ok=True)
+    """Write a self-describing checkpoint directory at ``path``.
+
+    Multi-host: call from EVERY process (the sharded-state gather is a
+    collective); only process 0 touches the filesystem, so a shared
+    checkpoint directory sees exactly one writer. Restore+device_put
+    with the trainer's ``state_shardings`` re-shards on any topology.
+    """
     kind = next(
         (n for n, cls in _STATE_TYPES.items() if isinstance(state, cls)),
         None,
@@ -67,7 +88,21 @@ def save_checkpoint(
             f"unsupported checkpoint state type {type(state).__name__}; "
             f"known: {sorted(_STATE_TYPES)}"
         )
-    host = _to_host(state)
+    host = _to_host(state)  # collective — before any process-0 gate
+    multi = jax.process_count() > 1
+    if not multi or jax.process_index() == 0:
+        _write_checkpoint(path, host, kind, cursor, extra)
+    if multi:
+        # barrier AFTER the commit marker: without it a non-zero process
+        # returning early could restore (or assert existence) before
+        # process 0 finished writing — a flaky missing-checkpoint race
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("det_ckpt_commit")
+
+
+def _write_checkpoint(path, host, kind, cursor, extra):
+    os.makedirs(path, exist_ok=True)
     # Invalidate any previous commit marker BEFORE touching state.npz, and
     # write the payload via tmp+rename: a crash at any point leaves either
     # the old complete checkpoint (marker still present, payload untouched)

@@ -269,6 +269,12 @@ def roofline_fields(
         gbps = bytes_total / fit_seconds / 1e9
         out["model_bytes_total"] = int(bytes_total)
         out["achieved_gb_per_sec"] = round(gbps, 1)
+        if hbm_anchor_gbps is not None and hbm_anchor_gbps != hbm_anchor_gbps:
+            # NaN = the probe's consistency check rejected this session's
+            # estimates — say so instead of silently omitting the block
+            # (consumers must be able to tell "not HBM-bound" from
+            # "anchor never measured")
+            out["hbm_probe_failed"] = True
         if hbm_anchor_gbps is not None and hbm_anchor_gbps == hbm_anchor_gbps:
             out["hbm_anchor_gb_per_sec"] = round(hbm_anchor_gbps, 1)
             out["pct_of_hbm_anchor"] = round(

@@ -557,3 +557,134 @@ def test_two_process_bin_stream_worker_range(tmp_path):
         st, _ = step(st, jax.device_put(x, worker_sharding(mesh)))
     ref = float(np.sum(np.abs(np.asarray(st.sigma_tilde))))
     assert abs(ref - sums[0]) < 1e-3, (ref, sums[0])
+
+
+def test_two_process_windowed_checkpoint_resume(tmp_path):
+    """Multi-host WHOLE-FIT CHECKPOINTING end to end across two OS
+    processes: windowed sketch fit with a per-window checkpoint (state
+    gather is a collective, process 0 is the only writer), then a FRESH
+    trainer in the same processes restores from disk and finishes — the
+    resumed checksum matches the unkilled single-process windowed run.
+    The reference loses all state with the master process
+    (distributed.py:88-91); here the longest (multi-host, large-d) runs
+    are exactly the ones that can resume."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    problem = textwrap.dedent(
+        """
+        import numpy as np
+        from distributed_eigenspaces_tpu.config import PCAConfig
+        M, N, D, K, T = 4, 64, 32, 2, 4
+        XS = np.random.default_rng(9).standard_normal(
+            (T, M, N, D)).astype(np.float32)
+        CFG = PCAConfig(dim=D, k=K, num_workers=M, rows_per_worker=N,
+                        num_steps=T, solver="subspace", subspace_iters=30,
+                        warm_start_iters=2, backend="feature_sharded")
+        """
+    )
+    script = textwrap.dedent(
+        """
+        import sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        pid = int(sys.argv[1])
+        jax.distributed.initialize(coordinator_address=sys.argv[2],
+                                   num_processes=2, process_id=pid)
+        ckdir = sys.argv[3]
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        import distributed_eigenspaces_tpu.parallel.multihost as mh
+        from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+        from distributed_eigenspaces_tpu.utils.checkpoint import (
+            restore_checkpoint, save_checkpoint)
+        {problem}
+        mesh = make_mesh(num_workers=2, num_feature_shards=2)
+        rect = mh.host_block_rect(mesh)
+        ws, fs = rect.block_slice(M, D)
+
+        def local_windows(lo, hi):
+            for t in range(lo, hi, 2):
+                yield XS[t : t + 2][:, ws, :, fs]
+
+        # phase 1: two steps windowed, checkpoint (collective gather,
+        # process-0 write), then the trainer object "dies"
+        fit1 = mh.make_multihost_feature_fit(CFG, mesh, trainer="sketch",
+                                             seed=4)
+        half = fit1.fit_windows(fit1.init_state(), local_windows(0, 2))
+        save_checkpoint(ckdir, half, cursor=2 * M * N)
+
+        # phase 2: fresh trainer, restore from disk, finish
+        fit2 = mh.make_multihost_feature_fit(CFG, mesh, trainer="sketch",
+                                             seed=4)
+        restored, cursor = restore_checkpoint(ckdir)
+        assert cursor == 2 * M * N
+        state = fit2.fit_windows(
+            jax.device_put(restored, fit2.state_shardings),
+            local_windows(2, T),
+        )
+        assert int(state.step) == T
+        chk = jax.jit(
+            lambda a: jnp.sum(jnp.abs(a)),
+            out_shardings=NamedSharding(mesh, P()),
+        )(state.y)
+        print("CHECKSUM %.8f" % float(chk))
+        """
+    ).format(problem=problem)
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        JAX_PLATFORMS="cpu",
+    )
+    ck = str(tmp_path / "ck")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(i), f"127.0.0.1:{port}", ck],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    sums = []
+    try:
+        for i, p in enumerate(procs):
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
+            line = [ln for ln in out.splitlines()
+                    if ln.startswith("CHECKSUM")][-1]
+            sums.append(float(line.split()[1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert sums[0] == sums[1], sums
+
+    # unkilled single-process windowed reference on the same mesh layout
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        make_feature_sharded_sketch_fit,
+    )
+    from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+    ns = {}
+    exec(problem, ns)
+    mesh = make_mesh(num_workers=2, num_feature_shards=2)
+    fit = make_feature_sharded_sketch_fit(ns["CFG"], mesh, seed=4)
+    state = fit.fit_windows(
+        fit.init_state(),
+        (ns["XS"][t : t + 2] for t in range(0, ns["T"], 2)),
+    )
+    ref = float(jnp.sum(jnp.abs(state.y)))
+    assert abs(ref - sums[0]) < 1e-3, (ref, sums)
