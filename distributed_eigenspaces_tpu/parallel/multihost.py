@@ -309,10 +309,9 @@ def make_multihost_feature_fit(
     fit.init_state = inner.init_state
     fit.blocks_sharding = inner.blocks_sharding
     fit.state_shardings = inner.state_shardings
-    if hasattr(inner, "extract"):
-        fit.extract = inner.extract
-    if hasattr(inner, "rank"):
-        fit.rank = inner.rank
+    for attr in ("extract", "rank", "sketch_width"):
+        if hasattr(inner, attr):
+            setattr(fit, attr, getattr(inner, attr))
     return fit
 
 

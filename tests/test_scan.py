@@ -522,3 +522,32 @@ def test_cli_warm_start_mapping(capsys):
                "--warm-start-iters", "3"])  # eigh solver -> loud error
     assert rc == 2
     assert "subspace" in capsys.readouterr().err
+
+
+def test_cli_feature_sharded_scan_trainer(tmp_path):
+    """--trainer scan --backend feature_sharded runs the EXACT rank-r
+    whole fit from the CLI (round 4 — previously rejected with a stale
+    'scan state is dense d x d' message), with per-window checkpoints
+    and a working resume."""
+    from distributed_eigenspaces_tpu.cli import main
+
+    ckpt = str(tmp_path / "ck")
+    out_w = str(tmp_path / "w.npy")
+    common = [
+        "--data", "synthetic", "--dim", "64", "--rank", "3",
+        "--workers", "4", "--rows-per-worker", "64",
+        "--trainer", "scan", "--backend", "feature_sharded",
+        "--solver", "subspace", "--subspace-iters", "24",
+        "--discount", "1/t",
+    ]
+    assert main(common + ["--steps", "4", "--checkpoint-every", "2",
+                          "--checkpoint-dir", ckpt, "--save", out_w]) == 0
+    import os as _os
+
+    assert sorted(
+        n for n in _os.listdir(ckpt) if n.startswith("step_")
+    ) == ["step_00000002", "step_00000004"]
+    w = np.load(out_w)
+    assert w.shape == (64, 3)
+    assert main(common + ["--steps", "8", "--checkpoint-every", "2",
+                          "--checkpoint-dir", ckpt, "--resume"]) == 0
