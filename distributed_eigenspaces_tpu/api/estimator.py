@@ -64,14 +64,29 @@ SKETCH_DK_CROSSOVER = 65536
 SCAN_STAGE_BYTES_MAX = 1 << 31  # 2 GiB
 
 
-def resolves_feature_sharded(cfg: PCAConfig) -> bool:
+def resolves_feature_sharded(cfg: PCAConfig, *, whole_fit: bool = True) -> bool:
     """ONE definition of "this workload runs the feature-sharded backend":
-    explicit, or ``auto`` at d >= 4096 where a dense d x d state must not
-    exist. Shared by the trainer chooser, the whole-fit executor and the
-    continuation path so the dispatch sites cannot drift."""
-    return cfg.backend == "feature_sharded" or (
-        cfg.backend == "auto" and cfg.dim >= 4096
-    )
+    explicit; ``auto`` at d >= 4096, where a dense d x d state must not
+    exist; or — for WHOLE fits only — ``auto`` above the measured
+    ``d*k`` crossover, where the sketch trainer's solve-free steady
+    state wins regardless of d. Round-4 measurement: at d=768/k=256
+    (config 5's shapes, d*k=197k) the sketch runs 17.9M samples/s vs
+    the dense scan's 0.50M at BETTER accuracy (0.151 vs 0.307 deg),
+    because the dense warm step is buried under k=256-sized
+    eigh/Cholesky latency. ``whole_fit=False`` (the per-step
+    continuation paths — hooks, fit_stream, partial_fit) keeps the d*k
+    clause OUT: the per-step loop never runs the sketch, so those
+    configs would trade the exact dense state for a rank-truncated one
+    with no measured benefit. Shared by the trainer chooser, the
+    whole-fit executor and the continuation path so the dispatch sites
+    cannot drift."""
+    if cfg.backend == "feature_sharded":
+        return True
+    if cfg.backend != "auto":
+        return False
+    if cfg.dim >= 4096:
+        return True
+    return whole_fit and cfg.dim * cfg.k >= SKETCH_DK_CROSSOVER
 
 
 def choose_trainer(
@@ -467,8 +482,11 @@ class OnlineDistributedPCA:
                 "feeding make_feature_sharded_sketch_fit, or refit"
             )
         cfg = self.cfg
+        # whole_fit=False: the per-step loop never runs the sketch, so
+        # the d*k crossover must not flip small-d per-step fits off the
+        # exact dense state (round-4 review finding)
         if cfg.backend != "feature_sharded" and (
-            resolves_feature_sharded(cfg)
+            resolves_feature_sharded(cfg, whole_fit=False)
             or isinstance(self.state, LowRankState)
         ):
             # two reasons to pin the backend: (a) auto at large d must

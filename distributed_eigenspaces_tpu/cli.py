@@ -488,10 +488,12 @@ def _fit_feature_whole(args, cfg, data, truth) -> int:
         ).start()
 
     t0 = time.time()
+    windowed = False
     with profile_to(args.profile_dir):
         if remaining:
             stage_dtype = jnp.dtype(cfg.compute_dtype or jnp.float32)
-            if ckpt is not None or metrics is not None:
+            windowed = ckpt is not None or metrics is not None
+            if windowed:
                 # windowed: one program + a committed checkpoint and/or
                 # a metrics record per --checkpoint-every steps (a kill
                 # between windows loses at most one window of work), fed
@@ -562,6 +564,15 @@ def _fit_feature_whole(args, cfg, data, truth) -> int:
         **(
             {"sketch_width": fit.sketch_width} if sketch
             else {"rank": fit.rank}
+        ),
+        # checkpoint/metrics runs execute as --checkpoint-every-step
+        # windows (one program each — same semantics as the dense scan
+        # route's segments); the report says so because the per-window
+        # dispatch makes samples_per_sec here NOT comparable to the
+        # one-program staged rate (bench.py/evals measure that)
+        **(
+            {"windowed": True, "window_steps": args.checkpoint_every}
+            if windowed else {}
         ),
         "resumed_step": done,
         "steps": int(state.step),

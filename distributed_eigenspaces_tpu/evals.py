@@ -99,13 +99,19 @@ EVAL_SPECS: dict[str, EvalSpec] = {
         # SAME shapes/accuracy gate with pre-staged device blocks, so the
         # report carries the chip rate next to the out-of-core row's
         # link-bound one — the pair separates "what the chip does at
-        # these shapes" from "what the measured host link admits"
+        # these shapes" from "what the measured host link admits".
+        # Sketch trainer (round-4 measurement): at k=256 the dense scan
+        # warm step is buried under eigh/Cholesky latency (0.50M
+        # samples/s); the solve-free sketch runs 17.9M at BETTER
+        # accuracy (0.151 vs 0.307 deg) — also what auto dispatch now
+        # picks at this d*k
         EvalSpec("clip768_chip", dim=768, k=256, num_workers=8,
                  rows_per_worker=2048, steps=10, subspace_iters=8,
                  warm_start_iters=2, compute_dtype="bfloat16",
-                 trainer="scan",
-                 description="config 5 shapes device-fed: chip-rate "
-                             "companion to clip768's link-bound row"),
+                 backend="feature_sharded", trainer="sketch",
+                 description="config 5 shapes device-fed (sketch): "
+                             "chip-rate companion to clip768's "
+                             "link-bound row"),
     ]
 }
 

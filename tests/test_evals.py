@@ -20,7 +20,9 @@ def test_all_baseline_configs_registered():
     assert (a.dim, a.k, a.num_workers, a.rows_per_worker) == (
         b.dim, b.k, b.num_workers, b.rows_per_worker
     )
-    assert b.streaming == "memory" and b.trainer == "scan"
+    # sketch: the measured-fastest trainer at these shapes (35x the
+    # dense scan, better accuracy — the k=256 latency chains vanish)
+    assert b.streaming == "memory" and b.trainer == "sketch"
     # published sizes match BASELINE.md
     assert (EVAL_SPECS["cifar10"].dim, EVAL_SPECS["cifar10"].k) == (3072, 10)
     assert (EVAL_SPECS["synthetic1024"].dim,
@@ -130,9 +132,9 @@ def test_eval_reports_timing_statistics():
     assert rep["timing"]["n_repeats"] == 2
 
 
-def test_clip768_chip_companion_small():
+def test_clip768_chip_companion_small(devices):
     rep = run_eval("clip768_chip", dim=64, k=8, subspace_iters=16,
                    rows_per_worker=128, steps=4)
-    _check(rep)
+    _check(rep, backend="feature_sharded")
     assert rep["streaming"] == "memory"
-    assert rep["trainer"] == "scan"
+    assert rep["trainer"] == "sketch"
