@@ -110,8 +110,10 @@ def online_distributed_pca(
       state: optional resume state (checkpoint restart, SURVEY.md §5.4).
       on_step: optional callback ``(t, state, v_bar)`` after each fold —
         metrics/checkpoint hook.
-      worker_masks: optional iterator of ``(m,)`` {0,1} masks for fault
-        injection (SURVEY.md §5.3).
+      worker_masks: optional iterable of ``(m,)`` {0,1} masks for fault
+        injection (SURVEY.md §5.3) — one per step; arrays/sequences are
+        accepted (wrapped with ``iter`` here, ONE place, so every
+        caller's contract matches).
       max_steps: ``"auto"`` caps the *total* step count (including resumed
         state) at ``cfg.num_steps`` — except under ``discount="1/t"``,
         where the auto cap is open-ended (a running mean only improves by
@@ -123,6 +125,8 @@ def online_distributed_pca(
       ``(w, state)`` — ``w`` the final (dim, k) principal subspace estimate
       (descending order, canonical signs), ``state`` the final online state.
     """
+    if worker_masks is not None:
+        worker_masks = iter(worker_masks)  # arrays/lists -> per-step iter
     if cfg.backend == "feature_sharded":
         if pool is not None:
             raise ValueError(
