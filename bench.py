@@ -96,10 +96,22 @@ def _bench_cfg():
     # Only the scan trainer implements it; the --steploop variant runs 12
     # cold iterations every step (so the steploop/scan delta conflates
     # dispatch overhead with the warm-start saving — see BASELINE.md).
+    # stage_dtype="int8": the warm steady state is HBM-bound (82-92% of
+    # the measured HBM anchor on its X re-reads — BASELINE.md), so
+    # halving the staged bytes attacks the binding resource directly.
+    # Round-5 A/B at this exact workload (scripts/exp_int8_stage.py):
+    # 67.7M samples/s [IQR 67.6-68.0M] int8-staged vs 57.0M [56.0-60.5M]
+    # bf16-staged, identical 0.1297 deg accuracy — the global symmetric
+    # quantization scale cancels in eigenvectors, the cold Gram runs
+    # int8 x int8 -> int32 natively (exact), and the warm matvec passes
+    # read half the bytes. DET_BENCH_STAGE overrides (e.g. "bfloat16"
+    # re-runs the A/B's losing arm).
+    stage = _os.environ.get("DET_BENCH_STAGE") or "int8"
     return PCAConfig(
         dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=TPU_STEPS,
         solver="subspace", subspace_iters=12, warm_start_iters=2,
         orth_method="cholqr2", compute_dtype="bfloat16",
+        stage_dtype=stage,
     )
 
 
@@ -212,13 +224,16 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
 
     cfg = _bench_cfg()
     fit = make_scan_fit(cfg, gather=True)
-    # stage in the compute dtype: the per-step cast happens once at
-    # staging, the host->device transfer ships half the bytes, and the
-    # per-step gather copies half the bytes (measured ~13% step-time
-    # saving at bf16, identical accuracy)
-    stage_dtype = cfg.compute_dtype or jnp.float32
+    # stage in the resolved stage dtype: bf16 staging ships/gathers half
+    # the fp32 bytes (measured ~13% step-time saving, identical
+    # accuracy); int8 staging (stage_dtype="int8") halves them AGAIN and
+    # the solvers contract int8 natively — the HBM-bound warm step reads
+    # half the bytes per pass (round-5 A/B, scripts/exp_int8_stage.py)
+    from distributed_eigenspaces_tpu.data.stream import stage_blocks
+
+    stage_dtype = cfg.resolved_stage_dtype()
     stacked = jnp.stack(
-        [jnp.asarray(b, dtype=stage_dtype) for b in blocks_host]
+        [jnp.asarray(b) for b in stage_blocks(blocks_host, stage_dtype)]
     )
     idx = jnp.arange(TPU_STEPS, dtype=jnp.int32) % len(blocks_host)
     _sync(stacked)
@@ -350,7 +365,7 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
             byte_model=step_byte_model(
                 M, N, D, K, cfg.subspace_iters,
                 cfg.resolved_warm_start(),
-                itemsize=2,  # blocks staged bf16
+                itemsize=stage_dtype.itemsize,  # what the passes read
             ),
             hbm_anchor_gbps=measure_hbm_anchor(small=small),
         )

@@ -67,6 +67,19 @@ class PCAConfig:
         matmul (``"bfloat16"`` runs the n x d^2 contraction at full MXU rate;
         accumulation stays fp32). ``None`` computes in the block dtype with
         fp32-equivalent precision.
+      stage_dtype: dtype blocks are STAGED in (HBM residency) by the
+        whole-fit trainers and the estimator. ``None`` stages in the
+        compute dtype (one cast at staging — half the host->device and
+        gather bytes at bf16). ``"int8"`` quantizes each staged block
+        symmetrically (``data.stream.quantize_blocks_i8``; the global
+        scale cancels in eigenvectors, so dequantization is free) and
+        the solvers contract it natively: the cold Gram runs int8 x
+        int8 -> int32 on the MXU (exact), and the HBM-bound warm
+        matvec passes read HALF the bf16 bytes — the round-5 measured
+        steady-state win (BASELINE.md; requires
+        ``compute_dtype="bfloat16"`` for the streaming path's in-loop
+        widen, and changes results only by the quantization noise,
+        measured ≤0.01° on the headline gate).
       dtype: storage/compute dtype for data blocks (bfloat16 keeps the MXU
         saturated; accumulation is always fp32 inside the kernels).
       state_dtype: dtype of the running ``sigma_tilde`` state.
@@ -104,6 +117,7 @@ class PCAConfig:
     warm_start_iters: int | None | str = "auto"
     orth_method: str = "cholqr2"
     compute_dtype: Any = None
+    stage_dtype: Any = None
     dtype: Any = jnp.float32
     state_dtype: Any = jnp.float32
     remainder: str = "drop"
@@ -138,6 +152,25 @@ class PCAConfig:
             raise ValueError(f"unknown orth_method: {self.orth_method!r}")
         if self.compute_dtype is not None:
             jnp.dtype(self.compute_dtype)  # raises on junk
+        if self.stage_dtype is not None:
+            sd = jnp.dtype(self.stage_dtype)  # raises on junk
+            if sd == jnp.dtype(jnp.int8) and (
+                self.compute_dtype is None
+                or jnp.dtype(self.compute_dtype) != jnp.dtype(jnp.bfloat16)
+            ):
+                # the int8 steady state exists to halve the bf16 HBM
+                # passes; without the bf16 compute path the streaming
+                # solver would widen up front and the staging only adds
+                # quantization noise — reject rather than silently
+                # running a strictly-worse configuration
+                raise ValueError(
+                    "stage_dtype='int8' requires compute_dtype='bfloat16' "
+                    "(the in-loop widen path; see BASELINE.md)"
+                )
+            if jnp.issubdtype(sd, jnp.integer) and sd != jnp.dtype(jnp.int8):
+                raise ValueError(
+                    f"integer stage_dtype must be int8, got {self.stage_dtype!r}"
+                )
         if self.collectives not in ("xla", "ring"):
             raise ValueError(f"unknown collectives mode: {self.collectives!r}")
         if self.remainder not in ("drop", "pad", "error"):
@@ -164,6 +197,18 @@ class PCAConfig:
         if self.warm_start_iters == "auto":
             return 2
         return self.warm_start_iters
+
+    def resolved_stage_dtype(self):
+        """The dtype staged blocks are HBM-resident in: ``stage_dtype``
+        when set, else the compute dtype (one cast at staging), else the
+        storage dtype. ONE definition for bench.py and the estimator's
+        whole-fit staging so they cannot drift."""
+        if self.stage_dtype is not None:
+            return jnp.dtype(self.stage_dtype)
+        return jnp.dtype(
+            self.compute_dtype if self.compute_dtype is not None
+            else self.dtype
+        )
 
     def replace(self, **kw) -> "PCAConfig":
         return dataclasses.replace(self, **kw)

@@ -34,6 +34,52 @@ def _place(block: np.ndarray, dtype, device: bool):
     return np.asarray(block, dtype=jnp.dtype(dtype))
 
 
+def quantize_block_i8(block) -> np.ndarray:
+    """Symmetric global int8 quantization of one staged block (host side).
+
+    The scale (absmax/127) is NOT returned: a symmetric scale cancels in
+    eigenvectors (the contract the int8 wire format already relies on,
+    ``data/bin_stream.py``), so PCA consumers never dequantize. One scale
+    per block — every worker inside a block shares it, and per-block
+    scales cancel per-worker-solve anyway (the merge consumes only the
+    orthonormal factors). Used by the whole-fit staging paths when
+    ``PCAConfig.stage_dtype == "int8"``: the solvers contract int8
+    natively (exact int32 Gram; in-loop-widened streaming passes reading
+    half the bf16 bytes — the HBM-bound warm step's round-5 win).
+    """
+    b = np.asarray(block, np.float32)
+    amax = float(np.max(np.abs(b))) if b.size else 0.0
+    if not np.isfinite(amax):
+        # loud beats silent: an inf makes the scale zero (whole block
+        # quantizes to zeros and is folded as if real), a NaN makes the
+        # int8 cast undefined garbage — and host-side quantization runs
+        # BEFORE the on-device DET_CHECKIFY NaN guards could trip
+        raise ValueError(
+            "quantize_block_i8: block contains non-finite values"
+        )
+    if amax == 0.0:
+        return np.zeros(b.shape, np.int8)
+    return np.clip(np.round(b * (127.0 / amax)), -127, 127).astype(np.int8)
+
+
+def stage_blocks(blocks, stage):
+    """Stage an iterable of ``(m, n, d)`` blocks in ``stage`` dtype — THE
+    one definition of the staging contract (estimator whole fits, the
+    sketch online continuation, and bench.py all route through it so
+    their staging cannot drift): int8 quantizes via
+    :func:`quantize_block_i8`; float dtypes cast (no-copy when the block
+    already matches)."""
+    stage = jnp.dtype(stage)
+    if stage == jnp.dtype(jnp.int8):
+        return (quantize_block_i8(np.asarray(b)) for b in blocks)
+    # host-side cast for EVERY input (numpy stays numpy, device arrays
+    # come back to host): the consumers (window_stream + the trainers'
+    # sharded device_put) own placement — a jnp cast here would commit
+    # blocks to the default device and break the per-device staging
+    # budget on multi-device meshes
+    return (np.asarray(b, stage) for b in blocks)
+
+
 def block_stream(
     data,
     *,

@@ -61,13 +61,27 @@ def gram(x: jax.Array, *, normalize: bool = True) -> jax.Array:
     numerical fidelity.
     """
     n = x.shape[0]
-    g = jnp.einsum(
-        "ni,nj->ij",
-        x,
-        x,
-        preferred_element_type=jnp.float32,
-        precision=_precision(x),
-    )
+    if x.dtype == jnp.int8 and n * 127 * 127 < 2**31:
+        # int8 wire blocks (symmetric quantization — the scale cancels in
+        # eigenvectors, data/bin_stream.py): contract NATIVELY on the MXU
+        # with exact int32 accumulation (n*127^2 < 2^31 guards overflow;
+        # 4x fewer HBM bytes than fp32 and 2x the bf16 MXU rate —
+        # measured ~4x faster at d=12288, scripts/exp_int8_stage.py)
+        g = jnp.einsum(
+            "ni,nj->ij", x, x, preferred_element_type=jnp.int32
+        ).astype(jnp.float32)
+    else:
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            # non-int8 integers (or overflow-unsafe n): widen — integer
+            # einsums accumulate in the input dtype and WRAP silently
+            x = x.astype(jnp.float32)
+        g = jnp.einsum(
+            "ni,nj->ij",
+            x,
+            x,
+            preferred_element_type=jnp.float32,
+            precision=_precision(x),
+        )
     if normalize:
         g = g / jnp.asarray(n, dtype=g.dtype)
     return g
@@ -89,7 +103,17 @@ def batched_xtxv(x: jax.Array, v: jax.Array) -> jax.Array:
     matmuls against neighboring step ops better than the opaque kernel
     call allows. Full table in BASELINE.md "Negative result: fused
     matvec kernel".
+
+    int8 inputs (the staged wire format — symmetric quantization, scale
+    cancels in eigenvectors) stay int8 in HBM: the bf16 widen happens
+    HERE, behind an optimization barrier so XLA's loop-invariant code
+    motion cannot hoist it out of the solver's iteration loop and
+    materialize a bf16 copy — each tall-skinny pass reads half the
+    bytes, which is the whole point on an HBM-bound warm step
+    (measured per-apply A/B in scripts/exp_int8_stage.py).
     """
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        x = jax.lax.optimization_barrier(x).astype(jnp.bfloat16)
     prec = _precision(x)
     xv = jnp.einsum(
         "mnd,mdk->mnk", x, v.astype(x.dtype), precision=prec,

@@ -211,20 +211,34 @@ def _make_matvec(x, n_total_rows, collectives="xla", compute_dtype=None):
         # integer einsums accumulate in the integer dtype and wrap
         # silently — widen quantized wire blocks (see bin_stream int8)
         compute_dtype = jnp.float32
-    xc = x.astype(compute_dtype) if compute_dtype is not None else x
+    # int8 wire blocks on the bf16 compute path stay int8 in HBM: the
+    # widen happens INSIDE the matvec behind an optimization barrier
+    # (mirrors ops.linalg.batched_xtxv — XLA's loop-invariant motion
+    # would otherwise hoist the convert out of the solver loop and
+    # materialize a bf16 copy, forfeiting the halved HBM reads the
+    # staging exists for; measured in scripts/exp_int8_stage.py)
+    int8_stream = x.dtype == jnp.int8 and (
+        jnp.dtype(compute_dtype) == jnp.bfloat16
+    )
+    xc = x if int8_stream else (
+        x.astype(compute_dtype) if compute_dtype is not None else x
+    )
     prec = HP if xc.dtype == jnp.float32 else None
     psum_c, _ = _collective_ops(collectives)
     reduce_features = lambda t: psum_c(t, FEATURE_AXIS)  # noqa: E731
 
     def matvec(v):
+        xw = xc
+        if int8_stream:
+            xw = jax.lax.optimization_barrier(xw).astype(jnp.bfloat16)
         xv = jnp.einsum(
-            "mnd,mdk->mnk", xc, v.astype(xc.dtype), precision=prec,
+            "mnd,mdk->mnk", xw, v.astype(xw.dtype), precision=prec,
             preferred_element_type=jnp.float32,
         )
         xv = reduce_features(xv)
         return (
             jnp.einsum(
-                "mnd,mnk->mdk", xc, xv.astype(xc.dtype), precision=prec,
+                "mnd,mnk->mdk", xw, xv.astype(xw.dtype), precision=prec,
                 preferred_element_type=jnp.float32,
             )
             / n_total_rows

@@ -108,11 +108,25 @@ def _local_eigenspaces(
 
     use_pallas = os.environ.get("DET_NO_PALLAS", "0") != "1"
 
-    if jnp.issubdtype(x_blocks.dtype, jnp.integer):
-        # quantized wire blocks (bin_stream int8 passthrough): integer
-        # einsums accumulate in the integer dtype and WRAP silently — always
-        # widen, to the compute dtype when set (the free-dequant contract:
-        # a symmetric quantization scale cancels in eigenvectors) else fp32
+    # int8 wire blocks (symmetric quantization — the scale cancels in
+    # eigenvectors, bin_stream / the int8-staged steady state) stay int8
+    # where a native contraction exists; every other integer dtype
+    # widens (integer einsums accumulate in the input dtype and WRAP
+    # silently). Two native consumers:
+    #   - Gram route: linalg.gram contracts int8 on the MXU with EXACT
+    #     int32 accumulation — keep int8 under any compute_dtype;
+    #   - streaming route: batched_xtxv widens to bf16 INSIDE the
+    #     iteration loop so every tall-skinny pass reads int8 bytes from
+    #     HBM (the warm step is HBM-bound — halving its resident bytes
+    #     is the round-5 measured win, scripts/exp_int8_stage.py). Only
+    #     taken on the bf16 compute path: fp32 semantics (HIGHEST-
+    #     precision matvecs) widen up front as before.
+    int8_wire = x_blocks.dtype == jnp.int8
+    int8_stream = int8_wire and (
+        compute_dtype is not None
+        and jnp.dtype(compute_dtype) == jnp.bfloat16
+    )
+    if jnp.issubdtype(x_blocks.dtype, jnp.integer) and not int8_wire:
         x_blocks = x_blocks.astype(
             compute_dtype if compute_dtype is not None else jnp.float32
         )
@@ -133,15 +147,20 @@ def _local_eigenspaces(
         d >= 4096 or (2 * k * iters < d and iters <= 6)
     )
     if streaming:
-        xall = (
-            x_blocks.astype(compute_dtype)
-            if compute_dtype is not None
-            else x_blocks
-        )
+        if int8_stream:
+            xall = x_blocks  # batched_xtxv widens in-loop (int8 HBM reads)
+        elif int8_wire:
+            xall = x_blocks.astype(
+                compute_dtype if compute_dtype is not None else jnp.float32
+            )
+        elif compute_dtype is not None:
+            xall = x_blocks.astype(compute_dtype)
+        else:
+            xall = x_blocks
         return _batched_streaming_eigenspaces(xall, k, iters, orth, v0)
 
     def one(xb):
-        if compute_dtype is not None:
+        if compute_dtype is not None and not int8_wire:
             xb = xb.astype(compute_dtype)
         g = gram_auto(xb) if use_pallas else gram(xb)
         if solver == "subspace":

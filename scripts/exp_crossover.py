@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Measure the sketch-vs-dense crossover NEAR the d*k boundary (round-5;
+advisor r4 item 1): the auto dispatch routes whole fits to the Nystrom
+sketch at d*k >= 65536, but the measured points were far from the
+boundary (2.5x sketch LOSS at d*k=8192; wins at 197k/614k). This script
+runs the SAME A/B protocol at configs bracketing the boundary so the
+crossover constant rests on measurements, not interpolation.
+
+Per config: dense scan fit vs sketch fit, one-program T-step schedule,
+value-fetch fence, RPC subtracted, median of 3 + IQR, plus the max
+principal angle vs a well-posed planted subspace (decay chosen so the
+k-th eigenvalue sits >> the noise floor) and the batch-PCA oracle angle
+on the same samples (the best ANY estimator of these rows could do).
+
+Usage: python scripts/exp_crossover.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+import warnings
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _sync(x):
+    return float(jnp.sum(x.astype(jnp.float32)))
+
+
+def _rpc():
+    tiny = jax.jit(lambda x: x + 1.0)
+    s = tiny(jnp.zeros(()))
+    _sync(s)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        s = tiny(s + 1.0)
+        _sync(s)
+    return (time.perf_counter() - t0) / 3
+
+
+def measure_config(d, k, m, n, steps, quick=False):
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        auto_feature_mesh,
+        make_feature_sharded_scan_fit,
+        make_feature_sharded_sketch_fit,
+    )
+
+    # decay so the k-th planted eigenvalue stays ~100x the noise floor:
+    # an ill-posed tail would measure estimation noise, not the trainers
+    decay = float(np.exp(np.log(0.055) / max(k - 1, 1)))
+    spec = planted_spectrum(
+        d, k_planted=k, gap=20.0, decay=decay, noise=0.01, seed=3
+    )
+    n_blocks = 4
+    blocks = np.stack([
+        np.asarray(
+            spec.sample(jax.random.PRNGKey(50 + b), m * n)
+        ).reshape(m, n, d)
+        for b in range(n_blocks)
+    ])
+    idx = jnp.arange(steps, dtype=jnp.int32) % n_blocks
+    cfg = PCAConfig(
+        dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=steps,
+        solver="subspace", subspace_iters=12, warm_start_iters=2,
+        compute_dtype="bfloat16", backend="feature_sharded",
+        discount="1/t",
+    )
+    mesh = auto_feature_mesh(cfg)
+
+    out = {"d": d, "k": k, "dk": d * k, "m": m, "n": n, "steps": steps}
+
+    for name, make in (
+        ("scan", make_feature_sharded_scan_fit),
+        ("sketch", make_feature_sharded_sketch_fit),
+    ):
+        fit = make(cfg, mesh, seed=cfg.seed)
+        staged = jax.device_put(
+            jnp.asarray(blocks), fit.blocks_sharding
+        )
+        st = fit(fit.init_state(), staged, jnp.roll(idx, 1))  # compile
+        jax.tree_util.tree_map(
+            lambda a: _sync(a) if hasattr(a, "astype") else a, st
+        )
+        rpc = _rpc()
+        reps = []
+        for r in range(3):
+            t0 = time.perf_counter()
+            st = fit(fit.init_state(), staged, idx)
+            _sync(st.y if name == "sketch" else st.u)
+            reps.append(time.perf_counter() - t0)
+        dt = float(np.median(reps))
+        dt -= min(rpc, 0.25 * dt)
+        w = fit.extract(st) if name == "sketch" else st.u[:, :k]
+        ang = float(
+            jnp.max(principal_angles_degrees(w, spec.top_k(k)))
+        )
+        out[name] = {
+            "samples_per_sec": round(steps * m * n / dt, 1),
+            "iqr_s": [round(min(reps), 4), round(max(reps), 4)],
+            "max_angle_deg": round(ang, 4),
+        }
+
+    out["sketch_over_scan"] = round(
+        out["sketch"]["samples_per_sec"] / out["scan"]["samples_per_sec"], 3
+    )
+    # oracle floor: batch PCA on every sampled row
+    pooled = blocks.reshape(-1, d)
+    g = pooled.T @ pooled
+    w_, v_ = np.linalg.eigh(g)
+    out["oracle_angle_deg"] = round(float(jnp.max(
+        principal_angles_degrees(
+            jnp.asarray(v_[:, ::-1][:, :k]), spec.top_k(k)
+        )
+    )), 4)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    steps = 20 if args.quick else 60
+    warnings.filterwarnings("ignore")
+
+    report = {"device": str(jax.devices()[0])}
+    # bracket the 65536 boundary: below, just above, the measured win
+    configs = [
+        (1024, 48, 8, 1024),   # dk=49k  (below)
+        (768, 96, 4, 1024),    # dk=74k  (just above — the A1 region)
+        (1024, 96, 4, 1024),   # dk=98k
+        (768, 160, 4, 1024),   # dk=123k
+    ]
+    report["configs"] = [
+        measure_config(d, k, m, n, steps, args.quick)
+        for d, k, m, n in configs
+    ]
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

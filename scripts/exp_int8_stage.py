@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""A/B harness for the int8-staged steady state (round-5 verdict item 1).
+
+The round-4 roofline pinned the warm online step at 82-92% of the measured
+HBM anchor: its floor is the 4 full passes over X per step (2 tall-skinny
+passes per solver iteration x warm_start_iters=2), so throughput scales
+with bytes moved, not FLOPs. Staging the cycled blocks int8 instead of
+bf16 halves the bytes on the binding resource; the global symmetric
+quantization scale cancels in eigenvectors (the contract already proven
+for the out-of-core wire format, data/bin_stream.py:16-22), so
+dequantization is a cast. The open questions this script answers with
+measurements (fused-kernel rigor: isolated probes AND end-to-end,
+median/IQR, delete what loses):
+
+  1. isolated matvec: does an int8-resident X actually cut per-apply time,
+     and does the convert need to stay inside the iteration loop
+     (optimization_barrier vs XLA's loop-invariant hoisting) to realize it?
+  2. isolated Gram: is the native int8 x int8 -> int32 MXU contraction
+     (exact for n <= 2^31/127^2 rows) faster than the bf16 Gram it would
+     replace in the cold step?
+  3. end-to-end: the full headline scan fit (T=600, gather staging) with
+     int8-staged blocks vs bf16 — throughput AND the principal-angle gate.
+
+Usage: python scripts/exp_int8_stage.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _sync(x):
+    return float(jnp.sum(x.astype(jnp.float32)))
+
+
+def _rpc_overhead():
+    tiny = jax.jit(lambda x: x + 1.0)
+    s = tiny(jnp.zeros(()))
+    _sync(s)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        s = tiny(s + 1.0)
+        _sync(s)
+    return (time.perf_counter() - t0) / 3
+
+
+def quantize_int8(x: np.ndarray):
+    """Global symmetric int8 quantization: scale cancels in eigenvectors."""
+    scale = np.abs(x).max() / 127.0
+    return np.clip(np.round(x / scale), -127, 127).astype(np.int8), scale
+
+
+# ---------------------------------------------------------------- matvec ---
+
+
+def _mv_chain(widen_in_loop: bool):
+    """Build jit(x, v0, L is static) running L dependent X^T(Xv) applies.
+
+    The staging dtype is carried by ``x`` itself (the jit specializes on
+    it). widen_in_loop: convert to bf16 INSIDE the loop body behind an
+    optimization_barrier (so XLA's LICM cannot hoist the convert out and
+    materialize a bf16 copy — the whole point of int8 residency is that
+    each pass reads int8).
+    """
+
+    def run(x, v, length):
+        def body(_, v):
+            xb = x
+            if widen_in_loop:
+                xb = jax.lax.optimization_barrier(xb)
+            xw = xb.astype(jnp.bfloat16)
+            xv = jnp.einsum(
+                "mnd,mdk->mnk", xw, v.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            out = jnp.einsum(
+                "mnd,mnk->mdk", xw, xv.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            return out / jnp.maximum(jnp.max(jnp.abs(out)), 1e-30)
+
+        return jax.lax.fori_loop(0, length, body, v)
+
+    return jax.jit(run, static_argnums=2)
+
+
+def _marginal(timed, base, ratio=3):
+    """Three-length differenced per-unit time with the roofline probe's
+    consistency gate (two independent estimates must agree within 2x, else
+    NaN — a jittery tunnel can silently produce wildly-wrong numbers)."""
+    from distributed_eigenspaces_tpu.utils.roofline import (
+        _consistent_marginal,
+    )
+
+    return _consistent_marginal(timed, base, ratio)
+
+
+def probe_matvec(m, n, d, k, quick=False):
+    """Differenced dependent-apply chains (three lengths, consistency-
+    gated, min-of-3 per length): per-apply ms for each staging variant."""
+    key = jax.random.PRNGKey(0)
+    x_f = jax.random.normal(key, (m, n, d), jnp.float32)
+    x_bf = x_f.astype(jnp.bfloat16)
+    x_i8, _ = quantize_int8(np.asarray(x_f))
+    x_i8 = jnp.asarray(x_i8)
+    v0 = jax.random.normal(jax.random.PRNGKey(1), (m, d, k), jnp.float32)
+
+    base = 16 if quick else 96
+    out = {}
+    variants = {
+        "bf16_staged": (x_bf, False),
+        "int8_widen_hoisted": (x_i8, False),
+        "int8_widen_in_loop": (x_i8, True),
+    }
+    for name, (x, in_loop) in variants.items():
+        f = _mv_chain(in_loop)
+
+        def timed(length):
+            _sync(f(x, v0, length))  # compile+warm
+            best = float("inf")
+            for r in range(3):
+                vr = v0 + (r + 1) * 1e-3  # fresh operands: no result cache
+                t0 = time.perf_counter()
+                _sync(f(x, vr, length))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        per = _marginal(timed, base)
+        out[name] = round(per * 1e3, 4) if per == per else None
+    return out
+
+
+# ------------------------------------------------------------------ gram ---
+
+
+def probe_gram(m, n, d, quick=False):
+    """Differenced chained Grams: bf16 einsum vs native int8->int32 MXU."""
+    key = jax.random.PRNGKey(0)
+    x_f = jax.random.normal(key, (m, n, d), jnp.float32)
+    x_bf = x_f.astype(jnp.bfloat16)
+    x_i8 = jnp.asarray(quantize_int8(np.asarray(x_f))[0])
+
+    def chain_bf16(x, s, length):
+        def body(acc, _):
+            g = jnp.einsum(
+                "mnd,mne->mde", x, x, preferred_element_type=jnp.float32
+            )
+            return acc + g[:, 0, 0] + s, None
+
+        out, _ = jax.lax.scan(
+            body, jnp.zeros((x.shape[0],), jnp.float32), None, length=length
+        )
+        return out
+
+    def chain_i8(x, s, length):
+        def body(acc, _):
+            g = jnp.einsum(
+                "mnd,mne->mde", x, x, preferred_element_type=jnp.int32
+            )
+            return acc + g[:, 0, 0].astype(jnp.float32) + s, None
+
+        out, _ = jax.lax.scan(
+            body, jnp.zeros((x.shape[0],), jnp.float32), None, length=length
+        )
+        return out
+
+    base = 4 if quick else 16
+    out = {}
+    for name, f, x in (
+        ("gram_bf16", chain_bf16, x_bf),
+        ("gram_int8_native", chain_i8, x_i8),
+    ):
+        g = jax.jit(f, static_argnums=2)
+
+        def timed(length):
+            _sync(g(x, jnp.zeros(()), length))
+            best = float("inf")
+            for r in range(3):
+                t0 = time.perf_counter()
+                _sync(g(x, jnp.full((), (r + 1) * 1e-3), length))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        per = _marginal(timed, base)
+        out[name] = round(per * 1e3, 4) if per == per else None
+    return out
+
+
+# ------------------------------------------------------------ end-to-end ---
+
+
+def run_fit(stage: str, steps: int, blocks_host, spectrum, cfg):
+    """One headline-protocol scan fit (gather staging, value-fetch fence,
+    RPC subtracted) with blocks staged in `stage` dtype."""
+    from distributed_eigenspaces_tpu.algo.online import OnlineState
+    from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+        top_k_eigvecs,
+    )
+
+    m, n, d, k = (
+        cfg.num_workers, cfg.rows_per_worker, cfg.dim, cfg.k,
+    )
+    fit = make_scan_fit(cfg, gather=True)
+    if stage == "int8":
+        staged = [quantize_int8(b)[0] for b in blocks_host]
+    else:
+        staged = [b.astype(stage) for b in blocks_host]
+    stacked = jnp.stack([jnp.asarray(b) for b in staged])
+    idx = jnp.arange(steps, dtype=jnp.int32) % len(blocks_host)
+    _sync(stacked.astype(jnp.float32)[:, 0, 0, 0])
+
+    warm = OnlineState.initial(d)
+    warm = warm._replace(sigma_tilde=warm.sigma_tilde + 1e-20)
+    st, _ = fit(warm, stacked, jnp.roll(idx, 1))
+    _sync(st.sigma_tilde)
+    rpc = _rpc_overhead()
+
+    reps = []
+    for r in range(3):
+        st0 = OnlineState.initial(d)._replace(
+            sigma_tilde=jnp.full((d, d), (r + 1) * 3e-20, jnp.float32)
+        )
+        t0 = time.perf_counter()
+        st, _ = fit(st0, stacked, idx)
+        _sync(st.sigma_tilde)
+        reps.append(time.perf_counter() - t0)
+    dt = float(np.median(reps)) - min(rpc, 0.25 * float(np.median(reps)))
+    w_est = top_k_eigvecs(st.sigma_tilde, k)
+    angle = float(
+        jnp.max(principal_angles_degrees(w_est, spectrum.top_k(k)))
+    )
+    return {
+        "samples_per_sec": round(steps * m * n / dt, 1),
+        "iqr": [
+            round(steps * m * n / (max(reps) - min(rpc, 0.25 * max(reps))), 1),
+            round(steps * m * n / (min(reps) - min(rpc, 0.25 * min(reps))), 1),
+        ],
+        "max_angle_deg": round(angle, 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+
+    report = {"device": str(jax.devices()[0])}
+
+    # headline shape + the HBM-heavy config-4 shape
+    shapes = [("headline", 8, 4096, 1024, 8), ("imagenet12288", 4, 2048, 12288, 50)]
+    report["matvec_ms_per_apply"] = {
+        name: probe_matvec(m, n, d, k, args.quick)
+        for name, m, n, d, k in shapes
+    }
+    report["gram_ms_per_build"] = {
+        name: probe_gram(m, n, d, args.quick)
+        for name, m, n, d, _ in shapes
+    }
+
+    # end-to-end headline fit
+    m, n, d, k, steps = (8, 4096, 1024, 8, 600 if not args.quick else 40)
+    spectrum = planted_spectrum(d, k_planted=k, gap=20.0, noise=0.01, seed=7)
+    blocks_host = [
+        np.asarray(
+            spectrum.sample(jax.random.PRNGKey(100 + b), m * n)
+        ).reshape(m, n, d)
+        for b in range(4)
+    ]
+    cfg = PCAConfig(
+        dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=steps,
+        solver="subspace", subspace_iters=12, warm_start_iters=2,
+        orth_method="cholqr2", compute_dtype="bfloat16",
+    )
+    report["end_to_end_headline"] = {
+        "bfloat16": run_fit("bfloat16", steps, blocks_host, spectrum, cfg),
+        "int8": run_fit("int8", steps, blocks_host, spectrum, cfg),
+    }
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""int8-staged steady state (round 5): native int8 contraction paths.
+
+The HBM-bound warm step reads the staged blocks twice per solver
+iteration; staging them int8 (symmetric quantization — the scale cancels
+in eigenvectors, the contract the out-of-core wire format already uses)
+halves the bytes on the binding resource. These tests pin the numerics:
+
+- ``linalg.gram`` on int8 contracts natively with EXACT int32
+  accumulation (bit-equal to the widened float path);
+- the streaming solver keeps int8 blocks int8 (in-loop widen) and lands
+  on the same subspace as the float path on a planted spectrum;
+- the estimator's ``stage_dtype="int8"`` whole fits (dense scan,
+  segmented, sharded) match the unquantized fit within the quantization
+  noise, well inside the 1-degree gate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.api.estimator import OnlineDistributedPCA
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.stream import quantize_block_i8
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.ops.linalg import (
+    batched_xtxv,
+    gram,
+    principal_angles_degrees,
+)
+from distributed_eigenspaces_tpu.parallel.worker_pool import (
+    _local_eigenspaces,
+)
+
+
+def _quantized_dataset(d=96, k=4, n_rows=4096, seed=3):
+    spec = planted_spectrum(d, k_planted=k, gap=20.0, noise=0.01, seed=seed)
+    x = np.asarray(spec.sample(jax.random.PRNGKey(seed), n_rows))
+    return spec, x
+
+
+def test_gram_int8_native_exact(rng):
+    x = rng.standard_normal((512, 64)).astype(np.float32)
+    xi = quantize_block_i8(x)
+    g_native = gram(jnp.asarray(xi))
+    g_widened = gram(jnp.asarray(xi).astype(jnp.float32))
+    # int32 accumulation of integer products is EXACT — not approximately
+    # equal, equal (both normalize by the same n afterwards)
+    np.testing.assert_array_equal(
+        np.asarray(g_native), np.asarray(g_widened)
+    )
+
+
+def test_gram_overflow_guard_widens(rng):
+    # n beyond the int32-exactness bound must take the widened path, not
+    # wrap: fake it by checking the bound arithmetic directly at a safe
+    # size (a real >2^31/127^2-row array would be ~16 GB)
+    n_unsafe = 2**31 // (127 * 127) + 1
+    assert n_unsafe * 127 * 127 >= 2**31
+    # safe n: native path engages and is exact (covered above); the
+    # guard's branch condition is pure Python on shapes, so asserting
+    # the arithmetic plus the safe-side behavior pins both sides
+    x = rng.integers(-127, 128, size=(64, 8)).astype(np.int8)
+    g = gram(jnp.asarray(x))
+    want = (x.astype(np.float64).T @ x.astype(np.float64)) / 64
+    np.testing.assert_allclose(np.asarray(g), want, rtol=1e-6)
+
+
+def test_quantize_block_i8_contract():
+    b = np.array([[0.5, -2.0], [1.0, 4.0]], np.float32)
+    q = quantize_block_i8(b)
+    assert q.dtype == np.int8
+    assert q.max() == 127 or q.min() == -127  # absmax maps to full scale
+    # zero block stays zero (no divide-by-zero)
+    z = quantize_block_i8(np.zeros((3, 3), np.float32))
+    assert z.dtype == np.int8 and not z.any()
+
+
+def test_batched_xtxv_int8_matches_bf16(rng):
+    x = rng.standard_normal((2, 128, 32)).astype(np.float32)
+    xi = quantize_block_i8(x)
+    v = rng.standard_normal((2, 32, 3)).astype(np.float32)
+    out_i8 = batched_xtxv(jnp.asarray(xi), jnp.asarray(v))
+    out_bf = batched_xtxv(
+        jnp.asarray(xi).astype(jnp.bfloat16), jnp.asarray(v)
+    )
+    # int8 -> bf16 is exact (integers <= 127), so the in-loop widen path
+    # must agree with pre-widened bf16 bit-for-bit
+    np.testing.assert_array_equal(np.asarray(out_i8), np.asarray(out_bf))
+
+
+def test_local_eigenspaces_int8_streaming_subspace():
+    spec, x = _quantized_dataset(d=96, k=4, n_rows=8 * 256)
+    blocks = x.reshape(8, 256, 96)
+    xi = quantize_block_i8(blocks)
+    # warm-route config (low iters -> streaming dispatch) on bf16: int8
+    # stays int8 into the in-loop widen
+    vs_i = _local_eigenspaces(
+        jnp.asarray(xi), 4, "subspace", 3, "cholqr2", jnp.bfloat16,
+        spec.top_k(4),
+    )
+    vs_f = _local_eigenspaces(
+        jnp.asarray(blocks), 4, "subspace", 3, "cholqr2", jnp.bfloat16,
+        spec.top_k(4),
+    )
+    ang = jnp.max(jax.vmap(principal_angles_degrees)(vs_i, vs_f))
+    assert float(ang) < 0.5, float(ang)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="compute_dtype='bfloat16'"):
+        PCAConfig(dim=8, k=2, stage_dtype="int8")
+    with pytest.raises(ValueError, match="must be int8"):
+        PCAConfig(
+            dim=8, k=2, stage_dtype="int16", compute_dtype="bfloat16"
+        )
+    cfg = PCAConfig(
+        dim=8, k=2, stage_dtype="int8", compute_dtype="bfloat16"
+    )
+    assert cfg.resolved_stage_dtype() == jnp.dtype(jnp.int8)
+    assert (
+        PCAConfig(dim=8, k=2, compute_dtype="bfloat16")
+        .resolved_stage_dtype()
+        == jnp.dtype(jnp.bfloat16)
+    )
+    assert PCAConfig(dim=8, k=2).resolved_stage_dtype() == jnp.dtype(
+        jnp.float32
+    )
+
+
+@pytest.mark.parametrize("trainer", ["scan", "segmented"])
+def test_estimator_int8_stage_matches_float(trainer):
+    spec, x = _quantized_dataset(d=64, k=3, n_rows=4 * 64 * 6)
+    base = PCAConfig(
+        dim=64, k=3, num_workers=4, rows_per_worker=64, num_steps=6,
+        solver="subspace", subspace_iters=10, compute_dtype="bfloat16",
+        backend="local",
+    )
+    ref = OnlineDistributedPCA(base, trainer=trainer).fit(x)
+    est = OnlineDistributedPCA(
+        base.replace(stage_dtype="int8"), trainer=trainer
+    ).fit(x)
+    ang = principal_angles_degrees(est.components_, ref.components_)
+    assert float(jnp.max(ang)) < 0.5, float(jnp.max(ang))
+    # and both against truth, inside the 1-degree gate
+    ang_t = principal_angles_degrees(est.components_, spec.top_k(3))
+    assert float(jnp.max(ang_t)) < 1.0, float(jnp.max(ang_t))
+
+
+def test_estimator_int8_stage_sketch_route(devices):
+    # the feature-sharded sketch route consumes int8 via _make_matvec's
+    # in-loop widen; pin it against the float sketch fit
+    spec, x = _quantized_dataset(d=128, k=4, n_rows=4 * 64 * 5)
+    base = PCAConfig(
+        dim=128, k=4, num_workers=4, rows_per_worker=64, num_steps=5,
+        solver="subspace", subspace_iters=10, compute_dtype="bfloat16",
+        backend="feature_sharded",
+    )
+    ref = OnlineDistributedPCA(base, trainer="sketch").fit(x)
+    est = OnlineDistributedPCA(
+        base.replace(stage_dtype="int8"), trainer="sketch"
+    ).fit(x)
+    ang = principal_angles_degrees(est.components_, ref.components_)
+    assert float(jnp.max(ang)) < 0.5, float(jnp.max(ang))

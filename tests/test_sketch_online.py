@@ -1,0 +1,144 @@
+"""Sketch-trainer online continuation (round-5 verdict item 3).
+
+The Nystrom carry (``SketchState``) is a per-step online state —
+``warm_step`` + the sketch fold are pure per-step functions — so
+``fit_stream``/``partial_fit`` after a sketch fit must CONTINUE the
+estimate instead of raising. The load-bearing equivalence: feeding T2
+extra blocks incrementally (any window split, including one-at-a-time
+``partial_fit``) lands on exactly the state a single windowed
+continuation produces — the cold-start-once contract of
+``fit_windows`` (the continuation programs are the same compiled
+programs, dispatched on the carry)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.api.estimator import OnlineDistributedPCA
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.ops.linalg import (
+    principal_angles_degrees,
+)
+from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+    SketchState,
+)
+
+D, K, M, N = 128, 4, 4, 64
+
+
+def _cfg(num_steps=4, **kw):
+    return PCAConfig(
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=num_steps,
+        solver="subspace", subspace_iters=10, backend="feature_sharded",
+        discount="1/t", **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def data():
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=5)
+    x = np.asarray(spec.sample(jax.random.PRNGKey(5), M * N * 10))
+    return spec, x.reshape(10, M, N, D)
+
+
+def _fresh(blocks, **kw):
+    est = OnlineDistributedPCA(_cfg(**kw), trainer="sketch")
+    est.fit(blocks[:4].reshape(-1, D))
+    assert isinstance(est.state, SketchState)
+    return est
+
+
+def test_partial_fit_continues_sketch(data):
+    spec, blocks = data
+    est = _fresh(blocks)
+    step0 = int(est.state.step)
+    est.partial_fit(blocks[4])
+    assert int(est.state.step) == step0 + 1
+    assert est.trainer_used_ == "sketch"
+    ang = principal_angles_degrees(est.components_, spec.top_k(K))
+    assert float(jnp.max(ang)) < 1.0
+
+
+def test_incremental_equals_windowed(data):
+    spec, blocks = data
+    # arm A: continue with 4 blocks in ONE fit_stream call
+    a = _fresh(blocks)
+    a.fit_stream(list(blocks[4:8]), max_steps=None)
+    # arm B: the same 4 blocks one partial_fit at a time
+    b = _fresh(blocks)
+    for t in range(4, 8):
+        b.partial_fit(blocks[t])
+    assert int(a.state.step) == int(b.state.step)
+    np.testing.assert_array_equal(np.asarray(a.state.y), np.asarray(b.state.y))
+    np.testing.assert_array_equal(np.asarray(a.state.v), np.asarray(b.state.v))
+    # arm C: uneven window split (segment=3 -> windows of 3+1)
+    c = _fresh(blocks)
+    c.segment = 3
+    c.fit_stream(list(blocks[4:8]), max_steps=None)
+    np.testing.assert_array_equal(np.asarray(a.state.y), np.asarray(c.state.y))
+
+
+def test_auto_cap_and_explicit_total_cap(data):
+    spec, blocks = data
+    # discount="1/T" (not 1/t): "auto" caps total steps at num_steps
+    est = OnlineDistributedPCA(
+        _cfg(num_steps=5).replace(discount="1/T"), trainer="sketch"
+    )
+    est.fit(blocks[:4].reshape(-1, D))
+    est.fit_stream(list(blocks[4:8]))  # max_steps="auto"
+    assert int(est.state.step) == 5  # 4 fitted + 1 allowed
+    # an explicit int is a TOTAL cap including the resumed state — the
+    # per-step loop's exact semantics (algo/online.py), so max_steps
+    # cannot silently mean something else on a sketch carry
+    est2 = _fresh(blocks)
+    est2.fit_stream(list(blocks[4:8]), max_steps=6)
+    assert int(est2.state.step) == 6
+    # a cap at/below the current step consumes nothing
+    est3 = _fresh(blocks)
+    est3.fit_stream(list(blocks[4:8]), max_steps=4)
+    assert int(est3.state.step) == 4
+
+
+def test_on_step_hook_sees_each_round(data):
+    spec, blocks = data
+    est = _fresh(blocks)
+    seen = []
+    est.fit_stream(
+        list(blocks[4:7]),
+        on_step=lambda t, st, v_bar: seen.append((t, v_bar.shape)),
+        max_steps=None,
+    )
+    assert [t for t, _ in seen] == [5, 6, 7]
+    assert all(shape == (D, K) for _, shape in seen)
+
+
+def test_worker_masks_per_step_contract(data):
+    spec, blocks = data
+    est = _fresh(blocks)
+    masks = [np.ones(M, np.float32) for _ in range(3)]
+    masks[1][0] = 0.0  # drop worker 0 in the middle round
+    est.fit_stream(list(blocks[4:7]), worker_masks=iter(masks),
+                   max_steps=None)
+    assert int(est.state.step) == 7
+    # short mask stream raises instead of silently dropping steps
+    est2 = _fresh(blocks)
+    with pytest.raises(ValueError, match="mask row"):
+        est2.fit_stream(
+            list(blocks[4:7]),
+            worker_masks=iter(masks[:2]), max_steps=None,
+        )
+
+
+def test_rebuilt_trainer_after_state_restore(data):
+    spec, blocks = data
+    est = _fresh(blocks)
+    restored = OnlineDistributedPCA(_cfg(), trainer="sketch")
+    restored.state = jax.tree_util.tree_map(jnp.asarray, est.state)
+    restored.partial_fit(blocks[4])  # _sketch_fit is None -> rebuilt
+    est.partial_fit(blocks[4])
+    np.testing.assert_allclose(
+        np.asarray(restored.state.y), np.asarray(est.state.y),
+        rtol=1e-5, atol=1e-6,
+    )
