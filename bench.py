@@ -411,10 +411,21 @@ def main():
     if "--profile-dir" in args:
         i = args.index("--profile-dir")
         if i + 1 >= len(args) or args[i + 1].startswith("--"):
-            print("usage: bench.py [--steploop] [--profile-dir DIR]",
+            print("usage: bench.py [--steploop] [--profile-dir DIR] "
+                  "[--compare BENCH_rNN.json]",
                   file=sys.stderr)
             return 2
         profile_dir = args[i + 1]
+    # --compare OLD.json: exit nonzero on >10% anchor-normalized
+    # regression vs a recorded round (see compare_reports)
+    compare_path = None
+    if "--compare" in args:
+        i = args.index("--compare")
+        if i + 1 >= len(args) or args[i + 1].startswith("--"):
+            print("usage: bench.py --compare BENCH_rNN.json",
+                  file=sys.stderr)
+            return 2
+        compare_path = args[i + 1]
 
     # persistent compile cache: TPU eigh at d=1024 is minutes to compile via
     # a remote-compile path; cache makes reruns start in seconds
@@ -450,6 +461,7 @@ def main():
         "vs_baseline": round(tpu_sps / cpu_sps, 2),
         **extras,
     }
+    _add_value_per_anchor(result)
     if angle_deg > 1.0:
         # fast-but-wrong is a FAIL: flag it and exit nonzero so harnesses
         # can't record the throughput as a pass
@@ -457,7 +469,53 @@ def main():
         print(json.dumps(result))
         return 1
     print(json.dumps(result))
+    if compare_path is not None:
+        return compare_reports(compare_path, result)
     return 0
+
+
+def _add_value_per_anchor(result: dict) -> None:
+    """Anchor-normalized throughput (round-5 verdict item 6): the tunnel
+    session moves BOTH the workload rate and the same-session anchors
+    (r3->r4: synthetic1024 fell 28.7M->21.2M while the matmul anchor
+    fell 125-157->92 TF/s), so cross-round comparisons must divide the
+    session out. value_per_anchor = samples/s per same-session anchor
+    TF/s — stable across sessions, the number --compare checks."""
+    anchor = result.get("anchor_tflops")
+    if anchor:
+        result["value_per_anchor"] = round(result["value"] / anchor, 1)
+
+
+def compare_reports(old_path: str, result: dict) -> int:
+    """``bench.py --compare BENCH_rNN.json``: exit nonzero on a >10%
+    ANCHOR-NORMALIZED regression vs a prior round's recorded report —
+    the machine answer to "is this a regression or a slow tunnel
+    session" that r3->r4 re-litigated in prose (BASELINE.md)."""
+    with open(old_path) as f:
+        old = json.load(f)
+    # driver-recorded BENCH_r files wrap the JSON line under "parsed"
+    old = old.get("parsed", old)
+    old_norm = old.get("value_per_anchor")
+    if old_norm is None and old.get("anchor_tflops"):
+        old_norm = old["value"] / old["anchor_tflops"]
+    new_norm = result.get("value_per_anchor")
+    if old_norm is None or new_norm is None:
+        print(
+            json.dumps({"compare": "skipped",
+                        "reason": "missing anchor fields"}),
+            file=sys.stderr,
+        )
+        return 0
+    ratio = new_norm / old_norm
+    verdict = {
+        "compare": old_path,
+        "old_value_per_anchor": round(float(old_norm), 1),
+        "new_value_per_anchor": round(float(new_norm), 1),
+        "normalized_ratio": round(ratio, 3),
+        "regression": bool(ratio < 0.9),
+    }
+    print(json.dumps(verdict), file=sys.stderr)
+    return 1 if ratio < 0.9 else 0
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ from typing import Callable, Iterable, Iterator, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_eigenspaces_tpu.config import PCAConfig
 from distributed_eigenspaces_tpu.ops.linalg import projector, top_k_eigvecs
@@ -175,7 +176,18 @@ def online_distributed_pca(
             iters=warm_iters if v_prev is not None else None,
         )
         if warm:
-            v_prev = v_bar
+            # an ALL-masked round merges to zeros; warm-starting from a
+            # zero basis is a fixed point of the solver (orth(0) = 0),
+            # so the carry keeps the last LIVE basis — and until any
+            # round survives, v_prev stays None and rounds run cold
+            # (round-5 §5.3 fix: an all-masked FIRST round previously
+            # dead-ended the whole fit at a zero estimate). Liveness is
+            # read from the MASK on the host (v_bar is all-zero exactly
+            # when the mask is all-zero) — checking v_bar itself would
+            # fetch device values every masked round and serialize the
+            # prefetch pipeline.
+            if mask is None or bool(np.any(np.asarray(mask))):
+                v_prev = v_bar
         return update(st, v_bar), v_bar
 
     state = _drive_stream(

@@ -26,8 +26,44 @@ from distributed_eigenspaces_tpu.config import PCAConfig
 from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
 
 
+def _masked_body_factory(cfg, round_core, warm_core, axis_name, update):
+    """ONE uniform masked step body shared by the masked scan and
+    segmented programs: per-step cold-vs-warm dispatch on the carry
+    itself (``lax.cond`` on "has any live round happened"), so a
+    killed-and-resumed masked run is bit-for-bit the unkilled one and an
+    all-masked FIRST round recovers instead of freezing a zero basis
+    (zeros are a fixed point of the warm solver). Semantics are the
+    per-step masked loop's exactly (tested equivalence): every round
+    folds its merge result — zeros on an all-masked round — and the warm
+    carry keeps the last LIVE basis.
+    """
+    warm = warm_core is not None
+
+    def body(carry, x, mk):
+        st, vp = carry
+        if warm:
+            live = jnp.any(vp != 0)
+            v_bar = jax.lax.cond(
+                live,
+                lambda xx, mm, vv: warm_core(
+                    xx, axis_name=axis_name, v0=vv, mask=mm
+                ),
+                lambda xx, mm, vv: round_core(
+                    xx, axis_name=axis_name, mask=mm
+                ),
+                x, mk, vp,
+            )
+        else:
+            v_bar = round_core(x, axis_name=axis_name, mask=mk)
+        vp_next = jnp.where(jnp.any(v_bar != 0), v_bar, vp)
+        return (update(st, v_bar), vp_next), v_bar
+
+    return body
+
+
 def make_scan_fit(
-    cfg: PCAConfig, mesh: Mesh | None = None, *, gather: bool = False
+    cfg: PCAConfig, mesh: Mesh | None = None, *, gather: bool = False,
+    masked: bool = False,
 ):
     """Build the whole-fit trainer, jitted.
 
@@ -49,10 +85,22 @@ def make_scan_fit(
     step runs the full-iteration cold core and every later step warm-starts
     its per-worker solves from the previous merged ``v_bar`` with the short
     iteration count — the online-stream optimization BASELINE.md measures.
+
+    ``masked=True`` builds the §5.3 fault-exclusion variant instead:
+    ``fit(state, x_steps, masks) -> (state, v_bars)`` with ``masks`` a
+    ``(T, m)`` {0,1} array — one program, per-step cold/warm dispatch on
+    the carry (:func:`_masked_body_factory`), equivalent to the per-step
+    masked loop (tested). The unmasked build stays the exact pre-mask
+    program, so the throughput path pays nothing for the fault
+    machinery. ``gather`` staging is not offered masked (masked fits are
+    dense-staged by the estimator).
     """
     # function-level import: utils.__init__ pulls checkpoint, which
     # imports this module — a top-level import would cycle
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
+
+    if masked and gather:
+        raise ValueError("masked scan fits take a dense (T, ...) stack")
 
     round_core = make_round_core(cfg)
     warm_iters = cfg.resolved_warm_start()
@@ -64,6 +112,23 @@ def make_scan_fit(
             return update_state(
                 st, v_bar, discount=cfg.discount, num_steps=cfg.num_steps
             )
+
+        if masked:
+            mbody = _masked_body_factory(
+                cfg, round_core, warm_core, axis_name, update
+            )
+
+            def fit_masked(state, x_steps, masks):
+                k = cfg.k
+                vp0 = jnp.zeros((cfg.dim, k), jnp.float32)
+                (state, _), v_bars = jax.lax.scan(
+                    lambda c, xm: mbody(c, xm[0], xm[1]),
+                    (state, vp0),
+                    (x_steps, masks.astype(jnp.float32)),
+                )
+                return state, v_bars
+
+            return fit_masked
 
         def step_body(st, x):
             v_bar = round_core(x, axis_name=axis_name)
@@ -127,8 +192,9 @@ def make_scan_fit(
     # crosses ICI each step
     rep = NamedSharding(mesh, P())
     x_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
-    in_specs = (P(), P(None, WORKER_AXIS)) + ((P(),) if gather else ())
-    in_shardings = (rep, x_sharding) + ((rep,) if gather else ())
+    extra = (P(),) if (gather or masked) else ()  # idx / (T, m) masks
+    in_specs = (P(), P(None, WORKER_AXIS)) + extra
+    in_shardings = (rep, x_sharding) + ((rep,) if (gather or masked) else ())
     inner = jax.shard_map(
         make_fit(axis_name=WORKER_AXIS),
         mesh=mesh,
@@ -220,9 +286,36 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
 
         return seg
 
+    def make_seg_masked(axis_name):
+        """§5.3 masked window program — ONE program for every window,
+        first or continuation: per-step cold/warm dispatch on the carry
+        (:func:`_masked_body_factory`), so kill/resume is bit-for-bit
+        and an all-masked first round recovers cold."""
+        mbody = _masked_body_factory(
+            cfg, round_core, warm_core, axis_name, update
+        )
+
+        def body(c, xm):
+            carry, _ = mbody(c, xm[0], xm[1])
+            return carry, None
+
+        def seg(sstate, x_steps, masks):
+            st = OnlineState(sstate.sigma_tilde, sstate.step)
+            (st, vp), _ = jax.lax.scan(
+                body,
+                (st, sstate.v_prev),
+                (x_steps, masks.astype(jnp.float32)),
+            )
+            return SegmentState(st.sigma_tilde, st.step, vp)
+
+        return seg
+
     if mesh is None:
         def build(first):
             return checked_jit(make_seg(None, first))
+
+        def build_masked():
+            return checked_jit(make_seg_masked(None))
     else:
         rep = NamedSharding(mesh, P())
         x_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
@@ -239,14 +332,31 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
                 inner, in_shardings=(rep, x_sharding), out_shardings=rep
             )
 
+        def build_masked():
+            inner = jax.shard_map(
+                make_seg_masked(WORKER_AXIS),
+                mesh=mesh,
+                in_specs=(P(), P(None, WORKER_AXIS), P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+            return checked_jit(
+                inner,
+                in_shardings=(rep, x_sharding, rep),
+                out_shardings=rep,
+            )
+
     compiled = {}
 
-    def _get(first):
-        if first not in compiled:
-            compiled[first] = build(first)
-        return compiled[first]
+    def _get(first, masked=False):
+        key = (False, True) if masked else (first, False)
+        if key not in compiled:
+            compiled[key] = build_masked() if masked else build(first)
+        return compiled[key]
 
-    def fit_windows(state, windows, on_segment=None) -> SegmentState:
+    def fit_windows(
+        state, windows, on_segment=None, worker_masks=None
+    ) -> SegmentState:
         """Out-of-core variant: consume an ITERATOR of staged
         ``(S, m, n, d)`` windows instead of one resident ``(T, ...)``
         array — the whole-fit path for streams that never fit in device
@@ -260,6 +370,12 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
         specializes the jit once more); semantics are identical to
         :func:`fit` on the concatenation (same compiled programs —
         ``fit`` IS this function over a slice generator).
+
+        ``worker_masks`` (an iterable of ``(S, m)`` {0,1} arrays
+        parallel to ``windows``, zipped strict) runs the §5.3 masked
+        window program instead — one cond-dispatch program for every
+        window, so kill/resume stays bit-for-bit (the per-step
+        cold/warm branch depends only on the restored carry).
         """
         # without warm start the "first" program is identical to the
         # continuation program — never compile it twice. A ZERO carry
@@ -273,8 +389,18 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
         first = warm and (
             int(state.step) == 0 or not bool(jnp.any(state.v_prev))
         )
-        for w in windows:
-            state = _get(first)(state, w)
+        pairs = (
+            ((w, None) for w in windows)
+            if worker_masks is None
+            else zip(windows, worker_masks, strict=True)
+        )
+        for w, mk in pairs:
+            if mk is None:
+                state = _get(first)(state, w)
+            else:
+                state = _get(first, masked=True)(
+                    state, w, jnp.asarray(mk, jnp.float32)
+                )
             first = False
             if on_segment is not None:
                 on_segment(int(state.step), state)

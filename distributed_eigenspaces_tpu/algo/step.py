@@ -41,7 +41,12 @@ def make_round_core(cfg: PCAConfig, iters: int | None = None):
     still exposes it). ``axis_name`` names the mesh axis to gather over
     (None = single device). ``iters`` overrides ``cfg.subspace_iters``
     (the warm-start trainer uses a short-iteration core for steps > 0);
-    ``v0`` warm-starts the per-worker subspace iterations.
+    ``v0`` warm-starts the per-worker subspace iterations. ``mask``
+    (full ``(m,)`` {0,1}, replicated) excludes failed workers from the
+    merge — the §5.3 fault exclusion, weighted exactly
+    (:func:`~..ops.linalg.merged_top_k_lowrank`); an all-masked round
+    merges to zeros (callers fold the zero projector and keep their
+    warm carry — the per-step loop's tested semantics).
     """
     k, solver = cfg.k, cfg.solver
     if iters is None:
@@ -52,7 +57,7 @@ def make_round_core(cfg: PCAConfig, iters: int | None = None):
     # captured trace shows — worker solve vs gather vs merge
     from distributed_eigenspaces_tpu.utils.tracing import named_scope
 
-    def round_core(x_blocks, axis_name=None, v0=None):
+    def round_core(x_blocks, axis_name=None, v0=None, mask=None):
         with named_scope("det_worker_solve"):
             vs = _local_eigenspaces(
                 x_blocks, k, solver, iters, orth, cdtype, v0
@@ -64,7 +69,7 @@ def make_round_core(cfg: PCAConfig, iters: int | None = None):
             with named_scope("det_factor_gather"):
                 vs = jax.lax.all_gather(vs, axis_name, axis=0, tiled=True)
         with named_scope("det_merge"):
-            return merged_top_k_lowrank(vs, k)
+            return merged_top_k_lowrank(vs, k, mask=mask)
 
     return round_core
 

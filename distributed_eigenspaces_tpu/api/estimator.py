@@ -153,6 +153,29 @@ def _budget_steps(cfg: PCAConfig, n_devices: int = 1) -> int:
     )
 
 
+def _validated_masks(worker_masks, num_workers: int) -> np.ndarray:
+    """Shape-check a (T, m) worker-mask sequence — shared by every
+    masked whole-fit route."""
+    worker_masks = np.asarray(worker_masks, np.float32)
+    if worker_masks.ndim != 2 or worker_masks.shape[1] != num_workers:
+        raise ValueError(
+            f"worker_masks shape {worker_masks.shape} != "
+            f"(T, num_workers={num_workers})"
+        )
+    return worker_masks
+
+
+def _masks_for(worker_masks: np.ndarray, t: int) -> np.ndarray:
+    """First ``t`` mask rows; raises when the supply is short — a
+    silently dropped step's mask is the §5.3 bug class this guards."""
+    if len(worker_masks) < t:
+        raise ValueError(
+            f"worker_masks covers {len(worker_masks)} steps; the "
+            f"schedule runs {t} — every step needs its mask row"
+        )
+    return worker_masks[:t]
+
+
 def _lockstep_mask_windows(windows, take_rows):
     """Mask windows SHAPED BY the data windows, not pre-windowed: the
     schedule's actual step count belongs to the data (a truncating
@@ -249,10 +272,12 @@ class OnlineDistributedPCA:
         at construction: whole-dataset fits run the whole-fit trainers the
         benchmark measures (scan / segmented / sketch); ``on_step`` hooks
         or explicit ``trainer="step"`` run the per-step loop.
-        ``worker_masks`` as a ``(T, m)`` SEQUENCE (array/list/tuple) on a
-        feature-sharded workload runs the MASKED whole-fit trainers
-        (§5.3 without giving up whole-fit throughput; the mask count
-        must match the step schedule — mismatches raise); a mask
+        ``worker_masks`` as a ``(T, m)`` SEQUENCE (array/list/tuple)
+        runs the MASKED whole-fit trainers on EVERY whole-fit route —
+        dense scan, segmented, feature-sharded scan, sketch (§5.3
+        without giving up whole-fit throughput; round 5 closed the
+        dense gap — previously a loud error). The mask count must
+        cover the step schedule (short masks raise); a mask
         generator/iterator keeps the per-step loop, whose contract is
         one ``next()`` per round.
         """
@@ -278,12 +303,11 @@ class OnlineDistributedPCA:
                 per_step_hooks=(on_step is not None),
                 checkpointing=self.checkpoint_dir is not None,
             )
-            if worker_masks is not None and not (
-                masks_seq and _routes_feature_whole(cfg, trainer)
-            ):
-                # masks that can't ride a masked whole fit fall back to
-                # the per-step loop (its contract covers generators and
-                # every backend)
+            if worker_masks is not None and not masks_seq:
+                # mask generators can't ride a compiled whole fit (one
+                # next() per round needs host control) — fall back to
+                # the per-step loop; every whole-fit trainer has masked
+                # programs for SEQUENCE masks since round 5
                 trainer = choose_trainer(
                     cfg,
                     per_step_hooks=True,
@@ -298,19 +322,14 @@ class OnlineDistributedPCA:
         elif (
             trainer != "step"
             and worker_masks is not None
-            and not (masks_seq and _routes_feature_whole(cfg, trainer))
+            and not masks_seq
         ):
-            # covers: segmented / dense-scan overrides (no masked
-            # whole-fit programs exist there — round-4 review: the
-            # segmented route previously DROPPED the masks silently) and
-            # mask generators on any whole-fit trainer
+            # a mask generator on an explicit whole-fit override: the
+            # whole-fit programs need the full (T, m) schedule up front
             raise ValueError(
-                f"trainer={trainer!r} takes worker_masks only as a "
-                "(T, m) sequence on a trainer that routes to the "
-                "feature-sharded whole fit (sketch, or scan on a "
-                "feature-sharded workload); pass an array/list there, "
-                "or use trainer='step' for a per-step mask generator "
-                "or the dense backends"
+                f"trainer={trainer!r} takes worker_masks as a (T, m) "
+                "sequence (array/list/tuple); use trainer='step' for a "
+                "per-step mask generator"
             )
         masks_whole = trainer != "step" and worker_masks is not None
         if self.checkpoint_dir is not None and (
@@ -362,8 +381,10 @@ class OnlineDistributedPCA:
         """Whole-fit trainers: stage the T-step schedule and run it as one
         (or T/segment) compiled programs — the bench.py throughput path,
         now reachable from the public API (round-2 verdict item 2).
-        ``worker_masks`` reaches only the feature-sharded routes (the
-        caller validated that)."""
+        ``worker_masks`` (a validated (T, m) sequence) reaches EVERY
+        route since round 5: the dense scan and segmented fits run
+        their masked programs (algo/scan.py), the feature-sharded
+        routes theirs."""
         cfg = self.cfg
 
         # host-side block source (device=False): a per-block device round
@@ -401,7 +422,9 @@ class OnlineDistributedPCA:
             # stream windows — never materialize the full stack anywhere:
             # O(segment) host AND device memory, the route the oversized-
             # stage dispatch (> SCAN_STAGE_BYTES_MAX) relies on
-            return self._fit_segmented(cfg, host_blocks())
+            return self._fit_segmented(
+                cfg, host_blocks(), worker_masks=worker_masks
+            )
 
         if _routes_feature_whole(cfg, trainer):
             return self._fit_feature_sharded(
@@ -417,9 +440,22 @@ class OnlineDistributedPCA:
             raise ValueError(f"unknown trainer {trainer!r}")
         from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
 
-        final, _ = make_scan_fit(cfg, mesh=_scan_mesh(cfg))(
-            OnlineState.initial(cfg.dim, cfg.state_dtype), xs
-        )
+        state0 = OnlineState.initial(cfg.dim, cfg.state_dtype)
+        if worker_masks is not None:
+            # §5.3 on the dense whole fit (round 5 — previously a loud
+            # ValueError): the masked scan program, equivalent to the
+            # per-step masked loop (tested)
+            masks = _masks_for(
+                _validated_masks(worker_masks, cfg.num_workers),
+                xs.shape[0],
+            )
+            final, _ = make_scan_fit(
+                cfg, mesh=_scan_mesh(cfg), masked=True
+            )(state0, xs, jnp.asarray(masks))
+        else:
+            final, _ = make_scan_fit(cfg, mesh=_scan_mesh(cfg))(
+                state0, xs
+            )
         return self._finish_dense(cfg, final)
 
     def _fit_feature_sharded(
@@ -481,24 +517,12 @@ class OnlineDistributedPCA:
         budget_steps = _budget_steps(cfg, mesh.devices.size)
 
         if worker_masks is not None:
-            worker_masks = np.asarray(worker_masks, np.float32)
-            if worker_masks.ndim != 2 or worker_masks.shape[1] != (
-                cfg.num_workers
-            ):
-                raise ValueError(
-                    f"worker_masks shape {worker_masks.shape} != "
-                    f"(T, num_workers={cfg.num_workers})"
-                )
+            worker_masks = _validated_masks(worker_masks, cfg.num_workers)
 
         def masks_for(t):
             if worker_masks is None:
                 return None
-            if len(worker_masks) < t:
-                raise ValueError(
-                    f"worker_masks covers {len(worker_masks)} steps; the "
-                    f"schedule runs {t} — every step needs its mask row"
-                )
-            return worker_masks[:t]
+            return _masks_for(worker_masks, t)
 
         if self.checkpoint_dir is None and cfg.num_steps <= budget_steps:
             blocks = list(host_blocks())
@@ -579,10 +603,15 @@ class OnlineDistributedPCA:
             windows = prefetch_stream(windows, depth=1, place=place)
         return windows, on_segment
 
-    def _fit_segmented(self, cfg, host_blocks) -> "OnlineDistributedPCA":
+    def _fit_segmented(
+        self, cfg, host_blocks, worker_masks=None
+    ) -> "OnlineDistributedPCA":
         """Segmented whole-fit over a HOST block iterator: windows of
         ``segment`` steps staged on device one at a time (fit_windows) —
-        O(segment) host and device memory, checkpoint every window."""
+        O(segment) host and device memory, checkpoint every window.
+        ``worker_masks`` (a (T, m) sequence) runs the masked window
+        programs in data-window lockstep — §5.3 on the out-of-core
+        route too (round 5)."""
         from distributed_eigenspaces_tpu.algo.scan import (
             SegmentState,
             make_segmented_fit,
@@ -593,6 +622,13 @@ class OnlineDistributedPCA:
         windows, on_segment = self._windowed_source(
             cfg, host_blocks, _budget_steps(cfg), place=lambda w: w,
         )
+        mask_windows = None
+        if worker_masks is not None:
+            worker_masks = _validated_masks(worker_masks, cfg.num_workers)
+            windows, mask_windows = _lockstep_mask_windows(
+                windows,
+                lambda start, s: _masks_for(worker_masks, start + s)[start:],
+            )
         fit = make_segmented_fit(
             cfg, _scan_mesh(cfg), segment=self.segment
         )
@@ -600,6 +636,7 @@ class OnlineDistributedPCA:
             SegmentState.initial(cfg.dim, cfg.k, dtype=cfg.state_dtype),
             windows,
             on_segment=on_segment,
+            worker_masks=mask_windows,
         )
         if int(state.step) == 0:
             raise ValueError("dataset yielded zero full steps")

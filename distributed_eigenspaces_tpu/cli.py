@@ -623,13 +623,22 @@ def main(argv=None) -> int:
             "collectives ride ICI)",
             file=sys.stderr,
         )
-    if args.warm_start_iters and args.solver != "subspace":
+    if (
+        args.warm_start_iters
+        and args.solver != "subspace"
+        and getattr(args, "trainer", None) != "sketch"
+    ):
         # an explicit 0 ("disable") is solver-independent; a positive
-        # count needs the iterative solver to exist
+        # count needs the iterative solver to exist — EXCEPT on the
+        # sketch trainer, which honors warm_start_iters regardless of
+        # solver (it sets the per-step matvec count; the sketch has no
+        # eigh alternative — config.py resolved_warm_start docs)
         print(
             "error: --warm-start-iters requires --solver subspace "
             "(warm start initializes the iterative solver; eigh has "
-            "nothing to warm-start)",
+            "nothing to warm-start). The sketch trainer is exempt "
+            "(--trainer sketch): it honors warm-start-iters with any "
+            "solver.",
             file=sys.stderr,
         )
         return 2

@@ -194,6 +194,19 @@ class Checkpointer:
         return sorted(out)
 
     def _gc(self):
+        # single-writer discipline, like save_checkpoint's: in a
+        # multi-host run every process calls on_step against the SHARED
+        # directory, and concurrent rmtree of the same step dirs was
+        # only masked by ignore_errors — worse, a non-zero process
+        # could delete a checkpoint process 0 is concurrently reading
+        # via latest(). Process 0 (the writer) is the only collector.
+        # (save_checkpoint barriers after its commit marker, so by the
+        # time any process returns from on_step the new checkpoint is
+        # durable and collecting old ones is safe.)
+        import jax
+
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
         steps = self._steps()
         for s in steps[: max(0, len(steps) - self.keep)]:
             import shutil

@@ -125,26 +125,56 @@ def step_byte_model(
     cold_iters: int,
     warm_iters: int | None,
     itemsize: int = 2,
+    state: str = "dense",
 ) -> dict:
     """Dominant-term HBM bytes per online step for the subspace trainers,
     following the SAME route dispatch as :func:`step_flop_model` (and the
-    actual solver, ``worker_pool.py``): the streaming route re-reads the
-    (m, n, d) block TWICE per solver iteration (the two tall-skinny
-    passes of ``X^T (X v)``); the Gram route reads the block once to
-    build the d x d Gram (fp32, one write) and then reads that Gram once
-    per matvec iteration. k-width bases/Grams are O(d*k) — <5% at every
-    BASELINE config — and excluded. The byte twin of
-    :func:`step_flop_model`, and the machine-readable reason an
-    HBM-bound config cannot approach the FLOP anchor: its ceiling is
-    the measured HBM rate instead.
+    actual solver, ``worker_pool.py``). Round 5 completed the model
+    (verdict item 5 — the old X-reads-only version was a known
+    undercount, which made ``pct_of_hbm_anchor`` quietly low):
+
+    streaming route, per solver iteration:
+      - X passes: the (m, n, d) block read TWICE (``X^T (X v)``),
+        ``itemsize`` = the STAGED dtype (int8 staging halves this, the
+        binding term);
+      - the (m, n, k) ``Xv`` intermediate: one fp32 write + one read;
+      - basis traffic: ~4 fp32 passes over (m, d, k) (matvec read +
+        result write, orthonormalization read + write; the k x k
+        Grams/Cholesky are O(k^2) — excluded).
+    per step: the factor merge (~2 fp32 passes over (m, d, k)) and the
+    state fold — ``state="dense"``: sigma_tilde read + write (2 d^2
+    fp32, the dense scan/segmented trainers); ``state="lowrank"``: ~2
+    passes over the rank-r carry (~(k+16)-wide — the feature-sharded /
+    sketch trainers, where no d x d exists by design).
+
+    Gram route: block read once + d x d Gram write (fp32, per worker) +
+    one Gram read per matvec iteration + the same merge/fold terms.
+
+    The byte twin of :func:`step_flop_model`, and the machine-readable
+    reason an HBM-bound config cannot approach the FLOP anchor: its
+    ceiling is the measured HBM rate instead.
     """
     block = m * n * d * itemsize
+    merge = 2 * m * d * k * 4
+    if state == "lowrank":
+        fold = 2 * d * (k + 16) * 4
+    else:
+        fold = 2 * d * d * 4
 
     def per_step(iters: int) -> int:
         streams = d >= 4096 or (2 * k * iters < d and iters <= 6)
         if streams:
-            return block * 2 * iters
-        return block + m * (1 + iters) * d * d * 4  # Gram is fp32
+            per_iter = (
+                block * 2          # the two tall-skinny X passes
+                + 2 * m * n * k * 4  # Xv intermediate write + read
+                + 4 * m * d * k * 4  # basis passes (matvec + orth)
+            )
+            return per_iter * iters + merge + fold
+        return (
+            block
+            + m * (1 + iters) * d * d * 4  # Gram write + per-iter reads
+            + merge + fold
+        )
 
     return {
         "cold_bytes_per_step": per_step(cold_iters),

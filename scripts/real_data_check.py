@@ -121,10 +121,80 @@ def prepare_mnist(data_dir: str, offline: bool) -> str:
     return out
 
 
+# Scale-out configs (BASELINE 4/5): there is no public fetchable corpus
+# (ImageNet requires registration; CLIP embeddings are user-produced),
+# so their real-data story is INGESTION of a user-supplied directory of
+# .npy / flat-.bin row files at {data_dir}/{config}/ (see
+# data/npy_dir.py for the formats: patch stacks flatten row-major).
+# Absent that directory, this script synthesizes a dataset TO DISK and
+# runs the same ingestion path end-to-end — the files/loader/report
+# plumbing is exercised even where the corpus itself cannot be (the
+# report then carries "source": "synthesized-on-disk" next to the
+# loader's provenance, never silently posing as the real corpus).
+# Shrunk schedules: the ingestion check reads real bytes through the
+# real path; it makes no throughput claim, so it does not need the
+# full 4 GB workload.
+ROWS_CONFIGS = {
+    "imagenet12288": dict(num_workers=2, rows_per_worker=256, steps=4),
+    "clip768": dict(num_workers=4, rows_per_worker=256, steps=4),
+}
+
+
+def prepare_rows(data_dir: str, name: str) -> tuple[str, bool]:
+    """Ensure ``{data_dir}/{name}/`` holds row files; returns
+    ``(config_dir_parent, synthesized)``. User-supplied files win; an
+    empty/missing directory gets a synthesized-on-disk dataset."""
+    import numpy as np
+
+    sub = os.path.join(data_dir, name)
+    if os.path.isdir(sub) and any(
+        f.endswith((".npy", ".bin")) for f in os.listdir(sub)
+    ):
+        return data_dir, False
+
+    from distributed_eigenspaces_tpu.data.synthetic import planted_subspace
+    from distributed_eigenspaces_tpu.evals import EVAL_SPECS
+
+    import jax
+
+    spec = EVAL_SPECS[name]
+    over = ROWS_CONFIGS[name]
+    d = spec.dim
+    rows = over["num_workers"] * over["rows_per_worker"] * (
+        over["steps"] + 1
+    )
+    print(f"# synthesizing {rows} x {d} rows under {sub}", file=sys.stderr)
+    os.makedirs(sub, exist_ok=True)
+    spectrum = planted_subspace(
+        d, k_planted=spec.k, gap=20.0, noise=0.01, seed=11
+    )
+    x = np.asarray(
+        spectrum.sample(jax.random.PRNGKey(11), rows), np.float32
+    )
+    half = rows // 2
+    if name == "imagenet12288":
+        # patch-stack form (N, 64, 64, 3): exercises the row-major
+        # flatten the patch contract documents
+        np.save(
+            os.path.join(sub, "patches_000.npy"),
+            x[:half].reshape(-1, 64, 64, 3),
+        )
+        np.save(
+            os.path.join(sub, "patches_001.npy"),
+            x[half:].reshape(-1, 64, 64, 3),
+        )
+    else:
+        # one .npy + one flat .bin: both ingestion formats covered
+        np.save(os.path.join(sub, "embeddings_000.npy"), x[:half])
+        x[half:].tofile(os.path.join(sub, "embeddings_001.bin"))
+    return data_dir, True
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     p.add_argument("configs", nargs="*", default=[],
-                   help="cifar10 and/or mnist784 (default: both)")
+                   help="cifar10 / mnist784 / imagenet12288 / clip768 "
+                        "(default: cifar10 mnist784)")
     p.add_argument("--data-dir", default="det-data",
                    help="where archives + extracted datasets live")
     p.add_argument("--offline", action="store_true",
@@ -134,18 +204,26 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     names = args.configs or ["cifar10", "mnist784"]
-    bad = set(names) - {"cifar10", "mnist784"}
+    known = {"cifar10", "mnist784"} | set(ROWS_CONFIGS)
+    bad = set(names) - known
     if bad:
-        print(f"error: real-data configs are cifar10/mnist784, got {bad}",
+        print(f"error: real-data configs are {sorted(known)}, got {bad}",
               file=sys.stderr)
         return 2
     os.makedirs(args.data_dir, exist_ok=True)
 
     prep = {"cifar10": prepare_cifar10, "mnist784": prepare_mnist}
     dirs = {}
+    synthesized = {}
     for name in names:
         try:
-            dirs[name] = prep[name](args.data_dir, args.offline)
+            if name in ROWS_CONFIGS:
+                dirs[name], synthesized[name] = prepare_rows(
+                    args.data_dir, name
+                )
+            else:
+                dirs[name] = prep[name](args.data_dir, args.offline)
+                synthesized[name] = False
         # EOFError: gzip raises it on a truncated pre-placed archive
         except (urllib.error.URLError, OSError, RuntimeError,
                 EOFError) as e:
@@ -161,13 +239,23 @@ def main(argv=None) -> int:
 
     ok = True
     for name in names:
-        over = {} if args.steps is None else {"steps": args.steps}
+        over = dict(ROWS_CONFIGS.get(name, {}))
+        if args.steps is not None:
+            over["steps"] = args.steps
         rep = run_eval(name, data_dir=dirs[name], **over)
+        if synthesized.get(name):
+            # provenance honesty: the bytes came off disk through the
+            # real ingestion path, but the corpus is locally made
+            rep["source"] = "synthesized-on-disk"
         print(json.dumps(rep))
         if rep["data"] != "real":
             # the whole point of this script — never silently fall back
             print(f"error: {name} fell back to synthetic data "
                   f"(dir: {dirs[name]})", file=sys.stderr)
+            ok = False
+        if name in ROWS_CONFIGS and "data_source" not in rep:
+            print(f"error: {name} report lacks data_source provenance",
+                  file=sys.stderr)
             ok = False
         # real-data gate: uncentered real covariances are dominated by
         # the mean direction, so the planted-subspace <=1 degree gate

@@ -1,0 +1,75 @@
+"""Anchor-normalized regression tracking (round-5 verdict item 6):
+``bench.py --compare`` must flag a >10% normalized regression with a
+nonzero exit, accept a same-or-better run, and normalize away
+tunnel-session swings (the r3->r4 synthetic1024 question a machine now
+answers)."""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def _report(value, anchor):
+    return {
+        "metric": "pca_samples_per_sec_per_chip",
+        "value": value,
+        "anchor_tflops": anchor,
+        "value_per_anchor": round(value / anchor, 1),
+    }
+
+
+def test_regression_flagged(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_report(60e6, 120.0)))  # 500k/anchor
+    new = _report(40e6, 120.0)  # 333k/anchor: -33%
+    assert bench.compare_reports(str(old), new) == 1
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["regression"] is True
+
+
+def test_session_swing_normalized(tmp_path, capsys):
+    # r3->r4 shape: value fell 28.7M->21.2M but the anchor fell with it
+    # (125 -> 92 TF/s) — normalized ratio ~1, NOT a regression
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_report(28.7e6, 125.0)))
+    new = _report(21.2e6, 92.0)
+    assert bench.compare_reports(str(old), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["regression"] is False
+
+
+def test_driver_wrapped_report(tmp_path):
+    # BENCH_r{N}.json wraps the bench line under "parsed"
+    old = tmp_path / "wrapped.json"
+    old.write_text(json.dumps({"rc": 0, "parsed": _report(60e6, 120.0)}))
+    assert bench.compare_reports(str(old), _report(60e6, 118.0)) == 0
+
+
+def test_old_report_without_normalized_field(tmp_path):
+    # pre-round-5 reports carry value + anchor but not value_per_anchor
+    old = tmp_path / "r4.json"
+    old.write_text(
+        json.dumps({"value": 57199461.5, "anchor_tflops": 115.3386})
+    )
+    new = _report(67.9e6, 134.3)
+    assert bench.compare_reports(str(old), new) == 0
+
+
+def test_missing_anchor_skips(tmp_path):
+    old = tmp_path / "noanchor.json"
+    old.write_text(json.dumps({"value": 1.0}))
+    assert bench.compare_reports(str(old), _report(60e6, 120.0)) == 0
+
+
+def test_add_value_per_anchor():
+    r = _report(60e6, 120.0)
+    del r["value_per_anchor"]
+    bench._add_value_per_anchor(r)
+    assert r["value_per_anchor"] == 500000.0
+    r2 = {"value": 1.0}
+    bench._add_value_per_anchor(r2)  # no anchor -> no field, no crash
+    assert "value_per_anchor" not in r2

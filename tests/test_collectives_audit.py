@@ -1,0 +1,186 @@
+"""Collective-traffic audit of the sharded trainers (round-5 verdict
+item 2): the multi-chip communication claims, asserted from the
+COMPILED (SPMD-partitioned) HLO on the 8-virtual-device mesh instead of
+argued in prose.
+
+The structural invariants:
+- the DP scan trainer's ONLY collective is the per-step ``all_gather``
+  of the ``(m, d, k)`` factor stack — no all-reduce at all;
+- the feature-sharded trainers add k-wide reductions (sharded matvec,
+  CholeskyQR2/ns_orth Grams, merge/sketch folds) but NEVER a payload
+  approaching ``d^2`` — the dense mean projector must not cross the
+  mesh;
+- a deliberately-dense merge program DOES trip the tripwire (the assert
+  actually bites).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.algo.online import OnlineState
+from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+    auto_feature_mesh,
+    make_feature_sharded_scan_fit,
+    make_feature_sharded_sketch_fit,
+)
+from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+from distributed_eigenspaces_tpu.utils.collectives_audit import (
+    assert_no_dense_collective,
+    audit_compiled,
+    ici_step_model,
+    parse_collectives,
+)
+
+D, K, M, N = 128, 4, 8, 32
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=6,
+        solver="subspace", subspace_iters=8, warm_start_iters=2,
+        compute_dtype="bfloat16",
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+def test_scan_fit_gathers_factors_only(devices):
+    """The headline sharded trainer: the entire reference wire protocol
+    (C11) must compile to all-gathers of (m, d, k) factors — nothing
+    else crosses the mesh, in particular no all-reduce."""
+    cfg = _cfg()
+    mesh = make_mesh(num_workers=8)
+    fit = make_scan_fit(cfg, mesh)
+    x = jnp.zeros((6, M, N, D), jnp.bfloat16)
+    audit = audit_compiled(fit.lower(OnlineState.initial(D), x).compile())
+
+    assert audit["n_collectives"] > 0
+    for key in audit["ops"]:
+        assert key.startswith("all-gather"), key
+        assert f"[{M},{D},{K}]" in key, key
+    # the gathered factor stack is the LARGEST payload anywhere
+    assert audit["max_payload_elems"] == M * D * K
+    assert_no_dense_collective(audit, D)
+
+
+@pytest.mark.parametrize(
+    "make", [make_feature_sharded_scan_fit, make_feature_sharded_sketch_fit]
+)
+def test_feature_sharded_collectives_are_k_wide(devices, make):
+    cfg = _cfg(num_workers=4, dim=256, backend="feature_sharded")
+    mesh = auto_feature_mesh(cfg)
+    fit = make(cfg, mesh, seed=0)
+    blocks = jax.device_put(
+        jnp.zeros((3, 4, N, 256), jnp.bfloat16), fit.blocks_sharding
+    )
+    idx = jnp.arange(6, dtype=jnp.int32) % 3
+    audit = audit_compiled(
+        jax.jit(lambda s, b, i: fit(s, b, i))
+        .lower(fit.init_state(), blocks, idx)
+        .compile()
+    )
+    assert audit["n_collectives"] > 0
+    assert_no_dense_collective(audit, 256)
+    # stronger than the tripwire: every payload is bounded by the factor
+    # stack (m * d_local * k) — k-wide, per the §5.7 design
+    n_feat = mesh.devices.shape[list(mesh.axis_names).index("features")]
+    bound = 4 * (256 // n_feat) * max(K, fit.sketch_width if hasattr(fit, "sketch_width") else K)
+    assert audit["max_payload_elems"] <= bound, audit["ops"]
+
+
+def test_tripwire_bites_on_dense_psum(devices):
+    """The assert must actually fire on the design this framework
+    replaced: a shard_map round that psums the d x d mean projector."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(num_workers=8)
+
+    def dense_round(x):  # (m_local, n, d) -> psum of d x d projector
+        g = jnp.einsum("mnd,mne->de", x, x)
+        return jax.lax.psum(g, "workers")
+
+    f = jax.jit(
+        jax.shard_map(
+            dense_round, mesh=mesh, in_specs=P("workers"), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    audit = audit_compiled(
+        f.lower(jnp.zeros((M, N, D), jnp.float32)).compile()
+    )
+    with pytest.raises(AssertionError, match="dense collective"):
+        assert_no_dense_collective(audit, D)
+
+
+def test_parse_collectives_shapes():
+    hlo = """
+      %ag = f32[8,128,4]{2,1,0} all-gather(%p), replica_groups={}
+      %ar = bf16[16,16]{1,0} all-reduce(%q), to_apply=%sum
+      %cp = f32[4]{0} collective-permute(%r)
+    """
+    ops = parse_collectives(hlo)
+    assert [(o.op, o.shape) for o in ops] == [
+        ("all-gather", (8, 128, 4)),
+        ("all-reduce", (16, 16)),
+        ("collective-permute", (4,)),
+    ]
+    assert ops[0].payload_bytes == 8 * 128 * 4 * 4
+    assert ops[1].payload_bytes == 16 * 16 * 2
+
+
+def test_parse_async_and_tuple_forms():
+    """TPU HLO lowers collectives to -start/-done pairs with
+    tuple-shaped results; the parser must see them (the tripwire would
+    otherwise pass vacuously on exactly the ICI deployment it guards),
+    take the largest tuple member as the payload, and NOT double-count
+    the -done halves."""
+    hlo = """
+      %s = (f32[1024,1024]{1,0}, u32[]) all-reduce-start(%p), to_apply=%a
+      %d = f32[1024,1024]{1,0} all-reduce-done(%s)
+      %g = (f32[8,64,4]{2,1,0}) all-gather-start(%q), dimensions={0}
+    """
+    ops = parse_collectives(hlo)
+    assert [(o.op, o.shape) for o in ops] == [
+        ("all-reduce", (1024, 1024)),
+        ("all-gather", (8, 64, 4)),
+    ]
+    # the dense tripwire fires on the async form too
+    audit = {"max_payload_elems": ops[0].elems, "_parsed": ops}
+    with pytest.raises(AssertionError, match="dense collective"):
+        assert_no_dense_collective(audit, 1024)
+
+
+def test_parser_drift_tripwire():
+    """A collective call site the structured regex cannot parse must
+    raise, never silently under-report."""
+    with pytest.raises(RuntimeError, match="parser drift"):
+        parse_collectives(
+            "%x = f32[8]{0} all-reduce(%p)\n"
+            "%y = exotic_new_shape_syntax all-gather(%q)\n"
+        )
+
+
+def test_ici_model_matches_hlo_payload(devices):
+    """The documented model's factor payload equals what the compiled
+    HLO actually gathers (elems, per device) — model and machine agree."""
+    cfg = _cfg()
+    mesh = make_mesh(num_workers=8)
+    fit = make_scan_fit(cfg, mesh)
+    x = jnp.zeros((6, M, N, D), jnp.bfloat16)
+    audit = audit_compiled(fit.lower(OnlineState.initial(D), x).compile())
+    model = ici_step_model(M, D, K, n_workers_mesh=8)
+    # HLO reports the gathered output (m*d*k); the ring model charges
+    # (W-1)/W of it as wire traffic per device
+    assert audit["max_payload_elems"] == M * D * K
+    assert model["factor_gather_bytes_per_step"] == int(
+        (8 - 1) / 8 * M * D * K * 4
+    )
+    # the headline claim, computed: the dense psum would cost 2d^2/(m k
+    # ring-adjusted) more — 16x at the benchmark shape ratios
+    assert model["dense_over_factor"] == round(
+        2 * D * D / (M * D * K), 2
+    )

@@ -1,0 +1,109 @@
+"""Row-directory ingestion for the scale-out configs (round-5 verdict
+item 7): ``data/npy_dir.py`` loads user-supplied ``.npy``/flat-``.bin``
+row files, the eval harness runs configs 4/5 on them with provenance in
+the report, and the check script synthesizes an on-disk dataset when no
+user corpus exists — so the ingestion path is tested end-to-end even
+where the corpora cannot be downloaded."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.data.npy_dir import load_rows_dir
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_loader_npy_rows_and_patches(tmp_path, rng):
+    d = 48
+    a = rng.standard_normal((10, d)).astype(np.float32)
+    b = rng.standard_normal((6, 4, 4, 3)).astype(np.float32)  # flattens
+    np.save(tmp_path / "a_rows.npy", a)
+    np.save(tmp_path / "b_patches.npy", b)
+    rows, prov = load_rows_dir(str(tmp_path), d)
+    assert rows.shape == (16, d)
+    # sorted-name order: a first, patches flatten ROW-MAJOR
+    np.testing.assert_array_equal(rows[:10], a)
+    np.testing.assert_array_equal(rows[10:], b.reshape(6, d))
+    assert prov["rows"] == 16 and len(prov["files"]) == 2
+
+
+def test_loader_bin_and_max_rows(tmp_path, rng):
+    d = 32
+    a = rng.standard_normal((8, d)).astype(np.float32)
+    b = rng.standard_normal((8, d)).astype(np.float32)
+    np.save(tmp_path / "0.npy", a)
+    b.tofile(tmp_path / "1.bin")
+    rows, prov = load_rows_dir(str(tmp_path), d, max_rows=11)
+    assert rows.shape == (11, d)
+    np.testing.assert_array_equal(rows[8:], b[:3])
+    assert prov["files"][1]["rows"] == 3  # only the consumed slice
+
+
+def test_loader_errors(tmp_path, rng):
+    with pytest.raises(FileNotFoundError):
+        load_rows_dir(str(tmp_path), 8)
+    np.save(tmp_path / "bad.npy", rng.standard_normal((4, 7)))
+    with pytest.raises(ValueError, match="dim=8"):
+        load_rows_dir(str(tmp_path), 8)
+    (tmp_path / "bad.npy").unlink()
+    (tmp_path / "ragged.bin").write_bytes(b"\x00" * 33)
+    with pytest.raises(ValueError, match="whole number"):
+        load_rows_dir(str(tmp_path), 8)
+
+
+@pytest.mark.parametrize("name,shrink", [
+    ("imagenet12288", dict(dim=192, k=5, num_workers=2,
+                           rows_per_worker=64, steps=3)),
+    ("clip768", dict(dim=96, k=8, num_workers=2,
+                     rows_per_worker=64, steps=3)),
+])
+def test_eval_ingests_rows_dir(tmp_path, rng, name, shrink):
+    """Configs 4/5 run on on-disk row files with provenance in the
+    report (CI-shrunk dims; the loader/report plumbing is identical)."""
+    from distributed_eigenspaces_tpu.evals import run_eval
+
+    d = shrink["dim"]
+    rows = (
+        shrink["num_workers"] * shrink["rows_per_worker"]
+        * (shrink["steps"] + 1)
+    )
+    sub = tmp_path / name
+    sub.mkdir()
+    x = rng.standard_normal((rows, d)).astype(np.float32)
+    if name == "imagenet12288":
+        np.save(sub / "patches.npy", x.reshape(rows, 8, 8, 3))
+    else:
+        np.save(sub / "emb.npy", x)
+    rep = run_eval(name, data_dir=str(tmp_path), **shrink)
+    assert rep["data"] == "real"
+    assert rep["data_source"]["rows"] == rows
+    assert rep["data_source"]["dir"] == str(sub)
+    assert 0.0 <= rep["principal_angle_deg"] <= 90.0
+
+
+def test_check_script_synthesizes_on_disk(tmp_path):
+    """No user corpus: the script writes one, runs the ingestion path,
+    and labels the result synthesized-on-disk."""
+    env = dict(
+        os.environ, PYTHONPATH=_ROOT, JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "real_data_check.py"),
+         "clip768", "--data-dir", str(tmp_path), "--steps", "3"],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["data"] == "real"
+    assert rep["source"] == "synthesized-on-disk"
+    assert rep["data_source"]["rows"] > 0
+    # both ingestion formats on disk
+    names = sorted(os.listdir(tmp_path / "clip768"))
+    assert any(n.endswith(".npy") for n in names)
+    assert any(n.endswith(".bin") for n in names)

@@ -53,7 +53,10 @@ def test_offline_missing_data_exits_3(tmp_path):
 
 
 def test_unknown_config_rejected(tmp_path):
-    r = _run("imagenet12288", "--data-dir", str(tmp_path), "--offline")
+    # round 5: imagenet12288/clip768 are now supported (row-directory
+    # ingestion, tests/test_npy_dir.py) — only a truly unknown name
+    # is rejected
+    r = _run("synthetic1024", "--data-dir", str(tmp_path), "--offline")
     assert r.returncode == 2
 
 

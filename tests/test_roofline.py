@@ -109,11 +109,32 @@ def test_byte_model_route_matches_flop_model():
     m, n, d, k = 8, 2048, 768, 256
     b = step_byte_model(m, n, d, k, 8, 2, itemsize=2)
     block = m * n * d * 2
-    assert b["warm_bytes_per_step"] == block + m * 3 * d * d * 4
-    # imagenet12288 shapes: large d -> streaming route, 2 passes/iter
-    b2 = step_byte_model(4, 2048, 12288, 50, 12, 1, itemsize=2)
-    assert b2["warm_bytes_per_step"] == 2 * 4 * 2048 * 12288 * 2
-    assert b2["cold_bytes_per_step"] == 24 * 4 * 2048 * 12288 * 2
+    merge = 2 * m * d * k * 4
+    fold_dense = 2 * d * d * 4
+    assert b["warm_bytes_per_step"] == (
+        block + m * 3 * d * d * 4 + merge + fold_dense
+    )
+    # imagenet12288 shapes: large d -> streaming route; round 5 added
+    # the Xv intermediate, basis, merge and state-fold terms (the old
+    # X-passes-only model was a documented undercount)
+    m2, n2, d2, k2 = 4, 2048, 12288, 50
+    b2 = step_byte_model(m2, n2, d2, k2, 12, 1, itemsize=2, state="lowrank")
+    per_iter = (
+        2 * m2 * n2 * d2 * 2
+        + 2 * m2 * n2 * k2 * 4
+        + 4 * m2 * d2 * k2 * 4
+    )
+    extra = 2 * m2 * d2 * k2 * 4 + 2 * d2 * (k2 + 16) * 4
+    assert b2["warm_bytes_per_step"] == per_iter + extra
+    assert b2["cold_bytes_per_step"] == 12 * per_iter + extra
+    # the X passes stay the dominant term at every BASELINE config
+    assert 2 * m2 * n2 * d2 * 2 > 0.8 * per_iter
+    # int8 staging halves exactly the X-pass term
+    b3 = step_byte_model(m2, n2, d2, k2, 12, 1, itemsize=1, state="lowrank")
+    assert (
+        b2["warm_bytes_per_step"] - b3["warm_bytes_per_step"]
+        == m2 * n2 * d2 * 2
+    )
 
 
 def test_bound_tristate():

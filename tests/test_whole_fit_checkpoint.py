@@ -417,10 +417,13 @@ def test_estimator_masked_windowed_matches_staged_semantics(
         )
 
 
-def test_explicit_segmented_rejects_masks(devices, blocks):
-    """trainer='segmented' has no masked programs — masks must raise,
-    never silently fold a known-faulty worker's blocks (round-4
-    review: this combination previously dropped the masks)."""
+def test_explicit_segmented_runs_masks(devices, blocks):
+    """Round 5: trainer='segmented' HAS masked window programs — masks
+    must run the §5.3 exclusion (never silently fold a known-faulty
+    worker's blocks, never raise). Equivalence with the masked scan fit
+    is pinned in tests/test_masked_dense_whole_fit.py; here: the route
+    accepts masks and the excluded worker demonstrably changes the
+    state."""
     from distributed_eigenspaces_tpu.api.estimator import (
         OnlineDistributedPCA,
     )
@@ -428,7 +431,15 @@ def test_explicit_segmented_rejects_masks(devices, blocks):
     xs, _spec = blocks
     data = xs.reshape(T * M * N, D)
     masks = np.ones((T, M), np.float32)
-    with pytest.raises(ValueError, match="worker_masks"):
-        OnlineDistributedPCA(
-            _cfg(backend="local"), trainer="segmented"
-        ).fit(data, worker_masks=masks)
+    masks[1, 0] = 0.0
+    est = OnlineDistributedPCA(
+        _cfg(backend="local"), trainer="segmented"
+    ).fit(data, worker_masks=masks)
+    assert est.trainer_used_ == "segmented"
+    unmasked = OnlineDistributedPCA(
+        _cfg(backend="local"), trainer="segmented"
+    ).fit(data)
+    assert not np.allclose(
+        np.asarray(est.state.sigma_tilde),
+        np.asarray(unmasked.state.sigma_tilde),
+    )
