@@ -438,9 +438,9 @@ class OnlineDistributedPCA:
 
         if trainer != "scan":
             raise ValueError(f"unknown trainer {trainer!r}")
-        from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+        from distributed_eigenspaces_tpu.api.runner import make_whole_fit
 
-        state0 = OnlineState.initial(cfg.dim, cfg.state_dtype)
+        masks = None
         if worker_masks is not None:
             # §5.3 on the dense whole fit (round 5 — previously a loud
             # ValueError): the masked scan program, equivalent to the
@@ -449,13 +449,10 @@ class OnlineDistributedPCA:
                 _validated_masks(worker_masks, cfg.num_workers),
                 xs.shape[0],
             )
-            final, _ = make_scan_fit(
-                cfg, mesh=_scan_mesh(cfg), masked=True
-            )(state0, xs, jnp.asarray(masks))
-        else:
-            final, _ = make_scan_fit(cfg, mesh=_scan_mesh(cfg))(
-                state0, xs
-            )
+        handle = make_whole_fit(
+            cfg, "scan", _scan_mesh(cfg), masked=masks is not None
+        )
+        final = handle.fit(handle.init_state(), xs, worker_masks=masks)
         return self._finish_dense(cfg, final)
 
     def _fit_feature_sharded(
@@ -474,13 +471,9 @@ class OnlineDistributedPCA:
         schedule (short masks raise — never a silently dropped step)."""
         import warnings
 
-        from distributed_eigenspaces_tpu.ops.linalg import (
-            canonicalize_signs,
-        )
+        from distributed_eigenspaces_tpu.api.runner import make_whole_fit
         from distributed_eigenspaces_tpu.parallel.feature_sharded import (
             auto_feature_mesh,
-            make_feature_sharded_scan_fit,
-            make_feature_sharded_sketch_fit,
         )
 
         if trainer == "sketch" and self.trainer == "auto":
@@ -499,12 +492,9 @@ class OnlineDistributedPCA:
             )
 
         mesh = auto_feature_mesh(cfg)
-        make = (
-            make_feature_sharded_sketch_fit
-            if trainer == "sketch"
-            else make_feature_sharded_scan_fit
+        fit = make_whole_fit(
+            cfg, "sketch" if trainer == "sketch" else "fs_scan", mesh
         )
-        fit = make(cfg, mesh, seed=cfg.seed, collectives=cfg.collectives)
         if trainer == "sketch":
             # cache for the online continuation path (fit_stream /
             # partial_fit on the SketchState this fit leaves behind)
@@ -529,10 +519,9 @@ class OnlineDistributedPCA:
             if not blocks:
                 raise ValueError("dataset yielded zero full steps")
             xs = np.stack(blocks)
-            state = fit(
+            state = fit.fit(
                 fit.init_state(),
                 jax.device_put(xs, fit.blocks_sharding),
-                jnp.arange(xs.shape[0], dtype=jnp.int32),
                 worker_masks=masks_for(xs.shape[0]),
             )
         else:
@@ -556,11 +545,7 @@ class OnlineDistributedPCA:
                 raise ValueError("dataset yielded zero full steps")
 
         self.state = state
-        self._w = (
-            fit.extract(state)
-            if trainer == "sketch"
-            else canonicalize_signs(state.u[:, : cfg.k])
-        )
+        self._w = fit.extract(state)
         return self
 
     def _windowed_source(self, cfg, host_blocks, budget_steps, *, place):
@@ -612,10 +597,7 @@ class OnlineDistributedPCA:
         ``worker_masks`` (a (T, m) sequence) runs the masked window
         programs in data-window lockstep — §5.3 on the out-of-core
         route too (round 5)."""
-        from distributed_eigenspaces_tpu.algo.scan import (
-            SegmentState,
-            make_segmented_fit,
-        )
+        from distributed_eigenspaces_tpu.api.runner import make_whole_fit
 
         # place=identity: the segmented programs take host windows
         # directly, so only the host-side prep needs overlapping
@@ -629,11 +611,11 @@ class OnlineDistributedPCA:
                 windows,
                 lambda start, s: _masks_for(worker_masks, start + s)[start:],
             )
-        fit = make_segmented_fit(
-            cfg, _scan_mesh(cfg), segment=self.segment
+        handle = make_whole_fit(
+            cfg, "segmented", _scan_mesh(cfg), segment=self.segment
         )
-        state = fit.fit_windows(
-            SegmentState.initial(cfg.dim, cfg.k, dtype=cfg.state_dtype),
+        state = handle.fit_windows(
+            handle.init_state(),
             windows,
             on_segment=on_segment,
             worker_masks=mask_windows,
@@ -645,15 +627,12 @@ class OnlineDistributedPCA:
         )
 
     def _finish_dense(self, cfg, final: OnlineState) -> "OnlineDistributedPCA":
-        from distributed_eigenspaces_tpu.ops.linalg import merged_top_k
+        from distributed_eigenspaces_tpu.api.runner import extract_dense
 
         self.state = final
-        # extraction honors the configured solver (a full d x d eigh at
-        # large d is the TPU anti-pattern the subspace solver exists for)
-        self._w = merged_top_k(
-            final.sigma_tilde, cfg.k, cfg.solver,
-            max(cfg.subspace_iters, 16),
-        )
+        # ONE extraction definition (api/runner.py): honors the
+        # configured solver and orthonormalization
+        self._w = extract_dense(cfg, final.sigma_tilde)
         return self
 
     def fit_stream(self, stream, *, on_step=None, worker_masks=None,
@@ -730,15 +709,11 @@ class OnlineDistributedPCA:
         if fit is None:
             # state restored externally (checkpoint/unpickle): rebuild
             # the same trainer the whole fit would have built
-            from distributed_eigenspaces_tpu.parallel.feature_sharded import (
-                auto_feature_mesh,
-                make_feature_sharded_sketch_fit,
+            from distributed_eigenspaces_tpu.api.runner import (
+                make_whole_fit,
             )
 
-            fit = make_feature_sharded_sketch_fit(
-                cfg, auto_feature_mesh(cfg), seed=cfg.seed,
-                collectives=cfg.collectives,
-            )
+            fit = make_whole_fit(cfg, "sketch")
             self._sketch_fit = fit
 
         # the per-step loop's cap semantics, EXACTLY (algo/online.py

@@ -237,15 +237,12 @@ def _scan_result(args, cfg, state, truth, elapsed, extra):
     """Final extraction + summary JSON shared by both scan paths."""
     import jax.numpy as jnp
 
+    from distributed_eigenspaces_tpu.api.runner import extract_dense
     from distributed_eigenspaces_tpu.ops.linalg import (
-        merged_top_k,
         principal_angles_degrees,
     )
 
-    w = merged_top_k(
-        state.sigma_tilde, cfg.k, cfg.solver, max(cfg.subspace_iters, 16),
-        cfg.orth_method,
-    )
+    w = extract_dense(cfg, state.sigma_tilde)
     w_host = np.asarray(w)  # materialization fence + result
     out = {
         "mode": "fit",
@@ -279,8 +276,7 @@ def _fit_scan(args, cfg, data, truth) -> int:
     """
     import jax.numpy as jnp
 
-    from distributed_eigenspaces_tpu.algo.online import OnlineState
-    from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+    from distributed_eigenspaces_tpu.api.runner import make_whole_fit
 
     if args.checkpoint_dir or args.resume or args.metrics:
         return _fit_scan_segmented(args, cfg, data, truth)
@@ -302,10 +298,10 @@ def _fit_scan(args, cfg, data, truth) -> int:
 
     from distributed_eigenspaces_tpu.utils.tracing import profile_to
 
-    fit = make_scan_fit(cfg, mesh=_scan_mesh(cfg))
+    handle = make_whole_fit(cfg, "scan", _scan_mesh(cfg))
     t0 = time.time()
     with profile_to(args.profile_dir):
-        state, _v_bars = fit(OnlineState.initial(dim), x_steps)
+        state = handle.fit(handle.init_state(), x_steps)
         float(jnp.sum(state.step))  # fence inside the capture
     elapsed = time.time() - t0
     return _scan_result(
@@ -322,10 +318,7 @@ def _fit_scan(args, cfg, data, truth) -> int:
 
 def _fit_scan_segmented(args, cfg, data, truth) -> int:
     """Segmented scan: checkpoint/resume/metrics between S-step programs."""
-    from distributed_eigenspaces_tpu.algo.scan import (
-        SegmentState,
-        make_segmented_fit,
-    )
+    from distributed_eigenspaces_tpu.api.runner import make_whole_fit
     from distributed_eigenspaces_tpu.utils.checkpoint import Checkpointer
     from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
 
@@ -333,7 +326,10 @@ def _fit_scan_segmented(args, cfg, data, truth) -> int:
         cfg.num_workers, cfg.rows_per_worker, cfg.num_steps, cfg.dim,
     )
     rows_per_step = m * n
-    state = SegmentState.initial(dim, cfg.k)
+    handle = make_whole_fit(
+        cfg, "segmented", _scan_mesh(cfg), segment=args.checkpoint_every
+    )
+    state = handle.init_state()
     cursor = 0
     ckpt = None
     if args.checkpoint_dir:
@@ -368,9 +364,6 @@ def _fit_scan_segmented(args, cfg, data, truth) -> int:
         stream=sys.stderr if args.metrics else None,
         reference_subspace=truth,
     ).start()
-    fit = make_segmented_fit(
-        cfg, mesh=_scan_mesh(cfg), segment=args.checkpoint_every
-    )
     last_t = {"t": done}
 
     def on_segment(t, st):
@@ -385,13 +378,13 @@ def _fit_scan_segmented(args, cfg, data, truth) -> int:
 
     t0 = time.time()
     with profile_to(args.profile_dir):
-        state = fit(state, x_steps, on_segment=on_segment)
+        state = handle.fit(state, x_steps, on_segment=on_segment)
     elapsed = time.time() - t0
     return _scan_result(
         args, cfg, state, truth, elapsed,
         {
             "includes_compile": True,
-            "segment": fit.segment,
+            "segment": handle.info["segment"],
             "resumed_step": done,
             **metrics.summary(),
         },
@@ -413,14 +406,12 @@ def _fit_feature_whole(args, cfg, data, truth) -> int:
     import jax
     import jax.numpy as jnp
 
+    from distributed_eigenspaces_tpu.api.runner import make_whole_fit
     from distributed_eigenspaces_tpu.ops.linalg import (
-        canonicalize_signs,
         principal_angles_degrees,
     )
     from distributed_eigenspaces_tpu.parallel.feature_sharded import (
         auto_feature_mesh,
-        make_feature_sharded_scan_fit,
-        make_feature_sharded_sketch_fit,
     )
     from distributed_eigenspaces_tpu.utils.checkpoint import Checkpointer
 
@@ -430,10 +421,7 @@ def _fit_feature_whole(args, cfg, data, truth) -> int:
     )
     rows_per_step = m * n
     mesh = auto_feature_mesh(cfg)
-    fit = (
-        make_feature_sharded_sketch_fit if sketch
-        else make_feature_sharded_scan_fit
-    )(cfg, mesh, seed=cfg.seed)
+    fit = make_whole_fit(cfg, "sketch" if sketch else "fs_scan", mesh)
     state = fit.init_state()
     cursor = 0
     ckpt = None
@@ -449,8 +437,9 @@ def _fit_feature_whole(args, cfg, data, truth) -> int:
                 return err
             if restored is not None:
                 want_shapes = (
-                    {"y": (dim, fit.sketch_width), "v": (dim, cfg.k)}
-                    if sketch else {"u": (dim, fit.rank)}
+                    {"y": (dim, fit.info["sketch_width"]),
+                     "v": (dim, cfg.k)}
+                    if sketch else {"u": (dim, fit.info["rank"])}
                 )
                 bad = {
                     f: tuple(getattr(restored, f).shape)
@@ -464,7 +453,7 @@ def _fit_feature_whole(args, cfg, data, truth) -> int:
                         file=sys.stderr,
                     )
                     return 2
-                state = jax.device_put(restored, fit.state_shardings)
+                state = jax.device_put(restored, fit.raw.state_shardings)
 
     done = int(state.step)
     remaining = max(0, T - done)
@@ -535,7 +524,7 @@ def _fit_feature_whole(args, cfg, data, truth) -> int:
                     on_segment=on_segment,
                 )
             else:
-                state = fit(
+                state = fit.fit(
                     state,
                     jax.device_put(
                         jnp.asarray(
@@ -546,12 +535,8 @@ def _fit_feature_whole(args, cfg, data, truth) -> int:
                         ),
                         fit.blocks_sharding,
                     ),
-                    jnp.arange(remaining, dtype=jnp.int32),
                 )
-        w = (
-            fit.extract(state) if sketch
-            else canonicalize_signs(state.u[:, : cfg.k])
-        )
+        w = fit.extract(state)
         w_host = np.asarray(w)  # materialization fence + result
     elapsed = time.time() - t0
 
@@ -562,8 +547,8 @@ def _fit_feature_whole(args, cfg, data, truth) -> int:
         "backend": "feature_sharded",
         "mesh": list(mesh.devices.shape),
         **(
-            {"sketch_width": fit.sketch_width} if sketch
-            else {"rank": fit.rank}
+            {"sketch_width": fit.info["sketch_width"]} if sketch
+            else {"rank": fit.info["rank"]}
         ),
         # checkpoint/metrics runs execute as --checkpoint-every-step
         # windows (one program each — same semantics as the dense scan

@@ -27,7 +27,6 @@ this is that arrangement for arbitrary row data, bounded and checked.
 
 from __future__ import annotations
 
-import glob
 import os
 
 import numpy as np
@@ -48,9 +47,16 @@ def load_rows_dir(
     ``ValueError`` for files whose shape cannot yield ``dim``-wide rows
     (loud beats a silent reshape of the wrong data).
     """
+    # listdir + suffix filter, NOT glob: a user path containing glob
+    # metacharacters (~/data[v2]/...) would silently match nothing and
+    # read as "no files" — triggering the check script's synthesize
+    # fallback over the user's real corpus
+    if not os.path.isdir(directory):
+        raise FileNotFoundError(f"{directory!r} is not a directory")
     paths = sorted(
-        glob.glob(os.path.join(directory, "*.npy"))
-        + glob.glob(os.path.join(directory, "*.bin"))
+        os.path.join(directory, name)
+        for name in os.listdir(directory)
+        if name.endswith((".npy", ".bin"))
     )
     if not paths:
         raise FileNotFoundError(

@@ -47,6 +47,9 @@ class EvalSpec:
     warm_start_iters: int | None = None
     compute_dtype: str | None = None
     backend: str = "local"  # "local" | "shard_map" | "feature_sharded"
+    #: HBM staging dtype for the in-memory configs (None = compute
+    #: dtype; "int8" = the quantized steady state, PCAConfig.stage_dtype)
+    stage_dtype: str | None = None
     streaming: str = "memory"  # "memory" | "bin" (out-of-core file)
     # on-disk dtype for "bin" streaming: "float32", or "int8" (symmetric
     # quantization, shipped to the device unconverted — the global scale
@@ -84,8 +87,17 @@ EVAL_SPECS: dict[str, EvalSpec] = {
                  rows_per_worker=2048, steps=10,
                  # 1 warm iteration measured both faster AND more accurate
                  # than 2 on this config (7.8M samples/s at 0.37 deg vs
-                 # 5.2M at 0.55 deg on one v5e chip)
+                 # 5.2M at 0.55 deg on one v5e chip).
+                 # stage_dtype="int8" (round 5): this config is
+                 # HBM-bound (55-75% of the anchor on modeled bytes);
+                 # int8 staging measured +36% (9.58M vs 7.06M samples/s,
+                 # 0.382 vs 0.370 deg — gate intact). The latency-bound
+                 # clip768_chip config measured a 4.5% LOSS from the
+                 # same staging (nothing to win on bytes, quantization
+                 # noise on k=256 marginal directions) and stays bf16 —
+                 # stage int8 where the roofline says "hbm".
                  warm_start_iters=1, compute_dtype="bfloat16",
+                 stage_dtype="int8",
                  backend="feature_sharded", trainer="sketch",
                  description="ImageNet 64x64 patches 12288-d, top-50, "
                              "feature-sharded (config 4)"),
@@ -301,6 +313,7 @@ def run_eval(
         solver=spec.solver, subspace_iters=spec.subspace_iters,
         warm_start_iters=spec.warm_start_iters,
         compute_dtype=spec.compute_dtype,
+        stage_dtype=spec.stage_dtype,
         backend=spec.backend,
         seed=seed,
     )
@@ -351,6 +364,11 @@ def run_eval(
         spec.trainer if (use_whole_fit or use_seg_bin) else "step"
     )
 
+    # final extraction: ONE definition (api/runner.py extract_dense /
+    # the trainer handles below) — it honors the configured solver (a
+    # full d x d eigh at d=12288 needs ~31 GB of HLO temps)
+    from distributed_eigenspaces_tpu.api.runner import extract_dense
+
     if backend_used == "feature_sharded":
         final_w = lambda st: np.asarray(st.u)[:, :k]  # noqa: E731
         if not use_whole_fit:
@@ -362,21 +380,12 @@ def run_eval(
             state = fstep.init_state()
             step_fn = fstep
     else:
-        from distributed_eigenspaces_tpu.ops.linalg import merged_top_k
-
         step_fn = make_train_step(
             cfg, mesh=mesh if backend_used == "shard_map" else None
         )
         state = OnlineState.initial(d)
-        # final extraction honors the configured solver: a full d x d eigh
-        # at d=12288 needs ~31 GB of HLO temps (OOM on one chip); the
-        # subspace solver converges in a few iterations on sigma_tilde's
-        # clean ~1-vs-~0 projector-average spectrum
         final_w = lambda st: np.asarray(  # noqa: E731
-            merged_top_k(
-                st.sigma_tilde, k, spec.solver,
-                max(spec.subspace_iters, 16),
-            )
+            extract_dense(cfg, st.sigma_tilde)
         )
 
     # --- stage data --------------------------------------------------------
@@ -423,10 +432,27 @@ def run_eval(
                 f.write(host_bytes[s % n_distinct])
 
     # staging dtype: blocks staged in the compute dtype halve the per-step
-    # gather copy at bf16 (bench.py methodology)
-    stage_dtype = (
-        jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else jnp.float32
-    )
+    # gather copy at bf16; stage_dtype="int8" halves them again and the
+    # solvers contract int8 natively (bench.py methodology; ONE staging
+    # contract — data.stream.stage_blocks)
+    from distributed_eigenspaces_tpu.data.stream import stage_blocks
+
+    stage_dtype = cfg.resolved_stage_dtype()
+
+    def staged_host(blocks):
+        if stage_dtype == jnp.dtype(jnp.int8):
+            # quantization is host-side (ONE staging contract,
+            # data.stream.stage_blocks)
+            return list(stage_blocks(blocks, stage_dtype))
+        # float stage dtypes cast IN PLACE (device arrays stay on
+        # device — memory-mode sample blocks are device-resident, and a
+        # host round trip would drag up to 4 x ~50-400 MB over the slow
+        # tunneled link for nothing)
+        return [
+            b.astype(stage_dtype) if hasattr(b, "astype")
+            else np.asarray(b, stage_dtype)
+            for b in blocks
+        ]
     if spec.streaming == "memory" and not (
         use_whole_fit and backend_used == "feature_sharded"
     ):
@@ -436,7 +462,7 @@ def run_eval(
         # out-of-core pipeline (disk -> host -> device) instead (the
         # feature-sharded whole fit stages its own mesh-sharded stack below)
         device_blocks = [
-            jnp.asarray(b, dtype=stage_dtype) for b in host_blocks
+            jnp.asarray(b) for b in staged_host(host_blocks)
         ]
 
     # shared whole-fit timing scaffold: warm-up must use DIFFERENT operand
@@ -508,70 +534,60 @@ def run_eval(
                 yield device_blocks[s % n_distinct]
 
     try:
-        if use_whole_fit and backend_used == "feature_sharded":
-            # whole-fit carry over the (workers, features) mesh: the B
-            # distinct blocks are staged once, mesh-sharded; no d x d
-            # matrix anywhere ("scan": exact rank-r state; "sketch": the
-            # Nystrom-sketch state whose steady-state loop has no
-            # eigh/Cholesky latency at all — the large-d throughput path)
-            if trainer_used == "sketch":
-                from distributed_eigenspaces_tpu.parallel.feature_sharded \
-                    import make_feature_sharded_sketch_fit as make_fs_fit
+        if use_whole_fit:
+            # ONE whole-fit wiring for all three in-memory kinds (round-5
+            # verdict item 8 — the runner module): dense scan (staged
+            # gather), feature-sharded exact rank-r scan, and the
+            # Nystrom sketch. The B distinct blocks stage once — mesh-
+            # sharded when the handle says so — and the SAME handle
+            # provides init/fit/extract for the accuracy and timed runs.
+            from distributed_eigenspaces_tpu.api.runner import (
+                make_whole_fit,
+            )
+
+            if backend_used == "feature_sharded":
+                kind = "sketch" if trainer_used == "sketch" else "fs_scan"
+                handle_mesh = mesh
             else:
-                from distributed_eigenspaces_tpu.parallel.feature_sharded \
-                    import make_feature_sharded_scan_fit as make_fs_fit
+                kind = "scan"
+                handle_mesh = mesh if backend_used == "shard_map" else None
 
-            fit = make_fs_fit(cfg, mesh, seed=seed)
-            if trainer_used == "sketch":
-                final_w = (  # noqa: E731
-                    lambda st: np.asarray(fit.extract(st))
+            def make_handle(c):
+                return make_whole_fit(
+                    c, kind, handle_mesh, seed=seed,
+                    gather=(kind == "scan"),
                 )
-            stacked = jax.device_put(
-                jnp.stack(
-                    [jnp.asarray(b, dtype=stage_dtype) for b in host_blocks]
-                ),
-                fit.blocks_sharding,
+
+            handle = make_handle(cfg)
+            if handle.blocks_sharding is not None:
+                stacked = jax.device_put(
+                    jnp.stack([
+                        jnp.asarray(b) for b in staged_host(host_blocks)
+                    ]),
+                    handle.blocks_sharding,
+                )
+            else:
+                stacked = jnp.stack(device_blocks)
+                del device_blocks  # the stack is the only staged copy
+            final_w = (  # noqa: E731
+                lambda st: np.asarray(handle.extract(st))
             )
-
-            idx = jnp.arange(spec.steps, dtype=jnp.int32) % n_distinct
-            state = fit(fit.init_state(), stacked, idx)
-            fence(state)  # accuracy run: exactly the spec's T-step workload
-
-            # throughput run on the longer one-program schedule
-            dts = timed_whole_fit(
-                lambda c: make_fs_fit(c, mesh, seed=seed),
-                fit.init_state,
-                lambda f, st, ix: f(st, stacked, ix),
-            )
-            steps_run = spec.steps
-            timed_steps = timed_T
-        elif use_whole_fit:
-            from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
-
-            scan_mesh = mesh if backend_used == "shard_map" else None
-            stacked = jnp.stack(device_blocks)
-            del device_blocks  # the stack is the only staged copy needed
 
             # accuracy run: exactly the spec's T-step workload
-            fit = make_scan_fit(cfg, mesh=scan_mesh, gather=True)
             idx = jnp.arange(spec.steps, dtype=jnp.int32) % n_distinct
-            state, _ = fit(OnlineState.initial(d), stacked, idx)
+            state = handle.fit(handle.init_state(), stacked, idx)
             fence(state)
 
             # throughput run: the SAME per-step workload on the longer
             # one-program schedule
             dts = timed_whole_fit(
-                lambda c: make_scan_fit(c, mesh=scan_mesh, gather=True),
-                lambda: OnlineState.initial(d),
-                lambda f, st, ix: f(st, stacked, ix)[0],
+                make_handle,
+                handle.init_state,
+                lambda h, st, ix: h.fit(st, stacked, ix),
             )
             steps_run = spec.steps  # the accuracy workload (reported)
             timed_steps = timed_T
         elif use_seg_bin:
-            from distributed_eigenspaces_tpu.algo.scan import (
-                SegmentState,
-                make_segmented_fit,
-            )
             from distributed_eigenspaces_tpu.data.bin_stream import (
                 bin_block_stream,
                 window_stream,
@@ -581,7 +597,13 @@ def run_eval(
             )
 
             seg = max(1, min(5, spec.steps))
-            fit = make_segmented_fit(cfg, mesh=None, segment=seg)
+            from distributed_eigenspaces_tpu.api.runner import (
+                make_whole_fit,
+            )
+
+            handle = make_whole_fit(cfg, "segmented", mesh=None, segment=seg)
+            fit_windows = handle.fit_windows
+            init_state = handle.init_state
 
             # compile pass OUTSIDE the timed region, on salted operands
             # (the tunneled backend serves identical (executable, operands)
@@ -596,11 +618,7 @@ def run_eval(
             shapes = [full_w] if spec.steps <= seg else [full_w, full_w]
             if spec.steps % seg and spec.steps > seg:
                 shapes.append(full_w[: spec.steps % seg])
-            fence(
-                fit.fit_windows(
-                    salted(SegmentState.initial(d, k)), iter(shapes)
-                )
-            )
+            fence(fit_windows(salted(init_state()), iter(shapes)))
 
             def bin_windows():
                 yield from window_stream(
@@ -619,13 +637,13 @@ def run_eval(
             # on a differently-salted state (tunnel-cache honesty).
             dts = []
             for r in range(repeats):
-                st0 = SegmentState.initial(d, k)
+                st0 = init_state()
                 if r:
                     st0 = st0._replace(
                         sigma_tilde=st0.sigma_tilde + (r + 1) * 7e-20
                     )
                 t0 = time.perf_counter()
-                state = fit.fit_windows(
+                state = fit_windows(
                     st0,
                     prefetch_stream(
                         bin_windows(), depth=1, place=lambda w: w
@@ -669,10 +687,10 @@ def run_eval(
                     np.roll(host_np[0], 2, axis=0).reshape(m, n, d)
                 )] * seg
             )
-            st2 = SegmentState.initial(d, k)
+            st2 = init_state()
             st2 = st2._replace(sigma_tilde=st2.sigma_tilde + 3e-20)
             t0 = time.perf_counter()
-            fence(fit.fit_windows(st2, iter([dummy2])))
+            fence(fit_windows(st2, iter([dummy2])))
             compute_ms = (time.perf_counter() - t0) * 1e3
             stage_ms = {
                 "disk_read": round(disk_ms, 1),
@@ -700,7 +718,9 @@ def run_eval(
                 # same dtype the timed loop feeds (device_blocks are staged
                 # in stage_dtype) — a dtype mismatch here would recompile
                 # inside the timed region
-                warm_blk = jnp.asarray(host_blocks[0], dtype=stage_dtype)
+                warm_blk = jnp.asarray(
+                    staged_host(host_blocks[:1])[0]
+                )
             out = step_fn(state, warm_blk)
             # value fetch, not block_until_ready: the tunneled dev backend
             # does not fence on block_until_ready (BASELINE.md timing
@@ -880,11 +900,13 @@ def run_eval(
         # matmul anchor (round-3 verdict item 1)
         byte_model=step_byte_model(
             m, n, d, k, spec.subspace_iters, spec.warm_start_iters,
-            # the X passes read the STAGED dtype (int8 for the quantized
-            # bin wire, else the compute dtype)
+            # the X passes read the STAGED dtype: the quantized bin wire
+            # or the memory configs' resolved stage dtype (int8 staging
+            # halves the binding term — the byte model must see it, or
+            # pct_of_hbm_anchor doubles and the bound verdict lies)
             itemsize=(
                 1 if (spec.streaming == "bin" and spec.bin_dtype == "int8")
-                else jnp.dtype(spec.compute_dtype or jnp.float32).itemsize
+                else cfg.resolved_stage_dtype().itemsize
             ),
             # rank-r carries (feature-sharded / sketch) have no d x d
             # state fold; the dense trainers read+write sigma_tilde
