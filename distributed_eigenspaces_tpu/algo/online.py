@@ -174,6 +174,9 @@ def online_distributed_pca(
             pool.shard(x_blocks), cfg.k, worker_mask=mask,
             v0=v_prev,
             iters=warm_iters if v_prev is not None else None,
+            orth=(
+                cfg.resolved_warm_orth() if v_prev is not None else None
+            ),
         )
         if warm:
             # an ALL-masked round merges to zeros; warm-starting from a

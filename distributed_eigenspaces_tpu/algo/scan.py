@@ -105,7 +105,12 @@ def make_scan_fit(
     round_core = make_round_core(cfg)
     warm_iters = cfg.resolved_warm_start()
     warm = warm_iters is not None
-    warm_core = make_round_core(cfg, iters=warm_iters) if warm else None
+    warm_core = (
+        make_round_core(
+            cfg, iters=warm_iters, orth=cfg.resolved_warm_orth()
+        )
+        if warm else None
+    )
 
     def make_fit(axis_name):
         def update(st, v_bar):
@@ -255,7 +260,12 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
     round_core = make_round_core(cfg)
     warm_iters = cfg.resolved_warm_start()
     warm = warm_iters is not None
-    warm_core = make_round_core(cfg, iters=warm_iters) if warm else None
+    warm_core = (
+        make_round_core(
+            cfg, iters=warm_iters, orth=cfg.resolved_warm_orth()
+        )
+        if warm else None
+    )
 
     def update(st, v_bar):
         return update_state(

@@ -28,7 +28,9 @@ from distributed_eigenspaces_tpu.parallel.worker_pool import (
 from distributed_eigenspaces_tpu.ops.linalg import merged_top_k_lowrank
 
 
-def make_round_core(cfg: PCAConfig, iters: int | None = None):
+def make_round_core(
+    cfg: PCAConfig, iters: int | None = None, orth: str | None = None
+):
     """Shared per-round compute: ``round_core(x_blocks, axis_name=None,
     v0=None) -> v_bar``.
 
@@ -51,7 +53,11 @@ def make_round_core(cfg: PCAConfig, iters: int | None = None):
     k, solver = cfg.k, cfg.solver
     if iters is None:
         iters = cfg.subspace_iters
-    orth, cdtype = cfg.orth_method, cfg.compute_dtype
+    # ``orth`` override: warm cores pass cfg.resolved_warm_orth() (the
+    # "ns" steady state is warm-only — see PCAConfig.warm_orth_method)
+    if orth is None:
+        orth = cfg.orth_method
+    cdtype = cfg.compute_dtype
 
     # profiler annotation (§5.1): these named regions are the units a
     # captured trace shows — worker solve vs gather vs merge
@@ -101,7 +107,12 @@ def make_train_step(
     round_core = make_round_core(cfg)
     warm_iters = cfg.resolved_warm_start()
     warm = warm_iters is not None
-    warm_core = make_round_core(cfg, iters=warm_iters) if warm else None
+    warm_core = (
+        make_round_core(
+            cfg, iters=warm_iters, orth=cfg.resolved_warm_orth()
+        )
+        if warm else None
+    )
     donate_args = (0,) if donate else ()
 
     def fold(state, v_bar):

@@ -63,6 +63,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--solver", choices=["eigh", "subspace"], default="eigh")
     p.add_argument("--subspace-iters", type=int, default=16,
                    help="power-iteration count for --solver subspace")
+    p.add_argument("--warm-orth-method", choices=["cholqr2", "qr", "ns"],
+                   default=None,
+                   help="orthonormalization for WARM solver rounds only "
+                        "(default: same as --orth-method). 'ns' = "
+                        "Newton-Schulz, pure matmuls — the measured "
+                        "latency win for warm steady states; warm-only "
+                        "because cold power steps feed it "
+                        "nearly-dependent columns (see PCAConfig docs)")
     p.add_argument("--orth-method", choices=["cholqr2", "qr"],
                    default="cholqr2",
                    help="orthonormalization inside the subspace solver "
@@ -694,6 +702,7 @@ def main(argv=None) -> int:
         solver=args.solver,
         subspace_iters=args.subspace_iters,
         orth_method=args.orth_method,
+        warm_orth_method=args.warm_orth_method,
         compute_dtype=(
             None if args.compute_dtype == "float32" else args.compute_dtype
         ),

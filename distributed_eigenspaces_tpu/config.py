@@ -63,6 +63,20 @@ class PCAConfig:
         ``"cholqr2"`` (CholeskyQR2 — MXU matmuls with a shallow dependency
         chain, the TPU default) or ``"qr"`` (Householder — bulletproof but a
         long sequential chain of small ops, the TPU latency anti-pattern).
+        Deliberately NOT ``"ns"``: cold power steps produce
+        nearly-dependent columns (one application of a spread spectrum to
+        a random basis leaves the column correlation with lambda_min ~
+        1e-3 — measured) where Newton-Schulz stalls/NaNs; NS is the WARM
+        knob below.
+      warm_orth_method: orthonormalization for the WARM-started solver
+        rounds only (``None`` = same as ``orth_method``). ``"ns"``
+        (composite Newton-Schulz, :func:`~.ops.linalg.ns_orth`) removes
+        every per-iteration Cholesky/triangular-solve from the
+        latency-bound steady state — pure matmuls — and is convergent
+        there by construction (warm bases start one short power step
+        from the previous orthonormal merged estimate): measured +14.2%
+        on the headline fit at identical accuracy (BASELINE.md round 5).
+        The cold first round always runs ``orth_method``.
       compute_dtype: optional cast applied to data blocks entering the Gram
         matmul (``"bfloat16"`` runs the n x d^2 contraction at full MXU rate;
         accumulation stays fp32). ``None`` computes in the block dtype with
@@ -116,6 +130,7 @@ class PCAConfig:
     subspace_iters: int = 16
     warm_start_iters: int | None | str = "auto"
     orth_method: str = "cholqr2"
+    warm_orth_method: str | None = None
     compute_dtype: Any = None
     stage_dtype: Any = None
     dtype: Any = jnp.float32
@@ -149,7 +164,17 @@ class PCAConfig:
                 f"{self.warm_start_iters}"
             )
         if self.orth_method not in ("qr", "cholqr2"):
-            raise ValueError(f"unknown orth_method: {self.orth_method!r}")
+            # "ns" is deliberately warm-only (see the docstring): cold
+            # power steps feed it nearly-dependent columns where it
+            # stalls — a silently degraded basis, the worst failure mode
+            raise ValueError(
+                f"unknown orth_method: {self.orth_method!r} (qr/cholqr2; "
+                "'ns' is warm_orth_method-only)"
+            )
+        if self.warm_orth_method not in (None, "qr", "cholqr2", "ns"):
+            raise ValueError(
+                f"unknown warm_orth_method: {self.warm_orth_method!r}"
+            )
         if self.compute_dtype is not None:
             jnp.dtype(self.compute_dtype)  # raises on junk
         if self.stage_dtype is not None:
@@ -197,6 +222,15 @@ class PCAConfig:
         if self.warm_start_iters == "auto":
             return 2
         return self.warm_start_iters
+
+    def resolved_warm_orth(self) -> str:
+        """Orthonormalization for WARM solver rounds — ONE definition for
+        every warm-core build site (scan/segmented/per-step) so the
+        tested trainer equivalences cannot drift."""
+        return (
+            self.orth_method if self.warm_orth_method is None
+            else self.warm_orth_method
+        )
 
     def resolved_stage_dtype(self):
         """The dtype staged blocks are HBM-resident in: ``stage_dtype``

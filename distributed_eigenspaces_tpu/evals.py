@@ -45,6 +45,9 @@ class EvalSpec:
     solver: str = "subspace"
     subspace_iters: int = 12
     warm_start_iters: int | None = None
+    #: orthonormalization for WARM solver rounds (None = orth default;
+    #: "ns" = the latency-free Newton-Schulz steady state, warm-only)
+    warm_orth_method: str | None = None
     compute_dtype: str | None = None
     backend: str = "local"  # "local" | "shard_map" | "feature_sharded"
     #: HBM staging dtype for the in-memory configs (None = compute
@@ -312,6 +315,7 @@ def run_eval(
         dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=spec.steps,
         solver=spec.solver, subspace_iters=spec.subspace_iters,
         warm_start_iters=spec.warm_start_iters,
+        warm_orth_method=spec.warm_orth_method,
         compute_dtype=spec.compute_dtype,
         stage_dtype=spec.stage_dtype,
         backend=spec.backend,

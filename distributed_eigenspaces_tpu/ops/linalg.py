@@ -344,15 +344,119 @@ def _cholqr2(v: jax.Array) -> jax.Array:
     return v
 
 
+def ns_orth(v: jax.Array, iters: int = 4, eps: float = 1e-20,
+            reduce=None) -> jax.Array:
+    """Orthonormalize tall-skinny ``v (..., d, k)`` by column scaling +
+    Newton-Schulz iteration — pure matmuls end to end.
+
+    Why it exists: on TPU every Cholesky / triangular-solve / eigh call
+    costs sequential-chain *latency* at k-sized shapes (the ops lower to
+    long dependent chains XLA can't tile onto the MXU), so a CholeskyQR2
+    per solver iteration can dominate a latency-bound warm step. NS needs
+    only Grams and matmuls. Composite form: ONE d-sized Gram + ONE
+    d-sized matmul; the iteration itself runs on k x k matrices (``G``
+    and the polynomial transform commute, so ``V_i = V_0 M_i`` with
+    ``M`` accumulated in k^3 ops).
+
+    Converges for inputs with bounded condition number: columns are
+    norm-scaled first, then the whole basis is scaled by the inf-norm
+    bound so every singular value is <= 1. This covers the WARM regime
+    only — bases one short power step from the previous orthonormal
+    estimate (measured end-to-end equal accuracy to CholeskyQR2 on the
+    headline fit at +14% throughput, BASELINE.md round 5). It does NOT
+    cover cold power iteration: one application of a spread spectrum to
+    a random basis leaves the column correlation with lambda_min ~ 1e-3
+    (nearly dependent columns — measured), where NS stalls for any
+    iteration count and eventually NaNs in fp32 — which is why
+    ``PCAConfig`` exposes this as ``warm_orth_method`` and rejects it
+    for ``orth_method``. ``reduce`` applies to every k x k Gram (the
+    feature-sharded wrapper passes the mesh psum). Under DET_CHECKIFY=1
+    the orthonormality residual is asserted.
+    """
+    red = (lambda t: t) if reduce is None else reduce
+    g = jnp.einsum(
+        "...dk,...dl->...kl", v, v, precision=lax.Precision.HIGHEST
+    )
+    g = red(g)
+    dscale = lax.rsqrt(
+        jnp.maximum(jnp.diagonal(g, axis1=-2, axis2=-1), eps)
+    )
+    g = g * dscale[..., :, None] * dscale[..., None, :]
+    # sigma_max^2 <= max abs row sum; after column normalization the diag
+    # is 1 so the bound is >= 1 and alpha <= 1
+    alpha2 = 1.0 / jnp.maximum(
+        jnp.max(jnp.sum(jnp.abs(g), axis=-1), axis=-1), 1.0
+    )
+    g = g * alpha2[..., None, None]
+    k = g.shape[-1]
+    eye = jnp.eye(k, dtype=g.dtype)
+    m_acc = eye * jnp.sqrt(alpha2)[..., None, None]
+
+    for _ in range(iters):
+        a = 1.5 * eye - 0.5 * g
+        m_acc = m_acc @ a
+        g = g @ (a @ a)  # G and a (a polynomial in G) commute
+
+    out = jnp.einsum(
+        "...dk,...kl->...dl", v * dscale[..., None, :], m_acc,
+        precision=lax.Precision.HIGHEST,
+    )
+    from distributed_eigenspaces_tpu.utils.guards import checks_enabled
+
+    if checks_enabled():
+        # NS converges only for bounded condition number; a silently
+        # broken assumption degrades the basis with no NaN anywhere, so
+        # float checks never fire. Under DET_CHECKIFY=1 assert the
+        # orthonormality residual (one extra k x k Gram — debug only).
+        from jax.experimental import checkify
+
+        vtv = jnp.einsum(
+            "...dk,...dl->...kl", out, out,
+            precision=lax.Precision.HIGHEST,
+        )
+        vtv = red(vtv)
+        resid = jnp.max(jnp.abs(vtv - eye))
+        checkify.check(
+            resid < 5e-2,
+            "ns_orth left ||V^T V - I||_max = {r}: input condition "
+            "number outside the convergence regime (use cholqr2)",
+            r=resid,
+        )
+    return out
+
+
+ORTH_METHODS = ("qr", "cholqr2", "ns")
+
+
+def validate_orth_method(method: str) -> None:
+    """Raise on an unknown orthonormalization method WITHOUT executing
+    anything — the eager-validation call sites used to run the method on
+    a dummy zeros matrix, which under DET_CHECKIFY=1 fires ns_orth's
+    orthonormality assert (zeros are maximally non-orthonormal) before
+    any real work happens."""
+    if method not in ORTH_METHODS:
+        raise ValueError(
+            f"unknown orthonormalization method: {method!r}; "
+            f"one of {ORTH_METHODS}"
+        )
+
+
 def orthonormalize(v: jax.Array, method: str = "qr") -> jax.Array:
     """Orthonormalize the columns of ``v (d, k)``.
 
     ``method="qr"``: Householder thin-QR (bulletproof, but a long sequential
     chain of small ops on TPU). ``method="cholqr2"``: CholeskyQR2 (see
     :func:`_cholqr2`) — the TPU fast path and the framework default.
+    ``method="ns"``: composite Newton-Schulz (:func:`ns_orth`) — pure
+    matmuls, no Cholesky/solve latency; WARM-REGIME ONLY (see ns_orth's
+    convergence note — reachable through ``PCAConfig.warm_orth_method``,
+    rejected for ``orth_method``), measured +14% on the latency-bound
+    headline fit at identical accuracy (round 5).
     """
     if method == "cholqr2":
         return _cholqr2(v)
+    if method == "ns":
+        return ns_orth(v)
     if method != "qr":
         raise ValueError(f"unknown orthonormalization method: {method!r}")
     with jax.default_matmul_precision("highest"):

@@ -99,69 +99,19 @@ def _small_eigh_desc(g):
 
 
 def ns_orth(v, axis_name=None, iters=4, eps=1e-20):
-    """Orthonormalize tall-skinny ``v (..., d, k)`` by column scaling +
-    Newton-Schulz iteration — pure matmuls end to end.
+    """Mesh-aware wrapper of the composite Newton-Schulz
+    orthonormalization (:func:`~..ops.linalg.ns_orth` — ONE definition
+    of the math since round 5, when "ns" also became the dense
+    trainers' ``warm_orth_method``; ``orth_method="ns"`` stays rejected
+    — cold power steps are outside NS's convergence region): every
+    k x k Gram reduces over the ``features`` axis so the row-sharded
+    basis is orthonormalized GLOBALLY."""
+    from distributed_eigenspaces_tpu.ops.linalg import ns_orth as _ns
 
-    Why it exists: on TPU every Cholesky / triangular-solve / eigh call
-    costs ~0.5-1.8 ms of *latency* at k-sized shapes (measured; the ops are
-    long sequential chains XLA can't tile onto the MXU), so a CholeskyQR2
-    per warm step dominates the whole step. NS needs only Grams and
-    matmuls. Composite form: ONE d-sized Gram + ONE d-sized matmul; the
-    iteration itself runs on k x k matrices (``G`` and the polynomial
-    transform commute, so ``V_i = V_0 M_i`` with ``M`` accumulated in k^3
-    ops).
-
-    Converges for inputs with bounded condition number (the warm regime:
-    bases one power step away from orthonormal ``v0``); columns are
-    norm-scaled first (covariance-scaled matvec outputs have column norms
-    spread like the top-k eigenvalues), then the whole basis is scaled by
-    the inf-norm bound so every singular value is <= 1. NOT a
-    general-purpose QR — cold starts keep :func:`chol_qr2`.
-    """
-    g = jnp.einsum("...dk,...dl->...kl", v, v, precision=HP)
-    g = _psum_if(g, axis_name)
-    dscale = jax.lax.rsqrt(
-        jnp.maximum(jnp.diagonal(g, axis1=-2, axis2=-1), eps)
+    return _ns(
+        v, iters=iters, eps=eps,
+        reduce=lambda t: _psum_if(t, axis_name),
     )
-    g = g * dscale[..., :, None] * dscale[..., None, :]
-    # sigma_max^2 <= max abs row sum; after column normalization the diag
-    # is 1 so the bound is >= 1 and alpha <= 1
-    alpha2 = 1.0 / jnp.maximum(
-        jnp.max(jnp.sum(jnp.abs(g), axis=-1), axis=-1), 1.0
-    )
-    g = g * alpha2[..., None, None]
-    k = g.shape[-1]
-    eye = jnp.eye(k, dtype=g.dtype)
-    m_acc = eye * jnp.sqrt(alpha2)[..., None, None]
-
-    for _ in range(iters):
-        a = 1.5 * eye - 0.5 * g
-        m_acc = m_acc @ a
-        g = g @ (a @ a)  # G and a (a polynomial in G) commute
-
-    out = jnp.einsum(
-        "...dk,...kl->...dl", v * dscale[..., None, :], m_acc, precision=HP
-    )
-    from distributed_eigenspaces_tpu.utils.guards import checks_enabled
-
-    if checks_enabled():
-        # NS converges only for bounded condition number (the warm-regime
-        # assumption); a silently broken assumption degrades the basis with
-        # no NaN anywhere, so float checks never fire. Under DET_CHECKIFY=1
-        # assert the orthonormality residual the iteration was supposed to
-        # drive to zero (one extra k x k Gram — debug mode only).
-        from jax.experimental import checkify
-
-        vtv = jnp.einsum("...dk,...dl->...kl", out, out, precision=HP)
-        vtv = _psum_if(vtv, axis_name)
-        resid = jnp.max(jnp.abs(vtv - eye))
-        checkify.check(
-            resid < 5e-2,
-            "ns_orth left ||V^T V - I||_max = {r}: input condition number "
-            "outside the warm regime (use chol_qr2 for cold bases)",
-            r=resid,
-        )
-    return out
 
 
 

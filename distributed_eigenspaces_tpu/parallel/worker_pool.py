@@ -65,7 +65,11 @@ def _batched_streaming_eigenspaces(
     )
 
     m, n, d = x.shape
-    orthonormalize(jnp.zeros((2, 1)), orth)  # validate method eagerly
+    # string-level validation — executing the method on a dummy zeros
+    # matrix would fire ns_orth's DET_CHECKIFY orthonormality assert
+    from distributed_eigenspaces_tpu.ops.linalg import validate_orth_method
+
+    validate_orth_method(orth)
     orth_b = jax.vmap(lambda v: orthonormalize(v, orth))
 
     def mv(vs):  # (m, d, k) -> (m, d, k)
@@ -276,6 +280,7 @@ class WorkerPool:
     def round(
         self, x_blocks: jax.Array, k: int, worker_mask=None,
         v0: jax.Array | None = None, iters: int | None = None,
+        orth: str | None = None,
     ):
         """One merge round: ``(m, n, d) -> (sigma_bar (d, d), v_bar (d, k))``.
 
@@ -285,9 +290,12 @@ class WorkerPool:
         needs). ``worker_mask`` (m,) of {0,1} excludes failed workers from
         the merge. ``v0`` (d, k) warm-starts every worker's subspace
         iteration (online callers pass the previous round's merged
-        estimate) and ``iters`` overrides the pool's iteration count for
-        this round — together they are the per-step trainer's warm-start
-        lever (``cfg.warm_start_iters``); both ignored by the eigh solver.
+        estimate), ``iters`` overrides the pool's iteration count for
+        this round, and ``orth`` overrides the orthonormalization (the
+        per-step loop passes ``cfg.resolved_warm_orth()`` on warm rounds
+        — the warm-only "ns" lever) — together they are the per-step
+        trainer's warm-start levers (``cfg.warm_start_iters`` /
+        ``cfg.warm_orth_method``); all ignored by the eigh solver.
         """
         m = x_blocks.shape[0]
         if m != self.num_workers:
@@ -298,7 +306,8 @@ class WorkerPool:
         if worker_mask is None:
             worker_mask = jnp.ones((m,), dtype=jnp.float32)
         return self._round_fn(
-            x_blocks, worker_mask, k=k, v0=v0, step_iters=iters
+            x_blocks, worker_mask, k=k, v0=v0, step_iters=iters,
+            step_orth=orth,
         )
 
     def shard(self, x_blocks: jax.Array) -> jax.Array:
@@ -334,12 +343,14 @@ class WorkerPool:
 
         if self.backend == "local":
 
-            @partial(jax.jit, static_argnames=("k", "step_iters"))
-            def round_local(x_blocks, mask, k, v0=None, step_iters=None):
+            @partial(jax.jit, static_argnames=("k", "step_iters", "step_orth"))
+            def round_local(x_blocks, mask, k, v0=None, step_iters=None,
+                            step_orth=None):
                 vs = _local_eigenspaces(
                     x_blocks, k, solver,
                     iters if step_iters is None else step_iters,
-                    orth, cdtype, v0=v0,
+                    orth if step_orth is None else step_orth,
+                    cdtype, v0=v0,
                 )
                 return merge(vs, mask, k)
 
@@ -348,14 +359,16 @@ class WorkerPool:
         mesh = self.mesh
         in_spec = P(WORKER_AXIS)
 
-        @partial(jax.jit, static_argnames=("k", "step_iters"))
-        def round_sharded(x_blocks, mask, k, v0=None, step_iters=None):
+        @partial(jax.jit, static_argnames=("k", "step_iters", "step_orth"))
+        def round_sharded(x_blocks, mask, k, v0=None, step_iters=None,
+                          step_orth=None):
             def shard_fn(xs, mask_s, v0_s):
                 # xs: (m_local, n, d) on this device's worker slot(s)
                 vs = _local_eigenspaces(
                     xs, k, solver,
                     iters if step_iters is None else step_iters,
-                    orth, cdtype, v0=v0_s,
+                    orth if step_orth is None else step_orth,
+                    cdtype, v0=v0_s,
                 )
                 # ICI gather of the d x k factors — the entire reference
                 # wire protocol (C11) collapses to these two lines, moving

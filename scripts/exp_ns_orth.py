@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""A/B: warm-only Newton-Schulz orthonormalization
+(``warm_orth_method="ns"`` — the SHIPPED wiring) vs CholeskyQR2 warm
+rounds, on the int8-staged headline fit (round 5 — with the bytes
+halved the warm step is latency-bound, and the per-iteration Cholesky +
+two triangular solves are sequential ops the MXU can't help with;
+ns_orth is pure matmuls).
+
+Protocol: the headline end-to-end fit (same harness as the int8 A/B —
+scripts/exp_int8_stage.run_fit: T=600 gather staging, value-fetch
+fence, RPC subtracted, median-of-3 + IQR, principal-angle gate). The B
+arm flips ONLY ``cfg.warm_orth_method`` — the cold first step keeps
+CholeskyQR2 in both arms, exactly like the shipped default (an earlier
+version of this script patched the cold solve to NS as well; the
+measured +14.2% survived, but that configuration is rejected by the
+config for a reason — cold power steps leave nearly-dependent columns
+where NS stalls, ``tests/test_linalg.py::
+test_ns_cold_solver_fragility_pinned``).
+
+Usage: python scripts/exp_ns_orth.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+import jax
+
+sys.path.insert(0, "scripts")
+from exp_int8_stage import run_fit  # noqa: E402  (the shared protocol)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+
+    m, n, d, k = 8, 4096, 1024, 8
+    steps = 40 if args.quick else 600
+    spectrum = planted_spectrum(d, k_planted=k, gap=20.0, noise=0.01, seed=7)
+    blocks_host = [
+        np.asarray(
+            spectrum.sample(jax.random.PRNGKey(100 + b), m * n)
+        ).reshape(m, n, d)
+        for b in range(4)
+    ]
+    cfg = PCAConfig(
+        dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=steps,
+        solver="subspace", subspace_iters=12, warm_start_iters=2,
+        orth_method="cholqr2", compute_dtype="bfloat16",
+        stage_dtype="int8",
+    )
+
+    report = {"device": str(jax.devices()[0])}
+    report["cholqr2"] = run_fit("int8", steps, blocks_host, spectrum, cfg)
+    report["warm_ns"] = run_fit(
+        "int8", steps, blocks_host, spectrum,
+        cfg.replace(warm_orth_method="ns"),
+    )
+    report["ns_over_cholqr2"] = round(
+        report["warm_ns"]["samples_per_sec"]
+        / report["cholqr2"]["samples_per_sec"], 3
+    )
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
