@@ -193,9 +193,18 @@ def measure_tpu(blocks_host, spectrum, profile_dir=None):
     from distributed_eigenspaces_tpu.algo.online import OnlineState
     from distributed_eigenspaces_tpu.algo.step import make_train_step
 
+    from distributed_eigenspaces_tpu.data.stream import stage_blocks
+
     steps = min(TPU_STEPS, 60)  # dispatch-bound: keep the wall time sane
-    step = make_train_step(_bench_cfg(), mesh=None, donate=False)
-    blocks = [jnp.asarray(b) for b in blocks_host]
+    cfg = _bench_cfg()
+    step = make_train_step(cfg, mesh=None, donate=False)
+    # stage in the SAME dtype as the scan arm (int8 by default) — a raw
+    # fp32 staging here would re-conflate the "pure dispatch" claim with
+    # a staging-dtype difference
+    blocks = [
+        jnp.asarray(b)
+        for b in stage_blocks(blocks_host, cfg.resolved_stage_dtype())
+    ]
 
     # compile + warm-up BOTH executables (cold and warm-started); salt the
     # warm-up state so the first timed step's (executable, operands) pair

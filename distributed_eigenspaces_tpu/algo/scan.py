@@ -21,7 +21,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_eigenspaces_tpu.algo.online import OnlineState, update_state
-from distributed_eigenspaces_tpu.algo.step import make_round_core
+from distributed_eigenspaces_tpu.algo.step import (
+    make_round_core,
+    make_warm_core,
+)
 from distributed_eigenspaces_tpu.config import PCAConfig
 from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
 
@@ -103,14 +106,8 @@ def make_scan_fit(
         raise ValueError("masked scan fits take a dense (T, ...) stack")
 
     round_core = make_round_core(cfg)
-    warm_iters = cfg.resolved_warm_start()
-    warm = warm_iters is not None
-    warm_core = (
-        make_round_core(
-            cfg, iters=warm_iters, orth=cfg.resolved_warm_orth()
-        )
-        if warm else None
-    )
+    warm_core = make_warm_core(cfg)
+    warm = warm_core is not None
 
     def make_fit(axis_name):
         def update(st, v_bar):
@@ -258,14 +255,8 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
     round_core = make_round_core(cfg)
-    warm_iters = cfg.resolved_warm_start()
-    warm = warm_iters is not None
-    warm_core = (
-        make_round_core(
-            cfg, iters=warm_iters, orth=cfg.resolved_warm_orth()
-        )
-        if warm else None
-    )
+    warm_core = make_warm_core(cfg)
+    warm = warm_core is not None
 
     def update(st, v_bar):
         return update_state(

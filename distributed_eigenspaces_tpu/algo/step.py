@@ -80,6 +80,21 @@ def make_round_core(
     return round_core
 
 
+def make_warm_core(cfg: PCAConfig):
+    """The warm-round core, or None when warm starts are off — ONE
+    definition of "short iteration count + warm orthonormalization"
+    (``resolved_warm_start`` / ``resolved_warm_orth``) for every
+    warm-core build site (per-step / scan / segmented), so a future
+    warm knob threads through one place and the tested trainer
+    equivalences cannot drift."""
+    warm_iters = cfg.resolved_warm_start()
+    if warm_iters is None:
+        return None
+    return make_round_core(
+        cfg, iters=warm_iters, orth=cfg.resolved_warm_orth()
+    )
+
+
 def make_train_step(
     cfg: PCAConfig, mesh: Mesh | None = None, *, donate: bool = True
 ):
@@ -105,14 +120,8 @@ def make_train_step(
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
     round_core = make_round_core(cfg)
-    warm_iters = cfg.resolved_warm_start()
-    warm = warm_iters is not None
-    warm_core = (
-        make_round_core(
-            cfg, iters=warm_iters, orth=cfg.resolved_warm_orth()
-        )
-        if warm else None
-    )
+    warm_core = make_warm_core(cfg)
+    warm = warm_core is not None
     donate_args = (0,) if donate else ()
 
     def fold(state, v_bar):

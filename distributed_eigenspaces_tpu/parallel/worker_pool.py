@@ -235,6 +235,17 @@ class WorkerPool:
         orth_method: str = "cholqr2",
         compute_dtype=None,
     ):
+        if orth_method == "ns":
+            # the pool's orth_method runs COLD solves too, and cold
+            # power steps are outside NS's convergence region (a
+            # silently degraded basis — PCAConfig rejects it for the
+            # same reason); warm rounds opt in per call via
+            # round(orth="ns")
+            raise ValueError(
+                "orth_method='ns' is warm-only: construct the pool with "
+                "cholqr2/qr and pass orth='ns' to round() on warm rounds "
+                "(or use cfg.warm_orth_method)"
+            )
         if backend == "tpu":
             # the north star's `backend="tpu"` selector (BASELINE.json):
             # mesh-sharded workers with the ICI psum merge

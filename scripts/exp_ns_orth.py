@@ -24,13 +24,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
 
 import jax
 
-sys.path.insert(0, "scripts")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from exp_int8_stage import run_fit  # noqa: E402  (the shared protocol)
 
 
