@@ -62,6 +62,21 @@ def quantize_block_i8(block) -> np.ndarray:
     return np.clip(np.round(b * (127.0 / amax)), -127, 127).astype(np.int8)
 
 
+def quantize_block_i8_device(block):
+    """Device-side twin of :func:`quantize_block_i8` (same math: global
+    symmetric absmax scale, round-half-even, clip, int8) for blocks that
+    are ALREADY device-resident — quantizing on device instead of
+    pulling fp32 to host saves the full block transfer on exactly the
+    slow-link setups the staging exists to help. Equality with the host
+    version is pinned in tests/test_int8_stage.py. (No finite guard: a
+    non-finite device block is the DET_CHECKIFY guards' jurisdiction —
+    a host check here would force the transfer this path avoids.)"""
+    b = block.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(b))
+    scale = jnp.where(amax > 0, 127.0 / jnp.maximum(amax, 1e-30), 0.0)
+    return jnp.clip(jnp.round(b * scale), -127, 127).astype(jnp.int8)
+
+
 def stage_blocks(blocks, stage):
     """Stage an iterable of ``(m, n, d)`` blocks in ``stage`` dtype — THE
     one definition of the staging contract (estimator whole fits, the

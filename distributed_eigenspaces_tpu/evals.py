@@ -448,15 +448,23 @@ def run_eval(
     # gather copy at bf16; stage_dtype="int8" halves them again and the
     # solvers contract int8 natively (bench.py methodology; ONE staging
     # contract — data.stream.stage_blocks)
-    from distributed_eigenspaces_tpu.data.stream import stage_blocks
+    from distributed_eigenspaces_tpu.data.stream import (
+        quantize_block_i8_device,
+        stage_blocks,
+    )
 
     stage_dtype = cfg.resolved_stage_dtype()
 
     def staged_host(blocks):
         if stage_dtype == jnp.dtype(jnp.int8):
-            # quantization is host-side (ONE staging contract,
-            # data.stream.stage_blocks)
-            return list(stage_blocks(blocks, stage_dtype))
+            # device-resident sample blocks quantize ON DEVICE (pulling
+            # fp32 to host just to quantize would drag 4 x ~100 MB over
+            # the slow link); host blocks take the host contract
+            return [
+                quantize_block_i8_device(b) if isinstance(b, jax.Array)
+                else next(iter(stage_blocks([b], stage_dtype)))
+                for b in blocks
+            ]
         # float stage dtypes cast IN PLACE (device arrays stay on
         # device — memory-mode sample blocks are device-resident, and a
         # host round trip would drag up to 4 x ~50-400 MB over the slow

@@ -66,6 +66,21 @@ def test_gram_overflow_guard_widens(rng):
     np.testing.assert_allclose(np.asarray(g), want, rtol=1e-6)
 
 
+def test_quantize_device_twin_matches_host(rng):
+    from distributed_eigenspaces_tpu.data.stream import (
+        quantize_block_i8_device,
+    )
+
+    b = rng.standard_normal((4, 64, 32)).astype(np.float32) * 3.7
+    host = quantize_block_i8(b)
+    dev = np.asarray(quantize_block_i8_device(jnp.asarray(b)))
+    np.testing.assert_array_equal(host, dev)
+    z = np.asarray(
+        quantize_block_i8_device(jnp.zeros((3, 3), jnp.float32))
+    )
+    assert z.dtype == np.int8 and not z.any()
+
+
 def test_quantize_block_i8_contract():
     b = np.array([[0.5, -2.0], [1.0, 4.0]], np.float32)
     q = quantize_block_i8(b)

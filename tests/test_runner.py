@@ -1,0 +1,110 @@
+"""Direct contract tests for the shared whole-fit runner
+(`api/runner.py`, round-5 verdict item 8). The estimator/evals/CLI
+exercise the handles end-to-end; these pin the handle CONTRACT itself —
+uniform fit/init/extract across kinds, kind-specific guards, and the
+one-definition extraction — so a new caller can rely on it without
+reading four trainer factories."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.api.runner import (
+    KINDS,
+    extract_dense,
+    make_whole_fit,
+)
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.ops.linalg import (
+    principal_angles_degrees,
+)
+
+D, K, M, N, T = 64, 3, 4, 64, 5
+
+
+@pytest.fixture(scope="module")
+def workload():
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=1)
+    xs = np.stack([
+        np.asarray(
+            spec.sample(jax.random.PRNGKey(t), M * N)
+        ).reshape(M, N, D)
+        for t in range(T)
+    ])
+    return spec, xs
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=T,
+        solver="subspace", subspace_iters=10,
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_handle_contract_uniform(workload, kind, devices):
+    spec, xs = workload
+    cfg = _cfg(
+        backend="feature_sharded" if kind in ("fs_scan", "sketch")
+        else "local"
+    )
+    h = make_whole_fit(cfg, kind)
+    state = h.init_state()
+    blocks = xs
+    if h.blocks_sharding is not None:
+        blocks = jax.device_put(jnp.asarray(xs), h.blocks_sharding)
+    state = h.fit(state, blocks)
+    w = h.extract(state)
+    assert w.shape == (D, K)
+    ang = float(jnp.max(principal_angles_degrees(w, spec.top_k(K))))
+    assert ang < 1.5, (kind, ang)
+    assert h.raw is not None
+    assert h.kind == kind
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError, match="unknown whole-fit kind"):
+        make_whole_fit(_cfg(), "pipeline")
+
+
+def test_scan_mask_guards(workload):
+    spec, xs = workload
+    masks = np.ones((T, M), np.float32)
+    h = make_whole_fit(_cfg(), "scan")
+    with pytest.raises(ValueError, match="masked=True"):
+        h.fit(h.init_state(), xs, worker_masks=masks)
+    hm = make_whole_fit(_cfg(), "scan", masked=True)
+    with pytest.raises(ValueError, match="needs worker_masks"):
+        hm.fit(hm.init_state(), xs)
+    state = hm.fit(hm.init_state(), xs, worker_masks=masks)
+    assert int(state.step) == T
+
+
+def test_segmented_masks_route_via_fit_windows(workload):
+    spec, xs = workload
+    h = make_whole_fit(_cfg(), "segmented", segment=2)
+    with pytest.raises(ValueError, match="fit_windows"):
+        h.fit(h.init_state(), xs, worker_masks=np.ones((T, M)))
+    # the documented route works
+    state = h.fit_windows(
+        h.init_state(), iter([xs[:3], xs[3:]]),
+        worker_masks=iter([np.ones((3, M)), np.ones((T - 3, M))]),
+    )
+    assert int(state.step) == T
+
+
+def test_extract_dense_single_definition(workload):
+    """extract_dense honors solver AND orth_method — the drift the
+    runner module exists to prevent (CLI passed orth, estimator
+    didn't)."""
+    spec, xs = workload
+    cfg = _cfg()
+    h = make_whole_fit(cfg, "scan")
+    state = h.fit(h.init_state(), xs)
+    w1 = np.asarray(h.extract(state))
+    w2 = np.asarray(extract_dense(cfg, state.sigma_tilde))
+    np.testing.assert_array_equal(w1, w2)
