@@ -68,13 +68,23 @@ def quantize_block_i8_device(block):
     are ALREADY device-resident — quantizing on device instead of
     pulling fp32 to host saves the full block transfer on exactly the
     slow-link setups the staging exists to help. Equality with the host
-    version is pinned in tests/test_int8_stage.py. (No finite guard: a
-    non-finite device block is the DET_CHECKIFY guards' jurisdiction —
-    a host check here would force the transfer this path avoids.)"""
+    version is pinned in tests/test_int8_stage.py — including the
+    non-finite contract: the SCALAR absmax (4 bytes, already reduced on
+    device) is fetched and checked on host, so a NaN/inf block raises
+    here exactly like the host twin instead of being laundered into
+    finite int8 garbage by the cast (no downstream guard could ever see
+    it — the int8 block is all-finite)."""
     b = block.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(b))
-    scale = jnp.where(amax > 0, 127.0 / jnp.maximum(amax, 1e-30), 0.0)
-    return jnp.clip(jnp.round(b * scale), -127, 127).astype(jnp.int8)
+    amax = float(jnp.max(jnp.abs(b)))  # scalar fetch: the loud guard
+    if not np.isfinite(amax):
+        raise ValueError(
+            "quantize_block_i8_device: block contains non-finite values"
+        )
+    if amax == 0.0:
+        return jnp.zeros(block.shape, jnp.int8)
+    return jnp.clip(
+        jnp.round(b * (127.0 / amax)), -127, 127
+    ).astype(jnp.int8)
 
 
 def stage_blocks(blocks, stage):
@@ -86,7 +96,15 @@ def stage_blocks(blocks, stage):
     already matches)."""
     stage = jnp.dtype(stage)
     if stage == jnp.dtype(jnp.int8):
-        return (quantize_block_i8(np.asarray(b)) for b in blocks)
+        # device-resident blocks quantize ON device (pulling fp32 to
+        # host just to quantize would drag the full block over the
+        # link); host blocks take the host quantizer — same math and
+        # same loud non-finite contract, pinned equal by test
+        return (
+            quantize_block_i8_device(b) if isinstance(b, jax.Array)
+            else quantize_block_i8(np.asarray(b))
+            for b in blocks
+        )
     # host-side cast for EVERY input (numpy stays numpy, device arrays
     # come back to host): the consumers (window_stream + the trainers'
     # sharded device_put) own placement — a jnp cast here would commit

@@ -457,14 +457,9 @@ def run_eval(
 
     def staged_host(blocks):
         if stage_dtype == jnp.dtype(jnp.int8):
-            # device-resident sample blocks quantize ON DEVICE (pulling
-            # fp32 to host just to quantize would drag 4 x ~100 MB over
-            # the slow link); host blocks take the host contract
-            return [
-                quantize_block_i8_device(b) if isinstance(b, jax.Array)
-                else next(iter(stage_blocks([b], stage_dtype)))
-                for b in blocks
-            ]
+            # stage_blocks dispatches device-resident blocks to the
+            # on-device quantizer itself (ONE staging contract)
+            return list(stage_blocks(blocks, stage_dtype))
         # float stage dtypes cast IN PLACE (device arrays stay on
         # device — memory-mode sample blocks are device-resident, and a
         # host round trip would drag up to 4 x ~50-400 MB over the slow

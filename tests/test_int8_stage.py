@@ -79,6 +79,18 @@ def test_quantize_device_twin_matches_host(rng):
         quantize_block_i8_device(jnp.zeros((3, 3), jnp.float32))
     )
     assert z.dtype == np.int8 and not z.any()
+    # the loud non-finite contract matches the host twin (a NaN block
+    # must never launder into finite int8 garbage)
+    bad = jnp.asarray(b[0]).at[0, 0].set(jnp.nan)
+    with pytest.raises(ValueError, match="non-finite"):
+        quantize_block_i8_device(bad)
+    # stage_blocks dispatches device arrays to the device twin
+    from distributed_eigenspaces_tpu.data.stream import stage_blocks
+
+    out = list(stage_blocks([jnp.asarray(b), b], "int8"))
+    assert isinstance(out[0], jax.Array)
+    assert isinstance(out[1], np.ndarray)
+    np.testing.assert_array_equal(np.asarray(out[0]), out[1])
 
 
 def test_quantize_block_i8_contract():

@@ -266,6 +266,39 @@ def test_warm_orth_ns_scan_matches_cholqr2(rng):
     assert abs(outs["ns"] - outs[None]) < 0.5, outs
 
 
+def test_warm_orth_ns_per_step_equals_scan(rng):
+    """The warm-orth knob must not break the scan ≡ per-step trainer
+    equivalence: both route through make_warm_core / pool.round(orth=),
+    and with warm_orth_method='ns' they still fold identical states."""
+    from distributed_eigenspaces_tpu.algo.online import (
+        OnlineState,
+        online_distributed_pca,
+    )
+    from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+
+    d, k, m, n, T = 64, 3, 4, 64, 5
+    spec = planted_spectrum(d, k_planted=k, gap=20.0, noise=0.01, seed=4)
+    xs = np.stack([
+        np.asarray(
+            spec.sample(jax.random.PRNGKey(20 + t), m * n)
+        ).reshape(m, n, d)
+        for t in range(T)
+    ])
+    cfg = PCAConfig(
+        dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=T,
+        solver="subspace", subspace_iters=10, warm_start_iters=2,
+        warm_orth_method="ns", backend="local",
+    )
+    st_scan, _ = make_scan_fit(cfg)(OnlineState.initial(d), jnp.asarray(xs))
+    _, st_step = online_distributed_pca(iter(list(xs)), cfg)
+    np.testing.assert_allclose(
+        np.asarray(st_scan.sigma_tilde), np.asarray(st_step.sigma_tilde),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
 def test_orthonormalize_unknown_method():
     with pytest.raises(ValueError):
         from distributed_eigenspaces_tpu.ops.linalg import orthonormalize
