@@ -98,6 +98,7 @@ def online_distributed_pca(
     on_step: Callable[[int, OnlineState, jax.Array], None] | None = None,
     worker_masks: Iterator[jax.Array] | None = None,
     max_steps: int | None | str = "auto",
+    step_hook: Callable | None = None,
 ):
     """Run the full online algorithm over a stream of ``(m, n, d)`` blocks.
 
@@ -121,6 +122,11 @@ def online_distributed_pca(
         folding more rounds); ``None`` consumes the whole stream
         (``partial_fit`` semantics — fold extra rounds past T); an int is
         an explicit total cap, honored under every discount rule.
+      step_hook: optional ``(step_fn, state, x_blocks, t) -> (state,
+        v_bar)`` wrapper around each step execution — the supervisor's
+        retry/backoff hook point (``runtime/supervisor.py``): it may
+        re-invoke ``step_fn`` on transient failures or escalate. ``None``
+        calls the step directly (zero overhead on the unsupervised path).
 
     Returns:
       ``(w, state)`` — ``w`` the final (dim, k) principal subspace estimate
@@ -138,6 +144,7 @@ def online_distributed_pca(
         return _fit_feature_sharded(
             stream, cfg, state=state, on_step=on_step,
             worker_masks=worker_masks, max_steps=max_steps,
+            step_hook=step_hook,
         )
     if pool is None:
         pool = WorkerPool(
@@ -195,20 +202,23 @@ def online_distributed_pca(
 
     state = _drive_stream(
         stream, cfg, place=pool.shard, step=step, state=state,
-        on_step=on_step, max_steps=max_steps,
+        on_step=on_step, max_steps=max_steps, step_hook=step_hook,
     )
     w = top_k_eigvecs(state.sigma_tilde, cfg.k)
     return w, state
 
 
-def _drive_stream(stream, cfg, *, place, step, state, on_step, max_steps):
+def _drive_stream(stream, cfg, *, place, step, state, on_step, max_steps,
+                  step_hook=None):
     """Shared training-loop scaffolding for the per-step backends: prefetch
     wiring, the step cap (open-ended for 1/t running means), step
     bookkeeping, and deterministic prefetch-producer cleanup.
 
     ``step(state, x) -> (state, v_bar)``; ``place`` stages a host block on
     the backend's devices (must be idempotent — the prefetch producer
-    applies it ahead of the loop).
+    applies it ahead of the loop). ``step_hook`` (see
+    :func:`online_distributed_pca`) wraps each step execution — the
+    supervisor's retry hook.
     """
     if cfg.prefetch_depth > 0:
         # overlap host block prep + host->HBM transfer with device compute
@@ -237,7 +247,12 @@ def _drive_stream(stream, cfg, *, place, step, state, on_step, max_steps):
             if cap is not None and steps_done >= cap and not open_ended:
                 break
             with annotate_step(steps_done + 1):
-                state, v_bar = step(state, x_blocks)
+                if step_hook is None:
+                    state, v_bar = step(state, x_blocks)
+                else:
+                    state, v_bar = step_hook(
+                        step, state, x_blocks, steps_done + 1
+                    )
             steps_done += 1
             if on_step is not None:
                 on_step(steps_done, state, v_bar)
@@ -258,6 +273,7 @@ def _fit_feature_sharded(
     on_step=None,
     worker_masks=None,
     max_steps="auto",
+    step_hook=None,
 ):
     """The large-d backend behind :func:`online_distributed_pca`: routes the
     same stream/loop semantics through the feature-sharded training step
@@ -291,6 +307,7 @@ def _fit_feature_sharded(
     state = _drive_stream(
         stream, cfg, place=place, step=step,
         state=state, on_step=on_step, max_steps=max_steps,
+        step_hook=step_hook,
     )
     w = canonicalize_signs(state.u[:, : cfg.k])
     return w, state

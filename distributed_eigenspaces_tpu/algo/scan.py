@@ -26,7 +26,7 @@ from distributed_eigenspaces_tpu.algo.step import (
     make_warm_core,
 )
 from distributed_eigenspaces_tpu.config import PCAConfig
-from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
+from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS, shard_map
 
 
 def _masked_body_factory(cfg, round_core, warm_core, axis_name, update):
@@ -58,7 +58,12 @@ def _masked_body_factory(cfg, round_core, warm_core, axis_name, update):
             )
         else:
             v_bar = round_core(x, axis_name=axis_name, mask=mk)
-        vp_next = jnp.where(jnp.any(v_bar != 0), v_bar, vp)
+        # liveness from the MASK row, not the merged result: the per-step
+        # loop reads the mask on the host (algo/online.py), and a LIVE
+        # round whose data happens to be all-zero merges to an exactly
+        # zero v_bar — deriving liveness from v_bar would diverge from
+        # the per-step semantics in that degenerate case (ADVICE.md r5)
+        vp_next = jnp.where(jnp.any(mk != 0), v_bar, vp)
         return (update(st, v_bar), vp_next), v_bar
 
     return body
@@ -197,7 +202,7 @@ def make_scan_fit(
     extra = (P(),) if (gather or masked) else ()  # idx / (T, m) masks
     in_specs = (P(), P(None, WORKER_AXIS)) + extra
     in_shardings = (rep, x_sharding) + ((rep,) if (gather or masked) else ())
-    inner = jax.shard_map(
+    inner = shard_map(
         make_fit(axis_name=WORKER_AXIS),
         mesh=mesh,
         in_specs=in_specs,
@@ -322,7 +327,7 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
         x_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
 
         def build(first):
-            inner = jax.shard_map(
+            inner = shard_map(
                 make_seg(WORKER_AXIS, first),
                 mesh=mesh,
                 in_specs=(P(), P(None, WORKER_AXIS)),
@@ -334,7 +339,7 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
             )
 
         def build_masked():
-            inner = jax.shard_map(
+            inner = shard_map(
                 make_seg_masked(WORKER_AXIS),
                 mesh=mesh,
                 in_specs=(P(), P(None, WORKER_AXIS), P()),

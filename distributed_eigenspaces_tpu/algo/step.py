@@ -21,7 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_eigenspaces_tpu.algo.online import OnlineState, update_state
 from distributed_eigenspaces_tpu.config import PCAConfig
-from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
+from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS, shard_map
 from distributed_eigenspaces_tpu.parallel.worker_pool import (
     _local_eigenspaces,
 )
@@ -158,7 +158,7 @@ def make_train_step(
         # the shard_map boundary (sharded vs replicated error payloads)
         state_specs = OnlineState(sigma_tilde=P(), step=P())
 
-        inner = jax.shard_map(
+        inner = shard_map(
             lambda state, x: fold(
                 state, round_core(x, axis_name=WORKER_AXIS)
             ),
@@ -175,7 +175,7 @@ def make_train_step(
         )
 
         if warm:
-            inner_warm = jax.shard_map(
+            inner_warm = shard_map(
                 lambda state, x, v0: fold(
                     state, warm_core(x, axis_name=WORKER_AXIS, v0=v0)
                 ),

@@ -78,6 +78,7 @@ def make_whole_fit(
     segment: int = 50,
     gather: bool = False,
     masked: bool = False,
+    supervisor=None,
 ) -> WholeFitHandle:
     """Build the ``kind`` whole-fit trainer as a uniform handle.
 
@@ -86,10 +87,19 @@ def make_whole_fit(
     feature-sharded kinds. ``gather``/``masked`` select the dense scan's
     staged-gather / §5.3 program variants (`algo/scan.py`);
     the feature-sharded kinds carry their masked programs internally.
+    ``supervisor`` (a ``runtime.supervisor.Supervisor``) wraps the
+    handle's ``fit``/``fit_windows`` entries in the retry/backoff
+    policy — the whole-fit half of the self-healing layer.
     """
     if kind not in KINDS:
         raise ValueError(f"unknown whole-fit kind {kind!r}; one of {KINDS}")
     seed = cfg.seed if seed is None else seed
+    if supervisor is not None:
+        inner = make_whole_fit(
+            cfg, kind, mesh, seed=seed, segment=segment, gather=gather,
+            masked=masked,
+        )
+        return supervisor.wrap_handle(inner)
 
     if kind == "scan":
         from distributed_eigenspaces_tpu.algo.online import OnlineState

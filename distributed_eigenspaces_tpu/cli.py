@@ -111,6 +111,33 @@ def build_parser() -> argparse.ArgumentParser:
                    "mark worker solve / gather / merge / state update)")
     p.add_argument("--save", default=None,
                    help="write the final (d, k) subspace to this .npy")
+    sup = p.add_argument_group(
+        "supervision",
+        "self-healing runs (runtime/supervisor.py): corrupt input "
+        "blocks are quarantined to worker-mask drops, transient "
+        "failures retry with backoff, and with --checkpoint-dir the "
+        "run auto-resumes from the newest committed checkpoint "
+        "(docs/ROBUSTNESS.md)",
+    )
+    sup.add_argument("--supervise", action="store_true",
+                     help="run the fit under the fault-detecting "
+                     "supervisor (--trainer step for any backend, or "
+                     "--trainer scan for the dense segmented whole-fit)")
+    sup.add_argument("--fault-budget", type=int, default=None,
+                     help="max quarantined worker-rounds + dropped "
+                     "rounds before the run fails loudly with the fault "
+                     "ledger (default: unlimited, every fault ledgered)")
+    sup.add_argument("--max-retries", type=int, default=3,
+                     help="transient-failure retries per stream pull / "
+                     "step before escalating to a resume")
+    sup.add_argument("--max-resumes", type=int, default=2,
+                     help="in-process auto-resumes before an "
+                     "escalation is terminal")
+    sup.add_argument("--backoff-base", type=float, default=0.05,
+                     help="first retry delay in seconds (doubles per "
+                     "attempt)")
+    sup.add_argument("--backoff-max", type=float, default=2.0,
+                     help="retry delay cap in seconds")
     return p
 
 
@@ -589,6 +616,97 @@ def _fit_feature_whole(args, cfg, data, truth) -> int:
     return 0
 
 
+def _fit_supervised(args, cfg, data, truth) -> int:
+    """``--supervise``: the fit under the self-healing layer
+    (``runtime/supervisor.py``) — block quarantine with a fault budget,
+    retry/backoff on transient failures, auto-resume from the newest
+    committed checkpoint with the stream cursor seeked. With
+    ``--checkpoint-dir`` a restarted process resumes automatically (no
+    ``--resume`` needed — that is the point)."""
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.data.stream import block_stream
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+    from distributed_eigenspaces_tpu.runtime.supervisor import (
+        SupervisorError,
+        supervised_fit,
+    )
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    trainer = "segmented" if args.trainer == "scan" else "step"
+    rows_per_step = cfg.num_workers * cfg.rows_per_worker
+    metrics = MetricsLogger(
+        samples_per_step=rows_per_step,
+        stream=sys.stderr if args.metrics else None,
+        reference_subspace=truth,
+    ).start()
+
+    def factory(start_row):
+        return block_stream(
+            data,
+            num_workers=cfg.num_workers,
+            rows_per_worker=cfg.rows_per_worker,
+            start_row=start_row,
+            remainder=cfg.remainder,
+            device=False,
+        )
+
+    t0 = time.time()
+    try:
+        w, state, sup = supervised_fit(
+            factory,
+            cfg,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            trainer=trainer,
+            metrics=metrics,
+            fault_budget=args.fault_budget,
+            max_retries=args.max_retries,
+            max_resumes=args.max_resumes,
+            backoff_base=args.backoff_base,
+            backoff_max=args.backoff_max,
+        )
+    except SupervisorError as e:
+        print(
+            json.dumps(
+                {
+                    "mode": "fit",
+                    "supervised": True,
+                    "error": str(e),
+                    "faults": e.ledger.as_dict(),
+                }
+            ),
+            file=sys.stderr,
+        )
+        return 3
+    elapsed = time.time() - t0
+
+    w_host = np.asarray(w)
+    out = {
+        "mode": "fit",
+        "supervised": True,
+        "trainer": trainer,
+        **metrics.summary(),
+        "steps": int(state.step),
+        "seconds": round(elapsed, 3),
+        "dim": cfg.dim,
+        "k": cfg.k,
+    }
+    if sup.ledger.events:
+        out["faults"] = sup.ledger.as_dict()
+    if truth is not None:
+        out["principal_angle_deg"] = round(
+            float(jnp.max(principal_angles_degrees(jnp.asarray(w), truth))),
+            4,
+        )
+    print(json.dumps(out))
+    if args.save:
+        np.save(args.save, w_host)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -601,6 +719,16 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    if args.data == "synthetic":
+        # --data synthetic sizes its sample by --steps, and checkpoint
+        # resume re-runs with a LARGER --steps: the resumed run must see
+        # the same leading rows, which needs the counter-based
+        # (partitionable) threefry — prefix-stable sampling. Default on
+        # newer JAX; explicit where the legacy scheme still is.
+        import jax
+
+        jax.config.update("jax_threefry_partitionable", True)
 
     if args.mode == "slave":
         print(
@@ -712,6 +840,21 @@ def main(argv=None) -> int:
                   else args.warm_start_iters)
         ),
     )
+
+    if args.supervise:
+        if args.trainer == "sketch" or (
+            args.trainer == "scan" and args.backend == "feature_sharded"
+        ):
+            print(
+                "error: --supervise covers the per-step loop (--trainer "
+                "step, any backend — feature_sharded included) and the "
+                "dense segmented whole-fit (--trainer scan); the "
+                "feature-sharded whole-fit trainers checkpoint/resume "
+                "via --checkpoint-dir/--resume without supervision",
+                file=sys.stderr,
+            )
+            return 2
+        return _fit_supervised(args, cfg, data, truth)
 
     if args.trainer == "sketch":
         if args.backend != "feature_sharded":

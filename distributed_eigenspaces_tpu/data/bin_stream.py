@@ -134,6 +134,7 @@ def bin_block_stream(
     out_dtype=jnp.float32,
     remainder: str = "drop",
     worker_range: tuple[int, int] | None = None,
+    start_row: int = 0,
 ) -> Iterator[jnp.ndarray]:
     """Yield ``(num_workers, rows_per_worker, dim)`` blocks from a binary
     row file without ever materializing the dataset.
@@ -152,6 +153,13 @@ def bin_block_stream(
     process reads the full dataset, ``distributed.py:169``). A ragged
     final step is dropped (only ``remainder="drop"`` is meaningful: a
     partial step may cut mid-stride, so other policies are rejected).
+
+    ``start_row`` seeks past already-consumed rows before the first read
+    — the resume argument for the cursor ``utils.checkpoint`` saves
+    (``steps_done * num_workers * rows_per_worker``). It must land on a
+    step boundary: the file's step layout is fixed, so a mid-step seek
+    would silently re-split every block (and in strided mode would
+    misalign every host's worker slots).
     """
     if remainder not in ("drop", "pad", "error"):
         raise ValueError(f"unknown remainder policy: {remainder!r}")
@@ -171,7 +179,19 @@ def bin_block_stream(
         raise ValueError(f"one step needs {step_rows} rows, file has {total}")
 
     row_bytes = dim * in_dt.itemsize
-    offset = 0
+    if start_row:
+        if start_row % step_rows:
+            raise ValueError(
+                f"start_row={start_row} is not a step boundary "
+                f"(step_rows={step_rows}) — checkpoint cursors are "
+                "whole-step row offsets"
+            )
+        if start_row > total:
+            raise ValueError(
+                f"start_row={start_row} beyond the file's {total} rows"
+            )
+    skipped_steps = start_row // step_rows
+    offset = start_row * row_bytes
     skip = 0
     out_workers = num_workers
     if worker_range is not None:
@@ -187,12 +207,17 @@ def bin_block_stream(
                 "final step may cut mid-stride)"
             )
         out_workers = hi - lo
-        offset = lo * rows_per_worker * row_bytes
+        # seek past the other hosts' leading worker slots AND any resumed
+        # whole steps (start_row is whole-step, so the strided layout
+        # stays aligned across hosts)
+        offset = (
+            lo * rows_per_worker + skipped_steps * step_rows
+        ) * row_bytes
         skip = (num_workers - out_workers) * rows_per_worker * row_bytes
         # every host must agree on the step count: a ragged final step may
         # be complete for low worker ranges but missing for high ones, so
-        # cap at the number of FULL steps in the file
-        full_steps = total // step_rows
+        # cap at the number of FULL steps left after the seek
+        full_steps = total // step_rows - skipped_steps
         num_steps = (
             full_steps if num_steps is None else min(num_steps, full_steps)
         )

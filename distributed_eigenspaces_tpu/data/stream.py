@@ -123,6 +123,7 @@ def block_stream(
     dtype=jnp.float32,
     wrap: bool = False,
     device: bool = True,
+    start_row: int = 0,
 ) -> Iterator[jax.Array]:
     """Yield (num_workers, rows_per_worker, d) blocks from (N, d) host data.
 
@@ -136,6 +137,10 @@ def block_stream(
     block on a device — for consumers that stage themselves (the
     whole-fit trainers), where a per-block device round trip would both
     waste host<->device bandwidth and pile up transient HBM buffers.
+    ``start_row`` seeks the cursor before the first step — the resume
+    argument for the row offset ``utils.checkpoint`` saves (a checkpoint
+    cursor is ``steps_done * step_rows``), so a restarted run continues
+    on unseen rows instead of replaying the stream from row 0.
     """
     data = np.asarray(data)
     n_total, d = data.shape
@@ -144,7 +149,11 @@ def block_stream(
         raise ValueError(
             f"one step needs {step_rows} rows, dataset has {n_total}"
         )
-    cursor, steps = 0, 0
+    if not 0 <= start_row <= n_total:
+        raise ValueError(
+            f"start_row={start_row} outside the dataset's {n_total} rows"
+        )
+    cursor, steps = start_row, 0
     while num_steps is None or steps < num_steps:
         if cursor + step_rows > n_total:
             if wrap:

@@ -213,21 +213,27 @@ def _real_data(spec: EvalSpec, data_dir: str | None):
                 "dir": os.path.abspath(data_dir), "kind": "mnist",
                 "rows": int(len(data)),
             }
-        if spec.name in ("imagenet12288", "clip768"):
-            from distributed_eigenspaces_tpu.data.npy_dir import (
-                load_rows_dir,
-            )
-
-            sub = os.path.join(data_dir, spec.name)
-            if not os.path.isdir(sub):
-                return None, None
-            needed = (
-                spec.num_workers * spec.rows_per_worker * spec.steps
-                + spec.num_workers * spec.rows_per_worker
-            )
-            return load_rows_dir(sub, spec.dim, max_rows=needed)
     except (FileNotFoundError, ValueError, OSError):
         return None, None
+    if spec.name in ("imagenet12288", "clip768"):
+        from distributed_eigenspaces_tpu.data.npy_dir import (
+            load_rows_dir,
+        )
+
+        sub = os.path.join(data_dir, spec.name)
+        if not os.path.isdir(sub):
+            # dataset simply not supplied -> synthetic stand-in
+            return None, None
+        needed = (
+            spec.num_workers * spec.rows_per_worker * spec.steps
+            + spec.num_workers * spec.rows_per_worker
+        )
+        # A PRESENT corpus that fails to load must be loud, not a silent
+        # synthetic fallback: load_rows_dir's ValueError (malformed file,
+        # wrong row width) and read errors propagate — the report must
+        # never claim synthetic numbers came from the user's real files
+        # (ADVICE.md r5; load_rows_dir's "loud beats a silent reshape").
+        return load_rows_dir(sub, spec.dim, max_rows=needed)
     return None, None
 
 

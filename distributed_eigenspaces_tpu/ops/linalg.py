@@ -112,8 +112,13 @@ def batched_xtxv(x: jax.Array, v: jax.Array) -> jax.Array:
     bytes, which is the whole point on an HBM-bound warm step
     (measured per-apply A/B in scripts/exp_int8_stage.py).
     """
-    if jnp.issubdtype(x.dtype, jnp.integer):
+    if x.dtype == jnp.int8:
+        # the staged wire format ONLY: other integer dtypes widen to
+        # fp32 below so a future fp32-semantics caller cannot silently
+        # get bf16 matvecs out of this branch (ADVICE.md r5)
         x = jax.lax.optimization_barrier(x).astype(jnp.bfloat16)
+    elif jnp.issubdtype(x.dtype, jnp.integer):
+        x = x.astype(jnp.float32)
     prec = _precision(x)
     xv = jnp.einsum(
         "mnd,mdk->mnk", x, v.astype(x.dtype), precision=prec,

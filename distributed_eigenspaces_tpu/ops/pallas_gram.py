@@ -24,6 +24,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed across pallas releases (TPUCompilerParams -> CompilerParams);
+# resolve whichever this runtime ships so the kernel builds on both
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", None
+) or pltpu.TPUCompilerParams
+
 
 def _gram_kernel(xi_ref, xj_ref, out_ref):
     """Grid (gi, gj, gn): accumulate xi_block^T @ xj_block over the n axis.
@@ -90,7 +96,7 @@ def gram_pallas(
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct((d, d), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_eigenspaces_tpu.config import PCAConfig
 from distributed_eigenspaces_tpu.ops.linalg import guarded_inv_sqrt
-from distributed_eigenspaces_tpu.parallel.mesh import FEATURE_AXIS, WORKER_AXIS
+from distributed_eigenspaces_tpu.parallel.mesh import FEATURE_AXIS, WORKER_AXIS, shard_map
 
 HP = jax.lax.Precision.HIGHEST
 
@@ -477,7 +477,7 @@ def make_feature_sharded_step(
 
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
-    inner = jax.shard_map(
+    inner = shard_map(
         sharded,
         mesh=mesh,
         in_specs=(state_specs, x_spec, mask_spec),
@@ -564,7 +564,7 @@ def _windowed_whole_fit(
             extra_specs = (masks_spec,) if masked else ()
             extra_shards = (masks_sharding,) if masked else ()
             compiled[key] = checked_jit(
-                jax.shard_map(
+                shard_map(
                     make(key[0]),
                     mesh=mesh,
                     in_specs=(
@@ -1057,7 +1057,7 @@ def make_feature_sharded_sketch_fit(
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
     fused_masked = checked_jit(
-        jax.shard_map(
+        shard_map(
             sharded_fit_masked,
             mesh=mesh,
             in_specs=(
@@ -1086,7 +1086,7 @@ def make_feature_sharded_sketch_fit(
         lambda: SketchState.initial(d, k, p), state_shardings
     )
     fit.extract = jax.jit(
-        jax.shard_map(
+        shard_map(
             sharded_extract,
             mesh=mesh,
             in_specs=(state_specs,),

@@ -22,6 +22,26 @@ WORKER_AXIS = "workers"
 FEATURE_AXIS = "features"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` on a JAX that exports the public alias; the
+    ``jax.experimental.shard_map`` fallback (whose ``check_rep`` is the
+    older spelling of ``check_vma``) everywhere else. ONE definition so
+    every sharded trainer runs on whatever JAX the host actually has —
+    an AttributeError at trainer-build time took down all of the mesh
+    paths on runtimes that predate the alias."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def make_mesh(
     num_workers: int | None = None,
     num_feature_shards: int = 1,

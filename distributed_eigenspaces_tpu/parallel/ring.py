@@ -32,9 +32,19 @@ import jax
 import jax.numpy as jnp
 
 
+def _axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` where it exists; ``psum(1, axis)`` on
+    runtimes that predate the alias (a unit constant psum over a named
+    axis resolves to the static axis size at trace time, so the ring
+    schedules below still see a concrete Python int)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def _ring_perm(axis_name):
     """Cyclic +1 neighbor permutation for the named mesh axis."""
-    size = jax.lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     return [(i, (i + 1) % size) for i in range(size)]
 
 
@@ -46,7 +56,7 @@ def ring_psum(x: jax.Array, axis_name: str) -> jax.Array:
     holds the full sum. Same result as ``jax.lax.psum(x, axis_name)`` (up
     to fp addition order, which is fixed and deterministic here).
     """
-    size = jax.lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     perm = _ring_perm(axis_name)
 
     def hop(_, carry):
@@ -65,7 +75,7 @@ def ring_all_gather(x: jax.Array, axis_name: str) -> jax.Array:
     ``jax.lax.all_gather(x, axis_name, axis=0, tiled=True)``, assembled by
     rotating shards around the ring and placing each at its source index.
     """
-    size = jax.lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     perm = _ring_perm(axis_name)
     idx = jax.lax.axis_index(axis_name)
     n_local = x.shape[0]

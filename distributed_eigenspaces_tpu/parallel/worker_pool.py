@@ -40,6 +40,7 @@ from distributed_eigenspaces_tpu.parallel.mesh import (
     WORKER_AXIS,
     largest_divisor_leq,
     make_mesh,
+    shard_map,
     worker_sharding,
 )
 
@@ -390,7 +391,7 @@ class WorkerPool:
                 )
                 return merge(vs, mask_all, k)
 
-            return jax.shard_map(
+            return shard_map(
                 partial(shard_fn),
                 mesh=mesh,
                 in_specs=(in_spec, in_spec, P()),

@@ -16,6 +16,12 @@ from distributed_eigenspaces_tpu.runtime.scheduler import (
     WorkQueue,
     run_dynamic_round,
 )
+from distributed_eigenspaces_tpu.runtime.supervisor import (
+    FaultLedger,
+    Supervisor,
+    SupervisorError,
+    supervised_fit,
+)
 
 __all__ = [
     "native_available",
@@ -25,4 +31,8 @@ __all__ = [
     "prefetch_stream",
     "WorkQueue",
     "run_dynamic_round",
+    "FaultLedger",
+    "Supervisor",
+    "SupervisorError",
+    "supervised_fit",
 ]

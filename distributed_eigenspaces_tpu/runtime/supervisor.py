@@ -1,0 +1,690 @@
+"""Self-healing runs: fault-detecting supervision for every fit path.
+
+The reference's entire fault story is AMQP at-least-once redelivery with
+no timeout or liveness (``distributed.py:53``, SURVEY.md §5.3). The
+paper's merge makes graceful degradation CHEAP — the projector mean
+reweights over survivors, so a dropped worker costs accuracy, not
+correctness — and the framework already had the primitives: worker
+masks (``utils/faults.py``), atomic checkpoints with a stream cursor
+(``utils/checkpoint.py``), checkify NaN guards (``utils/guards.py``),
+lease-timeout scheduling (``runtime/scheduler.py``). What was missing is
+the layer that makes them AUTOMATIC. This module is that layer — three
+detection → policy → recovery loops:
+
+1. **Block quarantine** (:meth:`Supervisor.screen_block`): every
+   incoming ``(m, n, d)`` block crosses a host-side boundary check —
+   non-finite scan per worker row-block, short reads, shape damage.
+   Per-worker corruption becomes a ``worker_mask`` drop for that round
+   (merge over survivors, exactly the §5.3 mechanism) with the corrupt
+   rows replaced by finite placeholder rows (:meth:`Supervisor.
+   _placeholder`) so a masked-out NaN cannot ride ``0 * NaN = NaN``
+   through the merge into ``sigma_tilde``. An explicit fault budget
+   bounds how much silent degradation is acceptable; exceeding it
+   raises a loud :class:`SupervisorError` with the fault ledger
+   attached.
+
+2. **Retry with backoff** (:meth:`Supervisor.step_hook` and the guarded
+   stream's pull loop): transient stream/step failures (IO errors,
+   ``checkify.JaxRuntimeError`` from a guarded step) retry with capped
+   exponential backoff before escalating.
+
+3. **Auto-resume** (:func:`supervised_fit`): on escalation — or plain
+   process restart — the newest committed checkpoint is restored and
+   the data stream is re-opened AT ITS CURSOR (``start_row``, threaded
+   through ``data/stream.py`` / ``data/bin_stream.py`` as a real seek),
+   so recovery replays only the steps since the last commit. A bounded
+   number of in-process resumes guards against crash loops; exhaustion
+   raises :class:`SupervisorError` with the ledger.
+
+Every fault event (quarantined worker, retried pull/step, resume) lands
+as a structured record in the supervisor's ledger and — when a
+``MetricsLogger`` is attached — in ``MetricsLogger.summary()['faults']``.
+
+The chaos harness (``scripts/chaos.py`` + ``utils.faults.ChaosStream``)
+proves the recovery contract: a run killed at a random step and resumed
+by the supervisor matches the unkilled run bit-for-bit on the dense
+checkpointed paths (tests/test_supervisor.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+__all__ = [
+    "Supervisor",
+    "SupervisorError",
+    "FaultLedger",
+    "supervised_fit",
+]
+
+
+def _retryable_exceptions() -> tuple:
+    """Exception classes the supervisor treats as transient: host IO
+    plus the device-side runtime errors a guarded (checkify) or
+    preempted step raises. Resolved once at import — the set depends
+    only on the installed JAX."""
+    kinds: list[type] = [OSError]
+    try:  # checkify guards (utils/guards.py) raise this on armed steps
+        from jax.experimental import checkify
+
+        kinds.append(checkify.JaxRuntimeError)
+    except (ImportError, AttributeError):
+        pass
+    try:  # device-side failures (preemption, OOM) surface as this
+        from jax.errors import JaxRuntimeError
+
+        kinds.append(JaxRuntimeError)
+    except (ImportError, AttributeError):
+        pass
+    return tuple(kinds)
+
+
+RETRYABLE = _retryable_exceptions()
+
+#: ledger kinds that spend fault budget — the DEGRADATION events
+#: (accuracy already paid), not the recovery bookkeeping around them
+BUDGET_KINDS = ("quarantine_nonfinite", "quarantine_short", "dropped_round")
+
+
+class FaultLedger:
+    """Append-only record of every fault event in a supervised run."""
+
+    def __init__(self):
+        self.events: list[dict] = []
+
+    def record(self, kind: str, step: int | None, **detail) -> dict:
+        ev = {"kind": kind, "step": step, **detail}
+        self.events.append(ev)
+        return ev
+
+    @property
+    def by_kind(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for e in self.events:
+            out[e["kind"]] = out.get(e["kind"], 0) + 1
+        return out
+
+    @property
+    def budget_spent(self) -> int:
+        """Fault units spent: one per quarantined WORKER-round, one per
+        dropped round — i.e. proportional to how much of the data the
+        run has already degraded away."""
+        spent = 0
+        for e in self.events:
+            if e["kind"] in BUDGET_KINDS:
+                spent += len(e.get("workers", ())) or 1
+        return spent
+
+    def as_dict(self) -> dict:
+        return {
+            "count": len(self.events),
+            "budget_spent": self.budget_spent,
+            "by_kind": self.by_kind,
+            "events": list(self.events),
+        }
+
+
+class SupervisorError(RuntimeError):
+    """Loud terminal failure of a supervised run — fault budget
+    exhausted, or retries AND resumes exhausted. Carries the full fault
+    ledger so the post-mortem starts with the evidence attached."""
+
+    def __init__(self, message: str, ledger: FaultLedger):
+        self.ledger = ledger
+        counts = ledger.by_kind
+        super().__init__(
+            f"{message} (fault ledger: {len(ledger.events)} events, "
+            f"{counts})"
+        )
+
+
+class _Escalation(Exception):
+    """Internal signal: a retry loop exhausted its budget; the
+    supervised-run driver decides (auto-resume vs terminal error)."""
+
+    def __init__(self, what: str, step: int | None, cause: Exception):
+        super().__init__(f"{what} failed at step {step}: {cause!r}")
+        self.what = what
+        self.step = step
+        self.cause = cause
+
+
+class _MaskFeed:
+    """The quarantine-mask side of a guarded stream: one mask pushed per
+    yielded block, one popped per executed step (FIFO — prefetch may
+    run the block side ahead). ``arm_replay`` re-serves the last mask
+    once, so a RETRIED step (which re-pulls its mask inside the step
+    closure) sees the same mask instead of stealing the next round's."""
+
+    def __init__(self):
+        self._q: deque = deque()
+        self._last = None
+        self._replay = False
+
+    def push(self, mask) -> None:
+        self._q.append(mask)
+
+    def arm_replay(self) -> None:
+        self._replay = True
+
+    def __iter__(self) -> "_MaskFeed":
+        return self
+
+    def __next__(self):
+        if self._replay and self._last is not None:
+            self._replay = False
+            return self._last
+        if not self._q:
+            raise RuntimeError(
+                "mask feed drained out of lockstep with its guarded "
+                "stream — a step consumed a mask no screened block "
+                "produced (supervisor wiring bug)"
+            )
+        self._last = self._q.popleft()
+        return self._last
+
+
+class _GuardedStream:
+    """Block iterator that screens every pull through the supervisor:
+    transient pull failures retry with backoff, each delivered block is
+    quarantine-checked, and its per-worker survival mask lands on the
+    paired :class:`_MaskFeed`."""
+
+    def __init__(self, sup: "Supervisor", stream: Iterable, base_masks,
+                 first_step: int):
+        self._sup = sup
+        self._raw = stream
+        self._it = iter(stream)
+        self._base = base_masks
+        self._t = first_step - 1
+
+    def __iter__(self) -> "_GuardedStream":
+        return self
+
+    def _base_mask(self, t: int):
+        b = self._base
+        if b is None:
+            return None
+        if hasattr(b, "__getitem__"):
+            # indexable (T, m) schedule: keyed by ABSOLUTE step so the
+            # schedule survives kill/resume without drifting
+            idx = t - 1
+            return b[idx] if idx < len(b) else None
+        return next(b, None)
+
+    def __next__(self):
+        while True:
+            t = self._t + 1
+            block = self._sup._retry_pull(self._it, t)
+            screened = self._sup.screen_block(
+                block, t, base_mask=self._base_mask(t)
+            )
+            if screened is None:
+                continue  # dropped round: same step number, next block
+            block, mask = screened
+            self._sup.mask_feed.push(mask)
+            self._t = t
+            return block
+
+    def close(self) -> None:
+        close = getattr(self._raw, "close", None)
+        if close is not None:
+            close()
+
+
+class Supervisor:
+    """Policy + ledger for one supervised run.
+
+    Args:
+      cfg: the run's ``PCAConfig`` (block geometry for screening).
+      fault_budget: max fault units (quarantined worker-rounds +
+        dropped rounds) before the run fails loudly; ``None`` = no cap
+        (every fault still lands in the ledger).
+      max_retries: transient-failure retries per pull/step before
+        escalation.
+      backoff_base / backoff_max: capped exponential backoff,
+        ``min(backoff_max, backoff_base * 2**(attempt-1))`` seconds.
+      metrics: optional ``MetricsLogger`` — fault events mirror into its
+        ``summary()['faults']`` ledger.
+      sleep: injectable sleep (tests pass a recorder; default
+        ``time.sleep``).
+    """
+
+    def __init__(
+        self,
+        cfg,
+        *,
+        fault_budget: int | None = None,
+        max_retries: int = 3,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
+        metrics=None,
+        sleep: Callable[[float], None] | None = None,
+    ):
+        if fault_budget is not None and fault_budget < 0:
+            raise ValueError(f"fault_budget must be >= 0: {fault_budget}")
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0: {max_retries}")
+        self.cfg = cfg
+        self.fault_budget = fault_budget
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.metrics = metrics
+        self.ledger = FaultLedger()
+        self.mask_feed = _MaskFeed()
+        self._sleep = sleep if sleep is not None else time.sleep
+
+    # -- ledger --------------------------------------------------------------
+
+    def record(self, kind: str, step: int | None = None, **detail) -> None:
+        ev = self.ledger.record(kind, step, **detail)
+        if self.metrics is not None:
+            self.metrics.fault(ev)
+        if (
+            self.fault_budget is not None
+            and kind in BUDGET_KINDS
+            and self.ledger.budget_spent > self.fault_budget
+        ):
+            raise SupervisorError(
+                f"fault budget exhausted: {self.ledger.budget_spent} "
+                f"fault units > budget {self.fault_budget}",
+                self.ledger,
+            )
+
+    # -- detection loop 1: block quarantine ----------------------------------
+
+    def screen_block(self, block, t: int, base_mask=None):
+        """Boundary check for one incoming block at step ``t``.
+
+        Returns ``(block, mask)`` — the (possibly repaired) host block
+        and its ``(m,)`` survivor mask — or ``None`` for a round that
+        cannot be salvaged (wrong geometry) and is dropped whole.
+        ``base_mask`` folds an externally injected fault mask
+        (``worker_masks=``) into the quarantine result.
+        """
+        m = self.cfg.num_workers
+        n, d = self.cfg.rows_per_worker, self.cfg.dim
+        arr = np.asarray(block)
+        mask = (
+            np.ones(m, np.float32) if base_mask is None
+            else np.array(base_mask, np.float32, copy=True)
+        )
+        if arr.shape != (m, n, d):
+            if arr.ndim == 3 and arr.shape[1:] == (n, d) and 0 < arr.shape[0] < m:
+                # short read: trailing workers never arrived — pad them
+                # with placeholder rows and drop them from the merge
+                missing = list(range(arr.shape[0], m))
+                padded = np.empty((m, n, d), arr.dtype)
+                padded[: arr.shape[0]] = arr
+                padded[arr.shape[0]:] = self._placeholder(n, d, arr.dtype)
+                mask[missing] = 0.0
+                self.record(
+                    "quarantine_short", t, workers=missing,
+                    got_workers=int(arr.shape[0]),
+                )
+                arr = padded
+            else:
+                self.record(
+                    "dropped_round", t, shape=list(arr.shape),
+                    want=[m, n, d],
+                )
+                return None
+        if not np.issubdtype(arr.dtype, np.integer):
+            check = (
+                arr if arr.dtype in (np.float32, np.float64)
+                else np.asarray(arr, np.float32)
+            )
+            finite = np.isfinite(check).all(axis=(1, 2))
+            if not finite.all():
+                bad = [int(i) for i in np.nonzero(~finite)[0]]
+                arr = np.array(arr, copy=True)
+                arr[bad] = self._placeholder(n, d, arr.dtype)
+                mask[bad] = 0.0
+                self.record("quarantine_nonfinite", t, workers=bad)
+        return arr, mask
+
+    @staticmethod
+    def _placeholder(n: int, d: int, dtype) -> np.ndarray:
+        """Replacement rows for a quarantined worker's data. NOT zeros:
+        the masked merge weights the worker 0, but the worker's LOCAL
+        solve still runs, and ``0 * NaN = NaN`` — a CholeskyQR on an
+        all-zero block produces exactly that on the feature-sharded
+        backend. Cycled identity rows give every solver a finite,
+        well-conditioned dummy problem whose (finite) result the zero
+        merge weight then cancels EXACTLY — so a quarantined round
+        stays bit-for-bit an explicit ``kill_workers`` round."""
+        rows = np.zeros((n, d), np.float32)
+        rows[np.arange(n), np.arange(n) % d] = 1.0
+        return rows.astype(dtype, copy=False)
+
+    def guard_stream(self, stream: Iterable, *, base_masks=None,
+                     first_step: int = 1) -> _GuardedStream:
+        """Wrap a raw block stream with pull-retry + quarantine. The
+        paired per-step masks arrive on ``self.mask_feed`` (pass it as
+        ``worker_masks=`` to the trainer). ``base_masks`` may be an
+        indexable ``(T, m)`` schedule (keyed by absolute step — resume
+        safe) or a per-step mask iterator."""
+        self.mask_feed = _MaskFeed()
+        return _GuardedStream(self, stream, base_masks, first_step)
+
+    # -- detection loop 2: retry with backoff --------------------------------
+
+    def _backoff(self, attempt: int) -> float:
+        delay = min(
+            self.backoff_max, self.backoff_base * (2.0 ** (attempt - 1))
+        )
+        if delay > 0:
+            self._sleep(delay)
+        return delay
+
+    def _retry_pull(self, it, t: int):
+        attempt = 0
+        while True:
+            try:
+                return next(it)
+            except StopIteration:
+                raise
+            except RETRYABLE as e:
+                attempt += 1
+                delay = min(
+                    self.backoff_max,
+                    self.backoff_base * (2.0 ** (attempt - 1)),
+                )
+                self.record(
+                    "stream_retry", t, error=repr(e), attempt=attempt,
+                    backoff_s=delay,
+                )
+                if attempt > self.max_retries:
+                    raise _Escalation("stream pull", t, e) from e
+                if delay > 0:
+                    self._sleep(delay)
+
+    def step_hook(self, step_fn, state, x_blocks, t: int):
+        """``_drive_stream`` hook: run one training step with transient
+        failures retried under backoff. A retried step re-pulls its
+        quarantine mask, so the feed re-serves the same row."""
+        attempt = 0
+        while True:
+            try:
+                return step_fn(state, x_blocks)
+            except RETRYABLE as e:
+                attempt += 1
+                delay = min(
+                    self.backoff_max,
+                    self.backoff_base * (2.0 ** (attempt - 1)),
+                )
+                self.record(
+                    "step_retry", t, error=repr(e), attempt=attempt,
+                    backoff_s=delay,
+                )
+                if attempt > self.max_retries:
+                    raise _Escalation("train step", t, e) from e
+                self.mask_feed.arm_replay()
+                if delay > 0:
+                    self._sleep(delay)
+
+    def run_guarded(self, what: str, fn: Callable, *args, step=None, **kw):
+        """Generic retry wrapper for coarse work units (a whole-fit
+        window program, an extraction) — the handle-level twin of
+        :meth:`step_hook`."""
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kw)
+            except RETRYABLE as e:
+                attempt += 1
+                delay = min(
+                    self.backoff_max,
+                    self.backoff_base * (2.0 ** (attempt - 1)),
+                )
+                self.record(
+                    f"{what}_retry", step, error=repr(e), attempt=attempt,
+                    backoff_s=delay,
+                )
+                if attempt > self.max_retries:
+                    raise _Escalation(what, step, e) from e
+                if delay > 0:
+                    self._sleep(delay)
+
+    def wrap_handle(self, handle):
+        """Supervise an ``api/runner.py`` whole-fit handle: its ``fit``
+        and ``fit_windows`` entries run under the retry/backoff policy
+        (``make_whole_fit(..., supervisor=...)`` applies this)."""
+
+        def wrap(fn, label):
+            if fn is None:
+                return None
+
+            def run(*args, **kw):
+                return self.run_guarded(label, fn, *args, **kw)
+
+            return run
+
+        return dataclasses.replace(
+            handle,
+            fit=wrap(handle.fit, "whole_fit"),
+            fit_windows=wrap(handle.fit_windows, "fit_window"),
+        )
+
+
+# -- detection loop 3: auto-resume ------------------------------------------
+
+
+def supervised_fit(
+    stream_factory: Callable[[int], Iterable],
+    cfg,
+    *,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 1,
+    resume: bool = True,
+    trainer: str = "step",
+    worker_masks=None,
+    metrics=None,
+    on_step=None,
+    pool=None,
+    max_steps: Any = "auto",
+    fault_budget: int | None = None,
+    max_retries: int = 3,
+    max_resumes: int = 2,
+    backoff_base: float = 0.05,
+    backoff_max: float = 2.0,
+    sleep: Callable[[float], None] | None = None,
+    supervisor: Supervisor | None = None,
+):
+    """Run a fit under full supervision: quarantine + retry + resume.
+
+    Args:
+      stream_factory: ``(start_row) -> iterable`` of ``(m, n, d)``
+        blocks. Called with the checkpoint cursor on (re)start — wire it
+        to ``block_stream(..., start_row=...)`` or
+        ``bin_block_stream(..., start_row=...)`` so a resume consumes
+        only unseen rows.
+      cfg: the ``PCAConfig``. Any per-step backend rides through
+        (``backend="feature_sharded"`` included — the supervised loops
+        share ``_drive_stream``).
+      checkpoint_dir: where the run commits resumable state
+        (``utils.checkpoint.Checkpointer`` layout). ``None`` disables
+        auto-resume: escalations become terminal ``SupervisorError``.
+      checkpoint_every: steps between commits on the ``"step"`` trainer;
+        the window size on ``"segmented"`` (one commit per window).
+      resume: restore the newest committed checkpoint on entry (process
+        restart recovery). ``False`` starts fresh.
+      trainer: ``"step"`` (per-step loop — any backend) or
+        ``"segmented"`` (the dense windowed whole-fit: one compiled
+        program per window, bit-for-bit kill/resume via its
+        ``SegmentState`` warm carry).
+      worker_masks: optional externally injected fault masks, folded
+        into the quarantine masks. An indexable ``(T, m)`` schedule is
+        keyed by absolute step (resume-safe); an iterator is consumed
+        per screened block.
+      max_resumes: in-process auto-resumes before an escalation is
+        terminal. Resumes triggered by a true process restart are not
+        counted (each fresh process gets the full allowance).
+
+    Returns:
+      ``(w, state, supervisor)`` — the final ``(d, k)`` estimate, final
+      trainer state, and the supervisor (ledger attached).
+    """
+    if trainer not in ("step", "segmented"):
+        raise ValueError(
+            f"supervised_fit trainer must be 'step' or 'segmented', "
+            f"got {trainer!r}"
+        )
+    sup = supervisor or Supervisor(
+        cfg,
+        fault_budget=fault_budget,
+        max_retries=max_retries,
+        backoff_base=backoff_base,
+        backoff_max=backoff_max,
+        metrics=metrics,
+        sleep=sleep,
+    )
+    rows_per_step = cfg.num_workers * cfg.rows_per_worker
+
+    ckpt = None
+    state, cursor = None, 0
+    if checkpoint_dir is not None:
+        from distributed_eigenspaces_tpu.utils.checkpoint import (
+            Checkpointer,
+        )
+
+        ckpt = Checkpointer(
+            checkpoint_dir,
+            every=1 if trainer == "segmented" else checkpoint_every,
+            rows_per_step=rows_per_step,
+        )
+        if resume:
+            latest = ckpt.latest()
+            if latest is not None:
+                state, cursor = latest
+                sup.record(
+                    "resume", int(state.step), cursor=int(cursor),
+                    reason="restart",
+                )
+
+    resumes = 0
+    while True:
+        try:
+            if trainer == "segmented":
+                return (*_segmented_supervised(
+                    sup, stream_factory, cfg, state, cursor, ckpt,
+                    metrics, worker_masks, on_step,
+                    segment=checkpoint_every,
+                ), sup)
+            return (*_step_supervised(
+                sup, stream_factory, cfg, state, cursor, ckpt, metrics,
+                worker_masks, on_step, pool, max_steps,
+            ), sup)
+        except _Escalation as esc:
+            if ckpt is None:
+                raise SupervisorError(
+                    f"{esc} — no checkpoint_dir, cannot auto-resume",
+                    sup.ledger,
+                ) from esc.cause
+            if resumes >= max_resumes:
+                raise SupervisorError(
+                    f"{esc} — {resumes} auto-resumes exhausted",
+                    sup.ledger,
+                ) from esc.cause
+            resumes += 1
+            latest = ckpt.latest()
+            state, cursor = latest if latest is not None else (None, 0)
+            sup.record(
+                "resume",
+                int(state.step) if state is not None else 0,
+                cursor=int(cursor), reason=str(esc), attempt=resumes,
+            )
+
+
+def _step_supervised(sup, stream_factory, cfg, state, cursor, ckpt,
+                     metrics, worker_masks, on_step, pool, max_steps):
+    """The per-step fit paths (``online_distributed_pca`` — dense
+    backends AND the feature-sharded step loop) under supervision."""
+    from distributed_eigenspaces_tpu.algo.online import (
+        online_distributed_pca,
+    )
+
+    done = int(state.step) if state is not None else 0
+    guarded = sup.guard_stream(
+        stream_factory(cursor), base_masks=worker_masks,
+        first_step=done + 1,
+    )
+    callbacks = []
+    if metrics is not None:
+        callbacks.append(metrics.on_step)
+    if on_step is not None:
+        callbacks.append(on_step)
+    if ckpt is not None:
+        callbacks.append(ckpt.on_step)  # last: commit AFTER observers
+
+    def cb(t, st, v_bar):
+        for c in callbacks:
+            c(t, st, v_bar)
+
+    return online_distributed_pca(
+        guarded,
+        cfg,
+        pool=pool,
+        state=state,
+        on_step=cb if callbacks else None,
+        worker_masks=sup.mask_feed,
+        max_steps=max_steps,
+        step_hook=sup.step_hook,
+    )
+
+
+def _segmented_supervised(sup, stream_factory, cfg, state, cursor, ckpt,
+                          metrics, worker_masks, on_step, segment):
+    """The dense windowed whole-fit (``api/runner.py`` ``"segmented"``
+    handle) under supervision: windows of ``segment`` steps run as one
+    masked program each, a committed checkpoint per window, retry at
+    window granularity. ``SegmentState`` carries the warm basis, so a
+    killed-and-resumed run is bit-for-bit the unkilled one."""
+    import itertools
+
+    from distributed_eigenspaces_tpu.api.estimator import _scan_mesh
+    from distributed_eigenspaces_tpu.api.runner import make_whole_fit
+    from distributed_eigenspaces_tpu.data.bin_stream import window_stream
+
+    handle = make_whole_fit(
+        cfg, "segmented", _scan_mesh(cfg), segment=segment,
+        supervisor=sup,
+    )
+    if state is None:
+        state = handle.init_state()
+    done = int(state.step)
+    remaining = max(0, cfg.num_steps - done)
+    if remaining:
+        guarded = sup.guard_stream(
+            stream_factory(cursor), base_masks=worker_masks,
+            first_step=done + 1,
+        )
+        try:
+            windows = window_stream(
+                itertools.islice(guarded, remaining), segment
+            )
+            for w in windows:
+                masks = np.stack(
+                    [next(sup.mask_feed) for _ in range(w.shape[0])]
+                )
+                # one retry-wrapped program per window (wrap_handle)
+                state = handle.fit_windows(
+                    state, [w], worker_masks=[masks]
+                )
+                t = int(state.step)
+                if metrics is not None:
+                    metrics.on_step(t, state, state.v_prev)
+                if on_step is not None:
+                    on_step(t, state, state.v_prev)
+                if ckpt is not None:
+                    ckpt.on_step(t, state)
+        finally:
+            guarded.close()
+    w = sup.run_guarded("extract", handle.extract, state)
+    return w, state

@@ -34,8 +34,13 @@ _OP_NAMES = (
     "all-to-all",
 )
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+# The tuple branch matches LAZILY up to the closing ") <op-name>(": TPU
+# tiled layouts put parens INSIDE the tuple members (e.g.
+# "(f32[64]{0:T(256)}, u32[])"), so a greedy-to-first-')' matcher would
+# truncate mid-member and the parser-drift tripwire would raise on every
+# TPU-compiled module (ADVICE.md r5).
 _COLLECTIVE_RE = re.compile(
-    r" = (\([^)]*\)|\w+\[[\d,]*\][^ ]*) "
+    r" = (\(.*?\)|\w+\[[\d,]*\][^ ]*) "
     r"(" + "|".join(_OP_NAMES) + r")(?:-start)?"
     r"\("
 )

@@ -8,14 +8,31 @@ worker's projector is excluded from the merge and the mean reweights over
 survivors exactly (see ``WorkerPool.round(worker_mask=...)``).
 
 This module generates deterministic fault schedules for tests and chaos
-runs.
+runs: per-step worker-drop masks (:class:`FaultInjector`), and — for the
+supervised runs of ``runtime/supervisor.py`` — scheduled DATA corruption
+(:class:`ChaosPlan` / :class:`ChaosStream`): NaN blocks, zeroed blocks,
+transient stream exceptions, and a hard kill at a chosen step. The
+supervisor's detection loops are exercised end to end by
+``scripts/chaos.py`` and tests/test_supervisor.py.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Iterator
 
 import numpy as np
+
+
+class KillSwitch(RuntimeError):
+    """Simulated hard process death (chaos harness kill-at-step-t).
+
+    Deliberately NOT in the supervisor's retryable set: a real SIGKILL
+    doesn't retry — it takes the process down, and recovery is the next
+    process restoring the newest committed checkpoint and seeking the
+    stream cursor. Tests/scripts catch it OUTSIDE ``supervised_fit`` and
+    call ``supervised_fit`` again to simulate the restart.
+    """
 
 
 class FaultInjector:
@@ -60,3 +77,71 @@ def kill_workers(num_workers: int, dead: list[int]) -> np.ndarray:
     if mask.sum() == 0:
         raise ValueError("cannot kill every worker")
     return mask
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan:
+    """Deterministic corruption schedule for a block stream (1-based
+    steps, matching the online loop's step numbering).
+
+    ``nan_blocks`` / ``zero_blocks``: ``{step: [worker indices]}`` —
+    the listed workers' row-blocks are overwritten with NaN / zeros
+    before the block is yielded (the corrupt-input classes the
+    supervisor's quarantine must catch: NaN is loud corruption, zeros
+    model a reader that delivered an unwritten buffer).
+    ``raise_at``: ``{step: message}`` — ``next()`` raises ``OSError``
+    ONCE for that step, then delivers the step's block on the retry
+    (the transient-IO class the supervisor's backoff absorbs).
+    ``kill_at``: raise :class:`KillSwitch` INSTEAD of yielding this step
+    — the hard-death class; fires once, so a restarted run streaming
+    from its checkpoint cursor sails past.
+    """
+
+    nan_blocks: dict[int, list[int]] = dataclasses.field(
+        default_factory=dict
+    )
+    zero_blocks: dict[int, list[int]] = dataclasses.field(
+        default_factory=dict
+    )
+    raise_at: dict[int, str] = dataclasses.field(default_factory=dict)
+    kill_at: int | None = None
+
+
+class ChaosStream:
+    """Apply a :class:`ChaosPlan` to a block stream.
+
+    An ITERATOR class, not a generator: a generator that raises is dead
+    (``next()`` after an exception is ``StopIteration``), but transient
+    faults must leave the stream resumable — the supervisor retries the
+    SAME pull and gets the step's block. ``first_step`` offsets the step
+    numbering for resumed streams (a run restored at step t sees its
+    first block as step t+1, so the plan keys stay absolute).
+    """
+
+    def __init__(self, stream, plan: ChaosPlan, *, first_step: int = 1):
+        self._it = iter(stream)
+        self._plan = plan
+        self._step = first_step - 1
+        self._raised: set[int] = set()
+        self._killed = False
+
+    def __iter__(self) -> "ChaosStream":
+        return self
+
+    def __next__(self):
+        t = self._step + 1
+        if self._plan.kill_at == t and not self._killed:
+            self._killed = True
+            raise KillSwitch(f"chaos kill at step {t}")
+        if t in self._plan.raise_at and t not in self._raised:
+            self._raised.add(t)
+            raise OSError(self._plan.raise_at[t])
+        block = next(self._it)
+        self._step = t
+        bad = self._plan.nan_blocks.get(t), self._plan.zero_blocks.get(t)
+        if bad != (None, None):
+            block = np.array(block, np.float32, copy=True)
+            for workers, value in zip(bad, (np.nan, 0.0)):
+                for w in workers or ():
+                    block[w] = value
+        return block

@@ -36,6 +36,10 @@ class MetricsLogger:
         self.stream = stream
         self.reference_subspace = reference_subspace
         self.records: list[dict] = []
+        #: structured fault events (runtime/supervisor.py): quarantined
+        #: workers, retried pulls/steps, resumes — the run's fault
+        #: ledger, surfaced by :meth:`summary`
+        self.fault_records: list[dict] = []
         self._last_time = None
 
     def start(self) -> "MetricsLogger":
@@ -68,8 +72,19 @@ class MetricsLogger:
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
 
+    def fault(self, event: dict) -> None:
+        """Record one structured fault event (a supervisor detection /
+        recovery action). Events ride the same JSON stream as step
+        records, tagged ``"fault"`` so consumers can split them."""
+        rec = {"fault": event.get("kind", "unknown"), **event}
+        self.fault_records.append(rec)
+        if self.stream is not None:
+            print(json.dumps(rec), file=self.stream, flush=True)
+
     def summary(self) -> dict:
-        """Aggregate: total steps, mean/max throughput, final accuracy."""
+        """Aggregate: total steps, mean/max throughput, final accuracy,
+        and — when any fault was recorded — the fault ledger (count,
+        per-kind histogram, and the raw events)."""
         out: dict = {"steps": len(self.records)}
         sps = [r["samples_per_sec"] for r in self.records if "samples_per_sec" in r]
         if sps:
@@ -82,6 +97,15 @@ class MetricsLogger:
         ]
         if angles:
             out["final_principal_angle_deg"] = angles[-1]
+        if self.fault_records:
+            by_kind: dict[str, int] = {}
+            for r in self.fault_records:
+                by_kind[r["fault"]] = by_kind.get(r["fault"], 0) + 1
+            out["faults"] = {
+                "count": len(self.fault_records),
+                "by_kind": by_kind,
+                "events": list(self.fault_records),
+            }
         return out
 
 

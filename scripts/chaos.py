@@ -1,0 +1,224 @@
+"""Chaos harness: prove the supervisor's recovery contract end to end.
+
+Runs the same synthetic workload twice under ``supervised_fit``
+(``runtime/supervisor.py``):
+
+1. a CLEAN reference run;
+2. a CHAOS run fed through ``utils.faults.ChaosStream`` — NaN-corrupted
+   worker blocks, zeroed blocks, a transient stream error, and a hard
+   ``KillSwitch`` at a (seeded-random) step — with the kill "restarting
+   the process": the harness catches ``KillSwitch`` outside
+   ``supervised_fit`` and calls it again against the same checkpoint
+   directory, exactly what a real restart does.
+
+It then checks the contract the docs promise (docs/ROBUSTNESS.md):
+
+- the killed-and-resumed run matches the unkilled run BIT-FOR-BIT on
+  the checkpointed dense paths when the corruption schedules match
+  (kill-only chaos), and within ``--tol-deg`` principal angle when
+  corruption degraded rounds (quarantine costs accuracy, not
+  correctness — the paper's survivor-mean mechanism);
+- ``sigma_tilde`` stays finite through NaN-corrupted inputs;
+- every fault landed in the ledger.
+
+Exit code 0 iff every check passes; the JSON report carries the ledger.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/chaos.py --trainer segmented
+    python scripts/chaos.py --dim 256 --steps 20 --kill-step 13
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+# runnable as `python scripts/chaos.py` from anywhere (the package
+# imports resolve from the repo root, like real_data_check's PYTHONPATH)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--k", type=int, default=3)
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--rows-per-worker", type=int, default=64)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--trainer", choices=["step", "segmented"],
+                   default="step")
+    p.add_argument("--solver", choices=["eigh", "subspace"],
+                   default="eigh")
+    p.add_argument("--kill-step", type=int, default=None,
+                   help="hard-kill step (default: seeded random in "
+                   "[2, steps])")
+    p.add_argument("--nan-step", type=int, default=None,
+                   help="step whose worker 0 block turns NaN (default: "
+                   "seeded random; pass 0 to disable)")
+    p.add_argument("--flaky-step", type=int, default=None,
+                   help="step whose first pull raises a transient "
+                   "OSError (default: seeded random; 0 disables)")
+    p.add_argument("--checkpoint-every", type=int, default=1)
+    p.add_argument("--fault-budget", type=int, default=None)
+    p.add_argument("--tol-deg", type=float, default=1.0,
+                   help="principal-angle tolerance for corrupted runs")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--keep-dir", default=None,
+                   help="checkpoint dir to keep (default: a tempdir)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax
+
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.stream import block_stream
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+    from distributed_eigenspaces_tpu.runtime.supervisor import (
+        supervised_fit,
+    )
+    from distributed_eigenspaces_tpu.utils.faults import (
+        ChaosPlan,
+        ChaosStream,
+        KillSwitch,
+    )
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    m, n, d, T = args.workers, args.rows_per_worker, args.dim, args.steps
+    rng = np.random.default_rng(args.seed)
+    kill_at = args.kill_step or int(rng.integers(2, T + 1))
+    nan_at = (
+        args.nan_step if args.nan_step is not None
+        else int(rng.integers(1, T + 1))
+    )
+    flaky_at = (
+        args.flaky_step if args.flaky_step is not None
+        else int(rng.integers(1, T + 1))
+    )
+
+    cfg = PCAConfig(
+        dim=d, k=args.k, num_workers=m, rows_per_worker=n, num_steps=T,
+        backend="local", solver=args.solver, prefetch_depth=0,
+    )
+    spec = planted_spectrum(
+        d, k_planted=args.k, gap=20.0, noise=0.01, seed=args.seed
+    )
+    data = np.asarray(spec.sample(jax.random.PRNGKey(args.seed + 1), m * n * T))
+    rows_per_step = m * n
+
+    def factory(start_row):
+        return block_stream(
+            data, num_workers=m, rows_per_worker=n,
+            start_row=start_row, device=False,
+        )
+
+    killed = {"fired": False}
+
+    def chaotic(start_row):
+        # the kill fires ONCE across restarts: a real SIGKILL takes the
+        # process down and the next process reads clean bytes — only the
+        # data corruption (absolute step keys) persists on disk
+        plan = ChaosPlan(
+            nan_blocks={nan_at: [0]} if nan_at else {},
+            raise_at={flaky_at: "chaos: flaky read"} if flaky_at else {},
+            kill_at=None if killed["fired"] else kill_at,
+        )
+        return ChaosStream(
+            factory(start_row), plan,
+            first_step=start_row // rows_per_step + 1,
+        )
+
+    # clean reference — same quarantine policy (none triggers)
+    w_ref, st_ref, _ = supervised_fit(factory, cfg, trainer=args.trainer)
+
+    keep = args.keep_dir
+    ckpt_dir = keep or tempfile.mkdtemp(prefix="det_chaos_")
+    metrics = MetricsLogger(samples_per_step=rows_per_step).start()
+    # ONE supervisor across the restart loop so the report's ledger
+    # spans the whole story (a real restart loses the in-memory ledger
+    # with the process; the MetricsLogger JSON stream is the durable
+    # record there)
+    from distributed_eigenspaces_tpu.runtime.supervisor import Supervisor
+
+    sup = Supervisor(
+        cfg, fault_budget=args.fault_budget, metrics=metrics
+    )
+    restarts = 0
+    while True:  # the "process restart" loop: KillSwitch == SIGKILL
+        try:
+            w, st, _ = supervised_fit(
+                chaotic, cfg, trainer=args.trainer,
+                checkpoint_dir=ckpt_dir,
+                checkpoint_every=args.checkpoint_every,
+                supervisor=sup,
+            )
+            break
+        except KillSwitch:
+            killed["fired"] = True
+            restarts += 1
+            if restarts > 3:
+                raise RuntimeError("chaos kill fired more than once?")
+
+    angle = float(
+        jax.numpy.max(
+            principal_angles_degrees(
+                jax.numpy.asarray(np.asarray(w)),
+                jax.numpy.asarray(np.asarray(w_ref)),
+            )
+        )
+    )
+    sigma = np.asarray(st.sigma_tilde) if hasattr(st, "sigma_tilde") else (
+        np.asarray(st.u)
+    )
+    corrupted = bool(nan_at)
+    checks = {
+        "completed_all_steps": int(st.step) == T,
+        "sigma_finite": bool(np.isfinite(sigma).all()),
+        "ledger_populated": len(sup.ledger.events) > 0
+        and "faults" in metrics.summary(),
+        "restarted_once": restarts == 1,
+        "matches_reference": (
+            angle <= args.tol_deg if corrupted
+            else bool(np.array_equal(np.asarray(w), np.asarray(w_ref)))
+        ),
+    }
+    report = {
+        "trainer": args.trainer,
+        "solver": args.solver,
+        "kill_step": kill_at,
+        "nan_step": nan_at or None,
+        "flaky_step": flaky_at or None,
+        "restarts": restarts,
+        "angle_vs_reference_deg": round(angle, 6),
+        "bit_exact": bool(np.array_equal(np.asarray(w), np.asarray(w_ref))),
+        "checks": checks,
+        "ok": all(checks.values()),
+        "faults": sup.ledger.as_dict(),
+        "checkpoint_dir": ckpt_dir if keep else None,
+    }
+    print(json.dumps(report, indent=2))
+    if not keep:
+        import shutil
+
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

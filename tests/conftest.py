@@ -25,6 +25,13 @@ import pytest  # noqa: E402
 # ineffective — force the platform at the config level too.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+# counter-based (partitionable) threefry: sample(key, n1)[:n2] ==
+# sample(key, n2) — the prefix stability the synthetic resume contract
+# relies on (a resumed run regenerates a LONGER stream and must see the
+# same leading rows). Default on newer JAX; explicit for runtimes where
+# the legacy scheme (whole-array counters, no prefix stability) is still
+# the default.
+jax.config.update("jax_threefry_partitionable", True)
 
 
 @pytest.fixture(scope="session")

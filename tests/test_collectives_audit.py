@@ -27,7 +27,7 @@ from distributed_eigenspaces_tpu.parallel.feature_sharded import (
     make_feature_sharded_scan_fit,
     make_feature_sharded_sketch_fit,
 )
-from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+from distributed_eigenspaces_tpu.parallel.mesh import make_mesh, shard_map
 from distributed_eigenspaces_tpu.utils.collectives_audit import (
     assert_no_dense_collective,
     audit_compiled,
@@ -104,7 +104,7 @@ def test_tripwire_bites_on_dense_psum(devices):
         return jax.lax.psum(g, "workers")
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             dense_round, mesh=mesh, in_specs=P("workers"), out_specs=P(),
             check_vma=False,
         )
@@ -184,3 +184,22 @@ def test_ici_model_matches_hlo_payload(devices):
     assert model["dense_over_factor"] == round(
         2 * D * D / (M * D * K), 2
     )
+
+
+def test_parse_tiled_tpu_layouts():
+    """TPU-compiled HLO writes tiled layouts with parens INSIDE the
+    result shapes ('{0:T(256)}'); the tuple matcher must not truncate at
+    the first ')' or the drift tripwire raises on every real TPU module
+    and the audit can never run where the ICI traffic actually flows
+    (ADVICE.md r5)."""
+    hlo = """
+      %s = (f32[64]{0:T(256)}, u32[]) all-reduce-start(%p), to_apply=%a
+      %g = f32[8,128,4]{2,1,0:T(8,128)} all-gather(%q), dimensions={0}
+      %t = (bf16[8,512]{1,0:T(8,128)(2,1)}, u32[], u32[]) all-gather-start(%r)
+    """
+    ops = parse_collectives(hlo)
+    assert [(o.op, o.dtype, o.shape) for o in ops] == [
+        ("all-reduce", "f32", (64,)),
+        ("all-gather", "f32", (8, 128, 4)),
+        ("all-gather", "bf16", (8, 512)),
+    ]

@@ -326,3 +326,51 @@ def test_quantize_cli_entry(tmp_path, rng, capsys):
     rep = json.loads(capsys.readouterr().out.strip())
     assert rep["rows"] == 128 and rep["wire_bytes"] == 128 * 16
     assert rep["float_bytes"] == 4 * rep["wire_bytes"]
+
+
+def test_bin_stream_start_row_seeks(tmp_path, rng):
+    """The out-of-core twin of block_stream's cursor seek: resuming at
+    a whole-step row offset reads only the unseen bytes; a mid-step
+    offset is rejected (it would silently re-split every block)."""
+    m, n, d, steps = 4, 8, 16, 5
+    data = rng.standard_normal((m * n * steps, d)).astype(np.float32)
+    path = str(tmp_path / "rows.bin")
+    write_rows(path, data)
+
+    full = list(
+        bin_block_stream(path, dim=d, num_workers=m, rows_per_worker=n)
+    )
+    resumed = list(
+        bin_block_stream(
+            path, dim=d, num_workers=m, rows_per_worker=n,
+            start_row=2 * m * n,
+        )
+    )
+    assert len(resumed) == steps - 2
+    for a, b in zip(resumed, full[2:]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    with pytest.raises(ValueError, match="step boundary"):
+        next(
+            bin_block_stream(
+                path, dim=d, num_workers=m, rows_per_worker=n, start_row=7
+            )
+        )
+
+    # strided multi-host mode seeks whole steps per worker range
+    lo, hi = 1, 3
+    strided_full = list(
+        bin_block_stream(
+            path, dim=d, num_workers=m, rows_per_worker=n,
+            worker_range=(lo, hi),
+        )
+    )
+    strided_resumed = list(
+        bin_block_stream(
+            path, dim=d, num_workers=m, rows_per_worker=n,
+            worker_range=(lo, hi), start_row=2 * m * n,
+        )
+    )
+    assert len(strided_resumed) == steps - 2
+    for a, b in zip(strided_resumed, strided_full[2:]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

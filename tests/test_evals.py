@@ -138,3 +138,23 @@ def test_clip768_chip_companion_small(devices):
     _check(rep, backend="feature_sharded")
     assert rep["streaming"] == "memory"
     assert rep["trainer"] == "sketch"
+
+
+def test_malformed_row_dir_is_loud(tmp_path):
+    """A PRESENT but malformed user corpus must raise, never silently
+    fall back to synthetic data — a --data-dir eval would otherwise
+    report synthetic numbers as if they came from the user's real files
+    (ADVICE.md r5; load_rows_dir's 'loud beats a silent reshape')."""
+    import pytest
+
+    from distributed_eigenspaces_tpu.evals import _real_data
+
+    sub = tmp_path / "clip768"
+    sub.mkdir()
+    np.save(sub / "bad.npy", np.zeros((10, 7), np.float32))  # wrong width
+    with pytest.raises(ValueError):
+        _real_data(EVAL_SPECS["clip768"], str(tmp_path))
+
+    # a dataset that simply is not supplied still falls back quietly
+    rows, prov = _real_data(EVAL_SPECS["clip768"], str(tmp_path / "nope"))
+    assert rows is None and prov is None

@@ -22,7 +22,7 @@ from distributed_eigenspaces_tpu.parallel.feature_sharded import (
     make_feature_sharded_step,
     ns_orth,
 )
-from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+from distributed_eigenspaces_tpu.parallel.mesh import make_mesh, shard_map
 from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
 
 D, K, M, N = 64, 3, 4, 128
@@ -159,7 +159,7 @@ def test_merged_lowrank_sharded_exact(mesh, devices, rng):
     ).astype(np.float32)
 
     got_sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda v: merged_lowrank_sharded(v, K),
             mesh=mesh,
             in_specs=(P("workers", "features", None),),
@@ -328,7 +328,7 @@ def test_merged_lowrank_sharded_dense_dispatch(mesh, devices, rng):
 
     def run(dim_total):
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda v: merged_lowrank_sharded(
                     v, kf, dim_total=dim_total
                 ),

@@ -425,3 +425,32 @@ def test_batched_xtxv_matches_per_worker():
         jnp.asarray(x, jnp.bfloat16), jnp.asarray(v)
     )
     assert got_bf.dtype == jnp.float32
+
+
+def test_batched_xtxv_integer_widen_paths():
+    """The in-loop bf16 widen is for int8 — the staged wire format —
+    ONLY; any other integer dtype widens to fp32 so a future
+    fp32-semantics caller cannot silently get bf16 matvecs (ADVICE.md
+    r5). Both branches pinned against their float-cast references."""
+    import numpy as np
+
+    from distributed_eigenspaces_tpu.ops.linalg import batched_xtxv
+
+    rng = np.random.default_rng(7)
+    v = jnp.asarray(rng.standard_normal((2, 32, 3)).astype(np.float32))
+
+    # int8 (wire format): identical to feeding the bf16-widened block
+    x8 = rng.integers(-127, 128, (2, 16, 32), dtype=np.int8)
+    got8 = batched_xtxv(jnp.asarray(x8), v)
+    ref8 = batched_xtxv(jnp.asarray(x8, jnp.bfloat16), v)
+    assert got8.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(got8), np.asarray(ref8))
+
+    # int16/int32: the fp32 path, bit-for-bit — values chosen so a bf16
+    # widen would visibly differ (>8 mantissa bits)
+    x16 = rng.integers(-2000, 2000, (2, 16, 32), dtype=np.int16)
+    got16 = batched_xtxv(jnp.asarray(x16), v)
+    ref32 = batched_xtxv(jnp.asarray(x16, jnp.float32), v)
+    np.testing.assert_array_equal(np.asarray(got16), np.asarray(ref32))
+    bf = batched_xtxv(jnp.asarray(x16, jnp.bfloat16), v)
+    assert not np.allclose(np.asarray(bf), np.asarray(ref32))

@@ -210,3 +210,46 @@ def test_estimator_masked_dense_routes(workload, tmp_path):
         OnlineDistributedPCA(cfg, trainer="scan").fit(
             data, worker_masks=masks[:2]
         )
+
+
+def test_zero_block_live_round_folds_zero_carry(workload):
+    """An all-zero data block on a fully-LIVE round merges to an exactly
+    zero v_bar. Liveness for the warm carry is read from the MASK row —
+    the per-step loop's host-side semantics — so the zero result is
+    FOLDED (the carry goes to zero and the next round re-dispatches
+    cold), not silently replaced by the stale previous basis (ADVICE.md
+    r5: the old ``jnp.any(v_bar != 0)`` read zero-merge as "masked")."""
+    spec, xs, _ = workload
+    xs = np.array(xs)
+    xs[2] = 0.0  # degenerate data, every worker live
+    masks = np.ones((T, M), np.float32)
+    cfg = _cfg(warm_start_iters=2)
+
+    fit = make_scan_fit(cfg, masked=True)
+    st, v_bars = fit(
+        OnlineState.initial(D), jnp.asarray(xs), jnp.asarray(masks)
+    )
+    v_bars = np.asarray(v_bars)
+    assert not np.isnan(v_bars).any()
+    np.testing.assert_array_equal(v_bars[2], np.zeros((D, K)))
+
+    # the segmented twin exposes the carry: after the window covering
+    # the zero round it must be ZERO (fold), and the fit still recovers
+    # the planted subspace via the cold re-dispatch
+    seg = make_segmented_fit(cfg, segment=3)
+    carries = []
+    final = seg.fit_windows(
+        SegmentState.initial(D, K),
+        iter([jnp.asarray(xs[:3]), jnp.asarray(xs[3:])]),
+        on_segment=lambda t, s: carries.append(np.asarray(s.v_prev)),
+        worker_masks=iter([jnp.asarray(masks[:3]), jnp.asarray(masks[3:])]),
+    )
+    np.testing.assert_array_equal(carries[0], np.zeros((D, K)))
+    ang = float(
+        jnp.max(
+            principal_angles_degrees(
+                jnp.asarray(np.asarray(final.v_prev)), spec.top_k(K)
+            )
+        )
+    )
+    assert ang < 5.0

@@ -16,6 +16,18 @@ from distributed_eigenspaces_tpu.parallel import multihost as mh
 from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
 
 
+def _skip_if_multiprocess_unsupported(err: str) -> None:
+    """The two-OS-process tests need cross-process CPU collectives; XLA
+    builds that predate them fail every such computation with one
+    canonical error. That is a missing runtime CAPABILITY, not a code
+    defect — skip with the reason instead of failing red."""
+    if "Multiprocess computations aren't implemented" in err:
+        pytest.skip(
+            "this XLA build has no multiprocess CPU collectives "
+            "(two-process DCN tests need a newer jaxlib)"
+        )
+
+
 def test_initialize_is_safe_single_process():
     mh.initialize()  # no coordinator -> no-op
     assert jax.process_count() == 1
@@ -148,6 +160,7 @@ def test_two_process_dcn_step():
     try:
         for i, p in enumerate(procs):
             out, err = p.communicate(timeout=300)
+            _skip_if_multiprocess_unsupported(err)
             assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
             line = [
                 l for l in out.splitlines() if l.startswith("CHECKSUM")
@@ -266,6 +279,7 @@ def test_two_process_feature_sharded_step():
     try:
         for i, p in enumerate(procs):
             out, err = p.communicate(timeout=300)
+            _skip_if_multiprocess_unsupported(err)
             assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
             for name in sums:
                 line = [
@@ -410,6 +424,7 @@ def test_two_process_whole_fit_trainers():
     try:
         for i, p in enumerate(procs):
             out, err = p.communicate(timeout=300)
+            _skip_if_multiprocess_unsupported(err)
             assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
             for name in sums:
                 line = [
@@ -525,6 +540,7 @@ def test_two_process_bin_stream_worker_range(tmp_path):
     try:
         for i, p in enumerate(procs):
             out, err = p.communicate(timeout=300)
+            _skip_if_multiprocess_unsupported(err)
             assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
             line = [ln for ln in out.splitlines()
                     if ln.startswith("CHECKSUM")][-1]
@@ -659,6 +675,7 @@ def test_two_process_windowed_checkpoint_resume(tmp_path):
     try:
         for i, p in enumerate(procs):
             out, err = p.communicate(timeout=300)
+            _skip_if_multiprocess_unsupported(err)
             assert p.returncode == 0, f"proc {i} failed:\n{err[-2000:]}"
             line = [ln for ln in out.splitlines()
                     if ln.startswith("CHECKSUM")][-1]

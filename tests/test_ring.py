@@ -23,8 +23,10 @@ def _mesh():
 def _run(fn, *args, in_specs, out_specs):
     from jax.sharding import PartitionSpec as P  # noqa: F401
 
+    from distributed_eigenspaces_tpu.parallel.mesh import shard_map
+
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             fn, mesh=_mesh(), in_specs=in_specs, out_specs=out_specs,
             check_vma=False,
         )

@@ -1,0 +1,468 @@
+"""Self-healing supervision (runtime/supervisor.py): the three
+detection -> policy -> recovery loops, pinned end to end.
+
+- kill-at-(seeded-)random-step + auto-resume reproduces the unkilled
+  run: BIT-FOR-BIT on the checkpointed scan path (SegmentState carries
+  the warm basis) and on the eigh per-step path; within tolerance on
+  the warm per-step path (OnlineState has no warm carry, so the first
+  post-resume step legitimately runs cold);
+- NaN-corrupted blocks under budget complete with the corrupt workers
+  quarantined — no crash, no NaN in sigma_tilde, and the round equals
+  an explicit ``kill_workers`` mask round exactly (the §5.3 survivor
+  merge is the mechanism either way);
+- exceeding the fault budget raises ``SupervisorError`` with the fault
+  ledger attached;
+- transient stream/step failures retry under capped exponential
+  backoff, and a retried step replays its quarantine mask instead of
+  stealing the next round's.
+
+Reference defect class being closed: the only fault handling anywhere
+in the reference is AMQP at-least-once redelivery with no timeout or
+liveness (``distributed.py:53``, SURVEY.md §5.3); every state dies with
+the master process (``distributed.py:88-91``).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.stream import block_stream
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.ops.linalg import principal_angles_degrees
+from distributed_eigenspaces_tpu.runtime.supervisor import (
+    Supervisor,
+    SupervisorError,
+    supervised_fit,
+)
+from distributed_eigenspaces_tpu.utils.faults import (
+    ChaosPlan,
+    ChaosStream,
+    KillSwitch,
+    kill_workers,
+)
+from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+D, K, M, N, T = 32, 2, 4, 32, 6
+ROWS = M * N
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=T,
+        backend="local", prefetch_depth=0,
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def data():
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=0)
+    return spec, np.asarray(spec.sample(jax.random.PRNGKey(1), ROWS * T))
+
+
+def _factory(data):
+    def factory(start_row):
+        return block_stream(
+            data, num_workers=M, rows_per_worker=N,
+            start_row=start_row, device=False,
+        )
+
+    return factory
+
+
+def _kill_then_resume(factory, cfg, tmp_path, kill_at, **kw):
+    """Simulate a hard process death + restart: the first supervised_fit
+    dies on KillSwitch; the second (fresh call, same checkpoint dir)
+    restores the newest commit and seeks the stream cursor."""
+    plan = ChaosPlan(kill_at=kill_at)
+    with pytest.raises(KillSwitch):
+        supervised_fit(
+            lambda s: ChaosStream(
+                factory(s), plan, first_step=s // ROWS + 1
+            ),
+            cfg, checkpoint_dir=str(tmp_path), **kw,
+        )
+    return supervised_fit(
+        factory, cfg, checkpoint_dir=str(tmp_path), **kw
+    )
+
+
+def test_kill_resume_bit_exact_segmented_scan(data, tmp_path):
+    """The checkpointed scan path: killed at a seeded-RANDOM step and
+    auto-resumed == unkilled, bit for bit (SegmentState carries the
+    warm basis across the kill)."""
+    spec, rows = data
+    factory = _factory(rows)
+    cfg = _cfg(solver="subspace", subspace_iters=12, warm_start_iters=2)
+    kill_at = int(np.random.default_rng(7).integers(2, T + 1))
+
+    w_ref, st_ref, _ = supervised_fit(factory, cfg, trainer="segmented")
+    w, st, sup = _kill_then_resume(
+        factory, cfg, tmp_path, kill_at, trainer="segmented",
+        checkpoint_every=2,
+    )
+    assert int(st.step) == T
+    assert [e["kind"] for e in sup.ledger.events] == ["resume"]
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(w_ref))
+    np.testing.assert_array_equal(
+        np.asarray(st.sigma_tilde), np.asarray(st_ref.sigma_tilde)
+    )
+
+
+def test_kill_resume_per_step_eigh_bit_exact(data, tmp_path):
+    """Per-step trainer, eigh solver (no warm carry to lose): resume is
+    bit-for-bit too — the restored OnlineState + cursor IS the complete
+    state."""
+    spec, rows = data
+    factory = _factory(rows)
+    cfg = _cfg()
+    w_ref, st_ref, _ = supervised_fit(factory, cfg)
+    w, st, _ = _kill_then_resume(factory, cfg, tmp_path, kill_at=4)
+    assert int(st.step) == T
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(w_ref))
+
+
+def test_kill_resume_per_step_warm_within_tol(data, tmp_path):
+    """Per-step trainer with warm starts: OnlineState has no warm
+    carry, so the first post-resume step runs cold — the documented
+    tolerance case (docs/ROBUSTNESS.md): same subspace, not same bits."""
+    spec, rows = data
+    factory = _factory(rows)
+    cfg = _cfg(solver="subspace", subspace_iters=12, warm_start_iters=2)
+    w_ref, _, _ = supervised_fit(factory, cfg)
+    w, st, _ = _kill_then_resume(factory, cfg, tmp_path, kill_at=4)
+    assert int(st.step) == T
+    ang = float(
+        jax.numpy.max(
+            principal_angles_degrees(
+                jax.numpy.asarray(np.asarray(w)),
+                jax.numpy.asarray(np.asarray(w_ref)),
+            )
+        )
+    )
+    assert ang < 0.5
+
+
+def test_nan_quarantine_equals_kill_workers_round(data):
+    """The acceptance scenario: NaN-corrupted blocks under budget
+    complete with those workers quarantined — no crash, no NaN in
+    sigma_tilde, ledger populated in MetricsLogger.summary() — and the
+    quarantined round is EXACTLY an explicit kill_workers mask round
+    (zeroed corrupt rows + zero merge weight == excluded worker)."""
+    spec, rows = data
+    factory = _factory(rows)
+    cfg = _cfg()
+    metrics = MetricsLogger(samples_per_step=ROWS).start()
+    plan = ChaosPlan(nan_blocks={3: [1, 2]})
+    w, st, sup = supervised_fit(
+        lambda s: ChaosStream(factory(s), plan), cfg,
+        fault_budget=4, metrics=metrics,
+    )
+    assert int(st.step) == T
+    assert np.isfinite(np.asarray(st.sigma_tilde)).all()
+    assert sup.ledger.by_kind == {"quarantine_nonfinite": 1}
+    assert sup.ledger.events[0]["workers"] == [1, 2]
+    assert sup.ledger.budget_spent == 2
+
+    summ = metrics.summary()
+    assert summ["faults"]["count"] == 1
+    assert summ["faults"]["by_kind"] == {"quarantine_nonfinite": 1}
+    assert summ["faults"]["events"][0]["step"] == 3
+
+    masks = np.ones((T, M), np.float32)
+    masks[2] = kill_workers(M, [1, 2])
+    w_mask, st_mask, _ = supervised_fit(factory, cfg, worker_masks=masks)
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(w_mask))
+    np.testing.assert_array_equal(
+        np.asarray(st.sigma_tilde), np.asarray(st_mask.sigma_tilde)
+    )
+
+
+def test_short_block_pads_and_masks_missing_workers(data):
+    """A short read (fewer worker row-blocks than m) is padded with the
+    missing workers masked dead — equal to an explicit kill of those
+    workers on the full block with the same surviving data."""
+    spec, rows = data
+    factory = _factory(rows)
+    cfg = _cfg()
+
+    class ShortRead:
+        def __init__(self, stream):
+            self._it = iter(stream)
+            self._t = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            block = next(self._it)
+            self._t += 1
+            if self._t == 2:
+                return np.asarray(block)[: M - 1]  # last worker lost
+            return block
+
+    w, st, sup = supervised_fit(
+        lambda s: ShortRead(factory(s)), cfg, fault_budget=1,
+    )
+    assert int(st.step) == T
+    assert sup.ledger.by_kind == {"quarantine_short": 1}
+    assert sup.ledger.events[0]["workers"] == [M - 1]
+
+    masks = np.ones((T, M), np.float32)
+    masks[1] = kill_workers(M, [M - 1])
+    w_mask, _, _ = supervised_fit(factory, cfg, worker_masks=masks)
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(w_mask))
+
+
+def test_fault_budget_exhaustion_raises_with_ledger(data):
+    spec, rows = data
+    factory = _factory(rows)
+    plan = ChaosPlan(nan_blocks={1: [0], 2: [1], 3: [2]})
+    with pytest.raises(SupervisorError) as ei:
+        supervised_fit(
+            lambda s: ChaosStream(factory(s), plan), _cfg(),
+            fault_budget=1,
+        )
+    ledger = ei.value.ledger
+    assert ledger.budget_spent == 2  # the breaching event is ledgered
+    assert ledger.by_kind == {"quarantine_nonfinite": 2}
+    assert "fault ledger" in str(ei.value)
+
+
+def test_transient_stream_error_retries_with_capped_backoff(data):
+    """One flaky pull per scheduled step: retried (same block delivered
+    on the retry) and the run equals the clean run bit-for-bit; the
+    injected sleep sees the capped exponential schedule."""
+    spec, rows = data
+    factory = _factory(rows)
+    cfg = _cfg()
+    w_ref, _, _ = supervised_fit(factory, cfg)
+
+    sleeps = []
+    plan = ChaosPlan(raise_at={2: "flaky nfs", 5: "flaky nfs again"})
+    w, st, sup = supervised_fit(
+        lambda s: ChaosStream(factory(s), plan), cfg,
+        sleep=sleeps.append, backoff_base=0.25, backoff_max=2.0,
+    )
+    assert int(st.step) == T
+    assert sup.ledger.by_kind == {"stream_retry": 2}
+    assert sleeps == [0.25, 0.25]
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(w_ref))
+
+
+def test_persistent_stream_failure_escalates(data, tmp_path):
+    """Retries exhausted with no checkpoint -> SupervisorError carrying
+    the ledger; with a checkpoint dir the resume allowance is spent
+    first (each resume re-opens the stream, which keeps failing)."""
+    spec, rows = data
+
+    class Dead:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            raise OSError("disk gone")
+
+    sleeps = []
+    with pytest.raises(SupervisorError) as ei:
+        supervised_fit(
+            lambda s: Dead(), _cfg(), max_retries=2, sleep=sleeps.append,
+            backoff_base=0.5, backoff_max=1.0,
+        )
+    assert "cannot auto-resume" in str(ei.value)
+    assert ei.value.ledger.by_kind == {"stream_retry": 3}
+    assert sleeps == [0.5, 1.0]  # capped exponential, no sleep after last
+
+    with pytest.raises(SupervisorError) as ei:
+        supervised_fit(
+            lambda s: Dead(), _cfg(), max_retries=1, max_resumes=2,
+            checkpoint_dir=str(tmp_path), sleep=sleeps.append,
+        )
+    assert ei.value.ledger.by_kind["resume"] == 2
+    assert "resumes exhausted" in str(ei.value)
+
+
+def test_step_retry_replays_quarantine_mask(data):
+    """A retried STEP re-pulls its mask inside the step closure; the
+    feed must re-serve the same row or every retry would steal the next
+    round's mask and desync the whole run."""
+    sup = Supervisor(_cfg(), max_retries=2, sleep=lambda s: None)
+    feed = sup.mask_feed
+    feed.push(np.array([1.0, 1.0, 0.0, 1.0]))
+    feed.push(np.array([1.0, 1.0, 1.0, 1.0]))
+
+    calls = []
+
+    def step_fn(state, x):
+        mask = next(feed)
+        calls.append(mask.copy())
+        if len(calls) < 3:
+            raise OSError("transient device loss")
+        return state, mask
+
+    out = sup.step_hook(step_fn, "st", "x", t=1)
+    assert len(calls) == 3
+    for c in calls:  # every attempt saw step 1's mask
+        np.testing.assert_array_equal(c, calls[0])
+    assert next(feed)[2] == 1.0  # step 2's mask intact
+    assert sup.ledger.by_kind == {"step_retry": 2}
+    np.testing.assert_array_equal(out[1], calls[-1])
+
+
+def test_bad_shape_round_dropped_run_continues(data):
+    """A block with unsalvageable geometry is dropped whole (one fault
+    unit); the run folds the remaining rounds."""
+    spec, rows = data
+    factory = _factory(rows)
+
+    class Garbage:
+        def __init__(self, stream):
+            self._it = iter(stream)
+            self._t = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self._t += 1
+            if self._t == 3:
+                return np.zeros((2, 2), np.float32)
+            return next(self._it)
+
+    w, st, sup = supervised_fit(
+        lambda s: Garbage(factory(s)), _cfg(), fault_budget=1,
+    )
+    assert sup.ledger.by_kind == {"dropped_round": 1}
+    # the garbage block is skipped without a step; the T real blocks
+    # behind it all fold
+    assert int(st.step) == T
+
+
+def test_supervised_whole_fit_handle_retries(data):
+    """make_whole_fit(..., supervisor=) wraps the handle's entries in
+    the retry policy — the api/runner.py half of the wiring."""
+    import dataclasses
+
+    from distributed_eigenspaces_tpu.api.runner import (
+        WholeFitHandle,
+        make_whole_fit,
+    )
+
+    sup = Supervisor(_cfg(), max_retries=2, sleep=lambda s: None)
+    handle = make_whole_fit(_cfg(), "segmented", None, supervisor=sup)
+    assert handle.fit_windows is not None
+
+    # the wrapped callables really retry: a flaky fake handle
+    attempts = []
+
+    def flaky_fit(state, blocks):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("preempted")
+        return "done"
+
+    fake = WholeFitHandle(
+        kind="scan", fit=flaky_fit, init_state=lambda: None,
+        extract=lambda s: s,
+    )
+    wrapped = sup.wrap_handle(fake)
+    assert wrapped.fit("st", "blocks") == "done"
+    assert len(attempts) == 3
+    assert sup.ledger.by_kind == {"whole_fit_retry": 2}
+    assert dataclasses.is_dataclass(wrapped)
+
+
+def test_feature_sharded_step_loop_supervised(data):
+    """The feature-sharded per-step loop rides the same _drive_stream
+    hook: quarantine + completion on the rank-r backend."""
+    spec, rows = data
+    factory = _factory(rows)
+    cfg = _cfg(backend="feature_sharded")
+    plan = ChaosPlan(nan_blocks={2: [0]})
+    w, st, sup = supervised_fit(
+        lambda s: ChaosStream(factory(s), plan), cfg, fault_budget=2,
+    )
+    assert int(st.step) == T
+    assert sup.ledger.by_kind == {"quarantine_nonfinite": 1}
+    assert np.isfinite(np.asarray(st.u)).all()
+
+
+def test_chaos_harness_script(tmp_path):
+    """scripts/chaos.py end to end: kill + NaN + flaky read, restart,
+    verify — the acceptance scenario as a command."""
+    import os
+    import json
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(root, "scripts", "chaos.py"),
+            "--dim", "32", "--k", "2", "--workers", "4",
+            "--rows-per-worker", "32", "--steps", "6",
+            "--kill-step", "4", "--nan-step", "2", "--flaky-step", "3",
+        ],
+        capture_output=True, text=True, timeout=420,
+        env=dict(os.environ, PYTHONPATH=root, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"], report
+    assert report["restarts"] == 1
+    assert set(report["faults"]["by_kind"]) == {
+        "quarantine_nonfinite", "stream_retry", "resume"
+    }
+
+
+def test_cli_supervise_flag(capsys, tmp_path):
+    """--supervise end to end through the CLI: supervised JSON report,
+    both trainer routes."""
+    import json
+
+    from distributed_eigenspaces_tpu.cli import main
+
+    args = [
+        "--data", "synthetic", "--dim", "48", "--workers", "4",
+        "--steps", "4", "--rows-per-worker", "32", "--supervise",
+        "--fault-budget", "8",
+    ]
+    assert main(args) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["supervised"] is True and out["trainer"] == "step"
+    assert out["steps"] == 4
+
+    assert main(args + [
+        "--trainer", "scan", "--checkpoint-dir", str(tmp_path),
+    ]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["trainer"] == "segmented"
+    assert out["steps"] == 4
+
+    # the excluded whole-fit routes refuse loudly
+    assert main(args + [
+        "--trainer", "sketch", "--backend", "feature_sharded",
+    ]) == 2
+
+
+def test_supervision_under_prefetch_matches_unprefetched(data):
+    """The guarded stream runs INSIDE the prefetch producer thread when
+    prefetch_depth > 0 (the CLI default): block/mask pairing must
+    survive the producer running ahead of the consumer."""
+    spec, rows = data
+    factory = _factory(rows)
+    plan = ChaosPlan(nan_blocks={2: [0]}, raise_at={4: "flaky"})
+    results = []
+    for depth in (0, 2):
+        cfg = _cfg(prefetch_depth=depth)
+        w, st, sup = supervised_fit(
+            lambda s: ChaosStream(factory(s), plan), cfg, fault_budget=2,
+        )
+        assert sup.ledger.by_kind == {
+            "quarantine_nonfinite": 1, "stream_retry": 1
+        }
+        results.append(np.asarray(w))
+    np.testing.assert_array_equal(results[0], results[1])
