@@ -117,14 +117,28 @@ def _bench_cfg():
     # PCAConfig docs); the cold first step keeps CholeskyQR2.
     # DET_BENCH_WARM_ORTH overrides (e.g. "cholqr2" re-runs the A/B's
     # losing arm).
+    # pipeline_merge / merge_interval (round 6): the two steady-state
+    # restructure knobs — (a) overlap step t-1's latency-bound
+    # merge/fold with step t's warm solves from a one-step-stale basis,
+    # (b) run the merged eigensolve only every s steps (mean-projector
+    # folds between). Both default OFF in the headline: the round-6 A/B
+    # on the CPU CI rig (scripts/exp_pipeline.py, BASELINE.md
+    # "Pipelined steady state A/B") measures the (pipeline × s) grid —
+    # re-run the grid on a TPU session before flipping these defaults
+    # (the CPU rig inverts the latency/FLOP trade the knobs target).
+    # DET_BENCH_PIPELINE=1 / DET_BENCH_MERGE_INTERVAL=s run the arms.
     stage = _os.environ.get("DET_BENCH_STAGE") or "int8"
     warm_orth = _os.environ.get("DET_BENCH_WARM_ORTH") or "ns"
+    pipeline = _os.environ.get("DET_BENCH_PIPELINE") == "1"
+    interval = int(_os.environ.get("DET_BENCH_MERGE_INTERVAL") or 1)
     return PCAConfig(
         dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=TPU_STEPS,
         solver="subspace", subspace_iters=12, warm_start_iters=2,
         orth_method="cholqr2", warm_orth_method=warm_orth,
         compute_dtype="bfloat16",
         stage_dtype=stage,
+        pipeline_merge=pipeline,
+        merge_interval=interval,
     )
 
 
@@ -213,17 +227,24 @@ def measure_tpu(blocks_host, spectrum, profile_dir=None):
     state = state._replace(sigma_tilde=state.sigma_tilde + 1e-20)
     state, v_bar = step(state, blocks[0])
     state, _ = step(state, blocks[1 % len(blocks)], v_bar)
+    if cfg.merge_interval > 1:
+        # the interval loop also runs the fold-only executables —
+        # compile them outside the timed region too
+        state, _ = step(state, blocks[0], v_bar, merge=False)
+        state, _ = step(state, blocks[0], merge=False)
     _sync(state.sigma_tilde)
 
     from distributed_eigenspaces_tpu.utils.tracing import profile_to
 
     state = OnlineState.initial(D)
     v_prev = None
+    s_int = cfg.merge_interval  # host-scheduled phase (merge every s)
     t0 = time.perf_counter()
     with profile_to(profile_dir):
         for s in range(steps):
             state, v_prev = step(
-                state, blocks[s % len(blocks)], v_prev
+                state, blocks[s % len(blocks)], v_prev,
+                merge=(s % s_int == 0),
             )
         _sync(state.sigma_tilde)
     dt = time.perf_counter() - t0
@@ -307,7 +328,7 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
     # is the measured chained-matmul rate on this same device (BASELINE.md
     # "Sanity anchors" as a number, not prose).
     from distributed_eigenspaces_tpu.utils.roofline import (
-        measure_hbm_anchor,
+        measure_hbm_anchor_probe,
         measure_matmul_anchor,
         roofline_fields,
         step_byte_model,
@@ -343,7 +364,13 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
     cold_s = None
     fixed_overhead_s = None
     if not small:
-        cold_cfg = cfg.replace(warm_start_iters=None)
+        # the probe measures the plain all-cold step: strip the
+        # steady-state knobs (pipeline_merge requires warm starts — the
+        # replace would otherwise fail validation — and an interval
+        # schedule would change what "cold step" means here)
+        cold_cfg = cfg.replace(
+            warm_start_iters=None, pipeline_merge=False, merge_interval=1
+        )
         t_c = {}
         for t_len in (60, 120):
             fit_c = make_scan_fit(
@@ -384,6 +411,12 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
     model = step_flop_model(
         M, N, D, K, cfg.subspace_iters, cfg.resolved_warm_start()
     )
+    # HBM anchor via the RETRYING probe (2-3 buffer sizes before giving
+    # up); on persistent failure the structured attempt record rides
+    # into the JSON so BENCH_rNN carries a diagnosable failure instead
+    # of a bare hbm_probe_failed (round-6 satellite — r05 shipped the
+    # bare boolean and the bandwidth verdict was unreconstructable)
+    hbm_probe = measure_hbm_anchor_probe(small=small)
     extras.update(
         roofline_fields(
             model,
@@ -399,7 +432,11 @@ def measure_tpu_scan(blocks_host, spectrum, profile_dir=None):
                 cfg.resolved_warm_start(),
                 itemsize=stage_dtype.itemsize,  # what the passes read
             ),
-            hbm_anchor_gbps=measure_hbm_anchor(small=small),
+            hbm_anchor_gbps=(
+                float("nan") if hbm_probe["gb_per_sec"] is None
+                else hbm_probe["gb_per_sec"]
+            ),
+            hbm_probe_record=hbm_probe,
         )
     )
     if fixed_overhead_s is not None and fixed_overhead_s > 0:
@@ -448,16 +485,29 @@ def main():
                   file=sys.stderr)
             return 2
         profile_dir = args[i + 1]
-    # --compare OLD.json: exit nonzero on >10% anchor-normalized
-    # regression vs a recorded round (see compare_reports)
+    # --compare OLD.json: exit nonzero on an anchor-normalized regression
+    # vs a recorded round (see compare_reports). --compare-threshold R
+    # overrides the default 0.9 ratio floor — the CI smoke stage runs a
+    # CPU-tolerant threshold (value_per_anchor is hardware-shaped: the
+    # ratio is stable across tunnel sessions of the SAME chip, not
+    # across chip generations or CPU-vs-TPU).
     compare_path = None
+    compare_threshold = 0.9
     if "--compare" in args:
         i = args.index("--compare")
         if i + 1 >= len(args) or args[i + 1].startswith("--"):
-            print("usage: bench.py --compare BENCH_rNN.json",
+            print("usage: bench.py --compare BENCH_rNN.json "
+                  "[--compare-threshold R]",
                   file=sys.stderr)
             return 2
         compare_path = args[i + 1]
+    if "--compare-threshold" in args:
+        i = args.index("--compare-threshold")
+        if i + 1 >= len(args):
+            print("usage: bench.py --compare BENCH_rNN.json "
+                  "--compare-threshold R", file=sys.stderr)
+            return 2
+        compare_threshold = float(args[i + 1])
 
     # persistent compile cache: TPU eigh at d=1024 is minutes to compile via
     # a remote-compile path; cache makes reruns start in seconds
@@ -486,11 +536,19 @@ def main():
         extras = {}
     cpu_sps = measure_cpu_baseline(blocks_host)
 
+    cfg = _bench_cfg()
     result = {
         "metric": "pca_samples_per_sec_per_chip",
         "value": round(tpu_sps, 1),
         "unit": "samples/s",
         "vs_baseline": round(tpu_sps / cpu_sps, 2),
+        # steady-state knobs recorded when non-default, so A/B rows
+        # (DET_BENCH_PIPELINE / DET_BENCH_MERGE_INTERVAL) self-describe
+        **({"pipeline_merge": True} if cfg.pipeline_merge else {}),
+        **(
+            {"merge_interval": cfg.merge_interval}
+            if cfg.merge_interval != 1 else {}
+        ),
         **extras,
     }
     _add_value_per_anchor(result)
@@ -502,7 +560,7 @@ def main():
         return 1
     print(json.dumps(result))
     if compare_path is not None:
-        return compare_reports(compare_path, result)
+        return compare_reports(compare_path, result, compare_threshold)
     return 0
 
 
@@ -518,11 +576,32 @@ def _add_value_per_anchor(result: dict) -> None:
         result["value_per_anchor"] = round(result["value"] / anchor, 1)
 
 
-def compare_reports(old_path: str, result: dict) -> int:
-    """``bench.py --compare BENCH_rNN.json``: exit nonzero on a >10%
-    ANCHOR-NORMALIZED regression vs a prior round's recorded report —
-    the machine answer to "is this a regression or a slow tunnel
-    session" that r3->r4 re-litigated in prose (BASELINE.md)."""
+def _hbm_verdict_shape(report: dict) -> str:
+    """One-line summary of a report's bandwidth-verdict SHAPE — handles
+    every generation: the full verdict (pct_of_hbm_anchor + bound), the
+    structured probe-failure record (round 6), and the bare
+    ``hbm_probe_failed: true`` older rounds shipped (r05)."""
+    pct = report.get("pct_of_hbm_anchor")
+    if pct is not None:
+        bound = report.get("bound", "?")
+        return f"{pct}% of hbm anchor (bound={bound})"
+    probe = report.get("hbm_probe")
+    if probe is not None:
+        return f"probe_failed:{probe.get('failed_check', 'unknown')}"
+    if report.get("hbm_probe_failed"):
+        return "probe_failed (no record — pre-round-6 report)"
+    return "absent"
+
+
+def compare_reports(old_path: str, result: dict,
+                    threshold: float = 0.9) -> int:
+    """``bench.py --compare BENCH_rNN.json``: exit nonzero on an
+    ANCHOR-NORMALIZED regression below ``threshold`` vs a prior round's
+    recorded report — the machine answer to "is this a regression or a
+    slow tunnel session" that r3->r4 re-litigated in prose
+    (BASELINE.md). The verdict also summarizes both reports' bandwidth
+    verdicts, handling the structured probe-failure record AND the bare
+    ``hbm_probe_failed`` shape older rounds carry."""
     with open(old_path) as f:
         old = json.load(f)
     # driver-recorded BENCH_r files wrap the JSON line under "parsed"
@@ -544,10 +623,13 @@ def compare_reports(old_path: str, result: dict) -> int:
         "old_value_per_anchor": round(float(old_norm), 1),
         "new_value_per_anchor": round(float(new_norm), 1),
         "normalized_ratio": round(ratio, 3),
-        "regression": bool(ratio < 0.9),
+        "threshold": threshold,
+        "regression": bool(ratio < threshold),
+        "hbm_old": _hbm_verdict_shape(old),
+        "hbm_new": _hbm_verdict_shape(result),
     }
     print(json.dumps(verdict), file=sys.stderr)
-    return 1 if ratio < 0.9 else 0
+    return 1 if ratio < threshold else 0
 
 
 if __name__ == "__main__":

@@ -65,17 +65,21 @@ def _discount(rule: str, step: jax.Array, num_steps: int) -> jax.Array:
     raise ValueError(rule)
 
 
-def update_state(
+def update_state_projector(
     state: OnlineState,
-    v_bar: jax.Array,
+    p: jax.Array,
     *,
     discount: str,
     num_steps: int,
 ) -> OnlineState:
-    """Fold one merged eigenspace into the online running average (jittable)."""
+    """Fold one (d, d) projector-like matrix into the running average
+    (jittable). The shared tail of :func:`update_state` — the
+    merge-interval steady state (``cfg.merge_interval > 1``) folds the
+    MEAN of the worker projectors here on the steps between merges,
+    with the same discount weights as the merged-projector fold."""
     step = state.step + 1
     w = _discount(discount, step, num_steps)
-    p = projector(v_bar).astype(state.sigma_tilde.dtype)
+    p = p.astype(state.sigma_tilde.dtype)
     if discount == "1/t":
         sigma = state.sigma_tilde * (1.0 - w) + p * w
     else:
@@ -86,6 +90,19 @@ def update_state(
     # silently on the first fold)
     return OnlineState(
         sigma_tilde=sigma.astype(state.sigma_tilde.dtype), step=step
+    )
+
+
+def update_state(
+    state: OnlineState,
+    v_bar: jax.Array,
+    *,
+    discount: str,
+    num_steps: int,
+) -> OnlineState:
+    """Fold one merged eigenspace into the online running average (jittable)."""
+    return update_state_projector(
+        state, projector(v_bar), discount=discount, num_steps=num_steps
     )
 
 
@@ -164,6 +181,11 @@ def online_distributed_pca(
             s, v, discount=cfg.discount, num_steps=cfg.num_steps
         )
     )
+    update_p = jax.jit(
+        lambda s, p: update_state_projector(
+            s, p, discount=cfg.discount, num_steps=cfg.num_steps
+        )
+    )
 
     # online warm start (cfg.warm_start_iters): after the cold first round,
     # warm-start each worker's subspace iteration from the previous merged
@@ -172,33 +194,57 @@ def online_distributed_pca(
     warm_iters = cfg.resolved_warm_start()
     warm = warm_iters is not None
     v_prev = None
+    # merge-interval steady state (cfg.merge_interval = s): the merged
+    # eigensolve runs on steps t with (t-1) % s == 0; the steps between
+    # fold the masked mean of worker projectors (pool.round's sigma_bar)
+    # at the same discount weight, and the warm carry keeps the last
+    # merged basis. The phase counter is HOST state committed only on a
+    # step's successful return, so a supervisor step_hook retry
+    # (runtime/supervisor.py) re-runs the SAME phase instead of drifting.
+    s_int = cfg.merge_interval
+    done_cell = [int(state.step)]
 
     def step(st, x_blocks):
         nonlocal v_prev
+        t = done_cell[0] + 1
+        merge_now = s_int == 1 or (t - 1) % s_int == 0
         mask = next(worker_masks) if worker_masks is not None else None
         # pool.shard is idempotent, so prefetch-placed blocks pass through
-        _, v_bar = pool.round(
+        sigma_bar, v_bar = pool.round(
             pool.shard(x_blocks), cfg.k, worker_mask=mask,
             v0=v_prev,
             iters=warm_iters if v_prev is not None else None,
             orth=(
                 cfg.resolved_warm_orth() if v_prev is not None else None
             ),
+            merge=merge_now,
         )
-        if warm:
-            # an ALL-masked round merges to zeros; warm-starting from a
-            # zero basis is a fixed point of the solver (orth(0) = 0),
-            # so the carry keeps the last LIVE basis — and until any
-            # round survives, v_prev stays None and rounds run cold
-            # (round-5 §5.3 fix: an all-masked FIRST round previously
-            # dead-ended the whole fit at a zero estimate). Liveness is
-            # read from the MASK on the host (v_bar is all-zero exactly
-            # when the mask is all-zero) — checking v_bar itself would
-            # fetch device values every masked round and serialize the
-            # prefetch pipeline.
-            if mask is None or bool(np.any(np.asarray(mask))):
-                v_prev = v_bar
-        return update(st, v_bar), v_bar
+        if merge_now:
+            if warm:
+                # an ALL-masked round merges to zeros; warm-starting from
+                # a zero basis is a fixed point of the solver (orth(0) =
+                # 0), so the carry keeps the last LIVE basis — and until
+                # any round survives, v_prev stays None and rounds run
+                # cold (round-5 §5.3 fix: an all-masked FIRST round
+                # previously dead-ended the whole fit at a zero
+                # estimate). Liveness is read from the MASK on the host
+                # (v_bar is all-zero exactly when the mask is all-zero)
+                # — checking v_bar itself would fetch device values
+                # every masked round and serialize the prefetch pipeline.
+                if mask is None or bool(np.any(np.asarray(mask))):
+                    v_prev = v_bar
+            st, out = update(st, v_bar), v_bar
+        else:
+            # between merges: fold the (masked — the drop takes effect
+            # THIS round, §5.3) mean projector; the on_step value is the
+            # carried last-merged basis (zeros before any live merge)
+            st = update_p(st, sigma_bar)
+            out = (
+                v_prev if v_prev is not None
+                else jnp.zeros((cfg.dim, cfg.k), jnp.float32)
+            )
+        done_cell[0] = t
+        return st, out
 
     state = _drive_stream(
         stream, cfg, place=pool.shard, step=step, state=state,

@@ -20,13 +20,59 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_eigenspaces_tpu.algo.online import OnlineState, update_state
+from distributed_eigenspaces_tpu.algo.online import (
+    OnlineState,
+    update_state,
+    update_state_projector,
+)
 from distributed_eigenspaces_tpu.algo.step import (
     make_round_core,
+    make_solve_core,
     make_warm_core,
+    make_warm_solve_core,
+    mean_projector,
+    merge_core,
 )
 from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.ops.linalg import projector
 from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS, shard_map
+
+
+def _merge_or_fold_factory(cfg: PCAConfig):
+    """ONE definition of the merge-interval round fold, shared by every
+    interval-aware body (unmasked/masked scan, pipelined scan,
+    segmented): ``fold_round(st, vs, vp, mask=None) -> (st, v_new,
+    merge_now)``. On merge rounds (``st.step % s == 0`` — steps 1, s+1,
+    2s+1, ... in 1-based step numbers) the gathered factors run the
+    exact low-rank merge and the merged projector ``v̄ v̄ᵀ`` is folded;
+    between merges the masked MEAN of the worker projectors is folded
+    at the same discount weight and ``v_new`` is the carried basis.
+    ``lax.cond`` executes ONE branch, so fold rounds never pay the
+    k-wide merge-eigh chain. The mask (when given) is THIS round's mask
+    — a worker drop takes effect in the same round's fold and at the
+    next merge, never ``s`` steps late (§5.3 under ``merge_interval``).
+    """
+    k, s = cfg.k, cfg.merge_interval
+
+    def update_p(st, p):
+        return update_state_projector(
+            st, p, discount=cfg.discount, num_steps=cfg.num_steps
+        )
+
+    def fold_round(st, vs, vp, mask=None):
+        merge_now = (st.step % s) == 0
+
+        def do_merge(vs_):
+            v = merge_core(vs_, k, mask=mask)
+            return v, projector(v)
+
+        def fold_only(vs_):
+            return vp, mean_projector(vs_, mask)
+
+        v_new, p = jax.lax.cond(merge_now, do_merge, fold_only, vs)
+        return update_p(st, p), v_new, merge_now
+
+    return fold_round
 
 
 def _masked_body_factory(cfg, round_core, warm_core, axis_name, update):
@@ -39,34 +85,229 @@ def _masked_body_factory(cfg, round_core, warm_core, axis_name, update):
     per-step masked loop's exactly (tested equivalence): every round
     folds its merge result — zeros on an all-masked round — and the warm
     carry keeps the last LIVE basis.
+
+    With ``cfg.merge_interval > 1`` the body dispatches a second
+    on-device cond per round (:func:`_merge_or_fold_factory`): merge
+    rounds fold the merged projector, rounds between fold the masked
+    mean projector, and the warm carry updates only on LIVE merge
+    rounds. At ``s = 1`` this factory returns the EXACT pre-interval
+    body — the chaos/kill-resume guarantees ride on that program being
+    byte-identical.
     """
     warm = warm_core is not None
+    s_int = cfg.merge_interval
+
+    if s_int == 1:
+
+        def body(carry, x, mk):
+            st, vp = carry
+            if warm:
+                live = jnp.any(vp != 0)
+                v_bar = jax.lax.cond(
+                    live,
+                    lambda xx, mm, vv: warm_core(
+                        xx, axis_name=axis_name, v0=vv, mask=mm
+                    ),
+                    lambda xx, mm, vv: round_core(
+                        xx, axis_name=axis_name, mask=mm
+                    ),
+                    x, mk, vp,
+                )
+            else:
+                v_bar = round_core(x, axis_name=axis_name, mask=mk)
+            # liveness from the MASK row, not the merged result: the
+            # per-step loop reads the mask on the host (algo/online.py),
+            # and a LIVE round whose data happens to be all-zero merges
+            # to an exactly zero v_bar — deriving liveness from v_bar
+            # would diverge from the per-step semantics in that
+            # degenerate case (ADVICE.md r5)
+            vp_next = jnp.where(jnp.any(mk != 0), v_bar, vp)
+            return (update(st, v_bar), vp_next), v_bar
+
+        return body
+
+    # merge-interval (s > 1) masked body: solve every round (cold until
+    # a LIVE merge has seeded the carry, warm after), then the shared
+    # merge-or-fold dispatch with THIS round's mask
+    solve_cold = make_solve_core(cfg)
+    solve_warm = make_warm_solve_core(cfg)
+    fold_round = _merge_or_fold_factory(cfg)
 
     def body(carry, x, mk):
         st, vp = carry
         if warm:
             live = jnp.any(vp != 0)
-            v_bar = jax.lax.cond(
+            vs = jax.lax.cond(
                 live,
-                lambda xx, mm, vv: warm_core(
-                    xx, axis_name=axis_name, v0=vv, mask=mm
-                ),
-                lambda xx, mm, vv: round_core(
-                    xx, axis_name=axis_name, mask=mm
-                ),
-                x, mk, vp,
+                lambda xx, vv: solve_warm(xx, axis_name=axis_name, v0=vv),
+                lambda xx, vv: solve_cold(xx, axis_name=axis_name),
+                x, vp,
             )
         else:
-            v_bar = round_core(x, axis_name=axis_name, mask=mk)
-        # liveness from the MASK row, not the merged result: the per-step
-        # loop reads the mask on the host (algo/online.py), and a LIVE
-        # round whose data happens to be all-zero merges to an exactly
-        # zero v_bar — deriving liveness from v_bar would diverge from
-        # the per-step semantics in that degenerate case (ADVICE.md r5)
-        vp_next = jnp.where(jnp.any(mk != 0), v_bar, vp)
-        return (update(st, v_bar), vp_next), v_bar
+            vs = solve_cold(x, axis_name=axis_name)
+        st, v_new, merge_now = fold_round(st, vs, vp, mask=mk)
+        # the warm carry advances only on LIVE merge rounds (an
+        # all-masked merge yields zeros — a fixed point of the warm
+        # solver; fold-only rounds produce no merged basis at all)
+        vp_next = jnp.where(
+            jnp.logical_and(merge_now, jnp.any(mk != 0)), v_new, vp
+        )
+        return (st, vp_next), v_new
 
     return body
+
+
+def _make_interval_fit(cfg: PCAConfig, axis_name, update, gather: bool):
+    """Unmasked whole-fit body for ``cfg.merge_interval > 1`` (pipeline
+    off): every round solves (warm from the carried last-merged basis
+    once one exists) and the shared merge-or-fold dispatch runs the
+    merged eigensolve only on merge rounds. ``v_bars[t]`` is the merged
+    basis AS OF step ``t+1`` (the carry on fold rounds)."""
+    solve_cold = make_solve_core(cfg)
+    solve_warm = make_warm_solve_core(cfg)
+    warm = solve_warm is not None
+    fold_round = _merge_or_fold_factory(cfg)
+    k = cfg.k
+
+    def body(carry, x):
+        st, vp = carry
+        vs = (
+            solve_warm(x, axis_name=axis_name, v0=vp) if warm
+            else solve_cold(x, axis_name=axis_name)
+        )
+        st, v_new, _ = fold_round(st, vs, vp)
+        return (st, v_new), v_new
+
+    if warm:
+        # step 1: cold at the full iteration count, always merged (it
+        # seeds the warm carry; also the resume-safe path)
+        def run(state, first_x, scan_body, xs_rest):
+            v0_bar = merge_core(
+                solve_cold(first_x, axis_name=axis_name), k
+            )
+            state = update(state, v0_bar)
+            (state, _), v_bars = jax.lax.scan(
+                scan_body, (state, v0_bar), xs_rest
+            )
+            return state, jnp.concatenate([v0_bar[None], v_bars], axis=0)
+
+        if gather:
+
+            def fit(state, blocks, idx):
+                def b(carry, i):
+                    return body(carry, blocks[i])
+
+                return run(state, blocks[idx[0]], b, idx[1:])
+
+            return fit
+
+        def fit(state, x_steps):
+            return run(state, x_steps[0], body, x_steps[1:])
+
+        return fit
+
+    # all-cold interval fit: one uniform body (step 1 merges because
+    # st.step % s == 0 at st.step = 0)
+    def run_cold(state, scan_body, xs):
+        vp0 = jnp.zeros((cfg.dim, k), jnp.float32)
+        (state, _), v_bars = jax.lax.scan(scan_body, (state, vp0), xs)
+        return state, v_bars
+
+    if gather:
+
+        def fit_cold(state, blocks, idx):
+            def b(carry, i):
+                return body(carry, blocks[i])
+
+            return run_cold(state, b, idx)
+
+        return fit_cold
+
+    def fit_cold(state, x_steps):
+        return run_cold(state, body, x_steps)
+
+    return fit_cold
+
+
+def _make_pipelined_fit(cfg: PCAConfig, axis_name, update, gather: bool):
+    """The software-pipelined steady state (``cfg.pipeline_merge``): one
+    scan body computes the latency-bound merge-or-fold of step ``t-1``'s
+    PENDING factors AND step ``t``'s warm worker solves from the
+    one-step-STALE merged basis (merges through step ``t-2``). The two
+    are data-independent inside one program, so XLA's scheduler can
+    overlap the serial merge/fold chain with the next round's MXU work
+    instead of serializing with it — the carry holds ``(state,
+    pending_factors, stale_basis)`` instead of ``(state, v_prev)``.
+
+    Schedule: step 1 runs cold and merges unpipelined (it seeds the
+    carry); step 2's solves use step 1's fresh merge (there is nothing
+    staler yet); steps >= 3 are fully pipelined; an epilogue merges/
+    folds the final pending round. Composes with ``merge_interval`` (the
+    pending fold dispatches through :func:`_merge_or_fold_factory`, same
+    phase schedule as the unpipelined interval fit). Requires warm
+    starts (config-validated): the stale carry IS a warm-start lever.
+    """
+    solve_cold = make_solve_core(cfg)
+    solve_warm = make_warm_solve_core(cfg)
+    fold_round = _merge_or_fold_factory(cfg)
+    k = cfg.k
+
+    def fold_pending(st, vs_p, vp):
+        st, v_new, _ = fold_round(st, vs_p, vp)
+        return st, v_new
+
+    def body(carry, x):
+        st, vs_p, vp = carry
+        # this round's solves read the STALE carry vp — independent of
+        # fold_pending's outputs, which is the whole point
+        vs = solve_warm(x, axis_name=axis_name, v0=vp)
+        st, v_new = fold_pending(st, vs_p, vp)
+        return (st, vs, v_new), v_new
+
+    def run(state, get, T, scan_body, xs_scan):
+        # prologue: cold step 1, merged + folded before any pipelining
+        v1 = merge_core(solve_cold(get(0), axis_name=axis_name), k)
+        state = update(state, v1)
+        if T == 1:
+            return state, v1[None]
+        # prime: step 2's solves from step 1's fresh merge
+        vs = solve_warm(get(1), axis_name=axis_name, v0=v1)
+        carry = (state, vs, v1)
+        ys = None
+        if T > 2:
+            carry, ys = jax.lax.scan(scan_body, carry, xs_scan)
+        state, vs_p, vp = carry
+        # epilogue: the final pending round's merge-or-fold
+        state, v_last = fold_pending(state, vs_p, vp)
+        parts = [v1[None]]
+        if ys is not None:
+            parts.append(ys)
+        parts.append(v_last[None])
+        return state, jnp.concatenate(parts, axis=0)
+
+    if gather:
+
+        def fit(state, blocks, idx):
+            T = int(idx.shape[0])
+
+            def b(carry, i):
+                return body(carry, blocks[i])
+
+            return run(
+                state, lambda t: blocks[idx[t]], T, b,
+                idx[2:] if T > 2 else None,
+            )
+
+        return fit
+
+    def fit(state, x_steps):
+        T = int(x_steps.shape[0])
+        return run(
+            state, lambda t: x_steps[t], T, body,
+            x_steps[2:] if T > 2 else None,
+        )
+
+    return fit
 
 
 def make_scan_fit(
@@ -102,6 +343,19 @@ def make_scan_fit(
     program, so the throughput path pays nothing for the fault
     machinery. ``gather`` staging is not offered masked (masked fits are
     dense-staged by the estimator).
+
+    Steady-state restructures (docs/ARCHITECTURE.md "Steady-state
+    pipeline"): ``cfg.merge_interval = s > 1`` runs the merged
+    eigensolve every s steps and folds the mean worker projector
+    between merges (:func:`_make_interval_fit` /
+    :func:`_merge_or_fold_factory`); ``cfg.pipeline_merge`` additionally
+    overlaps step ``t-1``'s merge/fold with step ``t``'s warm solves
+    from a one-step-stale basis (:func:`_make_pipelined_fit`). With both
+    knobs at their defaults (``s=1``, pipeline off) the build dispatches
+    to the UNCHANGED pre-knob code path — bit for bit. Masked fits honor
+    ``merge_interval`` but run unpipelined (``pipeline_merge`` is
+    ignored there — the fault path is not the throughput path; the
+    drop-at-next-merge timing is the tested contract).
     """
     # function-level import: utils.__init__ pulls checkpoint, which
     # imports this module — a top-level import would cycle
@@ -136,6 +390,11 @@ def make_scan_fit(
                 return state, v_bars
 
             return fit_masked
+
+        if cfg.pipeline_merge:
+            return _make_pipelined_fit(cfg, axis_name, update, gather)
+        if cfg.merge_interval > 1:
+            return _make_interval_fit(cfg, axis_name, update, gather)
 
         def step_body(st, x):
             v_bar = round_core(x, axis_name=axis_name)
@@ -254,14 +513,32 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
 
     ``x_steps`` may be a host array: each segment's slice is transferred
     as its program runs (O(S) device memory, not O(T)).
+
+    ``cfg.merge_interval > 1`` is honored resume-safely: the merge
+    phase derives from the on-device step counter (part of every
+    checkpoint), so a killed-and-resumed run re-enters the interval at
+    the right phase and stays bit-for-bit. ``cfg.pipeline_merge`` is
+    REJECTED here: the pipelined carry holds a pending (m, d, k) factor
+    stack that is not part of ``SegmentState``, so a kill between
+    segments could not resume bit-for-bit — use the one-program scan
+    trainer for pipelined fits, or ``merge_interval`` alone for a
+    checkpointable steady-state win.
     """
     if segment < 1:
         raise ValueError(f"segment must be >= 1, got {segment}")
+    if cfg.pipeline_merge:
+        raise ValueError(
+            "pipeline_merge is not supported by the segmented trainer: "
+            "the pending-factor carry is not checkpointable state, so "
+            "kill/resume could not be bit-for-bit (use make_scan_fit, "
+            "or merge_interval without pipelining)"
+        )
     from distributed_eigenspaces_tpu.utils.guards import checked_jit
 
     round_core = make_round_core(cfg)
     warm_core = make_warm_core(cfg)
     warm = warm_core is not None
+    s_int = cfg.merge_interval
 
     def update(st, v_bar):
         return update_state(
@@ -269,15 +546,30 @@ def make_segmented_fit(cfg: PCAConfig, mesh: Mesh | None = None, *,
         )
 
     def make_seg(axis_name, first):
-        core = warm_core if warm else round_core
+        if s_int > 1:
+            solve_cold = make_solve_core(cfg)
+            solve_warm = make_warm_solve_core(cfg)
+            fold_round = _merge_or_fold_factory(cfg)
 
-        def body(carry, x):
-            st, vp = carry
-            v = (
-                core(x, axis_name=axis_name, v0=vp) if warm
-                else core(x, axis_name=axis_name)
-            )
-            return (update(st, v), v), None
+            def body(carry, x):
+                st, vp = carry
+                vs = (
+                    solve_warm(x, axis_name=axis_name, v0=vp) if warm
+                    else solve_cold(x, axis_name=axis_name)
+                )
+                st, v_new, _ = fold_round(st, vs, vp)
+                return (st, v_new), None
+
+        else:
+            core = warm_core if warm else round_core
+
+            def body(carry, x):
+                st, vp = carry
+                v = (
+                    core(x, axis_name=axis_name, v0=vp) if warm
+                    else core(x, axis_name=axis_name)
+                )
+                return (update(st, v), v), None
 
         def seg(sstate, x_steps):
             st = OnlineState(sstate.sigma_tilde, sstate.step)

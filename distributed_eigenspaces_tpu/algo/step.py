@@ -19,36 +19,33 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_eigenspaces_tpu.algo.online import OnlineState, update_state
+from distributed_eigenspaces_tpu.algo.online import (
+    OnlineState,
+    update_state,
+    update_state_projector,
+)
 from distributed_eigenspaces_tpu.config import PCAConfig
 from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS, shard_map
 from distributed_eigenspaces_tpu.parallel.worker_pool import (
     _local_eigenspaces,
+    _masked_projector_mean,
 )
 from distributed_eigenspaces_tpu.ops.linalg import merged_top_k_lowrank
 
 
-def make_round_core(
+def make_solve_core(
     cfg: PCAConfig, iters: int | None = None, orth: str | None = None
 ):
-    """Shared per-round compute: ``round_core(x_blocks, axis_name=None,
-    v0=None) -> v_bar``.
+    """The SOLVE+GATHER half of a round: ``solve_core(x_blocks,
+    axis_name=None, v0=None) -> vs (m, d, k)`` — per-worker local
+    eigenspaces plus the cross-device factor gather, WITHOUT the merge.
 
-    The single definition of "one algorithm round" (local eigenspaces ->
-    cross-device ``all_gather`` of the (m, d, k) factors -> exact low-rank
-    merged top-k, :func:`~..ops.linalg.merged_top_k_lowrank`) used by both
-    the per-step trainer here and the whole-fit scan trainer (algo/scan.py),
-    so solver/merge changes can't diverge between them. The d x d mean
-    projector is never materialized on this path (the WorkerPool.round API
-    still exposes it). ``axis_name`` names the mesh axis to gather over
-    (None = single device). ``iters`` overrides ``cfg.subspace_iters``
-    (the warm-start trainer uses a short-iteration core for steps > 0);
-    ``v0`` warm-starts the per-worker subspace iterations. ``mask``
-    (full ``(m,)`` {0,1}, replicated) excludes failed workers from the
-    merge — the §5.3 fault exclusion, weighted exactly
-    (:func:`~..ops.linalg.merged_top_k_lowrank`); an all-masked round
-    merges to zeros (callers fold the zero projector and keep their
-    warm carry — the per-step loop's tested semantics).
+    The pipelined / merge-interval steady states (``cfg.pipeline_merge``
+    / ``cfg.merge_interval``) compose rounds from this half plus
+    :func:`merge_core` / :func:`mean_projector` so the merge can move
+    relative to the solves; :func:`make_round_core` composes the same
+    halves back into the classic fused round, so the numerics have ONE
+    definition either way.
     """
     k, solver = cfg.k, cfg.solver
     if iters is None:
@@ -63,7 +60,7 @@ def make_round_core(
     # captured trace shows — worker solve vs gather vs merge
     from distributed_eigenspaces_tpu.utils.tracing import named_scope
 
-    def round_core(x_blocks, axis_name=None, v0=None, mask=None):
+    def solve_core(x_blocks, axis_name=None, v0=None):
         with named_scope("det_worker_solve"):
             vs = _local_eigenspaces(
                 x_blocks, k, solver, iters, orth, cdtype, v0
@@ -74,8 +71,82 @@ def make_round_core(
             # dense merge would need
             with named_scope("det_factor_gather"):
                 vs = jax.lax.all_gather(vs, axis_name, axis=0, tiled=True)
-        with named_scope("det_merge"):
-            return merged_top_k_lowrank(vs, k, mask=mask)
+        return vs
+
+    return solve_core
+
+
+def make_warm_solve_core(cfg: PCAConfig):
+    """Warm-parameterized :func:`make_solve_core` (short iteration count
+    + warm orthonormalization), or None when warm starts are off — the
+    solve-only twin of :func:`make_warm_core`."""
+    warm_iters = cfg.resolved_warm_start()
+    if warm_iters is None:
+        return None
+    return make_solve_core(
+        cfg, iters=warm_iters, orth=cfg.resolved_warm_orth()
+    )
+
+
+def merge_core(vs, k, mask=None):
+    """The MERGE half of a round: exact masked low-rank top-k of the
+    gathered factors (``merged_top_k_lowrank``), under the profiler
+    region the traces name. ``mask`` (full ``(m,)`` {0,1}, replicated)
+    excludes failed workers exactly; an all-masked round merges to
+    zeros."""
+    from distributed_eigenspaces_tpu.utils.tracing import named_scope
+
+    with named_scope("det_merge"):
+        return merged_top_k_lowrank(vs, k, mask=mask)
+
+
+def mean_projector(vs, mask=None):
+    """Masked MEAN of the worker projectors ``(1/Σw) Σ w_l V_l V_lᵀ``
+    from the gathered ``(m, d, k)`` factors — what the merge-interval
+    steady state folds on the steps between merges (``sigma_bar``, the
+    same quantity ``WorkerPool.round`` exposes). An all-masked round
+    yields zeros (callers fold the zero projector — the tested §5.3
+    semantics)."""
+    from distributed_eigenspaces_tpu.utils.tracing import named_scope
+
+    if mask is None:
+        mask = jnp.ones((vs.shape[0],), jnp.float32)
+    with named_scope("det_mean_projector"):
+        psum, cnt = _masked_projector_mean(vs, mask)
+        return psum / jnp.maximum(cnt, 1.0)
+
+
+def make_round_core(
+    cfg: PCAConfig, iters: int | None = None, orth: str | None = None
+):
+    """Shared per-round compute: ``round_core(x_blocks, axis_name=None,
+    v0=None) -> v_bar``.
+
+    The single definition of "one algorithm round" (local eigenspaces ->
+    cross-device ``all_gather`` of the (m, d, k) factors -> exact low-rank
+    merged top-k, :func:`~..ops.linalg.merged_top_k_lowrank`) used by both
+    the per-step trainer here and the whole-fit scan trainer (algo/scan.py),
+    so solver/merge changes can't diverge between them — composed from
+    :func:`make_solve_core` + :func:`merge_core` since the pipelined
+    restructure, so the split cores and the fused round cannot drift.
+    The d x d mean projector is never materialized on this path (the
+    WorkerPool.round API still exposes it). ``axis_name`` names the mesh
+    axis to gather over (None = single device). ``iters`` overrides
+    ``cfg.subspace_iters`` (the warm-start trainer uses a
+    short-iteration core for steps > 0); ``v0`` warm-starts the
+    per-worker subspace iterations. ``mask`` (full ``(m,)`` {0,1},
+    replicated) excludes failed workers from the merge — the §5.3 fault
+    exclusion, weighted exactly
+    (:func:`~..ops.linalg.merged_top_k_lowrank`); an all-masked round
+    merges to zeros (callers fold the zero projector and keep their
+    warm carry — the per-step loop's tested semantics).
+    """
+    solve_core = make_solve_core(cfg, iters=iters, orth=orth)
+    k = cfg.k
+
+    def round_core(x_blocks, axis_name=None, v0=None, mask=None):
+        vs = solve_core(x_blocks, axis_name=axis_name, v0=v0)
+        return merge_core(vs, k, mask=mask)
 
     return round_core
 
@@ -98,7 +169,8 @@ def make_warm_core(cfg: PCAConfig):
 def make_train_step(
     cfg: PCAConfig, mesh: Mesh | None = None, *, donate: bool = True
 ):
-    """Build ``step(state, x_blocks, v_prev=None) -> (state, v_bar)``, jitted.
+    """Build ``step(state, x_blocks, v_prev=None, merge=True) ->
+    (state, v_bar)``, jitted.
 
     ``mesh=None`` gives the single-device (vmap-over-workers) step;
     with a mesh, worker compute runs under ``shard_map`` over the
@@ -112,6 +184,17 @@ def make_train_step(
     the scan trainer has (callers thread the returned ``v_bar`` back in).
     Without ``v_prev`` (or without the config knob) every step runs cold.
 
+    With ``cfg.merge_interval > 1``, ``merge=False`` runs the
+    FOLD-ONLY executables for the steps between merges: same solves,
+    then the masked-free mean projector folded directly — no
+    ``merged_top_k_lowrank``, no k-wide eigh chain in the program at
+    all. The return is ``(state, v_prev)`` (the carry is unchanged — a
+    fold round produces no new merged basis); callers schedule the
+    phase (``merge = ((t - 1) % s == 0)``). ``cfg.pipeline_merge`` does
+    not change this per-step builder — the pipelined carry restructure
+    lives in the whole-fit scan trainer (``algo/scan.py``), where the
+    merge and the next step's solves share one program.
+
     ``donate=True`` donates the state argument (reuses the d*d buffer —
     right for training loops that thread the state). Pass ``donate=False``
     if the same state object will be passed again (e.g. repeated timing
@@ -123,6 +206,7 @@ def make_train_step(
     warm_core = make_warm_core(cfg)
     warm = warm_core is not None
     donate_args = (0,) if donate else ()
+    s_int = cfg.merge_interval
 
     def fold(state, v_bar):
         return (
@@ -131,6 +215,17 @@ def make_train_step(
             ),
             v_bar,
         )
+
+    def fold_p(state, p):
+        return update_state_projector(
+            state, p, discount=cfg.discount, num_steps=cfg.num_steps
+        )
+
+    # fold-only executables (merge-interval steps between merges) are
+    # built lazily below ONLY when cfg.merge_interval > 1 — the default
+    # path compiles exactly the pre-knob programs
+    solve_cold = make_solve_core(cfg) if s_int > 1 else None
+    solve_warm = make_warm_solve_core(cfg) if s_int > 1 else None
 
     # checked_jit == jax.jit unless DET_CHECKIFY=1 arms the §5.2 NaN/inf
     # guards (resolved here, at build time)
@@ -147,6 +242,21 @@ def make_train_step(
                 return fold(state, warm_core(x_blocks, v0=v_prev))
 
             warm_step = checked_jit(warm_fn, donate_argnums=donate_args)
+
+        if s_int > 1:
+            cold_fold = checked_jit(
+                lambda state, x: fold_p(
+                    state, mean_projector(solve_cold(x))
+                ),
+                donate_argnums=donate_args,
+            )
+            if warm:
+                warm_fold = checked_jit(
+                    lambda state, x, v_prev: fold_p(
+                        state, mean_projector(solve_warm(x, v0=v_prev))
+                    ),
+                    donate_argnums=donate_args,
+                )
 
     else:
         x_sharding = NamedSharding(mesh, P(WORKER_AXIS))
@@ -191,7 +301,54 @@ def make_train_step(
                 donate_argnums=donate_args,
             )
 
-    def step(state: OnlineState, x_blocks, v_prev=None):
+        if s_int > 1:
+            inner_cold_fold = shard_map(
+                lambda state, x: fold_p(
+                    state,
+                    mean_projector(solve_cold(x, axis_name=WORKER_AXIS)),
+                ),
+                mesh=mesh,
+                in_specs=(state_specs, P(WORKER_AXIS)),
+                out_specs=state_specs,
+                check_vma=False,
+            )
+            cold_fold = checked_jit(
+                inner_cold_fold,
+                in_shardings=(rep, x_sharding),
+                out_shardings=rep,
+                donate_argnums=donate_args,
+            )
+            if warm:
+                inner_warm_fold = shard_map(
+                    lambda state, x, v0: fold_p(
+                        state,
+                        mean_projector(
+                            solve_warm(x, axis_name=WORKER_AXIS, v0=v0)
+                        ),
+                    ),
+                    mesh=mesh,
+                    in_specs=(state_specs, P(WORKER_AXIS), P()),
+                    out_specs=state_specs,
+                    check_vma=False,
+                )
+                warm_fold = checked_jit(
+                    inner_warm_fold,
+                    in_shardings=(rep, x_sharding, rep),
+                    out_shardings=rep,
+                    donate_argnums=donate_args,
+                )
+
+    def step(state: OnlineState, x_blocks, v_prev=None, merge=True):
+        if not merge:
+            if s_int == 1:
+                raise ValueError(
+                    "step(merge=False) needs cfg.merge_interval > 1 "
+                    "(the fold-only executables are built from the "
+                    "interval config)"
+                )
+            if warm and v_prev is not None:
+                return warm_fold(state, x_blocks, v_prev), v_prev
+            return cold_fold(state, x_blocks), v_prev
         if warm and v_prev is not None:
             return warm_step(state, x_blocks, v_prev)
         return cold(state, x_blocks)

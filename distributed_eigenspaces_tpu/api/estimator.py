@@ -285,6 +285,18 @@ class OnlineDistributedPCA:
         self._w = None
         cfg = self.cfg
         trainer = self.trainer
+        if cfg.pipeline_merge and self.checkpoint_dir is not None:
+            # the pipelined scan's pending-factor carry is not
+            # checkpointable state (make_segmented_fit rejects it for the
+            # same reason) — fail HERE with the remedy, not three layers
+            # down mid-dispatch
+            raise ValueError(
+                "pipeline_merge fits cannot checkpoint: the pipelined "
+                "carry (pending worker factors) is not part of any saved "
+                "state, so kill/resume could not be bit-for-bit. Drop "
+                "checkpoint_dir, or use merge_interval alone (resume-"
+                "safe: the merge phase derives from the step counter)."
+            )
         # mask-only fits whose trainer routes to the feature-sharded
         # whole-fit programs run those programs MASKED (the per-step
         # loop's host control is only needed by on_step); a generator of

@@ -96,6 +96,20 @@ def build_parser() -> argparse.ArgumentParser:
                    "estimate (requires --solver subspace; honored by all "
                    "trainers). Unset = the measured-fastest default (2) "
                    "with --solver subspace; 0 disables (every step cold)")
+    p.add_argument("--merge-interval", type=int, default=1,
+                   help="steady-state merge schedule s: run the merged "
+                   "eigensolve every s steps and fold the mean worker "
+                   "projector between merges (1 = every step, the exact "
+                   "pre-knob path; worker-mask drops still take effect "
+                   "in-round and at the next merge — see "
+                   "docs/ARCHITECTURE.md 'Steady-state pipeline')")
+    p.add_argument("--pipeline-merge", action="store_true",
+                   help="software-pipelined scan steady state: overlap "
+                   "step t-1's merge/fold with step t's warm solves from "
+                   "a one-step-stale basis (requires --solver subspace "
+                   "with warm starts; --trainer scan; incompatible with "
+                   "--checkpoint-dir/--resume — the pipelined carry is "
+                   "not checkpointable)")
     p.add_argument("--dim", type=int, default=1024,
                    help="feature dim for --data synthetic")
     p.add_argument("--checkpoint-dir", default=None)
@@ -770,6 +784,27 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.pipeline_merge:
+        # clean CLI errors for the combinations PCAConfig / the trainers
+        # would reject three layers down
+        if args.solver != "subspace" or args.warm_start_iters == 0:
+            print(
+                "error: --pipeline-merge requires --solver subspace with "
+                "warm starts enabled (the pipeline overlaps the merge "
+                "with the NEXT step's warm solves from a one-step-stale "
+                "basis; eigh / all-cold runs have nothing to pipeline)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.checkpoint_dir or args.supervise:
+            print(
+                "error: --pipeline-merge fits cannot checkpoint or run "
+                "supervised (the pipelined carry is not checkpointable "
+                "state); use --merge-interval alone for a resume-safe "
+                "steady-state win",
+                file=sys.stderr,
+            )
+            return 2
 
     import jax.numpy as jnp
 
@@ -839,6 +874,8 @@ def main(argv=None) -> int:
             else (None if args.warm_start_iters == 0
                   else args.warm_start_iters)
         ),
+        merge_interval=args.merge_interval,
+        pipeline_merge=args.pipeline_merge,
     )
 
     if args.supervise:

@@ -116,6 +116,39 @@ class PCAConfig:
         Rayleigh-Ritz and the tiny state-update psum stay on XLA
         collectives (latency-critical k x k reductions where an unrolled
         ring buys nothing).
+      merge_interval: steady-state merge schedule ``s``: the merged
+        eigensolve (``merged_top_k_lowrank`` — the k-wide eigh chain
+        that binds the latency-bound warm step) runs only every ``s``
+        steps; the ``s - 1`` steps between merges still fold the
+        (masked) MEAN of the worker projectors ``(1/Σw) Σ w_l V_l V_lᵀ``
+        into ``sigma_tilde`` at the same discount weight, and the warm
+        carry keeps the last merged basis across the interval. ``s = 1``
+        (default) is EXACTLY today's per-step merge — the trainers
+        dispatch to the unchanged pre-knob code path, bit for bit.
+        Fault semantics under ``s > 1``: a worker-mask drop takes
+        effect immediately in that step's fold AND at the next merge
+        (each round's merge/fold uses that round's own mask — never a
+        mask recorded at the interval's start). Honored by the dense
+        trainers (scan / segmented / per-step / ``make_train_step``)
+        and the feature-sharded exact step+scan trainers; the sketch
+        trainer ignores it (its steady state has no per-step eigensolve
+        to skip — that is its whole design).
+      pipeline_merge: software-pipelined steady state for the whole-fit
+        scan trainer (``algo/scan.py``): step ``t``'s warm worker
+        solves run against the one-step-STALE merged basis (merges
+        through step ``t - 2``) while step ``t - 1``'s latency-bound
+        merge + fold execute in the same scan body — data-independent,
+        so XLA can overlap the serial merge/fold chain with the next
+        step's MXU work instead of serializing with it. Requires the
+        subspace solver with warm starts enabled (the stale carry IS a
+        warm-start lever; there is nothing to pipeline cold). Composes
+        with ``merge_interval``. Scope: the unmasked scan trainer only
+        — masked fits run the non-pipelined (interval-aware) masked
+        programs (the fault path is not the throughput path), the
+        segmented trainer rejects it loudly (the pending-factor carry
+        is not checkpointable state, so kill/resume could not be
+        bit-for-bit), and the per-step pool loop runs unpipelined
+        (merge and next solve live in different dispatches there).
       seed: PRNG seed for initialization (subspace solver, synthetic data).
     """
 
@@ -139,6 +172,8 @@ class PCAConfig:
     prefetch_depth: int = 2
     mesh_shape: dict[str, int] | None = None
     collectives: str = "xla"
+    merge_interval: int = 1
+    pipeline_merge: bool = False
     seed: int = 0
 
     def __post_init__(self):
@@ -198,6 +233,26 @@ class PCAConfig:
                 )
         if self.collectives not in ("xla", "ring"):
             raise ValueError(f"unknown collectives mode: {self.collectives!r}")
+        if not isinstance(self.merge_interval, int) or isinstance(
+            self.merge_interval, bool
+        ) or self.merge_interval < 1:
+            raise ValueError(
+                f"merge_interval must be an int >= 1, got "
+                f"{self.merge_interval!r}"
+            )
+        if self.pipeline_merge:
+            # the pipelined body overlaps the merge/fold of step t-1 with
+            # step t's WARM solves from a one-step-stale basis; without
+            # the warm-start lever there is no stale carry to solve from
+            # (and eigh has nothing to warm-start) — reject rather than
+            # silently running an unpipelined fit under a pipeline flag
+            if self.solver != "subspace" or self.resolved_warm_start() is None:
+                raise ValueError(
+                    "pipeline_merge=True requires solver='subspace' with "
+                    "warm starts enabled (warm_start_iters not None): the "
+                    "pipeline overlaps the merge with the NEXT step's "
+                    "warm solves from a one-step-stale basis"
+                )
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")
         if self.prefetch_depth < 0:

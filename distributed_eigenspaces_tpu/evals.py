@@ -63,6 +63,11 @@ class EvalSpec:
     # "sketch" (feature-sharded whole fit with the Nystrom-sketch state —
     # the latency-free steady-state loop for large d)
     trainer: str = "scan"
+    #: steady-state restructure knobs (PCAConfig.merge_interval /
+    #: .pipeline_merge — docs/ARCHITECTURE.md "Steady-state pipeline");
+    #: defaults keep every config on the exact pre-knob programs
+    merge_interval: int = 1
+    pipeline_merge: bool = False
     description: str = ""
 
     def replace(self, **kw) -> "EvalSpec":
@@ -141,7 +146,7 @@ EVAL_SPECS: dict[str, EvalSpec] = {
 
 
 _ANCHOR_CACHE: dict[bool, float] = {}
-_HBM_CACHE: dict[bool, float] = {}
+_HBM_CACHE: dict[bool, tuple] = {}
 
 
 def _matmul_anchor(small: bool) -> float:
@@ -160,23 +165,27 @@ def _matmul_anchor(small: bool) -> float:
     return _ANCHOR_CACHE[small]
 
 
-def _hbm_anchor(small: bool) -> float:
-    """Per-process cache of the measured HBM streaming rate (GB/s) — the
+def _hbm_anchor(small: bool):
+    """Per-process cache of the measured HBM streaming rate — the
     denominator of the bandwidth roofline (round-4: an HBM-bound config's
-    honest ceiling is this rate, not the matmul anchor)."""
+    honest ceiling is this rate, not the matmul anchor). Returns
+    ``(gbps_or_nan, probe_record)`` — the record (raw attempt timings,
+    failed check) rides into the report on persistent failure so the
+    miss is diagnosable (round-6 satellite)."""
     if small not in _HBM_CACHE:
         from distributed_eigenspaces_tpu.utils.roofline import (
-            measure_hbm_anchor,
+            measure_hbm_anchor_probe,
         )
 
-        out = measure_hbm_anchor(small=small)
-        if out != out:
-            # NaN = the consistency check rejected this session's
-            # estimates; do NOT cache — the next eval re-measures
-            # instead of silently dropping the bandwidth block for the
-            # whole process (roofline_fields reports hbm_probe_failed)
-            return out
-        _HBM_CACHE[small] = out
+        out = measure_hbm_anchor_probe(small=small)
+        if out["gb_per_sec"] is None:
+            # every retried buffer size failed the consistency check;
+            # do NOT cache — the next eval re-measures instead of
+            # silently dropping the bandwidth block for the whole
+            # process (roofline_fields reports hbm_probe_failed + the
+            # attempt record)
+            return float("nan"), out
+        _HBM_CACHE[small] = (out["gb_per_sec"], out)
     return _HBM_CACHE[small]
 
 
@@ -334,6 +343,8 @@ def run_eval(
         compute_dtype=spec.compute_dtype,
         stage_dtype=spec.stage_dtype,
         backend=spec.backend,
+        merge_interval=spec.merge_interval,
+        pipeline_merge=spec.pipeline_merge,
         seed=seed,
     )
 
@@ -910,6 +921,7 @@ def run_eval(
         m, n, d, k, spec.subspace_iters, spec.warm_start_iters
     )
     small_anchor = spec.steps < 10 or d <= 256
+    hbm_gbps, hbm_record = _hbm_anchor(small=small_anchor)
     report_extra["roofline"] = roofline_fields(
         model,
         steps=timed_steps,
@@ -937,7 +949,8 @@ def run_eval(
                 else "dense"
             ),
         ),
-        hbm_anchor_gbps=_hbm_anchor(small=small_anchor),
+        hbm_anchor_gbps=hbm_gbps,
+        hbm_probe_record=hbm_record,
     )
     # anchor-normalized throughput (round-5 verdict item 6): the session
     # moves both the workload rate and the anchors, so cross-round
@@ -986,6 +999,13 @@ def run_eval(
         "samples_per_sec": round(samples_per_sec, 1),
         "principal_angle_deg": round(angle, 4),
         "accuracy_ok": bool(angle <= 1.0),
+        # steady-state restructure knobs, reported whenever non-default
+        # so A/B rows are self-describing
+        **(
+            {"merge_interval": spec.merge_interval}
+            if spec.merge_interval != 1 else {}
+        ),
+        **({"pipeline_merge": True} if spec.pipeline_merge else {}),
         **({"data_source": data_source} if data_source else {}),
         **report_extra,
     }

@@ -379,9 +379,25 @@ def _make_step_core(cfg: PCAConfig, *, collectives: str, key):
 
     ``step_core(state, x, step_iters, mask=None) -> (state, v_bar)`` —
     call inside ``shard_map`` over the ``(workers, features)`` mesh.
+
+    ``cfg.merge_interval = s > 1`` dispatches an on-device ``lax.cond``
+    per round: merge rounds (``st.step % s == 0``) run the exact
+    ``merged_lowrank_sharded`` eigensolve as before; rounds between fold
+    the masked scaled factor concatenation ``C = [√w_l V_l]/√Σw``
+    directly into the rank-r state (``C Cᵀ`` IS the masked mean worker
+    projector — the same between-merge fold as the dense trainers), and
+    the (m·k)²-sized merge eigh never enters those rounds. Note the
+    trade this backend makes explicit: the between-merge fold's
+    ``(r + m·k)²`` update eigh is LARGER than the ``(r + k)²`` one a
+    merge round pays, so ``merge_interval`` only wins here when the
+    merge eigh dominates the update eigh (small r, large m·k) — the
+    knob's home turf is the dense trainers; measure before enabling.
+    At ``s = 1`` the body is the unchanged pre-knob program.
     """
     k, n = cfg.k, cfg.rows_per_worker
     weights = _discount_weights(cfg)
+    s_int = cfg.merge_interval
+    _, gather_c = _collective_ops(collectives)
 
     def step_core(st, x, step_iters, mask=None):
         # warm-start worker solves from the running estimate's top-k (zero
@@ -394,17 +410,47 @@ def _make_step_core(cfg: PCAConfig, *, collectives: str, key):
                 v0=st.u[:, :k], compute_dtype=cfg.compute_dtype,
                 ritz=False,  # the merge below is rotation-invariant
             )
-        with jax.named_scope("det_merge"):
-            v_bar = merged_lowrank_sharded(
-                vws, k, mask=mask, dim_total=cfg.dim,
-                collectives=collectives,
-            )
         w, keep = weights(st.step)
-        with jax.named_scope("det_state_update"):
-            new_st = _lowrank_update(
-                st, v_bar, w, keep, axis_name=FEATURE_AXIS
-            )
-        return new_st, v_bar
+
+        def merge_round(st_, vws_):
+            with jax.named_scope("det_merge"):
+                v_bar = merged_lowrank_sharded(
+                    vws_, k, mask=mask, dim_total=cfg.dim,
+                    collectives=collectives,
+                )
+            with jax.named_scope("det_state_update"):
+                new_st = _lowrank_update(
+                    st_, v_bar, w, keep, axis_name=FEATURE_AXIS
+                )
+            return new_st, v_bar
+
+        if s_int == 1:
+            return merge_round(st, vws)
+
+        def fold_round(st_, vws_):
+            # masked scaled factor concat — the prologue of
+            # merged_lowrank_sharded WITHOUT its eigensolve; folding C
+            # folds C Cᵀ, the masked mean worker projector
+            with jax.named_scope("det_factor_fold"):
+                c = gather_c(vws_, WORKER_AXIS)  # (m_total, d_local, k)
+                m_total = c.shape[0]
+                if mask is None:
+                    wm = jnp.ones((m_total,), jnp.float32)
+                else:
+                    wm = gather_c(mask, WORKER_AXIS).astype(jnp.float32)
+                cnt = jnp.maximum(jnp.sum(wm), 1.0)
+                c = c * jnp.sqrt(wm / cnt)[:, None, None]
+                c = jnp.transpose(c, (1, 0, 2)).reshape(c.shape[1], -1)
+                new_st = _lowrank_update(
+                    st_, c, w, keep, axis_name=FEATURE_AXIS
+                )
+            # no merged basis this round: the step's reported basis is
+            # the post-fold running estimate's top-k
+            return new_st, new_st.u[:, :k]
+
+        return jax.lax.cond(
+            (st.step % s_int) == 0, merge_round, fold_round, st, vws
+        )
 
     return step_core
 
@@ -434,7 +480,10 @@ def make_feature_sharded_step(
     the full ``cfg.subspace_iters`` cold and later steps run the short
     count (scan-trainer contract). The cold/warm dispatch is a
     ``lax.cond`` on the on-device step counter inside the one executable —
-    no per-step host fetch.
+    no per-step host fetch. ``cfg.merge_interval > 1`` adds the
+    merge-every-s dispatch inside :func:`_make_step_core` (phase from
+    the same on-device counter — resume-safe); see its docstring for
+    the cost trade on this backend.
     """
     if collectives not in ("xla", "ring"):
         raise ValueError(f"unknown collectives mode: {collectives!r}")
@@ -849,6 +898,11 @@ def make_feature_sharded_sketch_fit(
     an exact truncated eigendecomposition (semantics differ from the
     per-step trainer beyond the first step; the drift is bounded — see
     tests/test_sketch_drift.py).
+
+    ``cfg.merge_interval`` and ``cfg.pipeline_merge`` are IGNORED here
+    by design: this trainer's steady state already has no per-step
+    eigensolve to skip or overlap — it is the restructured steady state
+    those knobs approximate on the exact trainers.
 
     Worker fault masks: ``fit(state, blocks, idx, worker_masks=(T, m))``
     excludes failed workers per step, the same §5.3 mechanism as the exact

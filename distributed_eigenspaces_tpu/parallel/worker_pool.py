@@ -273,7 +273,7 @@ class WorkerPool:
                     f"workers axis {axis}"
                 )
         self.mesh = mesh
-        self._round_fn = self._build_round()
+        self._round_fn, self._fold_fn = self._build_round()
         # jitted ONCE here: a per-call jax.jit(partial(...)) would rebuild
         # the wrapper every call and never hit the trace cache (r1 weak #4)
         self._local_fn = jax.jit(
@@ -292,7 +292,7 @@ class WorkerPool:
     def round(
         self, x_blocks: jax.Array, k: int, worker_mask=None,
         v0: jax.Array | None = None, iters: int | None = None,
-        orth: str | None = None,
+        orth: str | None = None, merge: bool = True,
     ):
         """One merge round: ``(m, n, d) -> (sigma_bar (d, d), v_bar (d, k))``.
 
@@ -308,6 +308,14 @@ class WorkerPool:
         — the warm-only "ns" lever) — together they are the per-step
         trainer's warm-start levers (``cfg.warm_start_iters`` /
         ``cfg.warm_orth_method``); all ignored by the eigh solver.
+
+        ``merge=False`` is the merge-interval steady state's fold-only
+        round (``cfg.merge_interval > 1``): the merged eigensolve — the
+        latency-bound k-wide chain — is skipped entirely and the return
+        is ``(sigma_bar, None)``; callers fold ``sigma_bar`` (already
+        the masked mean over survivors) and keep their warm carry. A
+        separate compiled executable, so the ``merge=True`` program is
+        untouched.
         """
         m = x_blocks.shape[0]
         if m != self.num_workers:
@@ -317,6 +325,12 @@ class WorkerPool:
             )
         if worker_mask is None:
             worker_mask = jnp.ones((m,), dtype=jnp.float32)
+        if not merge:
+            sigma_bar = self._fold_fn(
+                x_blocks, worker_mask, k=k, v0=v0, step_iters=iters,
+                step_orth=orth,
+            )
+            return sigma_bar, None
         return self._round_fn(
             x_blocks, worker_mask, k=k, v0=v0, step_iters=iters,
             step_orth=orth,
@@ -338,8 +352,16 @@ class WorkerPool:
     # -- round construction -------------------------------------------------
 
     def _build_round(self):
+        """Returns ``(round_fn, fold_fn)``: the full merge round and the
+        merge-interval fold-only round (same solves, NO merged
+        eigensolve — the whole point of ``round(merge=False)`` is that
+        the latency-bound k-wide chain never enters the program)."""
         solver, iters = self.solver, self.subspace_iters
         orth, cdtype = self.orth_method, self.compute_dtype
+
+        def mean_proj(vs, mask):
+            psum, cnt = _masked_projector_mean(vs, mask)
+            return psum / jnp.maximum(cnt, 1.0)
 
         def merge(vs, mask, k):
             """Masked mean projector + its EXACT top-k from the factors.
@@ -349,56 +371,74 @@ class WorkerPool:
             round() API exposes it (reference parity: it is what the master
             computed at ``distributed.py:126-131``).
             """
-            psum, cnt = _masked_projector_mean(vs, mask)
-            sigma_bar = psum / jnp.maximum(cnt, 1.0)
-            return sigma_bar, merged_top_k_lowrank(vs, k, mask)
+            return mean_proj(vs, mask), merged_top_k_lowrank(vs, k, mask)
 
         if self.backend == "local":
 
-            @partial(jax.jit, static_argnames=("k", "step_iters", "step_orth"))
-            def round_local(x_blocks, mask, k, v0=None, step_iters=None,
-                            step_orth=None):
-                vs = _local_eigenspaces(
-                    x_blocks, k, solver,
-                    iters if step_iters is None else step_iters,
-                    orth if step_orth is None else step_orth,
-                    cdtype, v0=v0,
+            def make_local(finish):
+                @partial(
+                    jax.jit,
+                    static_argnames=("k", "step_iters", "step_orth"),
                 )
-                return merge(vs, mask, k)
+                def round_local(x_blocks, mask, k, v0=None,
+                                step_iters=None, step_orth=None):
+                    vs = _local_eigenspaces(
+                        x_blocks, k, solver,
+                        iters if step_iters is None else step_iters,
+                        orth if step_orth is None else step_orth,
+                        cdtype, v0=v0,
+                    )
+                    return finish(vs, mask, k)
 
-            return round_local
+                return round_local
+
+            return (
+                make_local(merge),
+                make_local(lambda vs, mask, k: mean_proj(vs, mask)),
+            )
 
         mesh = self.mesh
         in_spec = P(WORKER_AXIS)
 
-        @partial(jax.jit, static_argnames=("k", "step_iters", "step_orth"))
-        def round_sharded(x_blocks, mask, k, v0=None, step_iters=None,
-                          step_orth=None):
-            def shard_fn(xs, mask_s, v0_s):
-                # xs: (m_local, n, d) on this device's worker slot(s)
-                vs = _local_eigenspaces(
-                    xs, k, solver,
-                    iters if step_iters is None else step_iters,
-                    orth if step_orth is None else step_orth,
-                    cdtype, v0=v0_s,
-                )
-                # ICI gather of the d x k factors — the entire reference
-                # wire protocol (C11) collapses to these two lines, moving
-                # m*d*k floats instead of the d*d a dense-merge psum needs.
-                vs = jax.lax.all_gather(vs, WORKER_AXIS, axis=0, tiled=True)
-                mask_all = jax.lax.all_gather(
-                    mask_s, WORKER_AXIS, axis=0, tiled=True
-                )
-                return merge(vs, mask_all, k)
+        def make_sharded(finish, out_specs):
+            @partial(
+                jax.jit, static_argnames=("k", "step_iters", "step_orth")
+            )
+            def round_sharded(x_blocks, mask, k, v0=None, step_iters=None,
+                              step_orth=None):
+                def shard_fn(xs, mask_s, v0_s):
+                    # xs: (m_local, n, d) on this device's worker slot(s)
+                    vs = _local_eigenspaces(
+                        xs, k, solver,
+                        iters if step_iters is None else step_iters,
+                        orth if step_orth is None else step_orth,
+                        cdtype, v0=v0_s,
+                    )
+                    # ICI gather of the d x k factors — the entire
+                    # reference wire protocol (C11) collapses to these two
+                    # lines, moving m*d*k floats instead of the d*d a
+                    # dense-merge psum needs.
+                    vs = jax.lax.all_gather(
+                        vs, WORKER_AXIS, axis=0, tiled=True
+                    )
+                    mask_all = jax.lax.all_gather(
+                        mask_s, WORKER_AXIS, axis=0, tiled=True
+                    )
+                    return finish(vs, mask_all, k)
 
-            return shard_map(
-                partial(shard_fn),
-                mesh=mesh,
-                in_specs=(in_spec, in_spec, P()),
-                out_specs=(P(), P()),
-                check_vma=False,
-            )(x_blocks, mask, v0)
+                return shard_map(
+                    partial(shard_fn),
+                    mesh=mesh,
+                    in_specs=(in_spec, in_spec, P()),
+                    out_specs=out_specs,
+                    check_vma=False,
+                )(x_blocks, mask, v0)
 
-        return round_sharded
+            return round_sharded
+
+        return (
+            make_sharded(merge, (P(), P())),
+            make_sharded(lambda vs, mask, k: mean_proj(vs, mask), P()),
+        )
 
 

@@ -536,6 +536,18 @@ def supervised_fit(
             f"supervised_fit trainer must be 'step' or 'segmented', "
             f"got {trainer!r}"
         )
+    if getattr(cfg, "pipeline_merge", False):
+        # the pipelined carry (pending worker factors) is not part of
+        # any checkpointable state, so the supervisor's auto-resume
+        # contract — killed-and-resumed == unkilled — cannot hold; the
+        # per-step path would also silently ignore the knob. Loud beats
+        # both. merge_interval IS supported (phase derives from the
+        # checkpointed step counter — tested bit-for-bit mid-interval).
+        raise ValueError(
+            "supervised runs do not support pipeline_merge (the "
+            "pipelined carry is not checkpointable; use merge_interval "
+            "for a resume-safe steady-state win)"
+        )
     sup = supervisor or Supervisor(
         cfg,
         fault_budget=fault_budget,

@@ -185,24 +185,13 @@ def step_byte_model(
     }
 
 
-def measure_hbm_anchor(
-    mb: int | None = None, base: int | None = None, ratio: int = 2,
-    small: bool = False,
-) -> float:
-    """Measured achievable HBM streaming rate (GB/s, read+write counted):
-    a dependent chain of whole-array adds over an ``mb``-MB fp32 buffer,
-    two chain lengths differenced so dispatch/launch/fence cancel — the
-    bandwidth twin of :func:`measure_matmul_anchor`. Each link reads and
-    writes the buffer once: 2 * mb MB of traffic per link. ``small=True``
-    is the ONE definition of the CI-shrunk preset (shared by bench.py
-    and evals.py so their anchors stay comparable)."""
+def _hbm_timed_factory(mb: int):
+    """One ``timed(count)`` closure for an ``mb``-MB add-chain probe —
+    best-of-3 fenced runs of a ``count``-link dependent whole-array add
+    program on fresh operands."""
     import jax
     import jax.numpy as jnp
 
-    if mb is None:
-        mb = 32 if small else 256
-    if base is None:
-        base = 6 if small else 24
     n = mb * (1 << 20) // 4
     x = jnp.zeros((n,), jnp.float32)
 
@@ -226,31 +215,111 @@ def measure_hbm_anchor(
             best = min(best, time.perf_counter() - t0)
         return best
 
-    dt = _consistent_marginal(timed, base, ratio)
-    if dt != dt or dt <= 0:
-        return float("nan")
-    return 2 * mb * (1 << 20) / dt / 1e9
+    return timed
 
 
-def _consistent_marginal(timed, base: int, ratio: int) -> float:
+def measure_hbm_anchor_probe(
+    sizes_mb: list[int] | None = None, base: int | None = None,
+    ratio: int = 2, small: bool = False,
+) -> dict:
+    """The HBM-anchor probe with RETRY and a structured record (round-6
+    satellite: a bare ``hbm_probe_failed: true`` was undiagnosable —
+    BENCH_r05 shipped without a bandwidth verdict and nothing said why).
+
+    Tries the consistency-checked differenced measurement at 2-3 buffer
+    sizes (a jittery session often fails at one size and passes at
+    another — smaller buffers run shorter programs with less exposure
+    to the jitter window) and returns::
+
+        {"gb_per_sec": float | None,      # None = every size failed
+         "attempts": [{"mb", "chain_lengths", "seconds",
+                       "est1_per_link_s", "est2_per_link_s",
+                       "failed_check"?}, ...],
+         "failed_check": str}             # only when gb_per_sec is None
+
+    ``attempts`` carries the raw timings of every size tried, so a
+    persistent failure in a recorded report is diagnosable (WHICH
+    consistency check failed, against WHAT numbers) instead of a bare
+    boolean. ``small=True`` is the ONE definition of the CI-shrunk
+    preset (shared by bench.py and evals.py so their anchors stay
+    comparable)."""
+    if sizes_mb is None:
+        sizes_mb = [32, 16, 8] if small else [256, 128, 64]
+    if base is None:
+        base = 6 if small else 24
+    attempts: list[dict] = []
+    for mb in sizes_mb:
+        dt, diag = _consistent_marginal_diag(
+            _hbm_timed_factory(mb), base, ratio
+        )
+        attempts.append({"mb": mb, **diag})
+        if dt == dt and dt > 0:
+            return {
+                "gb_per_sec": 2 * mb * (1 << 20) / dt / 1e9,
+                "attempts": attempts,
+            }
+    return {
+        "gb_per_sec": None,
+        "attempts": attempts,
+        "failed_check": attempts[-1].get("failed_check", "unknown"),
+    }
+
+
+def measure_hbm_anchor(
+    mb: int | None = None, base: int | None = None, ratio: int = 2,
+    small: bool = False,
+) -> float:
+    """Measured achievable HBM streaming rate (GB/s, read+write counted):
+    a dependent chain of whole-array adds over an fp32 buffer, two chain
+    lengths differenced so dispatch/launch/fence cancel — the bandwidth
+    twin of :func:`measure_matmul_anchor`. Each link reads and writes
+    the buffer once: 2 * mb MB of traffic per link. Retries 2-3 buffer
+    sizes before giving up (see :func:`measure_hbm_anchor_probe`, which
+    also returns the structured attempt record); NaN = every size
+    failed this session."""
+    out = measure_hbm_anchor_probe(
+        sizes_mb=None if mb is None else [mb], base=base, ratio=ratio,
+        small=small,
+    )
+    return float("nan") if out["gb_per_sec"] is None else out["gb_per_sec"]
+
+
+def _consistent_marginal_diag(timed, base: int, ratio: int):
     """Differenced per-unit time from THREE chain lengths, accepted only
     when the two independent estimates agree within 2x — a single
     differenced pair on a jittery tunnel can silently produce a
     wildly-wrong number (observed: an HBM "anchor" 3x below the same
     chip's earlier sessions, an op latency 30x below), and a wrong
-    denominator poisons every percentage derived from it. NaN = probe
-    failed this session; callers must report that, not a fiction."""
+    denominator poisons every percentage derived from it. Returns
+    ``(value_or_nan, diag)`` — the diag dict records the chain lengths,
+    raw seconds and both estimates, plus ``failed_check`` naming the
+    rejection, so callers can report a FAILURE as evidence instead of a
+    bare boolean (round-6 satellite)."""
     t1 = timed(base)
     t2 = timed(base * ratio)
     t3 = timed(base * (2 * ratio - 1))
     per = base * (ratio - 1)
     est1 = (t2 - t1) / per
     est2 = (t3 - t2) / per
+    diag = {
+        "chain_lengths": [base, base * ratio, base * (2 * ratio - 1)],
+        "seconds": [round(t1, 6), round(t2, 6), round(t3, 6)],
+        "est1_per_link_s": round(est1, 9),
+        "est2_per_link_s": round(est2, 9),
+    }
     if est1 <= 0 or est2 <= 0:
-        return float("nan")
+        diag["failed_check"] = "nonpositive_marginal"
+        return float("nan"), diag
     if max(est1, est2) > 2.0 * min(est1, est2):
-        return float("nan")
-    return 0.5 * (est1 + est2)
+        diag["failed_check"] = "estimates_disagree_2x"
+        return float("nan"), diag
+    return 0.5 * (est1 + est2), diag
+
+
+def _consistent_marginal(timed, base: int, ratio: int) -> float:
+    """Value-only wrapper of :func:`_consistent_marginal_diag` (kept for
+    callers that don't report diagnostics)."""
+    return _consistent_marginal_diag(timed, base, ratio)[0]
 
 
 def roofline_fields(
@@ -263,6 +332,7 @@ def roofline_fields(
     anchor_tflops: float | None = None,
     byte_model: dict | None = None,
     hbm_anchor_gbps: float | None = None,
+    hbm_probe_record: dict | None = None,
 ) -> dict:
     """Assemble the JSON roofline block from a flop model + measured times.
 
@@ -301,10 +371,21 @@ def roofline_fields(
         out["achieved_gb_per_sec"] = round(gbps, 1)
         if hbm_anchor_gbps is not None and hbm_anchor_gbps != hbm_anchor_gbps:
             # NaN = the probe's consistency check rejected this session's
-            # estimates — say so instead of silently omitting the block
-            # (consumers must be able to tell "not HBM-bound" from
-            # "anchor never measured")
+            # estimates at EVERY retried buffer size — say so instead of
+            # silently omitting the block (consumers must be able to tell
+            # "not HBM-bound" from "anchor never measured"), and attach
+            # the structured attempt record so the failure is diagnosable
+            # (which check failed, against what raw timings) rather than
+            # a bare boolean (round-6 satellite; BENCH_r05 shipped
+            # "hbm_probe_failed": true with no evidence)
             out["hbm_probe_failed"] = True
+            if hbm_probe_record is not None:
+                out["hbm_probe"] = {
+                    "failed_check": hbm_probe_record.get(
+                        "failed_check", "unknown"
+                    ),
+                    "attempts": hbm_probe_record.get("attempts", []),
+                }
         if hbm_anchor_gbps is not None and hbm_anchor_gbps == hbm_anchor_gbps:
             out["hbm_anchor_gb_per_sec"] = round(hbm_anchor_gbps, 1)
             out["pct_of_hbm_anchor"] = round(

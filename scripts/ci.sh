@@ -3,14 +3,22 @@
 # checkout (SURVEY.md §4: the reference ships no test strategy; this is
 # ours). Runs entirely on CPU with virtual devices — no TPU needed.
 #
-#   ./scripts/ci.sh            full suite + bench smoke + multichip dryrun
+#   ./scripts/ci.sh            full suite + bench smoke/compare + dryrun
 #   ./scripts/ci.sh --fast     suite only
 #
-# The three stages mirror what the driver checks at end of round:
+# The stages mirror what the driver checks at end of round:
 #   1. the pytest suite on the 8-virtual-device CPU rig (tests/conftest.py
 #      sets XLA_FLAGS/JAX_PLATFORMS; nothing to export here);
 #   2. bench.py in DET_BENCH_SMALL smoke mode (CPU; asserts the accuracy
-#      gate and prints the one JSON line — value not a perf result);
+#      gate and prints the one JSON line — value not a perf result),
+#      COMPARED anchor-normalized against the committed CPU smoke
+#      baseline (BENCH_SMOKE_CPU.json): value_per_anchor divides the
+#      machine/session speed out, so a warm-step latency regression
+#      fails CI here instead of surfacing at the next round's verdict.
+#      The threshold is CPU-tolerant (measured smoke jitter ~±15%;
+#      default ratio floor 0.5 ~ a 2x normalized regression) —
+#      override with DET_CI_COMPARE_THRESHOLD. On a TPU rig, compare
+#      the newest BENCH_rNN.json instead (same flag, tighter 0.9).
 #   3. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
@@ -24,8 +32,15 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/3] bench smoke (DET_BENCH_SMALL=1, CPU) =="
-DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
+echo "== [2/3] bench smoke + anchor-normalized compare (CPU) =="
+if [[ -f BENCH_SMOKE_CPU.json ]]; then
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
+        --compare BENCH_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    # no recorded baseline (fresh fork): smoke only, gate still asserted
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
+fi
 
 echo "== [3/3] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py

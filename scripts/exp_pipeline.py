@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""A/B grid for the round-6 steady-state restructure: (pipeline_merge ×
+merge_interval) on the headline scan fit.
+
+Motivation (BENCH_r05 / VERDICT r5 next-round item 1): with int8
+staging + warm-only NS the warm step is LATENCY-bound — 0.307 ms/step at
+6.2% of the FLOP anchor, ~0.41-0.48 ms of serial worker-solve → gather →
+merged_top_k_lowrank → fold chain. The two knobs attack that chain two
+ways: ``pipeline_merge`` overlaps step t-1's merge/fold with step t's
+warm solves (one-step-stale basis), ``merge_interval=s`` runs the merged
+eigensolve only every s steps (mean-projector folds between).
+
+Protocol: the headline end-to-end harness (scripts/exp_int8_stage.
+run_fit — gather staging, value-fetch fence, RPC subtracted,
+median-of-3 + IQR), one row per (pipeline, s) arm, plus a MARGINAL
+warm-step time per arm from differencing a full- and half-length fit
+(cold step / dispatch / fence cancel — bench.py methodology). The gate
+is the issue's: each arm's principal angle must sit within 0.2 deg of
+the baseline arm's (pipeline off, s=1), or the row is flagged.
+
+A negative result IS a result: the table lands in BASELINE.md either
+way ("silence is not" — ISSUE r6). Note the rig inversion: on a CPU
+rig the between-merge mean-projector fold costs MORE FLOPs than the
+merged fold it replaces (m·d²·k vs d²·k MACs) and nothing overlaps, so
+a CPU grid measures the knobs' floor, not their TPU ceiling — re-run on
+a TPU session before changing bench defaults.
+
+Usage: python scripts/exp_pipeline.py [--quick] [--steps T] [--rows N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root: the package
+from exp_int8_stage import run_fit  # noqa: E402  (the shared protocol)
+
+
+def run_arm(cfg, steps, blocks_host, spectrum):
+    """One grid arm: full-length fit (median-of-3 + IQR + angle) plus the
+    marginal warm-step ms from a half-length fit differenced against it."""
+    m, n = cfg.num_workers, cfg.rows_per_worker
+    full = run_fit("int8", steps, blocks_host, spectrum, cfg)
+    t_half = max(steps // 2, 1)
+    half = run_fit(
+        "int8", t_half, blocks_host, spectrum,
+        cfg.replace(num_steps=t_half),
+    )
+    dt_full = steps * m * n / full["samples_per_sec"]
+    dt_half = t_half * m * n / half["samples_per_sec"]
+    marginal = (
+        (dt_full - dt_half) / (steps - t_half) if steps > t_half else None
+    )
+    out = dict(full)
+    out["warm_ms_per_step"] = (
+        round(marginal * 1e3, 4)
+        if marginal is not None and marginal > 0 else None
+    )
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="fit length (default 600; --quick 40)")
+    ap.add_argument("--rows", type=int, default=4096,
+                    help="rows per worker per step (CPU grids shrink this)")
+    ap.add_argument("--intervals", type=int, nargs="*", default=[1, 2, 4, 8])
+    args = ap.parse_args()
+
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+
+    m, n, d, k = 8, args.rows, 1024, 8
+    steps = args.steps or (40 if args.quick else 600)
+    spectrum = planted_spectrum(d, k_planted=k, gap=20.0, noise=0.01, seed=7)
+    blocks_host = [
+        np.asarray(
+            spectrum.sample(jax.random.PRNGKey(100 + b), m * n)
+        ).reshape(m, n, d)
+        for b in range(4)
+    ]
+    base = PCAConfig(
+        dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=steps,
+        solver="subspace", subspace_iters=12, warm_start_iters=2,
+        orth_method="cholqr2", warm_orth_method="ns",
+        compute_dtype="bfloat16", stage_dtype="int8",
+    )
+
+    report = {
+        "device": str(jax.devices()[0]),
+        "workload": {"m": m, "n": n, "d": d, "k": k, "steps": steps},
+        "grid": {},
+    }
+    base_angle = None
+    for pipe in (False, True):
+        for s in args.intervals:
+            name = f"pipe={'on' if pipe else 'off'},s={s}"
+            cfg = base.replace(pipeline_merge=pipe, merge_interval=s)
+            row = run_arm(cfg, steps, blocks_host, spectrum)
+            if base_angle is None:  # the (off, 1) arm runs first
+                base_angle = row["max_angle_deg"]
+            row["angle_delta_vs_baseline_deg"] = round(
+                row["max_angle_deg"] - base_angle, 4
+            )
+            # the issue's gate: unchanged accuracy = within 0.2 deg of
+            # the current path's result
+            row["gate_0p2deg_ok"] = bool(
+                abs(row["max_angle_deg"] - base_angle) <= 0.2
+            )
+            report["grid"][name] = row
+    b = report["grid"]["pipe=off,s=1"]
+    for name, row in report["grid"].items():
+        row["speedup_vs_baseline"] = round(
+            row["samples_per_sec"] / b["samples_per_sec"], 3
+        )
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,44 @@ def test_missing_anchor_skips(tmp_path):
     assert bench.compare_reports(str(old), _report(60e6, 120.0)) == 0
 
 
+def test_custom_threshold(tmp_path, capsys):
+    # the CI smoke stage runs a CPU-tolerant ratio floor: a -33% swing
+    # passes at threshold 0.5 and fails at the default 0.9
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_report(60e6, 120.0)))
+    new = _report(40e6, 120.0)
+    assert bench.compare_reports(str(old), new, 0.5) == 0
+    v = json.loads(capsys.readouterr().err.strip())
+    assert v["threshold"] == 0.5 and v["regression"] is False
+    assert bench.compare_reports(str(old), new, 0.9) == 1
+
+
+def test_hbm_shapes_in_verdict(tmp_path, capsys):
+    """--compare must handle BOTH bandwidth-verdict shapes (round-6
+    satellite): the bare hbm_probe_failed older rounds carry (r05) and
+    the structured probe record, summarized side by side."""
+    old = tmp_path / "r05.json"
+    r_old = _report(60e6, 120.0)
+    r_old["hbm_probe_failed"] = True  # the r05 shape: boolean, no record
+    old.write_text(json.dumps(r_old))
+    new = _report(60e6, 120.0)
+    new["hbm_probe_failed"] = True
+    new["hbm_probe"] = {"failed_check": "estimates_disagree_2x",
+                        "attempts": [{"mb": 256}]}
+    assert bench.compare_reports(str(old), new) == 0
+    v = json.loads(capsys.readouterr().err.strip())
+    assert v["hbm_old"] == "probe_failed (no record — pre-round-6 report)"
+    assert v["hbm_new"] == "probe_failed:estimates_disagree_2x"
+
+    # and the healthy shape
+    new2 = _report(60e6, 120.0)
+    new2["pct_of_hbm_anchor"] = 38.2
+    new2["bound"] = "latency"
+    assert bench.compare_reports(str(old), new2) == 0
+    v2 = json.loads(capsys.readouterr().err.strip())
+    assert v2["hbm_new"] == "38.2% of hbm anchor (bound=latency)"
+
+
 def test_add_value_per_anchor():
     r = _report(60e6, 120.0)
     del r["value_per_anchor"]

@@ -1,0 +1,541 @@
+"""Round-6 steady-state restructure: pipelined merge + merge interval.
+
+Pins the ISSUE r6 acceptance contract:
+
+- ``s=1`` / pipeline-off dispatches to the UNCHANGED pre-knob programs
+  (bit-for-bit — the chaos/resume guarantees ride on it);
+- merge-interval semantics agree across ALL dense trainers (per-step
+  loop == scan == segmented, masked and unmasked) and drift vs the
+  every-step merge stays bounded across ``s ∈ {2, 4, 8}``;
+- the pipelined (one-step-stale) scan keeps the accuracy gate and its
+  staleness drift is bounded;
+- fault timing under ``s > 1``: a worker-mask drop mid-interval is
+  excluded from that round's FOLD immediately and from the NEXT merge —
+  never ``s`` steps late — including when the drop comes from the
+  supervisor's block quarantine (runtime/supervisor.py);
+- kill/resume stays bit-for-bit under ``s > 1`` (the merge phase
+  derives from the checkpointed step counter);
+- the combinations that cannot hold their guarantees are rejected
+  loudly (pipeline × segmented / checkpoint / eigh / no-warm).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_eigenspaces_tpu.algo.online import (
+    OnlineState,
+    online_distributed_pca,
+)
+from distributed_eigenspaces_tpu.algo.scan import (
+    SegmentState,
+    make_scan_fit,
+    make_segmented_fit,
+)
+from distributed_eigenspaces_tpu.algo.step import make_train_step
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.ops.linalg import (
+    principal_angles_degrees,
+    top_k_eigvecs,
+)
+
+D, K, M, N, T = 48, 3, 4, 64, 9
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=T,
+        solver="subspace", subspace_iters=16, warm_start_iters=3,
+        prefetch_depth=0,
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def planted():
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=3)
+    key = jax.random.PRNGKey(0)
+    xs = []
+    for _ in range(T):
+        key, sub = jax.random.split(key)
+        xs.append(np.asarray(spec.sample(sub, M * N)).reshape(M, N, D))
+    return spec, jnp.asarray(np.stack(xs))
+
+
+def _angle(spec, sigma):
+    return float(
+        jnp.max(
+            principal_angles_degrees(
+                top_k_eigvecs(sigma, K), spec.top_k(K)
+            )
+        )
+    )
+
+
+# --------------------------------------------------- default = unchanged ---
+
+
+def test_default_knobs_bit_identical_to_pre_knob_path(planted):
+    """Explicit defaults (s=1, pipeline off) produce the SAME arrays as
+    a config that never mentions the knobs — the dispatch must reach the
+    untouched pre-knob program."""
+    _, xs = planted
+    st_a, v_a = make_scan_fit(_cfg())(OnlineState.initial(D), xs)
+    st_b, v_b = make_scan_fit(
+        _cfg(merge_interval=1, pipeline_merge=False)
+    )(OnlineState.initial(D), xs)
+    np.testing.assert_array_equal(
+        np.asarray(st_a.sigma_tilde), np.asarray(st_b.sigma_tilde)
+    )
+    np.testing.assert_array_equal(np.asarray(v_a), np.asarray(v_b))
+
+
+# ------------------------------------------------ merge-interval parity ----
+
+
+@pytest.mark.parametrize("s", [2, 4, 8])
+def test_interval_drift_bounded(planted, s):
+    """s > 1 keeps the planted-subspace gate and stays within 0.5 deg of
+    the every-step-merge estimate (the between-merge mean-projector fold
+    is a bounded approximation, not a different algorithm)."""
+    spec, xs = planted
+    st1, _ = make_scan_fit(_cfg())(OnlineState.initial(D), xs)
+    sts, vbars = make_scan_fit(_cfg(merge_interval=s))(
+        OnlineState.initial(D), xs
+    )
+    assert vbars.shape == (T, D, K)
+    assert int(sts.step) == T
+    a1, a_s = _angle(spec, st1.sigma_tilde), _angle(spec, sts.sigma_tilde)
+    assert a_s <= 1.0, f"s={s} missed the gate: {a_s} deg"
+    assert abs(a_s - a1) <= 0.5, f"s={s} drifted: {a_s} vs {a1} deg"
+
+
+def test_interval_scan_matches_per_step_loop(planted):
+    """ONE merge-interval semantics across trainers: the s=3 scan fit,
+    the per-step pool loop, and the segmented fit fold the same rounds."""
+    _, xs = planted
+    cfg = _cfg(merge_interval=3)
+    st_scan, _ = make_scan_fit(cfg)(OnlineState.initial(D), xs)
+    _, st_loop = online_distributed_pca(iter(xs), cfg, max_steps=None)
+    np.testing.assert_allclose(
+        np.asarray(st_loop.sigma_tilde), np.asarray(st_scan.sigma_tilde),
+        atol=2e-5,
+    )
+    st_seg = make_segmented_fit(cfg, segment=2)(
+        SegmentState.initial(D, K), np.asarray(xs)
+    )
+    np.testing.assert_allclose(
+        np.asarray(st_seg.sigma_tilde), np.asarray(st_scan.sigma_tilde),
+        atol=2e-5,
+    )
+
+
+def test_interval_gather_matches_dense(planted):
+    _, xs = planted
+    cfg = _cfg(merge_interval=4)
+    idx = jnp.arange(T, dtype=jnp.int32) % 4
+    st_g, v_g = make_scan_fit(cfg, gather=True)(
+        OnlineState.initial(D), xs[:4], idx
+    )
+    st_d, v_d = make_scan_fit(cfg)(
+        OnlineState.initial(D), xs[:4][idx]
+    )
+    np.testing.assert_allclose(
+        np.asarray(st_g.sigma_tilde), np.asarray(st_d.sigma_tilde),
+        atol=2e-5,
+    )
+    np.testing.assert_allclose(np.asarray(v_g), np.asarray(v_d), atol=2e-5)
+
+
+def test_interval_train_step_matches_loop(planted):
+    """make_train_step's merge kwarg (host-scheduled phase) folds the
+    same rounds as the pool loop at s=3; merge=False at s=1 is a loud
+    error (there is no fold-only executable to run)."""
+    _, xs = planted
+    cfg = _cfg(merge_interval=3)
+    step = make_train_step(cfg, donate=False)
+    st = OnlineState.initial(D)
+    vp = None
+    for t in range(1, T + 1):
+        st, vp = step(st, xs[t - 1], vp, merge=((t - 1) % 3 == 0))
+    _, st_loop = online_distributed_pca(iter(xs), cfg, max_steps=None)
+    np.testing.assert_allclose(
+        np.asarray(st.sigma_tilde), np.asarray(st_loop.sigma_tilde),
+        atol=2e-5,
+    )
+    with pytest.raises(ValueError, match="merge_interval"):
+        make_train_step(_cfg(), donate=False)(
+            OnlineState.initial(D), xs[0], merge=False
+        )
+
+
+def test_pool_round_merge_false_skips_eigensolve(planted):
+    from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
+
+    _, xs = planted
+    pool = WorkerPool(M, backend="local", solver="subspace",
+                      subspace_iters=16)
+    sigma_full, v_bar = pool.round(xs[0], K)
+    sigma_fold, none = pool.round(xs[0], K, merge=False)
+    assert none is None and v_bar is not None
+    np.testing.assert_allclose(
+        np.asarray(sigma_fold), np.asarray(sigma_full), atol=1e-6
+    )
+
+
+# ----------------------------------------------------- pipelined scan ------
+
+
+@pytest.mark.parametrize("s", [1, 2])
+def test_pipelined_accuracy_and_staleness_bound(planted, s):
+    """The one-step-stale pipelined scan keeps the gate and stays within
+    0.5 deg of the unpipelined estimate at the same s."""
+    spec, xs = planted
+    st_ref, _ = make_scan_fit(_cfg(merge_interval=s))(
+        OnlineState.initial(D), xs
+    )
+    st_p, v_p = make_scan_fit(
+        _cfg(pipeline_merge=True, merge_interval=s)
+    )(OnlineState.initial(D), xs)
+    assert v_p.shape == (T, D, K)
+    assert int(st_p.step) == T
+    a_ref = _angle(spec, st_ref.sigma_tilde)
+    a_p = _angle(spec, st_p.sigma_tilde)
+    assert a_p <= 1.0, f"pipelined s={s} missed the gate: {a_p}"
+    assert abs(a_p - a_ref) <= 0.5, f"staleness drift: {a_p} vs {a_ref}"
+
+
+def test_pipelined_gather_matches_dense(planted):
+    _, xs = planted
+    cfg = _cfg(pipeline_merge=True)
+    idx = jnp.arange(T, dtype=jnp.int32) % 4
+    st_g, v_g = make_scan_fit(cfg, gather=True)(
+        OnlineState.initial(D), xs[:4], idx
+    )
+    st_d, v_d = make_scan_fit(cfg)(OnlineState.initial(D), xs[:4][idx])
+    np.testing.assert_allclose(
+        np.asarray(st_g.sigma_tilde), np.asarray(st_d.sigma_tilde),
+        atol=2e-5,
+    )
+    np.testing.assert_allclose(np.asarray(v_g), np.asarray(v_d), atol=2e-5)
+
+
+def test_pipelined_short_fits(planted):
+    """T=1 and T=2 exercise the prologue/prime/epilogue edges (no scan
+    body at all)."""
+    _, xs = planted
+    cfg = _cfg(pipeline_merge=True)
+    for t in (1, 2):
+        st, v = make_scan_fit(cfg.replace(num_steps=t))(
+            OnlineState.initial(D), xs[:t]
+        )
+        assert int(st.step) == t and v.shape == (t, D, K)
+
+
+def test_pipelined_sharded_matches_local(planted, devices):
+    from distributed_eigenspaces_tpu.parallel.mesh import (
+        make_mesh,
+        replicated_sharding,
+    )
+
+    _, xs = planted
+    cfg = _cfg(
+        num_workers=8, pipeline_merge=True, merge_interval=2
+    )
+    xs8 = jnp.concatenate([xs, xs], axis=1)  # (T, 8, N, D)
+    local = make_scan_fit(cfg)
+    st_l, _ = local(OnlineState.initial(D), xs8)
+    mesh = make_mesh(num_workers=8)
+    fit = make_scan_fit(cfg, mesh=mesh)
+    st_s, _ = fit(
+        jax.device_put(OnlineState.initial(D), replicated_sharding(mesh)),
+        xs8,
+    )
+    np.testing.assert_allclose(
+        np.asarray(st_s.sigma_tilde), np.asarray(st_l.sigma_tilde),
+        atol=2e-4,
+    )
+
+
+def test_pipeline_rejections():
+    """Every combination that cannot hold its guarantees fails loudly at
+    the layer that owns the reason."""
+    from distributed_eigenspaces_tpu.api.estimator import (
+        OnlineDistributedPCA,
+    )
+
+    # config: no warm lever -> nothing to pipeline
+    with pytest.raises(ValueError, match="pipeline_merge"):
+        PCAConfig(dim=D, k=K, pipeline_merge=True)  # eigh solver
+    with pytest.raises(ValueError, match="pipeline_merge"):
+        PCAConfig(dim=D, k=K, solver="subspace", warm_start_iters=None,
+                  pipeline_merge=True)
+    # segmented: pending factors are not checkpointable state
+    with pytest.raises(ValueError, match="pipeline_merge"):
+        make_segmented_fit(_cfg(pipeline_merge=True))
+    # estimator: checkpointed fits cannot pipeline, said up front
+    est = OnlineDistributedPCA(
+        _cfg(pipeline_merge=True), checkpoint_dir="/tmp/nope"
+    )
+    with pytest.raises(ValueError, match="checkpoint"):
+        est.fit(np.zeros((M * N * 2, D), np.float32))
+
+
+# ------------------------------------------- fault timing under s > 1 ------
+
+
+def _garbage_from(xs, worker, step0):
+    """Finite garbage (NOT NaN — 0 * NaN would poison the masked fold)
+    in one worker's blocks from step0 (1-based) on."""
+    xs = np.array(xs)
+    xs[step0 - 1:, worker] = 1e4
+    return jnp.asarray(xs)
+
+
+def test_mid_interval_drop_excluded_from_fold_and_next_merge(planted):
+    """Worker 2 feeds garbage from step 3 (mid-interval, s=4: merges at
+    1, 5, 9) and is masked from step 3 on. If the drop took effect only
+    at the interval boundary — or the merge at step 5 used factors/masks
+    recorded at the interval's start — the 1e4-scale garbage would
+    dominate the estimate. Accuracy holding proves the §5.3 timing:
+    excluded from the step-3 fold immediately AND from the step-5 merge.
+    """
+    spec, xs = planted
+    s = 4
+    bad = _garbage_from(xs, worker=2, step0=3)
+    masks = np.ones((T, M), np.float32)
+    masks[2:, 2] = 0.0  # dropped from step 3 on
+    cfg = _cfg(merge_interval=s)
+
+    # per-step loop (the supervisor's path)
+    _, st_loop = online_distributed_pca(
+        iter(bad), cfg, worker_masks=iter(masks), max_steps=None
+    )
+    a_loop = _angle(spec, st_loop.sigma_tilde)
+    assert a_loop <= 1.0, f"per-step merge leaked a dropped worker: {a_loop}"
+
+    # masked whole-fit scan (one program, same timing contract)
+    st_scan, _ = make_scan_fit(cfg, masked=True)(
+        OnlineState.initial(D), bad, jnp.asarray(masks)
+    )
+    a_scan = _angle(spec, st_scan.sigma_tilde)
+    assert a_scan <= 1.0, f"masked scan leaked a dropped worker: {a_scan}"
+    np.testing.assert_allclose(
+        np.asarray(st_scan.sigma_tilde), np.asarray(st_loop.sigma_tilde),
+        atol=2e-5,
+    )
+
+
+def test_supervisor_quarantine_mid_interval(planted, tmp_path):
+    """The supervisor's block quarantine composes with merge_interval:
+    NaN rows in worker 1 on steps 3-4 (mid-interval, s=4) become mask
+    drops for exactly those rounds — ledgered, excluded from those
+    folds, and the step-5 merge (the NEXT merge) runs on that round's
+    own healthy mask. No NaN reaches sigma_tilde, the gate holds."""
+    from distributed_eigenspaces_tpu.data.stream import block_stream
+    from distributed_eigenspaces_tpu.runtime.supervisor import (
+        supervised_fit,
+    )
+
+    spec, xs = planted
+    rows = np.asarray(xs).reshape(T * M * N, D).copy()
+
+    def factory(start_row):
+        def corrupted():
+            for t, b in enumerate(
+                block_stream(
+                    rows[start_row:], num_workers=M, rows_per_worker=N,
+                    device=False,
+                ),
+                start=start_row // (M * N) + 1,
+            ):
+                b = np.array(b)
+                if t in (3, 4):
+                    b[1] = np.nan
+                yield b
+
+        return corrupted()
+
+    cfg = _cfg(merge_interval=4, backend="local")
+    w, st, sup = supervised_fit(factory, cfg)
+    assert int(st.step) == T
+    assert np.isfinite(np.asarray(st.sigma_tilde)).all()
+    kinds = sup.ledger.by_kind
+    assert kinds.get("quarantine_nonfinite") == 2
+    quarantined_steps = sorted(
+        e["step"] for e in sup.ledger.events
+        if e["kind"] == "quarantine_nonfinite"
+    )
+    assert quarantined_steps == [3, 4]
+    ang = float(
+        jnp.max(principal_angles_degrees(jnp.asarray(w), spec.top_k(K)))
+    )
+    assert ang <= 1.0, f"quarantined run missed the gate: {ang}"
+
+
+# ------------------------------------------------- kill/resume at s > 1 ----
+
+
+def test_segmented_interval_resume_bit_exact(planted, tmp_path):
+    """Kill mid-INTERVAL (step 4 of an s=3 schedule: merges at 1, 4, 7)
+    and resume == unkilled, bit for bit: the merge phase derives from
+    the checkpointed step counter, so the resumed program re-enters the
+    interval at the right phase."""
+    from distributed_eigenspaces_tpu.utils.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    _, xs = planted
+    cfg = _cfg(merge_interval=3)
+    xs_np = np.asarray(xs)
+    fit = make_segmented_fit(cfg, segment=2)
+
+    st_full = fit(SegmentState.initial(D, K), xs_np)
+
+    st_half = fit(SegmentState.initial(D, K), xs_np[:4])
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, st_half, cursor=4 * M * N)
+    restored, cursor = restore_checkpoint(ck)
+    assert int(restored.step) == 4
+    st_resumed = fit(restored, xs_np[4:])
+
+    assert int(st_resumed.step) == T
+    for field in SegmentState._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st_resumed, field)),
+            np.asarray(getattr(st_full, field)),
+            err_msg=f"interval resume not bit-exact in {field}",
+        )
+
+
+# ------------------------------------------------- feature-sharded s>1 -----
+
+
+def test_feature_sharded_interval_step_scan_equivalent(devices):
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        make_feature_sharded_scan_fit,
+        make_feature_sharded_step,
+    )
+    from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+    spec = planted_spectrum(64, k_planted=K, gap=20.0, noise=0.01, seed=5)
+    key = jax.random.PRNGKey(0)
+    blocks = []
+    for _ in range(4):
+        key, sub = jax.random.split(key)
+        blocks.append(np.asarray(spec.sample(sub, M * N).reshape(M, N, 64)))
+    stacked = jnp.asarray(np.stack(blocks))
+    cfg = PCAConfig(
+        dim=64, k=K, num_workers=M, rows_per_worker=N, num_steps=6,
+        solver="subspace", subspace_iters=24, warm_start_iters=2,
+        discount="1/t", merge_interval=3,
+    )
+    mesh = make_mesh(num_workers=4, num_feature_shards=2)
+    fstep = make_feature_sharded_step(cfg, mesh, seed=4)
+    st = fstep.init_state()
+    for t in range(6):
+        st, _ = fstep(
+            st, jax.device_put(stacked[t % 4], fstep.x_sharding)
+        )
+    fit = make_feature_sharded_scan_fit(cfg, mesh, seed=4)
+    idx = jnp.arange(6, dtype=jnp.int32) % 4
+    st2 = fit(
+        fit.init_state(), jax.device_put(stacked, fit.blocks_sharding), idx
+    )
+    assert int(st2.step) == 6
+    np.testing.assert_allclose(
+        np.asarray(st2.u), np.asarray(st.u), atol=2e-5
+    )
+    ang = float(
+        np.max(np.asarray(principal_angles_degrees(
+            jnp.asarray(np.asarray(st.u)[:, :K]), spec.top_k(K)
+        )))
+    )
+    assert ang <= 1.0, f"fs interval missed the gate: {ang}"
+
+
+# --------------------------------------------------------------- CLI -------
+
+
+def test_cli_merge_interval_and_pipeline(tmp_path, capsys):
+    import json as _json
+
+    from distributed_eigenspaces_tpu.cli import main
+
+    common = [
+        "--data", "synthetic", "--dim", "48", "--rank", "3",
+        "--workers", "4", "--rows-per-worker", "32", "--steps", "6",
+        "--solver", "subspace", "--subspace-iters", "16",
+        "--warm-start-iters", "2", "--backend", "local",
+        "--trainer", "scan",
+    ]
+    assert main(common + ["--merge-interval", "3"]) == 0
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["steps"] == 6 and out["principal_angle_deg"] < 2.0
+
+    assert main(common + ["--pipeline-merge", "--merge-interval", "2"]) == 0
+    capsys.readouterr()
+
+    # clean CLI rejections (exit 2, not a traceback)
+    assert main(common + ["--pipeline-merge",
+                          "--checkpoint-dir", str(tmp_path / "ck")]) == 2
+    assert "checkpoint" in capsys.readouterr().err
+    assert main([
+        "--data", "synthetic", "--dim", "48", "--rank", "3",
+        "--trainer", "scan", "--pipeline-merge",  # eigh default solver
+    ]) == 2
+    assert "subspace" in capsys.readouterr().err
+
+
+# ---------------------------------------------------- HBM probe record -----
+
+
+def test_hbm_probe_structured_record():
+    from distributed_eigenspaces_tpu.utils.roofline import (
+        measure_hbm_anchor_probe,
+    )
+
+    out = measure_hbm_anchor_probe(sizes_mb=[1], base=2, ratio=2)
+    assert out["attempts"] and out["attempts"][0]["mb"] == 1
+    at = out["attempts"][0]
+    assert len(at["chain_lengths"]) == 3 and len(at["seconds"]) == 3
+    assert "est1_per_link_s" in at and "est2_per_link_s" in at
+    # success -> gb_per_sec; failure -> failed_check names the check
+    if out["gb_per_sec"] is None:
+        assert out["failed_check"] in (
+            "nonpositive_marginal", "estimates_disagree_2x"
+        )
+    else:
+        assert out["gb_per_sec"] > 0
+
+
+def test_roofline_fields_embeds_probe_failure_record():
+    from distributed_eigenspaces_tpu.utils.roofline import roofline_fields
+
+    record = {
+        "gb_per_sec": None,
+        "failed_check": "estimates_disagree_2x",
+        "attempts": [{"mb": 256, "chain_lengths": [24, 48, 72],
+                      "seconds": [0.1, 0.3, 0.2],
+                      "est1_per_link_s": 0.008,
+                      "est2_per_link_s": -0.004,
+                      "failed_check": "estimates_disagree_2x"}],
+    }
+    out = roofline_fields(
+        {"cold_flops_per_step": 10**9, "warm_flops_per_step": 10**8},
+        steps=3, fit_seconds=0.1, anchor_tflops=1.0,
+        byte_model={"cold_bytes_per_step": 10**7,
+                    "warm_bytes_per_step": 10**6},
+        hbm_anchor_gbps=float("nan"),
+        hbm_probe_record=record,
+    )
+    assert out["hbm_probe_failed"] is True
+    assert out["hbm_probe"]["failed_check"] == "estimates_disagree_2x"
+    assert out["hbm_probe"]["attempts"][0]["mb"] == 256
+    # the verdict fields stay absent — a failed probe must not fake one
+    assert "pct_of_hbm_anchor" not in out and "bound" not in out
