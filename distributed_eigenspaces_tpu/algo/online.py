@@ -116,6 +116,7 @@ def online_distributed_pca(
     worker_masks: Iterator[jax.Array] | None = None,
     max_steps: int | None | str = "auto",
     step_hook: Callable | None = None,
+    ingest_stats=None,
 ):
     """Run the full online algorithm over a stream of ``(m, n, d)`` blocks.
 
@@ -144,6 +145,11 @@ def online_distributed_pca(
         retry/backoff hook point (``runtime/supervisor.py``): it may
         re-invoke ``step_fn`` on transient failures or escalate. ``None``
         calls the step directly (zero overhead on the unsupervised path).
+      ingest_stats: optional ``runtime.prefetch.PrefetchStats`` — the
+        prefetch pipeline counts its queue stalls/occupancy into it, so
+        ingest-bound vs compute-bound is readable from the run report
+        (attach the same object to a ``MetricsLogger`` via
+        ``attach_ingest``). Ignored when ``cfg.prefetch_depth == 0``.
 
     Returns:
       ``(w, state)`` — ``w`` the final (dim, k) principal subspace estimate
@@ -161,7 +167,7 @@ def online_distributed_pca(
         return _fit_feature_sharded(
             stream, cfg, state=state, on_step=on_step,
             worker_masks=worker_masks, max_steps=max_steps,
-            step_hook=step_hook,
+            step_hook=step_hook, ingest_stats=ingest_stats,
         )
     if pool is None:
         pool = WorkerPool(
@@ -249,13 +255,14 @@ def online_distributed_pca(
     state = _drive_stream(
         stream, cfg, place=pool.shard, step=step, state=state,
         on_step=on_step, max_steps=max_steps, step_hook=step_hook,
+        ingest_stats=ingest_stats,
     )
     w = top_k_eigvecs(state.sigma_tilde, cfg.k)
     return w, state
 
 
 def _drive_stream(stream, cfg, *, place, step, state, on_step, max_steps,
-                  step_hook=None):
+                  step_hook=None, ingest_stats=None):
     """Shared training-loop scaffolding for the per-step backends: prefetch
     wiring, the step cap (open-ended for 1/t running means), step
     bookkeeping, and deterministic prefetch-producer cleanup.
@@ -276,7 +283,10 @@ def _drive_stream(stream, cfg, *, place, step, state, on_step, max_steps,
             prefetch_stream,
         )
 
-        stream = prefetch_stream(stream, depth=cfg.prefetch_depth, place=place)
+        stream = prefetch_stream(
+            stream, depth=cfg.prefetch_depth, place=place,
+            stats=ingest_stats,
+        )
 
     # function-level import: utils.__init__ pulls checkpoint, which imports
     # this module — a top-level import would cycle
@@ -320,6 +330,7 @@ def _fit_feature_sharded(
     worker_masks=None,
     max_steps="auto",
     step_hook=None,
+    ingest_stats=None,
 ):
     """The large-d backend behind :func:`online_distributed_pca`: routes the
     same stream/loop semantics through the feature-sharded training step
@@ -353,7 +364,7 @@ def _fit_feature_sharded(
     state = _drive_stream(
         stream, cfg, place=place, step=step,
         state=state, on_step=on_step, max_steps=max_steps,
-        step_hook=step_hook,
+        step_hook=step_hook, ingest_stats=ingest_stats,
     )
     w = canonicalize_signs(state.u[:, : cfg.k])
     return w, state

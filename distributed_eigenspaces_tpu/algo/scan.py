@@ -157,6 +157,19 @@ def _masked_body_factory(cfg, round_core, warm_core, axis_name, update):
     return body
 
 
+def make_masked_step_body(cfg, round_core, warm_core, axis_name, update):
+    """Public name of the masked per-step scan body
+    (:func:`_masked_body_factory`) for trainers OUTSIDE this module: the
+    fleet trainer (``parallel/fleet.py``) vmaps this exact body over the
+    tenant axis, so fleet-vs-solo §5.3 equivalence is equivalence of ONE
+    definition — a mask-semantics change here changes both trainers or
+    neither. (Under ``vmap`` the body's ``lax.cond`` cold/warm dispatch
+    lowers to ``select`` — both branches execute per tenant — which
+    keeps the cond's VALUES exactly and is why the masked fleet program
+    is the fault path, not the throughput path.)"""
+    return _masked_body_factory(cfg, round_core, warm_core, axis_name, update)
+
+
 def _make_interval_fit(cfg: PCAConfig, axis_name, update, gather: bool):
     """Unmasked whole-fit body for ``cfg.merge_interval > 1`` (pipeline
     off): every round solves (warm from the carried last-merged basis

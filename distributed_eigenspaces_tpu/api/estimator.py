@@ -25,7 +25,7 @@ from distributed_eigenspaces_tpu.algo.online import (
 from distributed_eigenspaces_tpu.data.stream import block_stream
 from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
 
-TRAINERS = ("auto", "step", "scan", "segmented", "sketch")
+TRAINERS = ("auto", "step", "scan", "segmented", "sketch", "fleet")
 
 
 def _scan_mesh(cfg: PCAConfig):
@@ -345,7 +345,7 @@ class OnlineDistributedPCA:
             )
         masks_whole = trainer != "step" and worker_masks is not None
         if self.checkpoint_dir is not None and (
-            trainer == "step"
+            trainer in ("step", "fleet")
             or (trainer == "scan" and not resolves_feature_sharded(cfg))
         ):
             # loud beats silent: a long fit that the user believes is
@@ -429,6 +429,32 @@ class OnlineDistributedPCA:
                 ),
                 stage,
             )
+
+        if trainer == "fleet":
+            # the solo fit AS a B=1 fleet program (parallel/fleet.py) —
+            # the explicit override that pins fleet-vs-solo equivalence
+            # through the public API (fleet serving's correctness
+            # contract), and the path a caller who will ALSO serve
+            # fleet traffic uses so solo and fleet results come from
+            # the same compiled cores
+            from distributed_eigenspaces_tpu.parallel.fleet import (
+                fit_fleet,
+            )
+
+            masks = None
+            if worker_masks is not None:
+                masks = [_validated_masks(worker_masks, cfg.num_workers)]
+            res = fit_fleet(
+                cfg, [np.asarray(data, np.float32)], mesh=None,
+                worker_masks=masks,
+            )
+            final = OnlineState(
+                sigma_tilde=res.states.sigma_tilde[0],
+                step=res.states.step[0],
+            )
+            self.state = final
+            self._w = jnp.asarray(res.components[0])
+            return self
 
         if trainer == "segmented":
             # stream windows — never materialize the full stack anywhere:

@@ -30,12 +30,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--mode",
-        choices=["fit", "oneshot", "master", "slave"],
+        choices=["fit", "fleet", "oneshot", "master", "slave"],
         default="fit",
-        help="fit: full online algorithm; oneshot: single merge round "
-        "(reference master parity); master is an alias of oneshot; "
-        "slave exists only to explain itself",
+        help="fit: full online algorithm; fleet: B independent fits as "
+        "ONE vmapped multi-tenant program (parallel/fleet.py — the "
+        "serving path; --fleet-size tenants, the dataset split into "
+        "per-tenant shards); oneshot: single merge round (reference "
+        "master parity); master is an alias of oneshot; slave exists "
+        "only to explain itself",
     )
+    p.add_argument("--fleet-size", type=int, default=8,
+                   help="B, tenants per fleet program for --mode fleet "
+                   "(the dataset is split into B tenant shards; the "
+                   "fleet axis shards over available devices as pure "
+                   "data parallelism)")
     p.add_argument("--broker", default=None,
                    help="ignored — no broker on a TPU mesh (kept for "
                    "reference CLI compatibility)")
@@ -169,6 +177,9 @@ def _load(args):
             seed=0,
         )
         n = args.workers * (args.rows_per_worker or 256) * args.steps
+        if args.mode == "fleet":
+            # every tenant shard must fill its own step schedule
+            n *= args.fleet_size
         data = np.asarray(spec.sample(jax.random.PRNGKey(1), n))
         return data, spec.top_k(args.rank)
     from distributed_eigenspaces_tpu.data.cifar import load_cifar10
@@ -721,6 +732,97 @@ def _fit_supervised(args, cfg, data, truth) -> int:
     return 0
 
 
+def _fit_fleet_cli(args, data, truth) -> int:
+    """``--mode fleet``: the dataset split into ``--fleet-size`` tenant
+    shards, fit as ONE vmapped multi-tenant program — the serving-path
+    demo (each shard is an independent tenant; per-tenant angles are
+    reported against the synthetic truth when available)."""
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+    from distributed_eigenspaces_tpu.parallel.fleet import FleetPCA
+
+    b = args.fleet_size
+    if b < 1:
+        print("error: --fleet-size must be >= 1", file=sys.stderr)
+        return 2
+    n_total, dim = data.shape
+    per_tenant = n_total // b
+    step_rows_min = args.workers  # at least 1 row per worker per step
+    if per_tenant < step_rows_min * args.steps:
+        print(
+            f"error: --fleet-size {b} leaves {per_tenant} rows per "
+            f"tenant; {args.workers} workers x {args.steps} steps need "
+            f"at least {step_rows_min * args.steps}",
+            file=sys.stderr,
+        )
+        return 2
+    rows = args.rows_per_worker or max(
+        1, per_tenant // (args.workers * args.steps)
+    )
+    cfg = PCAConfig(
+        dim=dim,
+        k=args.rank,
+        num_workers=args.workers,
+        rows_per_worker=rows,
+        num_steps=args.steps,
+        discount=args.discount,
+        solver=args.solver,
+        subspace_iters=args.subspace_iters,
+        orth_method=args.orth_method,
+        warm_orth_method=args.warm_orth_method,
+        compute_dtype=(
+            None if args.compute_dtype == "float32" else args.compute_dtype
+        ),
+        warm_start_iters=(
+            "auto" if args.warm_start_iters is None
+            else (None if args.warm_start_iters == 0
+                  else args.warm_start_iters)
+        ),
+        fleet_bucket_size=b,
+    )
+    problems = [
+        data[t * per_tenant : (t + 1) * per_tenant] for t in range(b)
+    ]
+    fleet = FleetPCA(cfg)
+    t0 = time.time()
+    fleet.fit(problems)
+    elapsed = time.time() - t0
+    out = {
+        "mode": "fleet",
+        "tenants": b,
+        "includes_compile": True,
+        "fits_per_sec": round(b / elapsed, 2),
+        "seconds": round(elapsed, 3),
+        "steps_per_tenant": args.steps,
+        "dim": dim,
+        "k": args.rank,
+    }
+    if truth is not None:
+        angles = [
+            round(
+                float(
+                    jnp.max(
+                        principal_angles_degrees(
+                            jnp.asarray(fleet.components_[t]), truth
+                        )
+                    )
+                ),
+                4,
+            )
+            for t in range(b)
+        ]
+        out["principal_angle_deg_max"] = max(angles)
+        out["principal_angle_deg"] = angles
+    print(json.dumps(out))
+    if args.save:
+        np.save(args.save, fleet.components_)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -850,6 +952,9 @@ def main(argv=None) -> int:
         if args.save:
             np.save(args.save, np.asarray(v_bar))
         return 0
+
+    if args.mode == "fleet":
+        return _fit_fleet_cli(args, data, truth)
 
     rows = args.rows_per_worker or max(
         1, n_total // (args.workers * args.steps)

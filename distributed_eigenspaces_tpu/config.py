@@ -133,6 +133,21 @@ class PCAConfig:
         and the feature-sharded exact step+scan trainers; the sketch
         trainer ignores it (its steady state has no per-step eigensolve
         to skip — that is its whole design).
+      fleet_bucket_size: B, the tenant capacity of one fleet program /
+        admission bucket (``parallel/fleet.py``, ``runtime/scheduler.py
+        ShapeBucketQueue``): independent fit requests sharing the exact
+        shape signature ``(d, k, m, n, T)`` accumulate into a bucket and
+        dispatch as ONE vmapped whole-fit program the moment the bucket
+        is full — B-fold amortization of the fixed per-program dispatch
+        cost, the multi-tenant serving lever (DrJAX-style mapped
+        clients). Partial buckets pad with inactive tenants so every
+        signature compiles exactly one program shape.
+      fleet_flush_s: admission deadline in seconds: a partially-full
+        bucket dispatches (padded) once its OLDEST request has waited
+        this long, so low-traffic signatures never starve behind the
+        batching window. ``0`` flushes every request immediately
+        (B-padded solo serving — maximum latency fairness, no
+        amortization).
       pipeline_merge: software-pipelined steady state for the whole-fit
         scan trainer (``algo/scan.py``): step ``t``'s warm worker
         solves run against the one-step-STALE merged basis (merges
@@ -174,6 +189,8 @@ class PCAConfig:
     collectives: str = "xla"
     merge_interval: int = 1
     pipeline_merge: bool = False
+    fleet_bucket_size: int = 8
+    fleet_flush_s: float = 0.1
     seed: int = 0
 
     def __post_init__(self):
@@ -253,6 +270,17 @@ class PCAConfig:
                     "pipeline overlaps the merge with the NEXT step's "
                     "warm solves from a one-step-stale basis"
                 )
+        if not isinstance(self.fleet_bucket_size, int) or isinstance(
+            self.fleet_bucket_size, bool
+        ) or self.fleet_bucket_size < 1:
+            raise ValueError(
+                f"fleet_bucket_size must be an int >= 1, got "
+                f"{self.fleet_bucket_size!r}"
+            )
+        if self.fleet_flush_s < 0:
+            raise ValueError(
+                f"fleet_flush_s must be >= 0, got {self.fleet_flush_s}"
+            )
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")
         if self.prefetch_depth < 0:

@@ -21,4 +21,7 @@ __all__ = [
     "replicated_sharding",
     "WorkerPool",
     "multihost",
+    # fleet serving (parallel/fleet.py) is imported lazily by callers —
+    # its module pulls the whole-fit stack, which this package's own
+    # modules feed; an eager import here would cycle
 ]

@@ -1,0 +1,696 @@
+"""Fleet serving: vmapped multi-tenant batched fits (ISSUE 3 tentpole).
+
+The ROADMAP's north star is heavy PCA traffic from millions of users,
+but one ``OnlineDistributedPCA.fit`` occupies the whole program: every
+request pays the fixed per-program dispatch cost (BENCH_r05 measured
+~90 ms over the tunneled dev link), and a small-d/k fit leaves the MXU
+nearly idle. DrJAX (arXiv:2403.07128) maps many independent clients
+through one vmapped JAX program; the TPU distributed-linear-algebra
+line (arXiv:2112.09017) shows dense small-problem batches are where
+TPUs earn their keep. This module is that serving layer:
+
+- :func:`make_fleet_fit` — B independent whole fits sharing one shape
+  signature ``(d, k, m, n, T)`` stacked along a leading FLEET axis and
+  run as ONE compiled scan-over-T with every per-problem core
+  (cold Gram / warm streaming solves / low-rank merge / state fold)
+  ``vmap``-ed over tenants. Dispatch is paid once for B fits, and the
+  stacked tall-skinny matmuls fill the MXU the way one small fit never
+  could.
+- Ragged schedules and early-finishing tenants ride a per-tenant
+  ``(B, T)`` ACTIVE mask: an inactive step's solves still execute (SPMD
+  has no per-lane early exit) but the tenant's carry — online state,
+  step counter, warm basis — is frozen by a select, so its result is
+  exactly its own T_b-step fit. Per-tenant ``(B, T, m)`` worker masks
+  run the §5.3 fault exclusion through the SAME masked step body the
+  solo masked scan uses (``algo.scan.make_masked_step_body``), so
+  fleet-vs-solo equivalence is equivalence of one definition.
+- The fleet axis shards across the mesh as PURE data parallelism
+  (:func:`fleet_mesh` reuses the ``workers`` mesh axis for tenants):
+  every op is per-tenant, so the partitioned program contains no
+  cross-tenant collectives at all — machine-checked by
+  ``utils.collectives_audit`` in tests/test_fleet.py.
+- :class:`FleetServer` — the admission front door: requests accumulate
+  into exact-signature buckets (``runtime.scheduler.ShapeBucketQueue``)
+  that dispatch when FULL (``cfg.fleet_bucket_size``) or on a deadline
+  (``cfg.fleet_flush_s``); partial buckets pad with inactive tenants so
+  each signature compiles exactly one program shape, and bucket
+  execution inherits the WorkQueue's lease/retry semantics.
+
+Solo fits are the B=1 special case: ``OnlineDistributedPCA`` with
+``trainer="fleet"`` routes through this module (api/estimator.py), and
+tests pin per-problem principal angles to the solo scan trainer's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_eigenspaces_tpu.algo.online import OnlineState, update_state
+from distributed_eigenspaces_tpu.algo.step import (
+    make_round_core,
+    make_warm_core,
+)
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.parallel.mesh import (
+    WORKER_AXIS,
+    largest_divisor_leq,
+    make_mesh,
+    shard_map,
+)
+
+__all__ = [
+    "FleetBatch",
+    "FleetResult",
+    "FleetServer",
+    "FleetPCA",
+    "fleet_mesh",
+    "fleet_signature",
+    "fit_fleet",
+    "init_fleet_states",
+    "make_fleet_fit",
+    "stage_fleet",
+]
+
+
+def fleet_signature(cfg: PCAConfig) -> tuple:
+    """The exact shape signature ``(d, k, m, n, T)`` two requests must
+    share to ride one fleet program (the admission bucket key's shape
+    half — :class:`FleetServer` adds the full config, since solver
+    knobs change the compiled program too)."""
+    return (
+        cfg.dim, cfg.k, cfg.num_workers, cfg.rows_per_worker,
+        cfg.num_steps,
+    )
+
+
+def _tree_where(pred, new, old):
+    """Per-tenant carry freeze: select ``new`` where ``pred`` (a scalar
+    bool per vmap lane) else ``old``, leafwise. ``where`` never
+    propagates values from the unselected branch, so a frozen tenant is
+    untouched even when the discarded solve produced NaN (e.g. a warm
+    orthonormalization of the zero basis a never-live tenant carries)."""
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), new, old
+    )
+
+
+def make_fleet_fit(cfg: PCAConfig, mesh=None, *, masked: bool = False):
+    """Build the vmapped B-tenant whole-fit trainer, jitted.
+
+    Returns ``fit(states, xs, actives) -> (states, v_bars)`` — or, with
+    ``masked=True``, ``fit(states, xs, masks, actives)`` — where
+
+    - ``states``: batched :class:`OnlineState` (``sigma_tilde (B, d, d)``,
+      ``step (B,)``) — :func:`init_fleet_states`;
+    - ``xs``: ``(B, T, m, n, d)`` stacked per-tenant step schedules
+      (:func:`stage_fleet` pads ragged tails with finite placeholder
+      blocks);
+    - ``actives``: ``(B, T)`` {0,1} — step t advances tenant b's carry
+      iff ``actives[b, t]``; a frozen step's solves are computed and
+      discarded (SPMD lanes can't exit early), its ``v_bars[b, t]`` is
+      the carried basis;
+    - ``masks``: ``(B, T, m)`` {0,1} per-tenant worker masks, running
+      the solo masked scan's exact step body
+      (``algo.scan.make_masked_step_body``) per tenant.
+
+    The unmasked build is the throughput path: the solo warm schedule
+    (cold full-iteration step 1, warm short-iteration steps after)
+    vmapped over tenants — all tenants in a bucket START together, so
+    the cold/warm phase is uniform across the fleet and no per-tenant
+    branch is needed. The masked build pays the cond-lowers-to-select
+    cost per step (fault path, not throughput path — same trade the
+    solo masked trainers make).
+
+    ``mesh`` (from :func:`fleet_mesh`) shards the FLEET axis over the
+    ``workers`` mesh axis as pure data parallelism: every op is
+    per-tenant, so the partitioned program needs no collectives —
+    composing with ``parallel/mesh`` without new communication
+    (audited in tests/test_fleet.py via ``utils.collectives_audit``).
+
+    The steady-state restructure knobs are rejected loudly:
+    ``pipeline_merge`` (a pending-factor carry per tenant does not
+    compose with the per-tenant freeze) and ``merge_interval > 1``
+    (tenants at different ragged phases would need per-tenant merge
+    schedules) — solo trainers keep both.
+    """
+    from distributed_eigenspaces_tpu.utils.guards import checked_jit
+
+    if cfg.pipeline_merge:
+        raise ValueError(
+            "fleet fits do not support pipeline_merge: the pipelined "
+            "pending-factor carry does not compose with the per-tenant "
+            "ragged-T freeze (use the solo scan trainer for pipelined "
+            "fits)"
+        )
+    if cfg.merge_interval != 1:
+        raise ValueError(
+            "fleet fits run the s=1 per-step merge: ragged tenants sit "
+            "at different schedule phases, so a shared merge interval "
+            "would change per-tenant results (use the solo trainers "
+            "for merge_interval > 1)"
+        )
+
+    round_core = make_round_core(cfg)
+    warm_core = make_warm_core(cfg)
+    warm = warm_core is not None
+    d, k = cfg.dim, cfg.k
+
+    def update(st, v_bar):
+        return update_state(
+            st, v_bar, discount=cfg.discount, num_steps=cfg.num_steps
+        )
+
+    if masked:
+        from distributed_eigenspaces_tpu.algo.scan import (
+            make_masked_step_body,
+        )
+
+        mbody = make_masked_step_body(
+            cfg, round_core, warm_core, None, update
+        )
+
+        def fit_one(state, x_steps, masks, active):
+            vp0 = jnp.zeros((d, k), jnp.float32)
+
+            def body(carry, xma):
+                x, mk, act = xma
+                new_carry, v = mbody(carry, x, mk)
+                keep = act != 0
+                carry = _tree_where(keep, new_carry, carry)
+                # a frozen step reports the carried basis (finite by
+                # construction), never the discarded solve
+                return carry, jnp.where(keep, v, carry[1])
+
+            (st, _), v_bars = jax.lax.scan(
+                body,
+                (state, vp0),
+                (x_steps, masks.astype(jnp.float32),
+                 active.astype(jnp.float32)),
+            )
+            return st, v_bars
+
+    elif warm:
+
+        def fit_one(state, x_steps, active):
+            # step 1: cold at the full iteration count — every tenant in
+            # a bucket starts together, so the phase is fleet-uniform
+            keep0 = active[0] != 0
+            v0 = round_core(x_steps[0])
+            st = _tree_where(keep0, update(state, v0), state)
+            vp = jnp.where(keep0, v0, jnp.zeros((d, k), jnp.float32))
+
+            def body(carry, xa):
+                x, act = xa
+                st, vp = carry
+                v = warm_core(x, v0=vp)
+                keep = act != 0
+                st = _tree_where(keep, update(st, v), st)
+                vp = jnp.where(keep, v, vp)
+                return (st, vp), vp
+
+            (st, _), vs = jax.lax.scan(
+                body, (st, vp),
+                (x_steps[1:], active[1:].astype(jnp.float32)),
+            )
+            return st, jnp.concatenate(
+                [jnp.where(keep0, v0, 0.0)[None], vs], axis=0
+            )
+
+    else:
+
+        def fit_one(state, x_steps, active):
+            def body(st, xa):
+                x, act = xa
+                v = round_core(x)
+                keep = act != 0
+                st = _tree_where(keep, update(st, v), st)
+                return st, jnp.where(keep, v, jnp.zeros_like(v))
+
+            return jax.lax.scan(
+                body, state, (x_steps, active.astype(jnp.float32))
+            )
+
+    fit_b = jax.vmap(fit_one)
+
+    if mesh is None:
+        return checked_jit(fit_b)
+
+    # pure data parallelism over the fleet axis, as a shard_map: each
+    # device runs its B/W tenants' whole fits locally and the axis name
+    # is never used, so the program contains ZERO collectives by
+    # construction (audited in tests/test_fleet.py). Left to the auto
+    # partitioner instead, the per-tenant eigh custom-calls — which SPMD
+    # cannot partition — get replicated via batch all-gathers, exactly
+    # the cross-tenant traffic a fleet must not pay.
+    fleet_sh = NamedSharding(mesh, P(WORKER_AXIS))
+    n_in = 4 if masked else 3
+    inner = shard_map(
+        fit_b,
+        mesh=mesh,
+        in_specs=(P(WORKER_AXIS),) * n_in,
+        out_specs=(P(WORKER_AXIS), P(WORKER_AXIS)),
+        check_vma=False,
+    )
+    return checked_jit(
+        inner,
+        in_shardings=(fleet_sh,) * n_in,
+        out_shardings=(fleet_sh, fleet_sh),
+    )
+
+
+def init_fleet_states(cfg: PCAConfig, b: int) -> OnlineState:
+    """Batched initial online state for a B-tenant fleet."""
+    return OnlineState(
+        sigma_tilde=jnp.zeros((b, cfg.dim, cfg.dim), cfg.state_dtype),
+        step=jnp.zeros((b,), jnp.int32),
+    )
+
+
+def fleet_mesh(b: int, devices=None):
+    """DP mesh for a B-tenant fleet, or None on one device: tenants
+    shard over the (reused) ``workers`` mesh axis — the fleet axis IS a
+    worker axis, one tenant's whole fit per slot — sized to the largest
+    divisor of B the device count allows."""
+    if devices is None:
+        devices = jax.devices()
+    shards = largest_divisor_leq(b, len(devices))
+    if shards <= 1:
+        return None
+    return make_mesh(num_workers=shards, devices=devices)
+
+
+def _placeholder_block(m: int, n: int, d: int) -> np.ndarray:
+    """Finite, well-conditioned padding for inactive steps/tenants: the
+    supervisor's cycled-identity placeholder rows, broadcast to a full
+    block. NOT zeros — a warm CholeskyQR on an all-zero block is NaN,
+    and although the per-tenant freeze discards those lanes, finite
+    padding keeps the discarded arithmetic clean for the §5.2 NaN
+    guards (DET_CHECKIFY) too."""
+    from distributed_eigenspaces_tpu.runtime.supervisor import Supervisor
+
+    rows = Supervisor._placeholder(n, d, np.float32)
+    return np.broadcast_to(rows[None], (m, n, d))
+
+
+def _tenant_blocks(cfg: PCAConfig, problem) -> Iterable[np.ndarray]:
+    """One tenant's ``(m, n, d)`` step blocks from any accepted problem
+    form: an ``(N, d)`` dataset (block-streamed exactly like the solo
+    estimator stages), a pre-blocked ``(T_b, m, n, d)`` stack, or an
+    iterable of blocks (e.g. a ChaosStream)."""
+    if hasattr(problem, "ndim") and problem.ndim == 2:
+        from distributed_eigenspaces_tpu.data.stream import block_stream
+
+        return block_stream(
+            np.asarray(problem),
+            num_workers=cfg.num_workers,
+            rows_per_worker=cfg.rows_per_worker,
+            num_steps=cfg.num_steps,
+            remainder=cfg.remainder,
+            device=False,
+        )
+    if hasattr(problem, "ndim"):
+        if problem.ndim != 4:
+            raise ValueError(
+                f"tenant problem array must be (N, d) or (T, m, n, d), "
+                f"got shape {problem.shape}"
+            )
+        return iter(np.asarray(problem))
+    return iter(problem)
+
+
+@dataclasses.dataclass
+class FleetBatch:
+    """One staged fleet dispatch: B tenants stacked along axis 0,
+    padded to a common T (and optionally to a common bucket size B_pad
+    with fully-inactive tenants)."""
+
+    xs: np.ndarray  # (B_pad, T, m, n, d)
+    actives: np.ndarray  # (B_pad, T) {0,1}
+    masks: np.ndarray | None  # (B_pad, T, m) {0,1}; None = unmasked
+    n_tenants: int  # real tenants (<= B_pad; the rest is padding)
+    signature: tuple
+
+    @property
+    def fleet_size(self) -> int:
+        return self.xs.shape[0]
+
+
+def stage_fleet(
+    cfg: PCAConfig,
+    problems: Sequence[Any],
+    *,
+    worker_masks=None,
+    supervisor=None,
+    pad_to: int | None = None,
+) -> FleetBatch:
+    """Stage B tenant problems into one fleet batch.
+
+    Ragged schedules are handled here: a tenant whose data yields
+    ``T_b < cfg.num_steps`` blocks gets placeholder padding and an
+    inactive tail (its result is exactly its own T_b-step fit — the
+    trainer freezes its carry). ``worker_masks`` is an optional
+    per-tenant sequence of ``(T_b, m)`` mask schedules (entries may be
+    None for all-live tenants). ``supervisor`` (a
+    ``runtime.supervisor.Supervisor``) screens every tenant block
+    through the quarantine boundary check — per-worker corruption
+    becomes that TENANT's worker-mask drop, ledgered with its tenant
+    index, and a tenant whose stream dies with
+    ``utils.faults.KillSwitch`` is quarantined whole (its remaining
+    steps go inactive, kind="tenant_killed") WITHOUT taking down the
+    other tenants' fits. ``pad_to`` pads the fleet axis with
+    fully-inactive tenants so partial admission buckets reuse the
+    full-bucket compiled program.
+    """
+    from distributed_eigenspaces_tpu.utils.faults import KillSwitch
+
+    b_real = len(problems)
+    if b_real == 0:
+        raise ValueError("stage_fleet needs at least one tenant")
+    b_pad = max(b_real, pad_to or 0)
+    m, n, d, t_max = (
+        cfg.num_workers, cfg.rows_per_worker, cfg.dim, cfg.num_steps,
+    )
+    if worker_masks is not None and len(worker_masks) != b_real:
+        raise ValueError(
+            f"worker_masks covers {len(worker_masks)} tenants, fleet "
+            f"has {b_real}"
+        )
+
+    ph = _placeholder_block(m, n, d)
+    xs = np.empty((b_pad, t_max, m, n, d), np.float32)
+    actives = np.zeros((b_pad, t_max), np.float32)
+    masks = np.ones((b_pad, t_max, m), np.float32)
+    any_mask = worker_masks is not None or supervisor is not None
+
+    for b, problem in enumerate(problems):
+        base = None if worker_masks is None else worker_masks[b]
+        if base is not None:
+            base = np.asarray(base, np.float32)
+            if base.ndim != 2 or base.shape[1] != m:
+                raise ValueError(
+                    f"tenant {b} worker_masks shape {base.shape} != "
+                    f"(T, num_workers={m})"
+                )
+        it = _tenant_blocks(cfg, problem)
+        t = 0
+        while t < t_max:
+            try:
+                block = next(it)
+            except StopIteration:
+                break
+            except KillSwitch as e:
+                if supervisor is None:
+                    raise
+                # hard tenant death: quarantine the WHOLE tenant from
+                # this step on — the fleet's other tenants never notice
+                supervisor.record(
+                    "tenant_killed", t + 1, tenant=b, error=repr(e)
+                )
+                break
+            base_row = None
+            if base is not None:
+                if t >= len(base):
+                    raise ValueError(
+                        f"tenant {b} worker_masks covers {len(base)} "
+                        f"steps; its schedule reached step {t + 1} — "
+                        "every step needs its mask row"
+                    )
+                base_row = base[t]
+            if supervisor is not None:
+                screened = supervisor.screen_block(
+                    block, t + 1, base_mask=base_row, tenant=b
+                )
+                if screened is None:
+                    continue  # dropped round: same step, next block
+                block, mask_row = screened
+            else:
+                mask_row = (
+                    np.ones(m, np.float32) if base_row is None
+                    else base_row
+                )
+            block = np.asarray(block, np.float32)
+            if block.shape != (m, n, d):
+                raise ValueError(
+                    f"tenant {b} step {t + 1} block shape {block.shape}"
+                    f" != ({m}, {n}, {d})"
+                )
+            xs[b, t] = block
+            masks[b, t] = mask_row
+            actives[b, t] = 1.0
+            t += 1
+        if t == 0 and supervisor is None:
+            raise ValueError(f"tenant {b} yielded zero full steps")
+        xs[b, t:] = ph
+    xs[b_real:] = ph
+
+    return FleetBatch(
+        xs=xs,
+        actives=actives,
+        masks=masks if any_mask else None,
+        n_tenants=b_real,
+        signature=fleet_signature(cfg),
+    )
+
+
+@dataclasses.dataclass
+class FleetResult:
+    """Per-tenant results of one fleet dispatch (padding dropped)."""
+
+    components: np.ndarray  # (B, d, k), descending, canonical signs
+    states: OnlineState  # batched final online states (B real tenants)
+    v_bars: np.ndarray  # (B, T, d, k) per-step merged bases
+    batch: FleetBatch
+
+    def __len__(self) -> int:
+        return len(self.components)
+
+
+def _make_extract_fleet(cfg: PCAConfig):
+    """Vmapped dense extraction — the solo ``extract_dense`` definition
+    per tenant (same solver/orthonormalization dispatch), jitted once
+    per cached build (``fit_cache``) so steady-state buckets reuse it."""
+    from distributed_eigenspaces_tpu.api.runner import extract_dense
+
+    return jax.jit(jax.vmap(lambda s: extract_dense(cfg, s)))
+
+
+def fit_fleet(
+    cfg: PCAConfig,
+    problems: Sequence[Any],
+    *,
+    mesh="auto",
+    worker_masks=None,
+    supervisor=None,
+    pad_to: int | None = None,
+    fit_cache: dict | None = None,
+) -> FleetResult:
+    """Fit B independent problems sharing ``cfg``'s shape signature as
+    ONE compiled fleet program; returns per-tenant results matching the
+    solo-fit path numerically (tested per-problem principal-angle
+    equivalence).
+
+    ``mesh="auto"`` shards the fleet axis over available devices
+    (:func:`fleet_mesh`); pass ``None`` to force single-device, or an
+    explicit mesh. ``fit_cache`` (a dict the caller owns) reuses
+    compiled programs across calls keyed by (config, variant, B, mesh)
+    — the :class:`FleetServer` passes its own so steady-state buckets
+    never recompile.
+    """
+    batch = stage_fleet(
+        cfg, problems, worker_masks=worker_masks, supervisor=supervisor,
+        pad_to=pad_to,
+    )
+    b_pad = batch.fleet_size
+    masked = batch.masks is not None
+    if mesh == "auto":
+        mesh = fleet_mesh(b_pad)
+    if mesh is not None and b_pad % mesh.shape[WORKER_AXIS]:
+        raise ValueError(
+            f"fleet size {b_pad} not divisible by the mesh fleet axis "
+            f"{mesh.shape[WORKER_AXIS]}"
+        )
+
+    key = (
+        repr(cfg), masked, b_pad,
+        None if mesh is None else tuple(mesh.shape.items()),
+    )
+    if fit_cache is not None and key in fit_cache:
+        fit, extract = fit_cache[key]
+    else:
+        fit = make_fleet_fit(cfg, mesh, masked=masked)
+        extract = _make_extract_fleet(cfg)
+        if fit_cache is not None:
+            fit_cache[key] = (fit, extract)
+
+    states = init_fleet_states(cfg, b_pad)
+    xs = jnp.asarray(batch.xs)
+    actives = jnp.asarray(batch.actives)
+    if mesh is not None:
+        sh = NamedSharding(mesh, P(WORKER_AXIS))
+        states = jax.device_put(states, sh)
+        xs = jax.device_put(xs, sh)
+        actives = jax.device_put(actives, sh)
+    if masked:
+        mk = jnp.asarray(batch.masks)
+        if mesh is not None:
+            mk = jax.device_put(mk, sh)
+        states, v_bars = fit(states, xs, mk, actives)
+    else:
+        states, v_bars = fit(states, xs, actives)
+
+    # extraction runs at the PADDED width (one compiled shape per
+    # signature regardless of how full the bucket was); padding lanes
+    # carry a zero state whose extraction is garbage by construction —
+    # they are dropped here, never returned
+    nreal = batch.n_tenants
+    w = extract(states.sigma_tilde)
+    states = jax.tree_util.tree_map(lambda a: a[:nreal], states)
+    return FleetResult(
+        components=np.asarray(w)[:nreal],
+        states=states,
+        v_bars=np.asarray(v_bars[:nreal]),
+        batch=batch,
+    )
+
+
+class FleetPCA:
+    """Multi-tenant estimator: B independent datasets, one compiled
+    program, per-tenant components — the fleet twin of
+    ``OnlineDistributedPCA`` (whose solo fit is the B=1 special case,
+    ``trainer="fleet"``).
+
+    Example::
+
+        fleet = FleetPCA(PCAConfig(dim=256, k=4, num_workers=4,
+                                   rows_per_worker=128, num_steps=8))
+        fleet.fit([data_a, data_b, data_c])      # each (N_b, 256)
+        z = fleet.transform(1, data_b)           # tenant 1's projection
+    """
+
+    def __init__(self, cfg: PCAConfig, *, mesh="auto"):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.result: FleetResult | None = None
+        self._fit_cache: dict = {}
+
+    def fit(self, problems, *, worker_masks=None,
+            supervisor=None) -> "FleetPCA":
+        self.result = fit_fleet(
+            self.cfg, problems, mesh=self.mesh,
+            worker_masks=worker_masks, supervisor=supervisor,
+            fit_cache=self._fit_cache,
+        )
+        return self
+
+    @property
+    def components_(self) -> np.ndarray:
+        """(B, d, k) per-tenant principal directions."""
+        if self.result is None:
+            raise RuntimeError("call fit() first")
+        return self.result.components
+
+    def transform(self, tenant: int, x) -> jax.Array:
+        x = jnp.asarray(x, dtype=self.cfg.dtype)
+        return x @ jnp.asarray(self.components_[tenant]).astype(x.dtype)
+
+
+@dataclasses.dataclass
+class _FleetRequest:
+    cfg: PCAConfig
+    problem: Any
+    worker_masks: Any = None
+
+
+class FleetServer:
+    """Shape-bucketed admission + vmapped dispatch: the serving loop.
+
+    ``submit(data)`` returns a ticket that resolves to the tenant's
+    ``(d, k)`` components once its bucket has executed. Buckets key on
+    the EXACT config (shape signature + solver knobs — anything that
+    changes the compiled program); a bucket dispatches when full
+    (``cfg.fleet_bucket_size`` requests — one program, B-fold dispatch
+    amortization) or when its oldest request has waited
+    ``cfg.fleet_flush_s`` seconds, padded with inactive tenants so the
+    full-bucket program is reused. Dispatch lanes inherit the
+    WorkQueue's lease/retry semantics (``runtime/scheduler.py``).
+    """
+
+    def __init__(
+        self,
+        cfg: PCAConfig,
+        *,
+        mesh="auto",
+        num_lanes: int = 1,
+        max_retries: int = 3,
+        lease_timeout: float | None = None,
+    ):
+        from distributed_eigenspaces_tpu.runtime.scheduler import (
+            ShapeBucketQueue,
+        )
+
+        self.cfg = cfg
+        self.mesh = mesh
+        self.queue = ShapeBucketQueue(
+            bucket_size=cfg.fleet_bucket_size,
+            flush_deadline=cfg.fleet_flush_s,
+            max_retries=max_retries,
+            lease_timeout=lease_timeout,
+        )
+        self._fit_cache: dict = {}
+        self._thread = threading.Thread(
+            target=self.queue.serve,
+            args=(self._fit_bucket,),
+            kwargs={"num_lanes": max(num_lanes, 1)},
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- client API ----------------------------------------------------------
+
+    def submit(self, problem, *, cfg: PCAConfig | None = None,
+               worker_masks=None):
+        """Admit one fit request; returns its
+        :class:`~..runtime.scheduler.FleetTicket` (``.result()`` blocks
+        for the tenant's ``(d, k)`` components)."""
+        cfg = self.cfg if cfg is None else cfg
+        sig = (fleet_signature(cfg), repr(cfg))
+        return self.queue.submit(
+            sig, _FleetRequest(cfg, problem, worker_masks)
+        )
+
+    def close(self) -> None:
+        """Flush partial buckets, drain, and join the dispatch lanes."""
+        self.queue.close()
+        self._thread.join()
+
+    def __enter__(self) -> "FleetServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _fit_bucket(self, bucket) -> list:
+        reqs = [t.payload for t in bucket.tickets]
+        cfg = reqs[0].cfg
+        masks = (
+            [r.worker_masks for r in reqs]
+            if any(r.worker_masks is not None for r in reqs) else None
+        )
+        result = fit_fleet(
+            cfg,
+            [r.problem for r in reqs],
+            mesh=self.mesh,
+            worker_masks=masks,
+            pad_to=cfg.fleet_bucket_size,
+            fit_cache=self._fit_cache,
+        )
+        return [result.components[i] for i in range(len(reqs))]

@@ -10,6 +10,7 @@ producer in a thread and keeping ``depth`` blocks in flight.
 
 from __future__ import annotations
 
+import dataclasses
 import queue
 import threading
 from typing import Callable, Iterable, Iterator
@@ -17,11 +18,55 @@ from typing import Callable, Iterable, Iterator
 import jax
 
 
+@dataclasses.dataclass
+class PrefetchStats:
+    """Ingest-pipeline health counters for one prefetched stream.
+
+    The question a fleet operator actually asks is "is this run
+    ingest-bound or compute-bound?", and these two counters answer it
+    structurally: a consumer pull that found the queue EMPTY is a
+    ``stall`` (the device waited on the host — ingest-bound), while a
+    producer push that found the queue FULL is a ``producer_wait`` (the
+    host ran ahead of the device — compute-bound, which is where a
+    healthy pipeline lives). ``occupancy_sum / yields`` is the mean
+    queue depth seen by the consumer — near ``depth`` means the
+    prefetcher is doing its job. Attach to a ``MetricsLogger`` via
+    :meth:`~..utils.metrics.MetricsLogger.attach_ingest` and the
+    counters land in ``summary()["ingest"]``.
+    """
+
+    depth: int = 0
+    yields: int = 0  # blocks delivered to the consumer
+    stalls: int = 0  # consumer pulls that found the queue empty
+    occupancy_sum: int = 0  # queue depth summed at each consumer pull
+    producer_waits: int = 0  # producer pushes that found the queue full
+
+    def as_dict(self) -> dict:
+        out = {
+            "depth": self.depth,
+            "yields": self.yields,
+            "stalls": self.stalls,
+            "producer_waits": self.producer_waits,
+        }
+        if self.yields:
+            out["stall_fraction"] = round(self.stalls / self.yields, 4)
+            out["mean_occupancy"] = round(
+                self.occupancy_sum / self.yields, 3
+            )
+            # the one-word verdict the counters exist for
+            out["verdict"] = (
+                "ingest_bound" if self.stalls > self.yields // 2
+                else "compute_bound"
+            )
+        return out
+
+
 def prefetch_stream(
     stream: Iterable,
     *,
     depth: int = 2,
     place: Callable | None = None,
+    stats: PrefetchStats | None = None,
 ) -> Iterator:
     """Wrap a block stream with background production + device placement.
 
@@ -37,6 +82,10 @@ def prefetch_stream(
     Note the producer reads AHEAD: up to ``depth + 1`` items may already be
     consumed from the underlying iterable when the consumer stops — don't
     share that iterable with other readers unless prefetching is disabled.
+
+    ``stats`` (a :class:`PrefetchStats`) counts queue stalls and
+    occupancy as the stream runs, so ingest-bound vs compute-bound is
+    diagnosable from the run report instead of a profiler session.
     """
     if depth < 1:
         raise ValueError("depth must be >= 1")
@@ -44,9 +93,15 @@ def prefetch_stream(
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
     _END = object()
+    if stats is not None:
+        stats.depth = depth
 
     def q_put(item) -> bool:
         """Bounded put that gives up when the consumer is gone."""
+        if stats is not None and q.full():
+            # counted once per item: the host produced into a full
+            # queue — it ran AHEAD of the device (compute-bound)
+            stats.producer_waits += 1
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
@@ -70,11 +125,19 @@ def prefetch_stream(
     def gen():
         try:
             while True:
+                occ = q.qsize() if stats is not None else 0
                 item = q.get()
                 if item is _END:
                     return
                 if isinstance(item, BaseException):
                     raise item
+                if stats is not None:
+                    # committed only for real blocks: the end-of-stream
+                    # sentinel pull is not a stall anyone can fix
+                    stats.yields += 1
+                    stats.occupancy_sum += occ
+                    if occ == 0:
+                        stats.stalls += 1
                 yield item
         finally:
             # consumer finished or abandoned us: release the producer

@@ -62,12 +62,13 @@ class WorkQueue:
 
     def __init__(
         self,
-        payloads: Sequence[Any],
+        payloads: Sequence[Any] = (),
         *,
         prefetch_depth: int = 5,
         order: str = "fifo",
         max_retries: int = 3,
         lease_timeout: float | None = None,
+        open_ended: bool = False,
     ):
         if order not in ("fifo", "lifo"):
             raise ValueError(f"unknown order: {order!r}")
@@ -77,8 +78,12 @@ class WorkQueue:
             TaskRecord(task_id=i, payload=p) for i, p in enumerate(payloads)
         ]
         # reference seeds exactly min(5, ...) — here depth is clamped, so
-        # fewer tasks than the prefetch depth is fine (B5 fix)
-        self.prefetch_depth = min(prefetch_depth, max(len(self.records), 1))
+        # fewer tasks than the prefetch depth is fine (B5 fix). An
+        # open-ended queue can't clamp to a count it doesn't know yet.
+        self.prefetch_depth = (
+            prefetch_depth if open_ended
+            else min(prefetch_depth, max(len(self.records), 1))
+        )
         self.order = order
         self.max_retries = max_retries
         self.lease_timeout = lease_timeout
@@ -87,6 +92,31 @@ class WorkQueue:
         # task_id -> (lease deadline, attempt number that holds the lease)
         self._leases: dict[int, tuple[float, int]] = {}
         self._failed: Exception | None = None
+        # open-ended queues accept add_task() until close(); a static
+        # queue is born closed, so every pre-existing behavior — acquire
+        # returning None the moment all seeded tasks complete — is
+        # untouched (the fleet admission path is the open-ended consumer)
+        self._closed = not open_ended
+
+    def add_task(self, payload: Any) -> int:
+        """Append one task to an open-ended queue (admission path);
+        returns its task id. Raises on a closed queue — a task fed after
+        close() would be silently unreachable to already-exiting lanes."""
+        with self._lock:
+            if self._closed:
+                raise SchedulerError("add_task on a closed WorkQueue")
+            rec = TaskRecord(task_id=len(self.records), payload=payload)
+            self.records.append(rec)
+            self._pending.append(rec.task_id)
+            self._lock.notify_all()
+            return rec.task_id
+
+    def close(self) -> None:
+        """No more add_task(): once the current tasks complete, acquire
+        returns None and run() lanes exit. Idempotent."""
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
 
     # -- lane-facing API -----------------------------------------------------
 
@@ -102,7 +132,7 @@ class WorkQueue:
                 if self._failed is not None:
                     raise self._failed
                 self._expire_leases_locked()
-                if self._all_done_locked():
+                if self._closed and self._all_done_locked():
                     self._lock.notify_all()
                     return None
                 if self._pending:
@@ -254,6 +284,232 @@ class WorkQueue:
         if errors:
             raise errors[0]
         return [r.result for r in self.records]
+
+
+class FleetTicket:
+    """One admitted fit request: resolves to its per-tenant result (or
+    the dispatch error) once the bucket it rode in has executed."""
+
+    def __init__(self, signature, payload: Any):
+        self.signature = signature
+        self.payload = payload
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Exception | None = None
+
+    def resolve(self, result: Any) -> None:
+        self._result = result
+        self._event.set()
+
+    def fail(self, exc: Exception) -> None:
+        self._error = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("fleet ticket not resolved in time")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclasses.dataclass
+class Bucket:
+    """One dispatch unit of the fleet admission queue: up to
+    ``bucket_size`` same-signature tickets, executed as ONE batched
+    program (``parallel/fleet.py`` stacks them along the fleet axis)."""
+
+    signature: Any
+    tickets: list[FleetTicket]
+
+    def __len__(self) -> int:
+        return len(self.tickets)
+
+
+class ShapeBucketQueue:
+    """Shape-bucketed admission over an open-ended :class:`WorkQueue`.
+
+    The fleet serving layer's front door (ISSUE 3): requests accumulate
+    into EXACT-signature buckets — the signature is whatever hashable
+    key the caller derives from the problem shape, canonically
+    ``(d, k, m, n, T)`` plus the solver config (``parallel/fleet.py
+    fleet_signature``) — and a bucket dispatches into the work queue
+    when it is FULL (``bucket_size`` requests: maximal dispatch
+    amortization) or when its OLDEST request has waited
+    ``flush_deadline`` seconds (no starvation for low-traffic shapes).
+    Dispatch itself rides the existing WorkQueue machinery, so the
+    lease-timeout liveness, bounded retries, and idempotent completion
+    the scheduler already guarantees apply unchanged to bucket
+    execution — a crashed dispatch lane's bucket is re-leased, not lost.
+
+    A deadline timer thread owns the flush clock; tests that want
+    determinism call :meth:`flush_expired` with an explicit ``now``
+    instead (the timer is harmless alongside — flushing is idempotent
+    under the lock).
+    """
+
+    def __init__(
+        self,
+        *,
+        bucket_size: int,
+        flush_deadline: float,
+        order: str = "fifo",
+        max_retries: int = 3,
+        lease_timeout: float | None = None,
+        prefetch_depth: int = 5,
+        start_timer: bool = True,
+    ):
+        if bucket_size < 1:
+            raise ValueError(f"bucket_size must be >= 1: {bucket_size}")
+        if flush_deadline < 0:
+            raise ValueError(
+                f"flush_deadline must be >= 0: {flush_deadline}"
+            )
+        self.bucket_size = bucket_size
+        self.flush_deadline = flush_deadline
+        self.wq = WorkQueue(
+            (),
+            prefetch_depth=prefetch_depth,
+            order=order,
+            max_retries=max_retries,
+            lease_timeout=lease_timeout,
+            open_ended=True,
+        )
+        self._lock = threading.Condition()
+        self._buckets: dict[Any, list[FleetTicket]] = {}
+        self._deadlines: dict[Any, float] = {}
+        self._closed = False
+        self._timer: threading.Thread | None = None
+        if start_timer and flush_deadline > 0:
+            self._timer = threading.Thread(
+                target=self._timer_loop, daemon=True
+            )
+            self._timer.start()
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, signature: Any, payload: Any) -> FleetTicket:
+        """Admit one request; returns its ticket. A full bucket
+        dispatches immediately; ``flush_deadline == 0`` dispatches every
+        submission immediately (padded solo serving)."""
+        ticket = FleetTicket(signature, payload)
+        with self._lock:
+            if self._closed:
+                raise SchedulerError("submit on a closed ShapeBucketQueue")
+            pending = self._buckets.setdefault(signature, [])
+            if not pending:
+                self._deadlines[signature] = (
+                    time.monotonic() + self.flush_deadline
+                )
+            pending.append(ticket)
+            if (
+                len(pending) >= self.bucket_size
+                or self.flush_deadline == 0
+            ):
+                self._flush_locked(signature)
+            self._lock.notify_all()
+        return ticket
+
+    def flush_expired(self, now: float | None = None) -> int:
+        """Dispatch every bucket whose oldest request has waited past
+        the deadline; returns how many buckets flushed. The timer thread
+        calls this; tests may call it directly with a synthetic ``now``."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            expired = [
+                sig for sig, dl in self._deadlines.items() if dl <= now
+            ]
+            for sig in expired:
+                self._flush_locked(sig)
+            return len(expired)
+
+    def flush_all(self) -> None:
+        """Dispatch every partially-full bucket now (close path)."""
+        with self._lock:
+            for sig in list(self._buckets):
+                self._flush_locked(sig)
+
+    def close(self) -> None:
+        """Flush remaining buckets and close the work queue: serve()
+        lanes drain what is queued and exit. Idempotent."""
+        with self._lock:
+            self._closed = True
+            for sig in list(self._buckets):
+                self._flush_locked(sig)
+            self._lock.notify_all()
+        self.wq.close()
+
+    def _flush_locked(self, signature) -> None:
+        tickets = self._buckets.pop(signature, None)
+        self._deadlines.pop(signature, None)
+        if tickets:
+            self.wq.add_task(Bucket(signature=signature, tickets=tickets))
+
+    def _timer_loop(self) -> None:
+        with self._lock:
+            while not self._closed:
+                if not self._deadlines:
+                    self._lock.wait()
+                    continue
+                now = time.monotonic()
+                soonest = min(self._deadlines.values())
+                if soonest <= now:
+                    for sig in [
+                        s for s, dl in self._deadlines.items()
+                        if dl <= now
+                    ]:
+                        self._flush_locked(sig)
+                else:
+                    self._lock.wait(soonest - now + 1e-3)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def serve(
+        self,
+        fit_bucket: Callable[[Bucket], Sequence[Any]],
+        *,
+        num_lanes: int = 1,
+    ) -> None:
+        """Drain the admission queue: ``fit_bucket(bucket)`` returns one
+        result per ticket (order-aligned); each ticket resolves as its
+        bucket completes. Blocks until :meth:`close` has been called and
+        everything queued has executed. WorkQueue's retry/lease policy
+        applies per bucket; a bucket that exhausts its retries fails its
+        tickets with the scheduler error instead of hanging them."""
+
+        def fold(task_id: int, out) -> None:
+            bucket, results = out
+            if len(results) != len(bucket.tickets):
+                raise SchedulerError(
+                    f"fit_bucket returned {len(results)} results for "
+                    f"{len(bucket.tickets)} tickets"
+                )
+            for ticket, res in zip(bucket.tickets, results):
+                ticket.resolve(res)
+
+        try:
+            self.wq.run(
+                lambda bucket: (bucket, fit_bucket(bucket)),
+                num_lanes=num_lanes,
+                on_result=fold,
+            )
+        finally:
+            # terminal scheduler failure (retries exhausted, poisoned
+            # fold): fail every unresolved ticket so waiters unblock
+            # with the cause instead of deadlocking on .result()
+            err = self.wq._failed or SchedulerError(
+                "fleet dispatch aborted"
+            )
+            for rec in self.wq.records:
+                payload = rec.payload
+                if isinstance(payload, Bucket):
+                    for t in payload.tickets:
+                        if not t.done():
+                            t.fail(err)
 
 
 def run_dynamic_round(

@@ -299,17 +299,23 @@ class Supervisor:
 
     # -- detection loop 1: block quarantine ----------------------------------
 
-    def screen_block(self, block, t: int, base_mask=None):
+    def screen_block(self, block, t: int, base_mask=None,
+                     tenant: int | None = None):
         """Boundary check for one incoming block at step ``t``.
 
         Returns ``(block, mask)`` — the (possibly repaired) host block
         and its ``(m,)`` survivor mask — or ``None`` for a round that
         cannot be salvaged (wrong geometry) and is dropped whole.
         ``base_mask`` folds an externally injected fault mask
-        (``worker_masks=``) into the quarantine result.
+        (``worker_masks=``) into the quarantine result. ``tenant`` tags
+        the ledger events with a fleet tenant index
+        (``parallel/fleet.py`` screens each tenant's stream through this
+        same check), so a multi-tenant post-mortem attributes each
+        quarantine to the tenant whose data caused it.
         """
         m = self.cfg.num_workers
         n, d = self.cfg.rows_per_worker, self.cfg.dim
+        who = {} if tenant is None else {"tenant": tenant}
         arr = np.asarray(block)
         mask = (
             np.ones(m, np.float32) if base_mask is None
@@ -326,13 +332,13 @@ class Supervisor:
                 mask[missing] = 0.0
                 self.record(
                     "quarantine_short", t, workers=missing,
-                    got_workers=int(arr.shape[0]),
+                    got_workers=int(arr.shape[0]), **who,
                 )
                 arr = padded
             else:
                 self.record(
                     "dropped_round", t, shape=list(arr.shape),
-                    want=[m, n, d],
+                    want=[m, n, d], **who,
                 )
                 return None
         if not np.issubdtype(arr.dtype, np.integer):
@@ -346,7 +352,7 @@ class Supervisor:
                 arr = np.array(arr, copy=True)
                 arr[bad] = self._placeholder(n, d, arr.dtype)
                 mask[bad] = 0.0
-                self.record("quarantine_nonfinite", t, workers=bad)
+                self.record("quarantine_nonfinite", t, workers=bad, **who)
         return arr, mask
 
     @staticmethod
@@ -622,6 +628,18 @@ def _step_supervised(sup, stream_factory, cfg, state, cursor, ckpt,
         online_distributed_pca,
     )
 
+    ingest = None
+    if metrics is not None and cfg.prefetch_depth > 0:
+        # ingest-bound vs compute-bound from the run report: the
+        # prefetch queue's stall/occupancy counters ride into
+        # metrics.summary()["ingest"] (runtime/prefetch.py)
+        from distributed_eigenspaces_tpu.runtime.prefetch import (
+            PrefetchStats,
+        )
+
+        ingest = PrefetchStats()
+        metrics.attach_ingest(ingest)
+
     done = int(state.step) if state is not None else 0
     guarded = sup.guard_stream(
         stream_factory(cursor), base_masks=worker_masks,
@@ -648,6 +666,7 @@ def _step_supervised(sup, stream_factory, cfg, state, cursor, ckpt,
         worker_masks=sup.mask_feed,
         max_steps=max_steps,
         step_hook=sup.step_hook,
+        ingest_stats=ingest,
     )
 
 

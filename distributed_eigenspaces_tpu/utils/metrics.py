@@ -40,6 +40,10 @@ class MetricsLogger:
         #: workers, retried pulls/steps, resumes — the run's fault
         #: ledger, surfaced by :meth:`summary`
         self.fault_records: list[dict] = []
+        #: ingest-pipeline counters (runtime/prefetch.py PrefetchStats),
+        #: attached via :meth:`attach_ingest` — surfaced by
+        #: :meth:`summary` under "ingest"
+        self.ingest_stats = None
         self._last_time = None
 
     def start(self) -> "MetricsLogger":
@@ -71,6 +75,15 @@ class MetricsLogger:
         self.records.append(rec)
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
+
+    def attach_ingest(self, stats) -> "MetricsLogger":
+        """Attach a live ``runtime.prefetch.PrefetchStats`` — its final
+        counters land in ``summary()["ingest"]``, so ingest-bound vs
+        compute-bound runs are diagnosable from the run report (the
+        counters keep mutating as the stream runs; summary reads the
+        state at call time)."""
+        self.ingest_stats = stats
+        return self
 
     def fault(self, event: dict) -> None:
         """Record one structured fault event (a supervisor detection /
@@ -106,6 +119,8 @@ class MetricsLogger:
                 "by_kind": by_kind,
                 "events": list(self.fault_records),
             }
+        if self.ingest_stats is not None:
+            out["ingest"] = self.ingest_stats.as_dict()
         return out
 
 

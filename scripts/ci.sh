@@ -19,12 +19,16 @@
 #      default ratio floor 0.5 ~ a 2x normalized regression) —
 #      override with DET_CI_COMPARE_THRESHOLD. On a TPU rig, compare
 #      the newest BENCH_rNN.json instead (same flag, tighter 0.9).
-#   3. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   3. bench.py --fleet in the same smoke mode: the multi-tenant
+#      serving A/B (fleet-vs-solo equivalence gate asserted by the
+#      bench itself), compared anchor-normalized against the committed
+#      BENCH_FLEET_SMOKE_CPU.json;
+#   4. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/3] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/4] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -32,7 +36,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/3] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/4] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -42,7 +46,22 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/3] graft entry + 8-device sharded dryrun =="
+echo "== [3/4] fleet equivalence + amortization smoke (CPU) =="
+# bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
+# (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
+# and the compare checks the anchor-normalized fits/sec against the
+# committed smoke expectation — a dispatch-amortization regression
+# fails CI here instead of at the next round's verdict. Same
+# CPU-tolerant 0.5 ratio floor as the headline smoke.
+if [[ -f BENCH_FLEET_SMOKE_CPU.json ]]; then
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet \
+        --compare BENCH_FLEET_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
+fi
+
+echo "== [4/4] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

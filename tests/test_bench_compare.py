@@ -111,3 +111,43 @@ def test_add_value_per_anchor():
     r2 = {"value": 1.0}
     bench._add_value_per_anchor(r2)  # no anchor -> no field, no crash
     assert "value_per_anchor" not in r2
+
+
+def _fleet_report(value, anchor, speedup):
+    return {
+        "metric": "pca_fleet_fits_per_sec",
+        "value": value,
+        "fleet_size": 8,
+        "fleet_speedup": speedup,
+        "anchor_tflops": anchor,
+        "value_per_anchor": round(value / anchor, 1),
+    }
+
+
+def test_fleet_records_compare_and_carry_speedup(tmp_path, capsys):
+    """Fleet records compare like headline records (anchor-normalized
+    value ratio) and the verdict surfaces both sides' batching win."""
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_fleet_report(5000.0, 0.12, 3.2)))
+    new = _fleet_report(5100.0, 0.12, 3.4)
+    assert bench.compare_reports(str(old), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["fleet_speedup_old"] == 3.2
+    assert verdict["fleet_speedup_new"] == 3.4
+    assert not verdict["regression"]
+
+    # fleet regression still trips the same normalized gate
+    worse = _fleet_report(2000.0, 0.12, 1.1)
+    assert bench.compare_reports(str(old), worse) == 1
+
+
+def test_metric_mismatch_skips_not_lies(tmp_path, capsys):
+    """A fleet record vs a headline record is a unit error, not a
+    regression verdict: --compare skips loudly."""
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_report(60e6, 120.0)))
+    new = _fleet_report(5000.0, 0.12, 3.2)
+    assert bench.compare_reports(str(old), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]

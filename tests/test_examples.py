@@ -35,6 +35,9 @@ def _run(script, *extra):
         ("out_of_core_quantized.py",
          ["--dim", "64", "--rank", "3", "--rows-per-worker", "64",
           "--steps", "4", "--window", "2"]),
+        ("fleet_serving.py",
+         ["--tenants", "6", "--dim", "24", "--rows-per-worker", "24",
+          "--steps", "3", "--bucket", "3"]),
         # notebook-scale by design (the reference workload has no size
         # flags to shrink): ~40 s on CPU, still worth the coverage — it
         # is the one example that crashed on TPU for two rounds

@@ -1,0 +1,430 @@
+"""Fleet serving (ISSUE 3): vmapped multi-tenant batched fits.
+
+The contract under test:
+
+- per-problem results MATCH the solo-fit path (same cores, vmapped):
+  online states allclose, per-problem principal angles identical within
+  tolerance — unmasked, masked, and ragged-T tenants alike;
+- ragged schedules freeze a tenant's carry exactly (its result is its
+  own T_b-step fit, not a T_max-step one);
+- the sharded fleet program contains ZERO collectives (pure data
+  parallelism over the fleet axis — machine-checked via
+  ``utils.collectives_audit``);
+- supervisor quarantine isolates ONLY the faulted tenant's workers
+  (NaN corruption -> that tenant's mask; ``KillSwitch`` -> that
+  tenant's remaining steps), other tenants' results untouched;
+- the admission queue (``ShapeBucketQueue``) dispatches full buckets
+  immediately and partial buckets on the deadline, and the served
+  results equal a direct ``fit_fleet`` call's.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_eigenspaces_tpu.algo.online import OnlineState
+from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+from distributed_eigenspaces_tpu.api.estimator import OnlineDistributedPCA
+from distributed_eigenspaces_tpu.api.runner import extract_dense
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.ops.linalg import principal_angles_degrees
+from distributed_eigenspaces_tpu.parallel.fleet import (
+    FleetPCA,
+    FleetServer,
+    fit_fleet,
+    fleet_mesh,
+    fleet_signature,
+    init_fleet_states,
+    make_fleet_fit,
+    stage_fleet,
+)
+from distributed_eigenspaces_tpu.runtime.supervisor import Supervisor
+from distributed_eigenspaces_tpu.utils import collectives_audit as ca
+from distributed_eigenspaces_tpu.utils.faults import (
+    ChaosPlan,
+    ChaosStream,
+    KillSwitch,
+)
+
+D, K, M, N, T = 64, 3, 4, 64, 6
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=T,
+        solver="subspace", subspace_iters=10, backend="local",
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=0)
+
+
+def _problem(spec, b, t=T):
+    return np.stack([
+        np.asarray(
+            spec.sample(jax.random.PRNGKey(1000 * b + i), M * N)
+        ).reshape(M, N, D)
+        for i in range(t)
+    ])
+
+
+def _angle(a, b):
+    return float(
+        jnp.max(principal_angles_degrees(jnp.asarray(a), jnp.asarray(b)))
+    )
+
+
+# -- numerical equivalence ----------------------------------------------------
+
+
+def test_fleet_matches_solo_per_tenant(spec):
+    cfg = _cfg()
+    probs = [_problem(spec, b) for b in range(4)]
+    res = fit_fleet(cfg, probs, mesh=None)
+    solo = make_scan_fit(cfg)
+    for b in range(4):
+        st, _ = solo(OnlineState.initial(D), jnp.asarray(probs[b]))
+        np.testing.assert_allclose(
+            np.asarray(res.states.sigma_tilde[b]),
+            np.asarray(st.sigma_tilde), rtol=1e-5, atol=1e-6,
+        )
+        assert int(res.states.step[b]) == int(st.step) == T
+        w_solo = extract_dense(cfg, st.sigma_tilde)
+        # per-problem principal angles identical within tolerance (the
+        # extraction's subspace iteration adds its own small noise)
+        assert _angle(res.components[b], w_solo) < 0.2
+        # and both land on the planted subspace
+        assert _angle(res.components[b], spec.top_k(K)) < 1.0
+
+
+def test_fleet_ragged_t_freezes_carry(spec):
+    """An early-finishing tenant's result is EXACTLY its own shorter
+    fit: the active mask freezes state, step counter and warm carry."""
+    cfg = _cfg()
+    t_short = 4
+    probs = [_problem(spec, 0), _problem(spec, 1, t_short),
+             _problem(spec, 2)]
+    res = fit_fleet(cfg, probs, mesh=None)
+    assert [int(s) for s in res.states.step] == [T, t_short, T]
+    solo = make_scan_fit(cfg)
+    st_short, _ = solo(OnlineState.initial(D), jnp.asarray(probs[1]))
+    np.testing.assert_allclose(
+        np.asarray(res.states.sigma_tilde[1]),
+        np.asarray(st_short.sigma_tilde), rtol=1e-5, atol=1e-6,
+    )
+    # the frozen tail reports the carried basis, not padding garbage
+    assert np.isfinite(res.v_bars).all()
+    np.testing.assert_array_equal(
+        res.v_bars[1, t_short], res.v_bars[1, T - 1]
+    )
+
+
+def test_fleet_masked_matches_solo_masked(spec):
+    """Per-tenant worker masks run the solo masked scan's exact step
+    body — tenant-by-tenant equivalence, live tenants unaffected."""
+    cfg = _cfg()
+    probs = [_problem(spec, b) for b in range(3)]
+    masks0 = np.ones((T, M), np.float32)
+    masks0[1, 0] = 0.0
+    masks0[3, :] = 0.0
+    res = fit_fleet(
+        cfg, probs, mesh=None, worker_masks=[masks0, None, None]
+    )
+    solo_m = make_scan_fit(cfg, masked=True)
+    st0, _ = solo_m(
+        OnlineState.initial(D), jnp.asarray(probs[0]), jnp.asarray(masks0)
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.states.sigma_tilde[0]), np.asarray(st0.sigma_tilde),
+        rtol=1e-5, atol=1e-6,
+    )
+    # an all-live tenant inside the masked program == the unmasked solo
+    solo = make_scan_fit(cfg)
+    st2, _ = solo(OnlineState.initial(D), jnp.asarray(probs[2]))
+    np.testing.assert_allclose(
+        np.asarray(res.states.sigma_tilde[2]), np.asarray(st2.sigma_tilde),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_fleet_eigh_solver_path(spec):
+    """The all-cold (eigh) fleet body: same equivalence, no warm carry."""
+    cfg = _cfg(solver="eigh")
+    probs = [_problem(spec, b) for b in range(2)]
+    res = fit_fleet(cfg, probs, mesh=None)
+    solo = make_scan_fit(cfg)
+    for b in range(2):
+        st, _ = solo(OnlineState.initial(D), jnp.asarray(probs[b]))
+        np.testing.assert_allclose(
+            np.asarray(res.states.sigma_tilde[b]),
+            np.asarray(st.sigma_tilde), rtol=1e-5, atol=1e-6,
+        )
+
+
+# -- sharding -----------------------------------------------------------------
+
+
+def test_fleet_sharded_matches_local_no_collectives(spec, devices):
+    b = 8
+    cfg = _cfg()
+    probs = [_problem(spec, b_) for b_ in range(b)]
+    mesh = fleet_mesh(b)
+    assert mesh is not None and mesh.shape["workers"] == 8
+    res_s = fit_fleet(cfg, probs, mesh=mesh)
+    res_l = fit_fleet(cfg, probs, mesh=None)
+    for b_ in range(b):
+        np.testing.assert_allclose(
+            np.asarray(res_s.states.sigma_tilde[b_]),
+            np.asarray(res_l.states.sigma_tilde[b_]),
+            rtol=1e-4, atol=1e-5,
+        )
+        assert _angle(res_s.components[b_], res_l.components[b_]) < 0.2
+
+    # machine-checked: the fleet axis is PURE data parallelism — zero
+    # collectives in the partitioned program, masked and unmasked alike
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("workers"))
+    states = jax.device_put(init_fleet_states(cfg, b), sh)
+    xs = jax.device_put(jnp.zeros((b, T, M, N, D), jnp.float32), sh)
+    act = jax.device_put(jnp.ones((b, T), jnp.float32), sh)
+    audit = ca.audit_compiled(
+        make_fleet_fit(cfg, mesh).lower(states, xs, act).compile()
+    )
+    assert audit["n_collectives"] == 0, audit["ops"]
+    mk = jax.device_put(jnp.ones((b, T, M), jnp.float32), sh)
+    audit_m = ca.audit_compiled(
+        make_fleet_fit(cfg, mesh, masked=True)
+        .lower(states, xs, mk, act).compile()
+    )
+    assert audit_m["n_collectives"] == 0, audit_m["ops"]
+    ca.assert_no_dense_collective(audit, D)
+
+
+def test_fleet_size_not_divisible_raises(spec, devices):
+    cfg = _cfg()
+    mesh = fleet_mesh(8)
+    with pytest.raises(ValueError, match="not divisible"):
+        fit_fleet(cfg, [_problem(spec, b) for b in range(3)], mesh=mesh)
+
+
+# -- API surface --------------------------------------------------------------
+
+
+def test_estimator_fleet_trainer_is_b1_fleet(spec):
+    cfg = _cfg()
+    data = _problem(spec, 0).reshape(-1, D)
+    est = OnlineDistributedPCA(cfg, trainer="fleet").fit(data)
+    assert est.trainer_used_ == "fleet"
+    ref = OnlineDistributedPCA(cfg, trainer="scan").fit(data)
+    np.testing.assert_allclose(
+        np.asarray(est.state.sigma_tilde),
+        np.asarray(ref.state.sigma_tilde), rtol=1e-5, atol=1e-6,
+    )
+    assert _angle(est.components_, ref.components_) < 0.2
+
+    # masked route too
+    masks = np.ones((T, M), np.float32)
+    masks[2, 1] = 0.0
+    est_m = OnlineDistributedPCA(cfg, trainer="fleet").fit(
+        data, worker_masks=masks
+    )
+    ref_m = OnlineDistributedPCA(cfg, trainer="scan").fit(
+        data, worker_masks=masks
+    )
+    np.testing.assert_allclose(
+        np.asarray(est_m.state.sigma_tilde),
+        np.asarray(ref_m.state.sigma_tilde), rtol=1e-5, atol=1e-6,
+    )
+
+    # fleet fits don't checkpoint — loud, like the other whole-fit gaps
+    with pytest.raises(ValueError, match="checkpoint"):
+        OnlineDistributedPCA(
+            cfg, trainer="fleet", checkpoint_dir="/tmp/nope"
+        ).fit(data)
+
+
+def test_fleet_rejects_steady_state_knobs():
+    with pytest.raises(ValueError, match="pipeline_merge"):
+        make_fleet_fit(
+            _cfg(pipeline_merge=True, warm_start_iters=2)
+        )
+    with pytest.raises(ValueError, match="merge_interval"):
+        make_fleet_fit(_cfg(merge_interval=2))
+
+
+def test_fleetpca_components_and_transform(spec):
+    cfg = _cfg()
+    datasets = [_problem(spec, b).reshape(-1, D) for b in range(2)]
+    fleet = FleetPCA(cfg, mesh=None).fit(datasets)
+    assert fleet.components_.shape == (2, D, K)
+    z = fleet.transform(1, datasets[1][:10])
+    assert z.shape == (10, K)
+
+
+def test_stage_fleet_validation(spec):
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="at least one"):
+        stage_fleet(cfg, [])
+    with pytest.raises(ValueError, match="worker_masks covers"):
+        stage_fleet(cfg, [_problem(spec, 0)], worker_masks=[])
+    bad = np.ones((T, M + 1), np.float32)
+    with pytest.raises(ValueError, match="worker_masks shape"):
+        stage_fleet(cfg, [_problem(spec, 0)], worker_masks=[bad])
+    short = np.ones((2, M), np.float32)
+    with pytest.raises(ValueError, match="mask row"):
+        stage_fleet(cfg, [_problem(spec, 0)], worker_masks=[short])
+    with pytest.raises(ValueError, match="block shape"):
+        stage_fleet(cfg, [np.zeros((T, M, N + 1, D), np.float32)])
+    with pytest.raises(ValueError, match="zero full steps"):
+        stage_fleet(cfg, [np.zeros((0, M, N, D), np.float32)])
+
+
+# -- faults -------------------------------------------------------------------
+
+
+def test_supervisor_quarantine_isolates_faulted_tenant(spec):
+    """NaN corruption in ONE tenant's stream drops only that tenant's
+    corrupt worker; every other tenant matches its clean fit."""
+    cfg = _cfg()
+    clean = [_problem(spec, b) for b in range(3)]
+    sup = Supervisor(cfg)
+    probs = [
+        clean[0],
+        ChaosStream(iter(clean[1]), ChaosPlan(nan_blocks={3: [2]})),
+        clean[2],
+    ]
+    res = fit_fleet(cfg, probs, mesh=None, supervisor=sup)
+
+    # the ledger attributes the quarantine to tenant 1, step 3, worker 2
+    events = [
+        e for e in sup.ledger.events if e["kind"] == "quarantine_nonfinite"
+    ]
+    assert len(events) == 1
+    assert events[0]["tenant"] == 1 and events[0]["step"] == 3
+    assert events[0]["workers"] == [2]
+    assert res.batch.masks is not None
+    assert res.batch.masks[1, 2, 2] == 0.0
+    assert res.batch.masks[[0, 2]].min() == 1.0  # others untouched
+
+    # tenant 1 == its solo MASKED fit with exactly that drop
+    masks1 = np.ones((T, M), np.float32)
+    masks1[2, 2] = 0.0
+    solo_m = make_scan_fit(cfg, masked=True)
+    st1, _ = solo_m(
+        OnlineState.initial(D), jnp.asarray(clean[1]), jnp.asarray(masks1)
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.states.sigma_tilde[1]), np.asarray(st1.sigma_tilde),
+        rtol=1e-5, atol=1e-6,
+    )
+    # clean tenants == their clean fits
+    clean_res = fit_fleet(cfg, [clean[0], clean[2]], mesh=None)
+    for got, want in ((0, 0), (2, 1)):
+        np.testing.assert_allclose(
+            np.asarray(res.states.sigma_tilde[got]),
+            np.asarray(clean_res.states.sigma_tilde[want]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_killswitch_quarantines_only_the_victim_tenant(spec):
+    """A tenant whose stream hard-dies (KillSwitch) is quarantined from
+    that step on; the fleet's other tenants never notice."""
+    cfg = _cfg()
+    clean = [_problem(spec, b) for b in range(3)]
+    sup = Supervisor(cfg)
+    kill_step = 4
+    probs = [
+        clean[0],
+        ChaosStream(iter(clean[1]), ChaosPlan(kill_at=kill_step)),
+        clean[2],
+    ]
+    res = fit_fleet(cfg, probs, mesh=None, supervisor=sup)
+    killed = [
+        e for e in sup.ledger.events if e["kind"] == "tenant_killed"
+    ]
+    assert len(killed) == 1
+    assert killed[0]["tenant"] == 1 and killed[0]["step"] == kill_step
+    # the victim ran exactly kill_step - 1 steps...
+    assert int(res.states.step[1]) == kill_step - 1
+    solo = make_scan_fit(cfg)
+    st1, _ = solo(
+        OnlineState.initial(D), jnp.asarray(clean[1][: kill_step - 1])
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.states.sigma_tilde[1]), np.asarray(st1.sigma_tilde),
+        rtol=1e-5, atol=1e-6,
+    )
+    # ...and the others ran their full schedules
+    assert int(res.states.step[0]) == int(res.states.step[2]) == T
+
+    # without a supervisor a hard death propagates — no silent loss
+    with pytest.raises(KillSwitch):
+        fit_fleet(
+            cfg,
+            [clean[0],
+             ChaosStream(iter(clean[1]), ChaosPlan(kill_at=2))],
+            mesh=None,
+        )
+
+
+# -- admission / serving ------------------------------------------------------
+
+
+def test_fleet_server_full_bucket_and_deadline_flush(spec):
+    """5 requests into bucket_size-4 admission: one full bucket
+    dispatches immediately, the leftover resolves via the deadline —
+    and every served result equals the direct fit_fleet call's."""
+    cfg = _cfg(fleet_bucket_size=4, fleet_flush_s=0.15)
+    probs = [_problem(spec, b) for b in range(5)]
+    with FleetServer(cfg, mesh=None) as srv:
+        tickets = [srv.submit(p) for p in probs]
+        ws = [t.result(timeout=300) for t in tickets]
+    ref = fit_fleet(cfg, probs, mesh=None)
+    for b in range(5):
+        # same compiled program (padded to the bucket size) -> exact
+        np.testing.assert_allclose(
+            ws[b],
+            fit_fleet(
+                cfg, [probs[b]], mesh=None,
+                pad_to=cfg.fleet_bucket_size,
+            ).components[0]
+            if b == 4 else ref.components[b],
+            rtol=1e-5, atol=1e-6,
+        )
+        assert _angle(ws[b], spec.top_k(K)) < 1.0
+
+
+def test_fleet_signature_is_the_bucket_shape_key():
+    assert fleet_signature(_cfg()) == (D, K, M, N, T)
+    assert fleet_signature(_cfg(k=2)) != fleet_signature(_cfg())
+
+
+def test_cli_fleet_mode_runs():
+    import json
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=root, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_eigenspaces_tpu.cli",
+         "--mode", "fleet", "--data", "synthetic", "--dim", "24",
+         "--rank", "2", "--workers", "2", "--steps", "3",
+         "--rows-per-worker", "16", "--fleet-size", "3",
+         "--solver", "subspace"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=root,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["mode"] == "fleet" and out["tenants"] == 3
+    assert out["principal_angle_deg_max"] < 2.0

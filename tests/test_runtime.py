@@ -131,3 +131,92 @@ def test_prefetch_abandoned_consumer_stops_producer():
     # read-ahead is bounded: depth + in-flight put + one being produced
     assert len(produced) <= 3 + 2 + 2
     s.close()  # idempotent
+
+
+def test_prefetch_stats_counts_stalls_and_occupancy():
+    """Ingest-bound vs compute-bound from counters: a slow producer
+    stalls the consumer (ingest_bound); a slow consumer keeps the queue
+    full and makes the producer wait (compute_bound)."""
+    import time
+
+    from distributed_eigenspaces_tpu.runtime.prefetch import (
+        PrefetchStats,
+        prefetch_stream,
+    )
+
+    def slow_producer():
+        for i in range(6):
+            time.sleep(0.02)
+            yield i
+
+    stats = PrefetchStats()
+    out = list(
+        prefetch_stream(
+            slow_producer(), depth=2, place=lambda b: b, stats=stats
+        )
+    )
+    assert out == list(range(6))
+    assert stats.yields == 6
+    assert stats.stalls >= 3  # the consumer kept catching an empty queue
+    d = stats.as_dict()
+    assert d["verdict"] == "ingest_bound"
+    assert 0.0 <= d["mean_occupancy"] <= 2.0
+
+    # slow consumer: queue stays full, producer waits, zero-ish stalls
+    stats2 = PrefetchStats()
+    gen = prefetch_stream(
+        iter(range(6)), depth=2, place=lambda b: b, stats=stats2
+    )
+    out2 = []
+    for item in gen:
+        time.sleep(0.02)
+        out2.append(item)
+    assert out2 == list(range(6))
+    assert stats2.producer_waits >= 1
+    assert stats2.as_dict()["verdict"] == "compute_bound"
+
+
+def test_metrics_logger_ingest_summary():
+    from distributed_eigenspaces_tpu.runtime.prefetch import PrefetchStats
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    stats = PrefetchStats(depth=2, yields=10, stalls=7, occupancy_sum=4,
+                          producer_waits=0)
+    metrics = MetricsLogger().attach_ingest(stats)
+    ingest = metrics.summary()["ingest"]
+    assert ingest["stalls"] == 7
+    assert ingest["stall_fraction"] == 0.7
+    assert ingest["verdict"] == "ingest_bound"
+
+
+def test_supervised_fit_reports_ingest(tmp_path):
+    """The wired path: a supervised per-step run's MetricsLogger
+    summary carries the prefetch counters under 'ingest'."""
+    import numpy as np
+
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.runtime.supervisor import (
+        supervised_fit,
+    )
+    from distributed_eigenspaces_tpu.data.stream import block_stream
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((4 * 8 * 4, 16)).astype(np.float32)
+    cfg = PCAConfig(dim=16, k=2, num_workers=4, rows_per_worker=8,
+                    num_steps=4, backend="local")
+    metrics = MetricsLogger(samples_per_step=32).start()
+
+    def factory(start_row):
+        return block_stream(
+            data, num_workers=4, rows_per_worker=8, start_row=start_row,
+            device=False,
+        )
+
+    w, state, sup = supervised_fit(
+        factory, cfg, metrics=metrics, max_steps=4,
+    )
+    ingest = metrics.summary()["ingest"]
+    assert ingest["yields"] == 4
+    assert ingest["depth"] == cfg.prefetch_depth
+    assert "stalls" in ingest and "producer_waits" in ingest

@@ -200,3 +200,152 @@ def test_dynamic_round_with_fault_injection(rng):
     np.testing.assert_allclose(
         np.asarray(sigma_bar), np.asarray(clean), atol=1e-5
     )
+
+
+# -- open-ended queue + shape-bucketed admission (fleet serving) -------------
+
+
+def test_open_ended_queue_add_task_and_close():
+    from distributed_eigenspaces_tpu.runtime.scheduler import WorkQueue
+
+    wq = WorkQueue(open_ended=True)
+    results = []
+    t = threading.Thread(
+        target=lambda: results.extend(wq.run(lambda p: p + 1))
+    )
+    t.start()
+    for i in range(5):
+        wq.add_task(i)
+    wq.close()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert results == [1, 2, 3, 4, 5]
+    with pytest.raises(SchedulerError, match="closed"):
+        wq.add_task(99)
+
+
+def test_static_queue_unchanged_by_open_ended_flag():
+    wq = WorkQueue([1, 2, 3])
+    assert wq.run(lambda p: p) == [1, 2, 3]
+    with pytest.raises(SchedulerError, match="closed"):
+        wq.add_task(4)
+
+
+def _bucket_queue(**kw):
+    from distributed_eigenspaces_tpu.runtime.scheduler import (
+        ShapeBucketQueue,
+    )
+
+    return ShapeBucketQueue(**kw)
+
+
+def test_full_bucket_dispatches_immediately():
+    q = _bucket_queue(bucket_size=3, flush_deadline=60.0,
+                      start_timer=False)
+    sig = ("a",)
+    tickets = [q.submit(sig, i) for i in range(3)]
+    served = []
+    t = threading.Thread(
+        target=q.serve,
+        args=(lambda b: [p.payload * 10 for p in b.tickets],),
+    )
+    t.start()
+    # the full bucket is already queued — tickets resolve WITHOUT any
+    # deadline or close
+    assert [tk.result(timeout=30) for tk in tickets] == [0, 10, 20]
+    q.close()
+    t.join(timeout=30)
+    assert not t.is_alive()
+
+
+def test_partial_bucket_flushes_on_deadline_not_starvation():
+    """THE bucket-flush deadline contract: a partially-full bucket must
+    dispatch after flush_deadline seconds, not wait for a full bucket
+    (or close) that may never come."""
+    q = _bucket_queue(bucket_size=8, flush_deadline=0.15)
+    t = threading.Thread(
+        target=q.serve,
+        args=(lambda b: [len(b.tickets)] * len(b.tickets),),
+    )
+    t.start()
+    tickets = [q.submit(("s",), i) for i in range(3)]
+    # resolves via the timer thread — no close(), no fourth submit
+    assert tickets[0].result(timeout=30) == 3
+    assert all(tk.result(timeout=5) == 3 for tk in tickets)
+    q.close()
+    t.join(timeout=30)
+
+
+def test_partial_bucket_flush_expired_deterministic():
+    """Deterministic twin of the deadline test: flush_expired(now=...)
+    flushes exactly the buckets whose oldest request aged out."""
+    q = _bucket_queue(bucket_size=8, flush_deadline=10.0,
+                      start_timer=False)
+    q.submit(("old",), 1)
+    base = q._deadlines[("old",)]
+    q.submit(("young",), 2)
+    q._deadlines[("young",)] = base + 5.0
+    assert q.flush_expired(now=base + 1.0) == 1
+    assert ("old",) not in q._buckets and ("young",) in q._buckets
+
+
+def test_bucket_retry_preserves_lease_semantics():
+    """A transiently failing dispatch retries through the WorkQueue's
+    existing machinery and the tickets still resolve."""
+    attempts = {"n": 0}
+
+    def flaky(bucket):
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise OSError("transient dispatch failure")
+        return [p.payload for p in bucket.tickets]
+
+    q = _bucket_queue(bucket_size=2, flush_deadline=60.0, max_retries=3,
+                      start_timer=False)
+    tickets = [q.submit(("s",), i) for i in range(2)]
+    q.close()
+    q.serve(flaky)
+    assert attempts["n"] == 3
+    assert [tk.result(timeout=5) for tk in tickets] == [0, 1]
+
+
+def test_bucket_retries_exhausted_fails_tickets():
+    """Terminal dispatch failure: tickets fail LOUDLY with the cause
+    instead of hanging their waiters forever."""
+
+    def broken(bucket):
+        raise OSError("dispatch always dies")
+
+    q = _bucket_queue(bucket_size=1, flush_deadline=60.0, max_retries=1,
+                      start_timer=False)
+    ticket = q.submit(("s",), 0)
+    q.close()
+    with pytest.raises(SchedulerError):
+        q.serve(broken)
+    with pytest.raises(SchedulerError):
+        ticket.result(timeout=5)
+
+
+def test_submit_after_close_raises():
+    q = _bucket_queue(bucket_size=2, flush_deadline=0.0,
+                      start_timer=False)
+    q.close()
+    with pytest.raises(SchedulerError, match="closed"):
+        q.submit(("s",), 0)
+
+
+def test_zero_deadline_flushes_every_submit():
+    q = _bucket_queue(bucket_size=8, flush_deadline=0.0,
+                      start_timer=False)
+    t1 = q.submit(("s",), "a")
+    t2 = q.submit(("s",), "b")
+    q.close()
+    buckets = []
+
+    def fit(bucket):
+        buckets.append(len(bucket.tickets))
+        return [p.payload for p in bucket.tickets]
+
+    q.serve(fit)
+    assert buckets == [1, 1]  # padded solo serving: one bucket each
+    assert t1.result(timeout=5) == "a" and t2.result(timeout=5) == "b"
