@@ -847,11 +847,35 @@ class OnlineDistributedPCA:
     # The reference calls this "matrix_w" (notebook cell 17-18).
     matrix_w = components_
 
-    def transform(self, x) -> jax.Array:
-        """Project ``(N, dim) -> (N, k)`` (notebook cells 19-20: ``data @ W``)."""
+    def transform(self, x, *, serve=None) -> jax.Array:
+        """Project ``(N, dim) -> (N, k)`` (notebook cells 19-20: ``data @ W``).
+
+        ``serve`` routes the query through a live
+        ``serving.QueryServer`` instead of a local matmul: the query is
+        admitted to the micro-batch queue and projected against the
+        registry's LATEST published version (which may be newer than
+        this estimator's own fit — that is the point of serving).
+        Served and direct projections of the same version are
+        bit-for-bit identical (padding a batched matmul does not change
+        its rows — pinned in tests/test_serving.py).
+        """
+        w = self.components_  # raises before fit — the right error
+        d = int(w.shape[0])
+        width = np.shape(x)[-1] if np.ndim(x) >= 1 else None
+        if np.ndim(x) not in (1, 2) or width != d:
+            # loud beats an opaque dot_general shape error three
+            # frames down (ISSUE 4 satellite; regression-tested)
+            raise ValueError(
+                f"transform input has feature width {width} "
+                f"(shape {np.shape(x)}); this estimator was fitted "
+                f"with dim={d} — pass (N, {d}) or ({d},) rows"
+            )
+        if serve is not None:
+            z = serve.submit(np.asarray(x, np.float32)).result().z
+            return jnp.asarray(z[0] if np.ndim(x) == 1 else z)
         x = jnp.asarray(x, dtype=self.cfg.dtype)
         prec = jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
-        return jnp.matmul(x, self.components_.astype(x.dtype), precision=prec)
+        return jnp.matmul(x, w.astype(x.dtype), precision=prec)
 
     def fit_transform(self, data, **kw) -> jax.Array:
         return self.fit(data, **kw).transform(data)

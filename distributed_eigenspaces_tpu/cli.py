@@ -30,20 +30,34 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--mode",
-        choices=["fit", "fleet", "oneshot", "master", "slave"],
+        choices=["fit", "fleet", "serve", "oneshot", "master", "slave"],
         default="fit",
         help="fit: full online algorithm; fleet: B independent fits as "
         "ONE vmapped multi-tenant program (parallel/fleet.py — the "
         "serving path; --fleet-size tenants, the dataset split into "
-        "per-tenant shards); oneshot: single merge round (reference "
-        "master parity); master is an alias of oneshot; slave exists "
-        "only to explain itself",
+        "per-tenant shards); serve: fit, publish the basis to a "
+        "versioned registry, and serve a micro-batched query burst "
+        "through serving/QueryServer (qps + latency percentiles "
+        "reported); oneshot: single merge round (reference master "
+        "parity); master is an alias of oneshot; slave exists only to "
+        "explain itself",
     )
     p.add_argument("--fleet-size", type=int, default=8,
                    help="B, tenants per fleet program for --mode fleet "
                    "(the dataset is split into B tenant shards; the "
                    "fleet axis shards over available devices as pure "
                    "data parallelism)")
+    p.add_argument("--serve-queries", type=int, default=64,
+                   help="--mode serve: queries in the served burst")
+    p.add_argument("--serve-rows", type=int, default=8,
+                   help="--mode serve: rows per query")
+    p.add_argument("--serve-bucket", type=int, default=8,
+                   help="--mode serve: micro-batch capacity (queries "
+                   "per dispatch; PCAConfig.serve_bucket_size)")
+    p.add_argument("--serve-flush-s", type=float, default=0.02,
+                   help="--mode serve: admission deadline for partial "
+                   "micro-batches (PCAConfig.serve_flush_s; 0 = one "
+                   "query per dispatch)")
     p.add_argument("--broker", default=None,
                    help="ignored — no broker on a TPU mesh (kept for "
                    "reference CLI compatibility)")
@@ -823,6 +837,86 @@ def _fit_fleet_cli(args, data, truth) -> int:
     return 0
 
 
+def _serve_cli(args, cfg, data, truth) -> int:
+    """``--mode serve``: fit → publish to the versioned registry →
+    serve a micro-batched query burst through ``serving/QueryServer``,
+    reporting qps, latency percentiles, occupancy and the served
+    version — the end-to-end read path (docs/ARCHITECTURE.md "Query
+    serving")."""
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.api.estimator import (
+        OnlineDistributedPCA,
+    )
+    from distributed_eigenspaces_tpu.serving import (
+        EigenbasisRegistry,
+        QueryServer,
+    )
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    est = OnlineDistributedPCA(cfg)
+    t0 = time.time()
+    est.fit(data)
+    fit_s = time.time() - t0
+    registry = EigenbasisRegistry(keep=cfg.serve_keep_versions)
+    version = registry.publish_fit(est, lineage={"producer": "cli"})
+
+    r = max(1, args.serve_rows)
+    n_q = max(1, args.serve_queries)
+    n_total = len(data)
+    queries = [
+        np.asarray(
+            data[(i * r) % max(1, n_total - r) :][:r], np.float32
+        )
+        for i in range(n_q)
+    ]
+    metrics = MetricsLogger(stream=sys.stderr if args.metrics else None)
+    t0 = time.time()
+    with QueryServer(registry, cfg, metrics=metrics) as srv:
+        tickets = [srv.submit(q) for q in queries]
+        results = [t.result(timeout=600) for t in tickets]
+    elapsed = time.time() - t0
+
+    # served projections must match the direct transform exactly
+    max_err = max(
+        float(np.abs(res.z - np.asarray(est.transform(q))).max())
+        for q, res in zip(queries, results)
+    )
+    out = {
+        "mode": "serve",
+        "version": version.version,
+        "signature": list(version.signature),
+        "queries": n_q,
+        "rows_per_query": r,
+        "includes_compile": True,
+        "fit_seconds": round(fit_s, 3),
+        "serve_seconds": round(elapsed, 3),
+        "max_abs_err_vs_direct": max_err,
+        **metrics.summary().get("serving", {}),
+        "dim": cfg.dim,
+        "k": cfg.k,
+    }
+    if truth is not None:
+        from distributed_eigenspaces_tpu.ops.linalg import (
+            principal_angles_degrees,
+        )
+
+        out["principal_angle_deg"] = round(
+            float(
+                jnp.max(
+                    principal_angles_degrees(
+                        jnp.asarray(version.v), truth
+                    )
+                )
+            ),
+            4,
+        )
+    print(json.dumps(out))
+    if args.save:
+        np.save(args.save, version.v)
+    return 0 if max_err == 0.0 else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -982,6 +1076,13 @@ def main(argv=None) -> int:
         merge_interval=args.merge_interval,
         pipeline_merge=args.pipeline_merge,
     )
+
+    if args.mode == "serve":
+        cfg = cfg.replace(
+            serve_bucket_size=args.serve_bucket,
+            serve_flush_s=args.serve_flush_s,
+        )
+        return _serve_cli(args, cfg, data, truth)
 
     if args.supervise:
         if args.trainer == "sketch" or (

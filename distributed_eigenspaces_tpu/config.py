@@ -148,6 +148,21 @@ class PCAConfig:
         batching window. ``0`` flushes every request immediately
         (B-padded solo serving — maximum latency fairness, no
         amortization).
+      serve_bucket_size: query-serving micro-batch capacity
+        (``serving/server.py QueryServer``): transform requests
+        accumulate until this many are pending, then dispatch as ONE
+        padded projection program — the read-side twin of
+        ``fleet_bucket_size`` (dispatch amortization for queries
+        instead of fits).
+      serve_flush_s: query-serving admission deadline: a partial
+        micro-batch dispatches once its OLDEST query has waited this
+        long (the fleet admission's no-starvation rule, applied to the
+        read path). ``0`` dispatches every query immediately
+        (one-query-per-dispatch — the A/B baseline ``bench.py --serve``
+        measures against).
+      serve_keep_versions: how many published basis versions the
+        ``serving/registry.py EigenbasisRegistry`` retains (append-only
+        store, GC keeps the newest N; ``latest()`` never dangles).
       pipeline_merge: software-pipelined steady state for the whole-fit
         scan trainer (``algo/scan.py``): step ``t``'s warm worker
         solves run against the one-step-STALE merged basis (merges
@@ -191,6 +206,9 @@ class PCAConfig:
     pipeline_merge: bool = False
     fleet_bucket_size: int = 8
     fleet_flush_s: float = 0.1
+    serve_bucket_size: int = 8
+    serve_flush_s: float = 0.02
+    serve_keep_versions: int = 4
     seed: int = 0
 
     def __post_init__(self):
@@ -280,6 +298,24 @@ class PCAConfig:
         if self.fleet_flush_s < 0:
             raise ValueError(
                 f"fleet_flush_s must be >= 0, got {self.fleet_flush_s}"
+            )
+        if not isinstance(self.serve_bucket_size, int) or isinstance(
+            self.serve_bucket_size, bool
+        ) or self.serve_bucket_size < 1:
+            raise ValueError(
+                f"serve_bucket_size must be an int >= 1, got "
+                f"{self.serve_bucket_size!r}"
+            )
+        if self.serve_flush_s < 0:
+            raise ValueError(
+                f"serve_flush_s must be >= 0, got {self.serve_flush_s}"
+            )
+        if not isinstance(self.serve_keep_versions, int) or isinstance(
+            self.serve_keep_versions, bool
+        ) or self.serve_keep_versions < 1:
+            raise ValueError(
+                f"serve_keep_versions must be an int >= 1, got "
+                f"{self.serve_keep_versions!r}"
             )
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")

@@ -1,0 +1,40 @@
+"""Query serving: versioned eigenbasis registry, micro-batched transform
+server, and drift-triggered refresh (ISSUE 4 tentpole).
+
+The write side of the system mass-produces fits (fleet, supervisor,
+scheduler); this package is the READ side — the paper's online loop
+closed end-to-end: ingest → fit → publish → serve → drift → refit.
+
+- :mod:`.registry` — append-only store of immutable basis versions with
+  a lock-free ``latest()`` pointer (publish is atomic; GC keeps N).
+- :mod:`.transform` — jitted projection / reconstruction /
+  residual-energy kernels that take the basis as a TRACED argument, so
+  a version hot-swap reuses the compiled program; padded micro-batch
+  row buckets keep the compile cache finite.
+- :mod:`.server` — :class:`~.server.QueryServer`: deadline micro-batched
+  admission (full bucket or ``serve_flush_s``), double-buffered basis
+  swap atomic w.r.t. in-flight batches, per-request error isolation.
+- :mod:`.drift` — :class:`~.drift.DriftMonitor`: served residual energy
+  + principal-angle gap vs a background refit fold into a drift score;
+  past threshold a refit is launched and published as a new version.
+"""
+
+from distributed_eigenspaces_tpu.serving.registry import (
+    BasisVersion,
+    EigenbasisRegistry,
+)
+from distributed_eigenspaces_tpu.serving.transform import (
+    TransformEngine,
+    bucket_rows,
+)
+from distributed_eigenspaces_tpu.serving.server import QueryServer
+from distributed_eigenspaces_tpu.serving.drift import DriftMonitor
+
+__all__ = [
+    "BasisVersion",
+    "EigenbasisRegistry",
+    "TransformEngine",
+    "bucket_rows",
+    "QueryServer",
+    "DriftMonitor",
+]

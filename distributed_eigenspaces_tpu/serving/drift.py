@@ -1,0 +1,316 @@
+"""DriftMonitor: served residual energy → background refit → republish.
+
+The paper's premise is a stream whose eigenspace EVOLVES; a serving tier
+that pins version 1 forever would quietly degrade as the data walks
+away from it. This module closes the loop with two signals of different
+cost, composed into one drift score:
+
+- **Residual energy (free).** Every served batch already computes each
+  query's residual energy ``||x||² - ||xV||²`` (``serving/transform.py``
+  — the drift monitor's raw feed from :class:`~..serving.server.
+  QueryServer`). An EWMA of the residual RATIO compared against the
+  live version's published explained-variance baseline is the cheap
+  always-on tripwire: queries stop being explained ⇒ the basis is
+  stale.
+- **Principal-angle gap (paid on suspicion).** When the tripwire arms,
+  a BACKGROUND refit runs on a ring buffer of recently served rows —
+  under the fault-detecting supervisor (``runtime/supervisor.py``), so
+  a corrupt buffer block is quarantined, not fatal — and the worst
+  principal angle between the live basis and the refit is the
+  confirmation signal (a noisy residual spike with no subspace rotation
+  does not trigger a republish).
+
+``score = residual_drift + angle_gap_deg / 90``; past ``threshold`` the
+refit publishes as a NEW registry version (lineage records the trigger
+score and the version it replaces), and the server's next batch serves
+it via the lock-free ``latest()`` — ingest → fit → publish → serve →
+drift → refit, end to end.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import numpy as np
+
+from distributed_eigenspaces_tpu.serving.registry import (
+    BasisVersion,
+    EigenbasisRegistry,
+)
+
+__all__ = ["DriftMonitor"]
+
+_EPS = 1e-12
+
+
+class DriftMonitor:
+    """Folds served residual energy and a background-refit angle gap
+    into a drift score; past threshold, republishes.
+
+    Args:
+      registry: where refreshed versions publish (and where the live
+        baseline is read from).
+      cfg: the refit's ``PCAConfig`` — block geometry for the buffered
+        rows; ``num_steps`` is re-derived from the buffer size.
+      threshold: drift score at or above which a refresh publishes.
+      arm_ratio: residual-drift level that arms the (expensive)
+        background refit; defaults to ``threshold / 2``.
+      ema_alpha: EWMA weight for the per-batch residual ratio.
+      buffer_rows: ring-buffer capacity of recently served rows the
+        refit trains on; defaults to one full fit's worth
+        (``num_steps * num_workers * rows_per_worker``).
+      supervise: run the refit under ``runtime/supervisor.
+        supervised_fit`` (quarantine + retry) instead of a bare fit.
+      refit: optional override ``(rows) -> (w, state)`` replacing the
+        built-in supervised refit (e.g. a fleet ticket).
+      auto: spawn the background refresh thread when armed (the
+        serving loop's hands-free mode); ``False`` leaves refreshes to
+        explicit :meth:`refresh_now` calls (tests).
+      cooldown_batches: observed batches required between auto
+        refreshes — a spike that refits but does NOT clear the publish
+        threshold must not re-refit on every subsequent batch.
+      metrics: optional ``MetricsLogger`` — drift events land in
+        ``summary()["serving"]``.
+    """
+
+    def __init__(
+        self,
+        registry: EigenbasisRegistry,
+        cfg,
+        *,
+        threshold: float = 0.25,
+        arm_ratio: float | None = None,
+        ema_alpha: float = 0.2,
+        buffer_rows: int | None = None,
+        supervise: bool = True,
+        refit: Callable | None = None,
+        auto: bool = True,
+        cooldown_batches: int = 8,
+        metrics=None,
+    ):
+        if threshold <= 0:
+            raise ValueError(f"threshold must be > 0, got {threshold}")
+        self.registry = registry
+        self.cfg = cfg
+        self.threshold = threshold
+        self.arm_ratio = (
+            threshold / 2.0 if arm_ratio is None else arm_ratio
+        )
+        self.ema_alpha = ema_alpha
+        self.supervise = supervise
+        self.refit = refit
+        self.auto = auto
+        self.cooldown_batches = cooldown_batches
+        self._observes_since_refresh = 0
+        self.metrics = metrics
+        rows_per_step = cfg.num_workers * cfg.rows_per_worker
+        self.buffer_rows = buffer_rows or cfg.num_steps * rows_per_step
+        self._lock = threading.Lock()
+        self._buffer: list[np.ndarray] = []
+        self._buffered = 0
+        self._ewma: float | None = None
+        self._baseline: float | None = None
+        self._baseline_version: int | None = None
+        self._refresh_lock = threading.Lock()
+        self._refresh_thread: threading.Thread | None = None
+        #: last computed drift score (refreshes update it)
+        self.last_score: float | None = None
+        self.refreshes = 0
+
+    # -- cheap always-on signal ---------------------------------------------
+
+    def _live_baseline(self) -> float | None:
+        """Residual-ratio baseline for the CURRENT live version: from
+        its published explained-variance summary when available, else
+        the first EWMA observed while it was live (re-anchored on every
+        version change, so a refresh resets the tripwire)."""
+        live = self.registry.latest()
+        if live is None:
+            return None
+        if self._baseline_version != live.version:
+            self._baseline_version = live.version
+            energy = live.explained_variance.get("top_k_energy")
+            self._baseline = (
+                max(0.0, 1.0 - energy) if energy is not None else None
+            )
+        return self._baseline
+
+    def observe(self, residual_sq: float, input_sq: float,
+                rows=None) -> float:
+        """Fold one served batch's energies; returns the current
+        residual drift (EWMA ratio minus the live baseline). Called by
+        the :class:`~..serving.server.QueryServer` dispatch lane —
+        cheap, lock-scoped host arithmetic only."""
+        ratio = residual_sq / max(input_sq, _EPS)
+        with self._lock:
+            self._ewma = (
+                ratio if self._ewma is None
+                else (1 - self.ema_alpha) * self._ewma
+                + self.ema_alpha * ratio
+            )
+            baseline = self._live_baseline()
+            if baseline is None:
+                # no published energy summary: first impression is the
+                # baseline (drift is measured as departure from it)
+                self._baseline = baseline = self._ewma
+            drift = max(0.0, self._ewma - baseline)
+            if rows is not None:
+                arr = np.asarray(rows, np.float32)
+                self._buffer.append(arr)
+                self._buffered += arr.shape[0]
+                while (
+                    len(self._buffer) > 1
+                    and self._buffered - self._buffer[0].shape[0]
+                    >= self.buffer_rows
+                ):
+                    self._buffered -= self._buffer.pop(0).shape[0]
+            self._observes_since_refresh += 1
+            armed = (
+                drift > self.arm_ratio
+                and self._buffered >= self.cfg.num_workers
+                * self.cfg.rows_per_worker
+                and (
+                    self.refreshes == 0
+                    or self._observes_since_refresh
+                    >= self.cooldown_batches
+                )
+            )
+        if armed and self.auto:
+            self._spawn_refresh()
+        return drift
+
+    def residual_drift(self) -> float:
+        with self._lock:
+            if self._ewma is None:
+                return 0.0
+            baseline = self._live_baseline()
+            if baseline is None:
+                return 0.0
+            return max(0.0, self._ewma - baseline)
+
+    # -- paid confirmation + republish ---------------------------------------
+
+    def _spawn_refresh(self) -> None:
+        if self._refresh_lock.locked():
+            return  # one background refresh in flight at a time
+        t = threading.Thread(target=self.refresh_now, daemon=True)
+        self._refresh_thread = t
+        t.start()
+
+    def join_refresh(self, timeout: float | None = None) -> None:
+        """Wait for an in-flight background refresh (tests / shutdown)."""
+        t = self._refresh_thread
+        if t is not None:
+            t.join(timeout)
+
+    def _run_refit(self, rows: np.ndarray):
+        """The background refit: supervised by default (a corrupt
+        buffered block quarantines instead of killing the refresh), or
+        the caller's ``refit`` override. Returns ``(w, state)``."""
+        if self.refit is not None:
+            return self.refit(rows)
+        cfg = self.cfg
+        rows_per_step = cfg.num_workers * cfg.rows_per_worker
+        steps = max(1, len(rows) // rows_per_step)
+        cfg = cfg.replace(num_steps=steps)
+        if self.supervise:
+            from distributed_eigenspaces_tpu.data.stream import (
+                block_stream,
+            )
+            from distributed_eigenspaces_tpu.runtime.supervisor import (
+                supervised_fit,
+            )
+
+            def factory(start_row):
+                return block_stream(
+                    rows,
+                    num_workers=cfg.num_workers,
+                    rows_per_worker=cfg.rows_per_worker,
+                    start_row=start_row,
+                    remainder=cfg.remainder,
+                    device=False,
+                )
+
+            w, state, _sup = supervised_fit(
+                factory, cfg, metrics=self.metrics
+            )
+            return w, state
+        from distributed_eigenspaces_tpu.api.estimator import (
+            OnlineDistributedPCA,
+        )
+
+        est = OnlineDistributedPCA(cfg)
+        est.fit(rows)
+        return est.components_, est.state
+
+    def refresh_now(self) -> BasisVersion | None:
+        """Run the refit + angle confirmation inline; publish and return
+        the new version when the score clears the threshold, else None.
+        Serializes with the auto-spawned background refresh."""
+        with self._refresh_lock:
+            with self._lock:
+                if not self._buffer:
+                    return None
+                rows = np.concatenate(self._buffer, axis=0)
+                drift = (
+                    max(0.0, (self._ewma or 0.0) - (self._baseline or 0.0))
+                    if self._ewma is not None else 0.0
+                )
+            live = self.registry.latest()
+            if live is None:
+                return None
+            w, state = self._run_refit(rows)
+
+            from distributed_eigenspaces_tpu.ops.linalg import (
+                principal_angles_degrees,
+            )
+
+            angle = float(
+                np.max(
+                    np.asarray(
+                        principal_angles_degrees(
+                            np.asarray(w), live.v
+                        )
+                    )
+                )
+            )
+            score = drift + angle / 90.0
+            self.last_score = score
+            self.refreshes += 1
+            with self._lock:
+                self._observes_since_refresh = 0
+            published = None
+            if score >= self.threshold:
+                published = self.registry.publish(
+                    np.asarray(w),
+                    sigma_tilde=(
+                        state.sigma_tilde
+                        if hasattr(state, "sigma_tilde")
+                        and np.asarray(state.sigma_tilde).ndim == 2
+                        else None
+                    ),
+                    step=int(state.step) if state is not None else 0,
+                    lineage={
+                        "producer": "drift_refresh",
+                        "base_version": live.version,
+                        "trigger_score": round(score, 4),
+                        "supervised": self.supervise
+                        and self.refit is None,
+                    },
+                )
+                with self._lock:
+                    # re-anchor the tripwire on the new version
+                    self._ewma = None
+            if self.metrics is not None:
+                self.metrics.serve({
+                    "kind": "drift",
+                    "score": round(score, 4),
+                    "residual_drift": round(drift, 4),
+                    "angle_gap_deg": round(angle, 4),
+                    "refit_rows": int(len(rows)),
+                    "published": (
+                        published.version if published else None
+                    ),
+                })
+            return published

@@ -1,0 +1,237 @@
+"""Versioned eigenbasis registry: immutable publishes, lock-free reads.
+
+A live serving tier cannot hand queries a basis that is half-written,
+and it cannot block the query path on a publisher's lock. Both follow
+from one rule: a :class:`BasisVersion` is FULLY CONSTRUCTED (arrays
+copied to host, frozen read-only, diagnostics computed) before the
+registry ever sees it, and publication is a single reference assignment
+— the CPython-atomic write readers observe either entirely or not at
+all. ``latest()`` therefore takes no lock: an in-flight query batch
+that grabbed version ``t`` keeps projecting against version ``t`` even
+while ``t+1`` publishes and ``t-N`` is garbage-collected, because the
+version object itself is immutable and reference-held.
+
+Lineage makes a served projection auditable back to its producer: every
+version records which trainer/checkpoint/fit made it, its step count,
+and an explained-variance summary — the registry is the system of
+record connecting the fit fleet's write side to the query tier's read
+side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Mapping
+
+import numpy as np
+
+__all__ = ["BasisVersion", "EigenbasisRegistry"]
+
+
+def _frozen_array(a, dtype=np.float32) -> np.ndarray:
+    """Host copy with the write flag dropped: the version's arrays must
+    not be mutable through any alias — a publisher reusing its buffer
+    would otherwise mutate a version already being served."""
+    arr = np.array(np.asarray(a), dtype=dtype, copy=True)
+    arr.setflags(write=False)
+    return arr
+
+
+@dataclasses.dataclass(frozen=True)
+class BasisVersion:
+    """One immutable published eigenbasis.
+
+    Attributes:
+      version: monotonically increasing id (assigned by the registry).
+      v: ``(d, k)`` orthonormal basis, host-resident, read-only.
+      sigma_tilde: optional ``(d, d)`` state snapshot the basis was
+        extracted from (read-only; large — publishers may omit it).
+      signature: ``(d, k)`` — the shape contract a query batch checks.
+      step: the producing fit's online step count.
+      explained_variance: summary diagnostics (e.g. the top-k energy
+        fraction of the producing state) — what a dashboard shows next
+        to the version id.
+      lineage: provenance of the producing fit — trainer name,
+        checkpoint path, fleet ticket, refit trigger — whatever the
+        publisher knows. Stored as an immutable snapshot.
+    """
+
+    version: int
+    v: np.ndarray
+    sigma_tilde: np.ndarray | None
+    signature: tuple[int, int]
+    step: int
+    explained_variance: dict[str, float]
+    lineage: dict[str, Any]
+
+    @property
+    def d(self) -> int:
+        return self.signature[0]
+
+    @property
+    def k(self) -> int:
+        return self.signature[1]
+
+
+class EigenbasisRegistry:
+    """Append-only store of :class:`BasisVersion` with lock-free reads.
+
+    ``publish`` validates and freezes the version OUTSIDE the lock,
+    assigns the next id and the ``latest`` pointer inside it, and GCs
+    down to the newest ``keep`` versions. ``latest()`` is a plain
+    attribute read — never blocked by a publisher, never a torn value.
+    """
+
+    def __init__(self, *, keep: int = 4):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.keep = keep
+        self._lock = threading.Lock()
+        self._versions: dict[int, BasisVersion] = {}
+        self._latest: BasisVersion | None = None
+        self._next_id = 1
+
+    # -- write side ----------------------------------------------------------
+
+    def publish(
+        self,
+        v,
+        *,
+        sigma_tilde=None,
+        step: int = 0,
+        explained_variance: Mapping[str, float] | None = None,
+        lineage: Mapping[str, Any] | None = None,
+    ) -> BasisVersion:
+        """Publish one basis as the new latest version; returns it.
+
+        The basis is copied, frozen, and validated (2-D, finite) before
+        the swap — a rejected publish leaves the registry untouched, and
+        an accepted one is visible to ``latest()`` only as a complete
+        version.
+        """
+        arr = _frozen_array(v)
+        if arr.ndim != 2:
+            raise ValueError(
+                f"basis must be (d, k), got shape {arr.shape}"
+            )
+        if not np.isfinite(arr).all():
+            raise ValueError(
+                "refusing to publish a non-finite basis (serving it "
+                "would poison every query batch that grabs it)"
+            )
+        st = None
+        ev = dict(explained_variance or {})
+        if sigma_tilde is not None:
+            st = _frozen_array(sigma_tilde)
+            if st.shape != (arr.shape[0], arr.shape[0]):
+                raise ValueError(
+                    f"sigma_tilde shape {st.shape} != "
+                    f"({arr.shape[0]}, {arr.shape[0]})"
+                )
+            if "top_k_energy" not in ev:
+                # fraction of the state's variance the published basis
+                # captures — the number drift is measured against
+                trace = float(np.trace(st))
+                if trace > 0:
+                    ev["top_k_energy"] = round(
+                        float(np.trace(arr.T @ st @ arr)) / trace, 6
+                    )
+        bv_partial = dict(
+            v=arr,
+            sigma_tilde=st,
+            signature=(int(arr.shape[0]), int(arr.shape[1])),
+            step=int(step),
+            explained_variance=ev,
+            lineage=dict(lineage or {}),
+        )
+        with self._lock:
+            bv = BasisVersion(version=self._next_id, **bv_partial)
+            self._next_id += 1
+            self._versions[bv.version] = bv
+            # single reference assignment = the atomic hot-swap point
+            self._latest = bv
+            while len(self._versions) > self.keep:
+                oldest = min(self._versions)
+                del self._versions[oldest]
+        return bv
+
+    def publish_fit(self, estimator, *, lineage: Mapping[str, Any] | None = None,
+                    include_state: bool = True) -> BasisVersion:
+        """Publish an ``OnlineDistributedPCA`` fit's result.
+
+        Lineage records the trainer the fit actually ran
+        (``trainer_used_``) and its checkpoint dir when present; the
+        dense state snapshot rides along (``include_state=True``) so
+        drift monitoring can diff explained variance later. Low-rank /
+        sketch states have no dense ``sigma_tilde`` — the snapshot is
+        skipped for those, never synthesized.
+        """
+        w = estimator.components_  # raises before fit — the right error
+        lin = {
+            "producer": "OnlineDistributedPCA",
+            "trainer": estimator.trainer_used_,
+        }
+        if estimator.checkpoint_dir is not None:
+            lin["checkpoint_dir"] = estimator.checkpoint_dir
+        lin.update(lineage or {})
+        state = estimator.state
+        step = int(state.step) if state is not None else 0
+        sigma = (
+            state.sigma_tilde
+            if include_state and hasattr(state, "sigma_tilde")
+            else None
+        )
+        return self.publish(
+            np.asarray(w), sigma_tilde=sigma, step=step, lineage=lin
+        )
+
+    def publish_fleet(self, result, tenant: int, *,
+                      lineage: Mapping[str, Any] | None = None,
+                      include_state: bool = True) -> BasisVersion:
+        """Publish one tenant's basis from a ``parallel/fleet.py``
+        ``FleetResult`` — the fleet → registry edge of the serving
+        loop. Lineage records the tenant index and the fleet batch's
+        shape signature, so a served projection is attributable to the
+        exact multi-tenant dispatch that produced its basis."""
+        if not (0 <= tenant < len(result.components)):
+            raise ValueError(
+                f"tenant {tenant} out of range for a "
+                f"{len(result.components)}-tenant fleet result"
+            )
+        lin = {
+            "producer": "fit_fleet",
+            "tenant": int(tenant),
+            "fleet_signature": tuple(result.batch.signature),
+        }
+        lin.update(lineage or {})
+        return self.publish(
+            result.components[tenant],
+            sigma_tilde=(
+                result.states.sigma_tilde[tenant]
+                if include_state else None
+            ),
+            step=int(result.states.step[tenant]),
+            lineage=lin,
+        )
+
+    # -- read side -----------------------------------------------------------
+
+    def latest(self) -> BasisVersion | None:
+        """The newest complete version — lock-free (one attribute read;
+        publishers swap it with one assignment)."""
+        return self._latest
+
+    def get(self, version: int) -> BasisVersion:
+        """A retained version by id; KeyError once GC'd."""
+        with self._lock:
+            return self._versions[version]
+
+    def versions(self) -> list[int]:
+        """Retained version ids, oldest first."""
+        with self._lock:
+            return sorted(self._versions)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._versions)

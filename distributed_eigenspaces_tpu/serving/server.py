@@ -1,0 +1,274 @@
+"""QueryServer: deadline micro-batched projection against the registry.
+
+The write-side dual of ``parallel/fleet.FleetServer``: where fleet
+admission batches independent FITS into one vmapped program, query
+admission batches independent TRANSFORM requests into one padded
+projection dispatch. The same no-starvation rule applies — a micro-batch
+dispatches when FULL (``cfg.serve_bucket_size`` queries) or when its
+OLDEST query has waited ``cfg.serve_flush_s`` — and dispatch rides the
+same ``runtime/scheduler`` machinery (lease/retry, idempotent
+completion), so the serving tier inherits the scheduler's liveness
+guarantees instead of reimplementing them.
+
+Correctness properties (each pinned by tests):
+
+- **One basis per batch, no torn reads.** A dispatch lane reads
+  ``registry.latest()`` exactly ONCE and projects every query in the
+  batch against that version object (immutable, reference-held). A
+  publish that lands mid-batch affects only later batches.
+- **Double-buffered swap, zero stall.** The device-resident basis is a
+  ``(version_id, array)`` pair swapped by reference; in-flight batches
+  keep the old array alive, and the kernels take the basis as an
+  operand (``serving/transform.py``), so a swap is one device_put — no
+  recompile, no drained queue.
+- **Per-request error isolation.** A query with non-finite rows fails
+  ITS ticket (with the offending row indices) and is excluded from the
+  batch; its neighbors' projections are untouched — the exact dual of
+  the fleet's per-tenant quarantine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_eigenspaces_tpu.runtime.scheduler import ShapeBucketQueue
+from distributed_eigenspaces_tpu.serving.registry import EigenbasisRegistry
+from distributed_eigenspaces_tpu.serving.transform import TransformEngine
+
+__all__ = ["QueryServer", "ServedProjection"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServedProjection:
+    """One resolved query: the projection, the residual energies the
+    drift monitor folds, and the basis version that served it (the
+    auditable link back through the registry's lineage)."""
+
+    z: np.ndarray  # (rows, k)
+    residual_sq: np.ndarray  # (rows,) per-row residual energy
+    input_sq: np.ndarray  # (rows,) per-row input energy
+    version: int
+
+
+@dataclasses.dataclass
+class _QueryRequest:
+    x: np.ndarray  # (rows, d) host rows, width-validated at submit
+    t_submit: float
+
+
+class QueryServer:
+    """Micro-batched transform serving against an
+    :class:`~..serving.registry.EigenbasisRegistry`.
+
+    ``submit(x)`` admits one ``(rows, d)`` query (a ``(d,)`` vector is
+    one row) and returns a ticket whose ``.result()`` blocks for a
+    :class:`ServedProjection`. ``drift`` (a
+    :class:`~..serving.drift.DriftMonitor`) receives every served
+    batch's residual energies and recent rows — the hook that closes
+    the serve → drift → refit loop.
+    """
+
+    def __init__(
+        self,
+        registry: EigenbasisRegistry,
+        cfg=None,
+        *,
+        d: int | None = None,
+        k: int | None = None,
+        bucket_size: int | None = None,
+        flush_s: float | None = None,
+        mesh=None,
+        metrics=None,
+        drift=None,
+        num_lanes: int = 1,
+        max_retries: int = 3,
+        lease_timeout: float | None = None,
+        engine: TransformEngine | None = None,
+    ):
+        live = registry.latest()
+        if d is None:
+            d = cfg.dim if cfg is not None else (live.d if live else None)
+        if k is None:
+            k = cfg.k if cfg is not None else (live.k if live else None)
+        if d is None or k is None:
+            raise ValueError(
+                "QueryServer needs a (d, k) signature: pass cfg / d+k, "
+                "or publish a version before constructing"
+            )
+        if bucket_size is None:
+            bucket_size = cfg.serve_bucket_size if cfg is not None else 8
+        if flush_s is None:
+            flush_s = cfg.serve_flush_s if cfg is not None else 0.02
+        self.registry = registry
+        self.d, self.k = int(d), int(k)
+        self.bucket_size = bucket_size
+        self.metrics = metrics
+        self.drift = drift
+        self.engine = engine or TransformEngine(self.d, self.k, mesh=mesh)
+        #: served-version bookkeeping: the last version a batch used and
+        #: how many hot-swaps dispatch has observed
+        self.swap_count = 0
+        self._served_version: int | None = None
+        self.queue = ShapeBucketQueue(
+            bucket_size=bucket_size,
+            flush_deadline=flush_s,
+            max_retries=max_retries,
+            lease_timeout=lease_timeout,
+        )
+        self._num_lanes = max(num_lanes, 1)
+        self._thread = threading.Thread(
+            target=self._serve_loop, daemon=True
+        )
+        self._thread.start()
+
+    def _serve_loop(self) -> None:
+        try:
+            self.queue.serve(self._run_batch, num_lanes=self._num_lanes)
+        except Exception as e:
+            # terminal dispatch failure (retries exhausted): every
+            # unresolved ticket was already failed with the cause by
+            # ShapeBucketQueue.serve — waiters see it; the lane thread
+            # logs instead of dying through the unhandled-thread hook
+            from distributed_eigenspaces_tpu.utils.metrics import (
+                log_line,
+            )
+
+            log_line("query server dispatch aborted", error=repr(e))
+
+    # -- client API ----------------------------------------------------------
+
+    def submit(self, x):
+        """Admit one query; returns its ticket. Width is validated HERE
+        (a malformed request must fail its caller at the door, not a
+        batch three layers down)."""
+        arr = np.asarray(x, np.float32)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        if arr.ndim != 2 or arr.shape[1] != self.d:
+            raise ValueError(
+                f"query shape {np.shape(x)} does not match the served "
+                f"signature: want (rows, {self.d})"
+            )
+        if arr.shape[0] < 1:
+            raise ValueError("empty query (zero rows)")
+        return self.queue.submit(
+            (self.d, self.k),
+            _QueryRequest(x=arr, t_submit=time.perf_counter()),
+        )
+
+    def close(self) -> None:
+        """Flush partial micro-batches, drain, join dispatch lanes."""
+        self.queue.close()
+        self._thread.join()
+
+    def __enter__(self) -> "QueryServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _basis_device(self, ver):
+        """Device-resident basis for ``ver`` — the double buffer: a
+        ``(version_id, array)`` pair swapped by reference, so in-flight
+        batches holding the previous array are untouched and a swap
+        never blocks on them."""
+        pair = getattr(self, "_dev_basis", None)
+        if pair is not None and pair[0] == ver.version:
+            return pair[1]
+        arr = jnp.asarray(ver.v)  # device_put; old buffer stays alive
+        self._dev_basis = (ver.version, arr)
+        return arr
+
+    def _run_batch(self, bucket) -> list:
+        t0 = time.perf_counter()
+        reqs = [t.payload for t in bucket.tickets]
+        ver = self.registry.latest()
+        if ver is None:
+            raise RuntimeError(
+                "no published basis: publish to the registry before "
+                "serving queries"
+            )
+        if ver.signature != (self.d, self.k):
+            raise RuntimeError(
+                f"live version {ver.version} has signature "
+                f"{ver.signature}; this server serves ({self.d}, {self.k})"
+            )
+        swap = (
+            self._served_version is not None
+            and self._served_version != ver.version
+        )
+        if swap:
+            self.swap_count += 1
+        self._served_version = ver.version
+
+        # per-request quarantine: a non-finite query fails ITS ticket
+        # and leaves the batch; everyone else is served normally
+        good: list[int] = []
+        fails: dict[int, Exception] = {}
+        for i, req in enumerate(reqs):
+            finite = np.isfinite(req.x).all(axis=1)
+            if finite.all():
+                good.append(i)
+            else:
+                bad_rows = [int(r) for r in np.nonzero(~finite)[0]]
+                fails[i] = ValueError(
+                    f"query contains non-finite rows {bad_rows} — "
+                    "rejected (its batch neighbors were served)"
+                )
+
+        results: list[Any] = [None] * len(reqs)
+        if good:
+            v_dev = self._basis_device(ver)
+            x = np.concatenate([reqs[i].x for i in good], axis=0)
+            z = self.engine.project(x, v_dev)
+            r_sq, e_sq = self.engine.residual_energy(x, z)
+            z = np.asarray(z)
+            r_sq = np.asarray(r_sq)
+            e_sq = np.asarray(e_sq)
+            off = 0
+            for i in good:
+                rows = reqs[i].x.shape[0]
+                results[i] = ServedProjection(
+                    z=z[off : off + rows],
+                    residual_sq=r_sq[off : off + rows],
+                    input_sq=e_sq[off : off + rows],
+                    version=ver.version,
+                )
+                off += rows
+        for i, exc in fails.items():
+            bucket.tickets[i].fail(exc)
+            # the scheduler's fold skips already-resolved tickets via
+            # FleetTicket.resolve's event — mark the slot served anyway
+            results[i] = ServedProjection(
+                z=np.zeros((0, self.k), np.float32),
+                residual_sq=np.zeros(0, np.float32),
+                input_sq=np.zeros(0, np.float32),
+                version=ver.version,
+            )
+
+        now = time.perf_counter()
+        if self.metrics is not None:
+            self.metrics.serve({
+                "kind": "batch",
+                "queries": len(reqs),
+                "rejected": len(fails),
+                "rows": int(sum(r.x.shape[0] for r in reqs)),
+                "batch_seconds": round(now - t0, 6),
+                "query_latency_s": [
+                    round(now - r.t_submit, 6) for r in reqs
+                ],
+                "occupancy": round(len(reqs) / self.bucket_size, 4),
+                "version": ver.version,
+                "swap": swap,
+            })
+        if self.drift is not None and good:
+            self.drift.observe(float(r_sq.sum()), float(e_sq.sum()), rows=x)
+        return results

@@ -1,0 +1,236 @@
+"""Jitted query kernels: basis as a TRACED argument, shape-bucketed rows.
+
+Two disciplines make a hot-swappable serving tier cheap:
+
+1. **The basis is an argument, never a constant.** Closing a jit over
+   ``V`` would bake the version into the executable — every hot-swap
+   would recompile, and a swap under traffic would stall the admission
+   queue behind XLA. Here every kernel is ``f(x, v)``: publishing
+   version ``t+1`` changes an operand, not a program, so the swap costs
+   one device_put (machine-checked: tests count compile-cache misses
+   across a swap and find zero).
+
+2. **Rows pad to shape buckets.** Query batches arrive at arbitrary row
+   counts; compiling per count would grow the jit cache without bound
+   (the same discipline ``runtime/scheduler.ShapeBucketQueue`` applies
+   to fleet admission, applied to the row axis). :func:`bucket_rows`
+   pads to the next power of two (floored at ``min_bucket``), so the
+   cache holds O(log max_batch) programs per kernel. Padding rows are
+   zeros; a row's projection is independent of its neighbors (one
+   matmul row = one dot), so padded results equal unpadded ones
+   BIT-FOR-BIT — pinned in tests, and the contract the served-vs-direct
+   equality gate rests on.
+
+The optional mesh path shards the padded row axis over the existing
+``workers`` mesh axis as pure data parallelism — the axis name is never
+used inside the kernel, so the partitioned program contains ZERO
+collectives by construction (audited like the fleet trainer, via
+``utils.collectives_audit``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_eigenspaces_tpu.parallel.mesh import (
+    WORKER_AXIS,
+    shard_map,
+)
+
+__all__ = ["TransformEngine", "bucket_rows"]
+
+
+def bucket_rows(n: int, *, min_bucket: int = 8, multiple_of: int = 1) -> int:
+    """Padded row count for an ``n``-row batch: next power of two,
+    floored at ``min_bucket``, rounded up to ``multiple_of`` (the mesh
+    path needs the row axis divisible by its worker count)."""
+    if n < 1:
+        raise ValueError(f"need n >= 1, got {n}")
+    b = max(min_bucket, 1 << (n - 1).bit_length())
+    if b % multiple_of:
+        b = ((b + multiple_of - 1) // multiple_of) * multiple_of
+    return b
+
+
+def _precision_for(dtype) -> jax.lax.Precision | None:
+    # mirror api/estimator.OnlineDistributedPCA.transform exactly: the
+    # served projection's bit-for-bit contract against the direct path
+    # is a contract about running the SAME matmul
+    return (
+        jax.lax.Precision.HIGHEST
+        if jnp.dtype(dtype) == jnp.dtype(jnp.float32) else None
+    )
+
+
+class TransformEngine:
+    """Compile-cached projection / reconstruction / residual kernels for
+    one ``(d, k)`` signature.
+
+    All kernels take the basis as an operand (hot-swap reuses the
+    program). AOT-compiled per ``(kind, padded_rows)`` with explicit
+    hit/miss counters, so a serving test can ASSERT a basis swap did
+    not recompile (``stats()["compile_misses"]`` unchanged) instead of
+    hoping. ``mesh`` shards the padded row axis over the ``workers``
+    mesh axis (zero collectives — the kernels are row-local).
+    """
+
+    def __init__(self, d: int, k: int, *, dtype=jnp.float32, mesh=None,
+                 min_bucket: int = 8):
+        if not (0 < k <= d):
+            raise ValueError(f"need 0 < k <= d, got k={k}, d={d}")
+        self.d = int(d)
+        self.k = int(k)
+        self.dtype = jnp.dtype(dtype)
+        self.mesh = mesh
+        self.min_bucket = min_bucket
+        self._row_multiple = (
+            1 if mesh is None else int(mesh.shape[WORKER_AXIS])
+        )
+        self._cache: dict = {}
+        self.compile_misses = 0
+        self.cache_hits = 0
+        prec = _precision_for(self.dtype)
+
+        def project(x, v):
+            return jnp.matmul(x, v.astype(x.dtype), precision=prec)
+
+        def reconstruct(z, v):
+            return jnp.matmul(z, v.T.astype(z.dtype), precision=prec)
+
+        def residual(x, z):
+            # per-row residual energy ||x||^2 - ||xV||^2 (>= 0 for an
+            # orthonormal V up to rounding; clamped so drift scores
+            # never go negative on noise)
+            e_in = jnp.sum(
+                x.astype(jnp.float32) ** 2, axis=-1
+            )
+            e_out = jnp.sum(z.astype(jnp.float32) ** 2, axis=-1)
+            return jnp.maximum(e_in - e_out, 0.0), e_in
+
+        self._fns = {
+            "project": (project, self._x_like, (self.d, self.k)),
+            "reconstruct": (reconstruct, self._z_like, (self.d, self.k)),
+            "residual": (residual, self._x_like, None),
+        }
+
+    # -- operand shapes ------------------------------------------------------
+
+    def _x_like(self, rows):
+        return jax.ShapeDtypeStruct((rows, self.d), self.dtype)
+
+    def _z_like(self, rows):
+        return jax.ShapeDtypeStruct((rows, self.k), self.dtype)
+
+    # -- compile cache -------------------------------------------------------
+
+    def _compiled(self, kind: str, rows: int):
+        key = (kind, rows)
+        hit = self._cache.get(key)
+        if hit is not None:
+            self.cache_hits += 1
+            return hit
+        self.compile_misses += 1
+        fn, arg_like, second_shape = self._fns[kind]
+        if kind == "residual":
+            second = self._z_like(rows)
+        else:
+            second = jax.ShapeDtypeStruct(second_shape, jnp.float32)
+        if self.mesh is None:
+            compiled = jax.jit(fn).lower(arg_like(rows), second).compile()
+        else:
+            # rows over the workers axis, basis replicated (the residual
+            # kernel's second operand is the per-row projection — it
+            # shards with the rows); the axis name is never used ->
+            # zero collectives by construction
+            rows_sh = NamedSharding(self.mesh, P(WORKER_AXIS))
+            rep_sh = NamedSharding(self.mesh, P())
+            row_second = kind == "residual"
+            out_specs = (
+                (P(WORKER_AXIS), P(WORKER_AXIS))
+                if row_second else P(WORKER_AXIS)
+            )
+            inner = shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(
+                    P(WORKER_AXIS),
+                    P(WORKER_AXIS) if row_second else P(),
+                ),
+                out_specs=out_specs,
+                check_vma=False,
+            )
+            compiled = (
+                jax.jit(
+                    inner,
+                    in_shardings=(
+                        rows_sh, rows_sh if row_second else rep_sh
+                    ),
+                )
+                .lower(arg_like(rows), second)
+                .compile()
+            )
+        self._cache[key] = compiled
+        return compiled
+
+    def compiled_for(self, kind: str, rows: int):
+        """The compiled executable for one ``(kind, padded_rows)`` pair —
+        tests audit its HLO for collectives; does not bump counters
+        beyond a normal cache access."""
+        return self._compiled(kind, rows)
+
+    def stats(self) -> dict:
+        return {
+            "compile_misses": self.compile_misses,
+            "cache_hits": self.cache_hits,
+            "buckets": sorted({r for _, r in self._cache}),
+        }
+
+    # -- padded dispatch -----------------------------------------------------
+
+    def _pad(self, x, width: int):
+        x = jnp.asarray(x, self.dtype)
+        if x.ndim != 2 or x.shape[1] != width:
+            raise ValueError(
+                f"query batch must be (rows, {width}), got shape "
+                f"{tuple(x.shape)}"
+            )
+        rows = int(x.shape[0])
+        padded = bucket_rows(
+            rows, min_bucket=self.min_bucket,
+            multiple_of=self._row_multiple,
+        )
+        if padded != rows:
+            x = jnp.zeros((padded, width), self.dtype).at[:rows].set(x)
+        return x, rows
+
+    def project(self, x, v) -> jax.Array:
+        """``(n, d) -> (n, k)`` against basis ``v`` — pad, dispatch the
+        bucket program, slice. Numerically the direct ``x @ V`` (same
+        precision), bit-for-bit regardless of padding."""
+        x_pad, rows = self._pad(x, self.d)
+        z = self._compiled("project", int(x_pad.shape[0]))(
+            x_pad, jnp.asarray(v, jnp.float32)
+        )
+        return z[:rows]
+
+    def reconstruct(self, z, v) -> jax.Array:
+        """``(n, k) -> (n, d)`` back-projection against basis ``v``."""
+        z_pad, rows = self._pad(z, self.k)
+        x = self._compiled("reconstruct", int(z_pad.shape[0]))(
+            z_pad, jnp.asarray(v, jnp.float32)
+        )
+        return x[:rows]
+
+    def residual_energy(self, x, z) -> tuple[jax.Array, jax.Array]:
+        """Per-row ``(residual_sq, input_sq)`` energies from a query
+        batch and its projection — the drift monitor's raw signal.
+        Zero-padded rows contribute zero to both (harmless)."""
+        x_pad, rows = self._pad(x, self.d)
+        z_pad, _ = self._pad(z, self.k)
+        r, e = self._compiled("residual", int(x_pad.shape[0]))(
+            x_pad, z_pad
+        )
+        return r[:rows], e[:rows]

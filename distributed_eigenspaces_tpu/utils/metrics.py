@@ -44,6 +44,10 @@ class MetricsLogger:
         #: attached via :meth:`attach_ingest` — surfaced by
         #: :meth:`summary` under "ingest"
         self.ingest_stats = None
+        #: query-serving events (serving/server.py QueryServer batches,
+        #: serving/drift.py DriftMonitor refreshes) — surfaced by
+        #: :meth:`summary` under "serving"
+        self.serve_records: list[dict] = []
         self._last_time = None
 
     def start(self) -> "MetricsLogger":
@@ -85,6 +89,19 @@ class MetricsLogger:
         self.ingest_stats = stats
         return self
 
+    def serve(self, event: dict) -> None:
+        """Record one structured serving event — a dispatched query
+        micro-batch (``kind="batch"``: query count, per-query
+        latencies, occupancy, basis version, swap flag) or a drift
+        refresh (``kind="drift"``: score, angle gap, published
+        version). Rides the same JSON stream as step records, tagged
+        ``"serve"``."""
+        rec = {"serve": event.get("kind", "batch"), **event}
+        rec.setdefault("t", time.perf_counter())
+        self.serve_records.append(rec)
+        if self.stream is not None:
+            print(json.dumps(rec), file=self.stream, flush=True)
+
     def fault(self, event: dict) -> None:
         """Record one structured fault event (a supervisor detection /
         recovery action). Events ride the same JSON stream as step
@@ -121,6 +138,54 @@ class MetricsLogger:
             }
         if self.ingest_stats is not None:
             out["ingest"] = self.ingest_stats.as_dict()
+        if self.serve_records:
+            out["serving"] = self._serving_summary()
+        return out
+
+    def _serving_summary(self) -> dict:
+        """The ``summary()["serving"]`` section (mirrors ``["ingest"]``):
+        qps over the served window, p50/p99 query latency, mean batch
+        occupancy, hot-swap count, and the latest drift score."""
+        batches = [r for r in self.serve_records if r["serve"] == "batch"]
+        out: dict = {"batches": len(batches)}
+        if batches:
+            queries = sum(r.get("queries", 0) for r in batches)
+            out["queries"] = queries
+            out["rejected"] = sum(r.get("rejected", 0) for r in batches)
+            ts = [r["t"] for r in batches]
+            span = max(ts) - min(ts)
+            if len(batches) > 1 and span > 0:
+                # arrival-window rate; a single batch has no window, so
+                # its own dispatch time is the only honest denominator
+                out["qps"] = round(queries / span, 1)
+            else:
+                secs = sum(r.get("batch_seconds", 0.0) for r in batches)
+                if secs > 0:
+                    out["qps"] = round(queries / secs, 1)
+            lat = sorted(
+                l for r in batches for l in r.get("query_latency_s", ())
+            )
+            if lat:
+                out["p50_latency_s"] = round(
+                    lat[len(lat) // 2], 6
+                )
+                out["p99_latency_s"] = round(
+                    lat[min(len(lat) - 1, int(len(lat) * 0.99))], 6
+                )
+            occ = [r["occupancy"] for r in batches if "occupancy" in r]
+            if occ:
+                out["mean_occupancy"] = round(sum(occ) / len(occ), 4)
+            out["swaps"] = sum(1 for r in batches if r.get("swap"))
+            versions = {r["version"] for r in batches if "version" in r}
+            out["versions_served"] = sorted(versions)
+        drifts = [r for r in self.serve_records if r["serve"] == "drift"]
+        if drifts:
+            out["drift_refreshes"] = len(drifts)
+            out["drift_score"] = drifts[-1].get("score")
+            out["drift_published"] = [
+                r["published"] for r in drifts
+                if r.get("published") is not None
+            ]
         return out
 
 

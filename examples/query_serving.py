@@ -1,0 +1,187 @@
+"""Query serving end to end: publish → serve → drift → auto-refresh.
+
+The paper's online loop closed as a serving system (ISSUE 4, the read
+side of the fleet demo): a fitted eigenbasis publishes to a VERSIONED
+registry, transform queries stream through a micro-batched
+:class:`QueryServer`, and when the data walks away from the published
+subspace the :class:`DriftMonitor` notices from the served residual
+energy alone, refits in the background under the fault-detecting
+supervisor, and publishes the refreshed basis as a new version that the
+very next micro-batch serves — no restart, no recompile. Four acts:
+
+1. **publish**: fit on spectrum A, publish version 1 (immutable, with
+   explained-variance summary and lineage back to the producing
+   trainer);
+2. **serve**: a burst of spectrum-A queries micro-batches through the
+   admission queue (dispatch on full bucket or ``serve_flush_s`` — the
+   fleet admission's no-starvation rule on the read path); served
+   projections are BIT-FOR-BIT the direct ``estimator.transform``
+   result;
+3. **drift**: the query stream shifts to spectrum B — served residual
+   energy climbs, arming the monitor;
+4. **auto-refresh**: the monitor's background supervised refit confirms
+   the subspace rotated (principal-angle gap), publishes version 2, and
+   the post-refresh batches serve it — measurably closer to the
+   shifted truth than the stale version.
+
+Run (any host):
+
+    python examples/query_serving.py [--dim 32] [--queries 48]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--rank", type=int, default=2)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--rows-per-worker", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--bucket", type=int, default=4)
+    ap.add_argument("--queries", type=int, default=48)
+    ap.add_argument("--query-rows", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_eigenspaces_tpu.api.estimator import (
+        OnlineDistributedPCA,
+    )
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+    from distributed_eigenspaces_tpu.serving import (
+        DriftMonitor,
+        EigenbasisRegistry,
+        QueryServer,
+    )
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    d, k, m, n, t = (
+        args.dim, args.rank, args.workers, args.rows_per_worker,
+        args.steps,
+    )
+    cfg = PCAConfig(
+        dim=d, k=k, num_workers=m, rows_per_worker=n, num_steps=t,
+        serve_bucket_size=args.bucket, serve_flush_s=0.05,
+    )
+    spec_a = planted_spectrum(d, k_planted=k, gap=20.0, noise=0.01, seed=0)
+    spec_b = planted_spectrum(d, k_planted=k, gap=20.0, noise=0.01, seed=99)
+
+    # -- act 1: fit on spectrum A, publish version 1 -------------------------
+    est = OnlineDistributedPCA(cfg)
+    est.fit(np.asarray(spec_a.sample(jax.random.PRNGKey(1), t * m * n)))
+    registry = EigenbasisRegistry(keep=cfg.serve_keep_versions)
+    v1 = registry.publish_fit(est, lineage={"producer": "example"})
+    print(json.dumps({
+        "act": "publish",
+        "version": v1.version,
+        "signature": list(v1.signature),
+        "top_k_energy": v1.explained_variance.get("top_k_energy"),
+        "lineage": v1.lineage,
+    }))
+
+    # -- act 2: serve an in-distribution burst -------------------------------
+    metrics = MetricsLogger()
+    # arm_ratio=0.5: let the residual EWMA climb (and the recent-rows
+    # ring buffer turn over to the drifted distribution) before paying
+    # for the background refit — an early refit on a mixed buffer may
+    # decline to publish or publish a mixed basis
+    monitor = DriftMonitor(
+        registry, cfg, threshold=0.25, arm_ratio=0.5, auto=True,
+        metrics=metrics,
+    )
+    n_q, r = args.queries, args.query_rows
+
+    def burst(spec, seed0, count):
+        for i in range(count):
+            yield np.asarray(
+                spec.sample(jax.random.PRNGKey(seed0 + i), r),
+                np.float32,
+            )
+
+    with QueryServer(
+        registry, cfg, metrics=metrics, drift=monitor
+    ) as srv:
+        tickets = [
+            (q, srv.submit(q)) for q in burst(spec_a, 100, n_q // 2)
+        ]
+        served = [(q, tk.result(timeout=600)) for q, tk in tickets]
+        max_err = max(
+            float(np.abs(res.z - np.asarray(est.transform(q))).max())
+            for q, res in served
+        )
+        print(json.dumps({
+            "act": "serve",
+            "queries": len(served),
+            "served_version": served[-1][1].version,
+            "max_abs_err_vs_direct": max_err,
+        }))
+        assert max_err == 0.0, "served projection != direct transform"
+
+        # -- act 3: the stream drifts to spectrum B --------------------------
+        tickets = [
+            (q, srv.submit(q)) for q in burst(spec_b, 500, n_q)
+        ]
+        [tk.result(timeout=600) for _, tk in tickets]
+        # -- act 4: background supervised refit + republish ------------------
+        # keep drifted traffic flowing while waiting: the monitor's
+        # ring buffer turns over to the NEW distribution and its
+        # cooldown re-arms on live observes (a refresh confirmed on a
+        # still-mixed buffer may decline to publish — by design)
+        deadline = time.time() + 300
+        seed = 900
+        while registry.latest().version == v1.version:
+            tickets = [
+                (q, srv.submit(q)) for q in burst(spec_b, seed, n_q)
+            ]
+            [tk.result(timeout=600) for _, tk in tickets]
+            seed += n_q
+            monitor.join_refresh(timeout=2)
+            if time.time() > deadline:
+                raise RuntimeError("drift refresh never published")
+        v2 = registry.latest()
+        # post-refresh queries serve the NEW version
+        post = srv.submit(next(burst(spec_b, 9999, 1))).result(
+            timeout=600
+        )
+
+    truth_b = jnp.asarray(np.asarray(spec_b.top_k(k)))
+    stale_deg = float(jnp.max(
+        principal_angles_degrees(jnp.asarray(v1.v), truth_b)
+    ))
+    fresh_deg = float(jnp.max(
+        principal_angles_degrees(jnp.asarray(v2.v), truth_b)
+    ))
+    summary = metrics.summary()["serving"]
+    print(json.dumps({
+        "act": "drift_refresh",
+        "published_version": v2.version,
+        "trigger_score": v2.lineage.get("trigger_score"),
+        "supervised_refit": v2.lineage.get("supervised"),
+        "post_refresh_served_version": post.version,
+        "stale_angle_to_shifted_truth_deg": round(stale_deg, 3),
+        "fresh_angle_to_shifted_truth_deg": round(fresh_deg, 3),
+        "serving_summary": summary,
+    }))
+    assert v2.version > v1.version
+    assert post.version == v2.version, "post-refresh batch served stale"
+    assert fresh_deg < stale_deg - 10.0, (
+        "refreshed basis not meaningfully closer to the shifted truth"
+    )
+    print("query_serving: OK (drift loop closed end to end)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

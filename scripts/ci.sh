@@ -23,12 +23,18 @@
 #      serving A/B (fleet-vs-solo equivalence gate asserted by the
 #      bench itself), compared anchor-normalized against the committed
 #      BENCH_FLEET_SMOKE_CPU.json;
-#   4. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   4. bench.py --serve in the same smoke mode: publish a basis, run a
+#      query burst through serving/QueryServer with a mid-burst hot
+#      swap — the bench itself asserts served projections equal the
+#      direct estimator.transform BIT-FOR-BIT and that the swap
+#      recompiled nothing; compared (qps normalized + p99 floor)
+#      against the committed BENCH_SERVE_SMOKE_CPU.json;
+#   5. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/4] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/5] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -36,7 +42,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/4] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/5] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -46,7 +52,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/4] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/5] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -61,7 +67,22 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/4] graft entry + 8-device sharded dryrun =="
+echo "== [4/5] serve equality + amortization smoke (CPU) =="
+# bench.py --serve asserts the serving correctness gates itself:
+# every served projection BIT-FOR-BIT equal to the direct
+# estimator.transform result, and the mid-burst basis hot-swap
+# counted at ZERO compile-cache misses. The compare checks the
+# anchor-normalized queries/sec AND the p99 latency floor against the
+# committed smoke expectation at the same CPU-tolerant 0.5 ratio.
+if [[ -f BENCH_SERVE_SMOKE_CPU.json ]]; then
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve \
+        --compare BENCH_SERVE_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
+fi
+
+echo "== [5/5] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

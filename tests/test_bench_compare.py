@@ -151,3 +151,63 @@ def test_metric_mismatch_skips_not_lies(tmp_path, capsys):
     verdict = json.loads(capsys.readouterr().err.strip())
     assert verdict["compare"] == "skipped"
     assert "metric mismatch" in verdict["reason"]
+
+
+def _serve_report(qps, anchor, speedup, p99, flush=0.05):
+    return {
+        "metric": "pca_serve_queries_per_sec",
+        "value": qps,
+        "anchor_tflops": anchor,
+        "value_per_anchor": round(qps / anchor, 1),
+        "serve_speedup": speedup,
+        "p99_latency_s": p99,
+        "serve_flush_s": flush,
+    }
+
+
+def test_serve_records_compare_and_check_p99(tmp_path, capsys):
+    """Serve records compare anchor-normalized like every other record
+    AND enforce the p99 latency floor: a tail-latency regression fails
+    even when bulk qps passes."""
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_serve_report(25000.0, 0.1, 4.5, 0.04)))
+    new = _serve_report(26000.0, 0.1, 4.2, 0.041)
+    assert bench.compare_reports(str(old), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["serve_speedup_old"] == 4.5
+    assert verdict["serve_speedup_new"] == 4.2
+    assert verdict["p99_ratio"] is not None
+    assert not verdict["regression"]
+
+    # qps fine, p99 blown past BOTH the ratio floor and the structural
+    # bound (3 flush windows) -> regression
+    slow_tail = _serve_report(26000.0, 0.1, 4.2, 0.5)
+    assert (
+        bench.compare_reports(str(old), slow_tail, threshold=0.5) == 1
+    )
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["p99_regression"] is True
+
+    # rig-load jitter: ratio floor tripped but p99 still within the
+    # flush-window-dominated regime -> NOT a regression (the healthy
+    # p99 is the admission deadline, which session speed can't shrink)
+    jitter = _serve_report(26000.0, 0.1, 4.2, 0.09)
+    assert (
+        bench.compare_reports(str(old), jitter, threshold=0.5) == 0
+    )
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert "p99_regression" not in verdict
+
+    # qps regression trips the same normalized gate as ever
+    worse = _serve_report(8000.0, 0.1, 1.2, 0.04)
+    assert bench.compare_reports(str(old), worse) == 1
+
+
+def test_serve_vs_fleet_metric_mismatch_skips(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_serve_report(25000.0, 0.1, 4.5, 0.04)))
+    new = _fleet_report(5000.0, 0.12, 3.2)
+    assert bench.compare_reports(str(old), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]

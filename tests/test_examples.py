@@ -38,6 +38,9 @@ def _run(script, *extra):
         ("fleet_serving.py",
          ["--tenants", "6", "--dim", "24", "--rows-per-worker", "24",
           "--steps", "3", "--bucket", "3"]),
+        ("query_serving.py",
+         ["--dim", "24", "--rows-per-worker", "12", "--steps", "3",
+          "--queries", "24", "--query-rows", "6", "--bucket", "4"]),
         # notebook-scale by design (the reference workload has no size
         # flags to shrink): ~40 s on CPU, still worth the coverage — it
         # is the one example that crashed on TPU for two rounds

@@ -1,0 +1,561 @@
+"""Query-serving subsystem (serving/): registry semantics, padded
+transform kernels, micro-batched QueryServer, drift-triggered refresh.
+
+The contracts under test are the ISSUE-4 acceptance gates: served
+projection EXACTLY equal to the direct transform, hot-swap without
+recompilation, version immutability + GC with a never-dangling
+``latest()``, one basis per batch (no torn reads), per-request NaN
+isolation, and the end-to-end drift → refit → republish loop beating
+the stale version on shifted data.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.api.estimator import OnlineDistributedPCA
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.ops.linalg import principal_angles_degrees
+from distributed_eigenspaces_tpu.serving import (
+    DriftMonitor,
+    EigenbasisRegistry,
+    QueryServer,
+    TransformEngine,
+    bucket_rows,
+)
+from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+D, K, M, N, T = 32, 3, 2, 16, 4
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=T,
+        serve_bucket_size=4, serve_flush_s=0.02,
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    cfg = _cfg()
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=0)
+    data = np.asarray(spec.sample(jax.random.PRNGKey(1), T * M * N))
+    est = OnlineDistributedPCA(cfg).fit(data)
+    return cfg, spec, est
+
+
+def _queries(spec, count, rows=5, seed0=100):
+    return [
+        np.asarray(
+            spec.sample(jax.random.PRNGKey(seed0 + i), rows), np.float32
+        )
+        for i in range(count)
+    ]
+
+
+# -- registry semantics ------------------------------------------------------
+
+
+class TestRegistry:
+    def test_publish_and_latest(self, fitted):
+        _, _, est = fitted
+        reg = EigenbasisRegistry()
+        bv = reg.publish_fit(est)
+        assert reg.latest() is bv
+        assert bv.signature == (D, K)
+        assert bv.step == T
+        assert bv.lineage["trainer"] == est.trainer_used_
+        assert 0.0 < bv.explained_variance["top_k_energy"] <= 1.0
+
+    def test_versions_are_immutable(self, fitted):
+        _, _, est = fitted
+        reg = EigenbasisRegistry()
+        src = np.array(np.asarray(est.components_), np.float32)
+        bv = reg.publish(src, step=3)
+        # mutating the publisher's buffer must not reach the version
+        src[:] = 0.0
+        assert not np.array_equal(bv.v, src)
+        # and the version's own array is write-protected
+        with pytest.raises((ValueError, RuntimeError)):
+            bv.v[0, 0] = 1.0
+
+    def test_rejects_nonfinite_and_bad_shape(self):
+        reg = EigenbasisRegistry()
+        bad = np.zeros((4, 2), np.float32)
+        bad[1, 1] = np.nan
+        with pytest.raises(ValueError, match="non-finite"):
+            reg.publish(bad)
+        with pytest.raises(ValueError, match=r"\(d, k\)"):
+            reg.publish(np.zeros(4, np.float32))
+        assert reg.latest() is None  # rejected publish leaves no trace
+
+    def test_gc_keeps_exactly_n_latest_never_dangles(self):
+        reg = EigenbasisRegistry(keep=3)
+        for i in range(10):
+            reg.publish(np.full((4, 2), float(i + 1), np.float32))
+        assert reg.versions() == [8, 9, 10]
+        assert len(reg) == 3
+        assert reg.latest().version == 10
+        with pytest.raises(KeyError):
+            reg.get(7)
+        # the retained window still resolves
+        assert reg.get(8).version == 8
+
+    def test_publish_fleet_tenant(self, fitted):
+        """The fleet → registry edge: one tenant's basis from a
+        multi-tenant dispatch publishes with tenant-attributed lineage
+        and serves bit-for-bit like any other version."""
+        from distributed_eigenspaces_tpu.parallel.fleet import fit_fleet
+
+        cfg, spec, _ = fitted
+        problems = [
+            np.asarray(
+                spec.sample(jax.random.PRNGKey(40 + b), T * M * N)
+            )
+            for b in range(2)
+        ]
+        result = fit_fleet(cfg, problems, mesh=None)
+        reg = EigenbasisRegistry()
+        bv = reg.publish_fleet(result, 1)
+        assert bv.lineage["producer"] == "fit_fleet"
+        assert bv.lineage["tenant"] == 1
+        assert bv.signature == (D, K)
+        np.testing.assert_array_equal(bv.v, result.components[1])
+        with pytest.raises(ValueError, match="out of range"):
+            reg.publish_fleet(result, 5)
+
+    def test_concurrent_publish_yields_only_complete_versions(self):
+        """Readers racing publishers must only ever observe versions
+        whose content is internally consistent (v matches its lineage
+        marker) — never a half-written one."""
+        reg = EigenbasisRegistry(keep=2)
+        stop = threading.Event()
+        torn: list = []
+
+        def reader():
+            while not stop.is_set():
+                bv = reg.latest()
+                if bv is None:
+                    continue
+                marker = bv.lineage["marker"]
+                if not np.all(bv.v == marker) or bv.step != marker:
+                    torn.append(bv.version)
+
+        threads = [threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for i in range(1, 200):
+            reg.publish(
+                np.full((6, 2), float(i), np.float32),
+                step=i, lineage={"marker": i},
+            )
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not torn
+        assert reg.latest().version == 199
+
+
+# -- transform kernels -------------------------------------------------------
+
+
+class TestTransformEngine:
+    def test_bucket_rows_policy(self):
+        assert bucket_rows(1) == 8
+        assert bucket_rows(8) == 8
+        assert bucket_rows(9) == 16
+        assert bucket_rows(33) == 64
+        assert bucket_rows(12, multiple_of=5) == 20
+        with pytest.raises(ValueError):
+            bucket_rows(0)
+
+    def test_padded_project_bit_equals_direct(self, fitted, rng):
+        _, _, est = fitted
+        eng = TransformEngine(D, K)
+        w = np.asarray(est.components_)
+        for rows in (1, 3, 8, 11, 40):
+            x = rng.standard_normal((rows, D)).astype(np.float32)
+            z = np.asarray(eng.project(x, w))
+            direct = np.asarray(est.transform(x))
+            assert np.array_equal(z, direct), rows
+
+    def test_reconstruct_and_residual(self, fitted, rng):
+        _, _, est = fitted
+        eng = TransformEngine(D, K)
+        w = np.asarray(est.components_)
+        x = rng.standard_normal((7, D)).astype(np.float32)
+        z = eng.project(x, w)
+        back = np.asarray(eng.reconstruct(z, w))
+        assert back.shape == (7, D)
+        np.testing.assert_allclose(
+            back, np.asarray(z) @ w.T, rtol=1e-5, atol=1e-5
+        )
+        r, e = eng.residual_energy(x, z)
+        expect_r = (x**2).sum(1) - (np.asarray(z) ** 2).sum(1)
+        np.testing.assert_allclose(np.asarray(r), expect_r, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(e), (x**2).sum(1), rtol=1e-5
+        )
+
+    def test_basis_swap_is_not_a_recompile(self, rng):
+        """The basis is a traced ARGUMENT: projecting the same bucket
+        against ten different bases compiles exactly once."""
+        eng = TransformEngine(D, K)
+        x = rng.standard_normal((8, D)).astype(np.float32)
+        eng.project(x, rng.standard_normal((D, K)).astype(np.float32))
+        misses = eng.stats()["compile_misses"]
+        for s in range(10):
+            v = rng.standard_normal((D, K)).astype(np.float32)
+            eng.project(x, v)
+        assert eng.stats()["compile_misses"] == misses
+        assert eng.stats()["cache_hits"] >= 10
+
+    def test_width_mismatch_raises(self, rng):
+        eng = TransformEngine(D, K)
+        with pytest.raises(ValueError, match="query batch"):
+            eng.project(
+                rng.standard_normal((4, D + 1)).astype(np.float32),
+                np.eye(D, K, dtype=np.float32),
+            )
+
+    def test_mesh_shard_zero_collectives(self, devices, rng):
+        """The data-parallel query shard must contain NO collectives —
+        projection is row-local; the audit is the machine check."""
+        from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+        from distributed_eigenspaces_tpu.utils import (
+            collectives_audit as ca,
+        )
+
+        mesh = make_mesh(num_workers=8)
+        eng = TransformEngine(D, K, mesh=mesh)
+        for kind in ("project", "reconstruct", "residual"):
+            audit = ca.audit_compiled(eng.compiled_for(kind, 16))
+            assert audit["n_collectives"] == 0, (kind, audit["ops"])
+        # and the sharded result matches the unsharded one exactly
+        x = rng.standard_normal((16, D)).astype(np.float32)
+        v = np.linalg.qr(
+            rng.standard_normal((D, K))
+        )[0].astype(np.float32)
+        solo = TransformEngine(D, K)
+        np.testing.assert_array_equal(
+            np.asarray(eng.project(x, v)),
+            np.asarray(solo.project(x, v)),
+        )
+
+
+# -- server ------------------------------------------------------------------
+
+
+class TestQueryServer:
+    def test_served_equals_direct_bit_for_bit(self, fitted):
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        qs = _queries(spec, 9)
+        with QueryServer(reg, cfg) as srv:
+            res = [srv.submit(q).result(timeout=60) for q in [qs[0]]]
+            tickets = [srv.submit(q) for q in qs[1:]]
+            res += [t.result(timeout=60) for t in tickets]
+        for q, r in zip(qs, res):
+            assert np.array_equal(r.z, np.asarray(est.transform(q)))
+            assert r.version == 1
+
+    def test_partial_bucket_flushes_on_deadline(self, fitted):
+        """No starvation: fewer queries than the bucket still serve
+        once the oldest has waited serve_flush_s."""
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        with QueryServer(
+            reg, cfg, bucket_size=64, flush_s=0.05
+        ) as srv:
+            t0 = time.monotonic()
+            r = srv.submit(_queries(spec, 1)[0]).result(timeout=60)
+            assert r.z.shape == (5, K)
+            assert time.monotonic() - t0 < 30
+
+    def test_nan_query_poisons_only_its_ticket(self, fitted):
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        qs = _queries(spec, 3)
+        bad = qs[1].copy()
+        bad[0, 0] = np.nan
+        with QueryServer(
+            reg, cfg, bucket_size=3, flush_s=10.0
+        ) as srv:
+            t_good1 = srv.submit(qs[0])
+            t_bad = srv.submit(bad)
+            t_good2 = srv.submit(qs[2])
+            r1 = t_good1.result(timeout=60)
+            r2 = t_good2.result(timeout=60)
+            with pytest.raises(ValueError, match="non-finite rows"):
+                t_bad.result(timeout=60)
+        # neighbors bit-exact despite the poisoned batchmate
+        assert np.array_equal(r1.z, np.asarray(est.transform(qs[0])))
+        assert np.array_equal(r2.z, np.asarray(est.transform(qs[2])))
+
+    def test_malformed_width_rejected_at_submit(self, fitted):
+        cfg, _, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        with QueryServer(reg, cfg) as srv:
+            with pytest.raises(ValueError, match="signature"):
+                srv.submit(np.zeros((3, D + 1), np.float32))
+
+    def test_serve_without_published_basis_fails_tickets(self, fitted):
+        cfg, spec, _ = fitted
+        reg = EigenbasisRegistry()
+        with QueryServer(reg, cfg, max_retries=0) as srv:
+            t = srv.submit(_queries(spec, 1)[0])
+            with pytest.raises(Exception, match="no published basis|failed"):
+                t.result(timeout=60)
+
+    def test_hot_swap_no_recompile_no_drop(self, fitted):
+        """A mid-traffic publish swaps the served basis without a
+        single new compile and without dropping in-flight tickets."""
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        v1 = reg.publish_fit(est)
+        qs = _queries(spec, 12)
+        metrics = MetricsLogger()
+        with QueryServer(reg, cfg, metrics=metrics) as srv:
+            first = [srv.submit(q) for q in qs[:6]]
+            [t.result(timeout=60) for t in first]
+            misses = srv.engine.stats()["compile_misses"]
+            # hot swap to a NEW version (different basis content)
+            w2 = np.linalg.qr(
+                np.asarray(v1.v) + 0.05 * np.eye(D, K, dtype=np.float32)
+            )[0].astype(np.float32)
+            v2 = reg.publish(w2, step=T + 1)
+            second = [srv.submit(q) for q in qs[6:]]
+            res2 = [t.result(timeout=60) for t in second]
+            assert srv.engine.stats()["compile_misses"] == misses
+            assert srv.swap_count >= 1
+        for q, r in zip(qs[6:], res2):
+            assert r.version == v2.version
+            assert np.array_equal(
+                r.z,
+                np.asarray(
+                    jnp.matmul(
+                        jnp.asarray(q), jnp.asarray(w2),
+                        precision=jax.lax.Precision.HIGHEST,
+                    )
+                ),
+            )
+        summary = metrics.summary()["serving"]
+        assert summary["swaps"] >= 1
+        assert set(summary["versions_served"]) == {1, 2}
+
+    def test_mid_swap_batch_uses_exactly_one_basis(self, fitted):
+        """No torn reads: under a publisher flipping versions as fast
+        as it can, every served batch's results come from EXACTLY one
+        registry version — each ticket's z recomputes bit-for-bit from
+        the version it reports, and co-batched tickets agree on it."""
+        cfg, spec, _ = fitted
+        reg = EigenbasisRegistry(keep=300)
+        rng = np.random.default_rng(7)
+        bases = {}
+        v = reg.publish(
+            np.linalg.qr(rng.standard_normal((D, K)))[0].astype(
+                np.float32
+            )
+        )
+        bases[v.version] = np.asarray(v.v)
+        stop = threading.Event()
+
+        def publisher():
+            while not stop.is_set():
+                nv = reg.publish(
+                    np.linalg.qr(rng.standard_normal((D, K)))[0].astype(
+                        np.float32
+                    )
+                )
+                bases[nv.version] = np.asarray(nv.v)
+
+        pub = threading.Thread(target=publisher)
+        pub.start()
+        qs = _queries(spec, 40, rows=4)
+        try:
+            with QueryServer(
+                reg, cfg, bucket_size=4, flush_s=0.001
+            ) as srv:
+                groups = []
+                for lo in range(0, 40, 4):
+                    tickets = [
+                        srv.submit(q) for q in qs[lo : lo + 4]
+                    ]
+                    groups.append(
+                        [t.result(timeout=60) for t in tickets]
+                    )
+        finally:
+            stop.set()
+            pub.join()
+        for lo, group in zip(range(0, 40, 4), groups):
+            for q, r in zip(qs[lo : lo + 4], group):
+                w = bases[r.version]
+                expect = np.asarray(
+                    jnp.matmul(
+                        jnp.asarray(q), jnp.asarray(w),
+                        precision=jax.lax.Precision.HIGHEST,
+                    )
+                )
+                assert np.array_equal(r.z, expect), (
+                    "torn read: z does not match the version the "
+                    "batch reports"
+                )
+
+    def test_estimator_transform_serve_kwarg(self, fitted):
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        q = _queries(spec, 1)[0]
+        with QueryServer(reg, cfg) as srv:
+            z = est.transform(q, serve=srv)
+            z1 = est.transform(q[0], serve=srv)  # single row
+        assert np.array_equal(np.asarray(z), np.asarray(est.transform(q)))
+        assert z1.shape == (K,)
+        np.testing.assert_array_equal(
+            np.asarray(z1), np.asarray(z)[0]
+        )
+
+
+# -- estimator.transform width validation (ISSUE 4 satellite) ----------------
+
+
+class TestTransformValidation:
+    def test_width_mismatch_is_loud(self, fitted):
+        _, _, est = fitted
+        with pytest.raises(ValueError, match=f"fitted with dim={D}"):
+            est.transform(np.zeros((5, D + 3), np.float32))
+        with pytest.raises(ValueError, match="feature width"):
+            est.transform(np.zeros(D - 1, np.float32))
+        with pytest.raises(ValueError):
+            est.transform(np.zeros((2, 2, D), np.float32))
+
+    def test_valid_shapes_still_work(self, fitted):
+        _, spec, est = fitted
+        q = _queries(spec, 1)[0]
+        assert est.transform(q).shape == (5, K)
+        assert est.transform(q[0]).shape == (K,)
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def test_metrics_serving_summary_section():
+    m = MetricsLogger()
+    for i in range(4):
+        m.serve({
+            "kind": "batch", "queries": 4, "rows": 20,
+            "batch_seconds": 0.01,
+            "query_latency_s": [0.01, 0.02, 0.03, 0.2],
+            "occupancy": 0.5, "version": 1 + (i == 3), "swap": i == 3,
+        })
+    m.serve({"kind": "drift", "score": 0.42, "published": 2})
+    s = m.summary()["serving"]
+    assert s["batches"] == 4
+    assert s["queries"] == 16
+    assert s["swaps"] == 1
+    assert s["mean_occupancy"] == 0.5
+    assert s["p50_latency_s"] <= s["p99_latency_s"]
+    assert s["versions_served"] == [1, 2]
+    assert s["drift_score"] == 0.42
+    assert s["drift_published"] == [2]
+    assert "qps" in s
+
+
+# -- drift ------------------------------------------------------------------
+
+
+class TestDrift:
+    def test_no_drift_no_republish(self, fitted):
+        """In-distribution traffic must NOT trigger a version bump."""
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        mon = DriftMonitor(reg, cfg, threshold=0.25, auto=False)
+        with QueryServer(reg, cfg, drift=mon) as srv:
+            tickets = [
+                srv.submit(q) for q in _queries(spec, 12, rows=8)
+            ]
+            [t.result(timeout=60) for t in tickets]
+        assert mon.residual_drift() < 0.05
+        assert mon.refresh_now() is None  # score below threshold
+        assert reg.latest().version == 1
+
+    def test_drift_injection_end_to_end(self, fitted):
+        """The acceptance gate: shifted traffic drives a refresh whose
+        published basis beats the stale version's angle to the SHIFTED
+        truth."""
+        cfg, spec_a, est = fitted
+        spec_b = planted_spectrum(
+            D, k_planted=K, gap=20.0, noise=0.01, seed=97
+        )
+        reg = EigenbasisRegistry()
+        v1 = reg.publish_fit(est)
+        metrics = MetricsLogger()
+        mon = DriftMonitor(
+            reg, cfg, threshold=0.25, auto=False, metrics=metrics
+        )
+        with QueryServer(reg, cfg, drift=mon, metrics=metrics) as srv:
+            tickets = [
+                srv.submit(q)
+                for q in _queries(spec_b, 16, rows=8, seed0=700)
+            ]
+            [t.result(timeout=60) for t in tickets]
+            assert mon.residual_drift() > mon.arm_ratio
+            v2 = mon.refresh_now()
+            assert v2 is not None and v2.version > v1.version
+            assert reg.latest().version == v2.version
+            assert v2.lineage["producer"] == "drift_refresh"
+            assert v2.lineage["supervised"] is True
+            # the very next batch serves the refreshed version
+            post = srv.submit(
+                _queries(spec_b, 1, rows=8, seed0=900)[0]
+            ).result(timeout=60)
+            assert post.version == v2.version
+        truth_b = jnp.asarray(np.asarray(spec_b.top_k(K)))
+        stale = float(jnp.max(principal_angles_degrees(
+            jnp.asarray(v1.v), truth_b
+        )))
+        fresh = float(jnp.max(principal_angles_degrees(
+            jnp.asarray(v2.v), truth_b
+        )))
+        assert fresh < stale
+        s = metrics.summary()["serving"]
+        assert s["drift_refreshes"] >= 1
+        assert s["drift_published"] == [v2.version]
+
+    def test_refit_override(self, fitted):
+        """A custom refit hook (e.g. a fleet ticket) replaces the
+        built-in supervised refit."""
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        calls = []
+
+        def refit(rows):
+            calls.append(len(rows))
+            w = np.linalg.qr(
+                np.random.default_rng(0).standard_normal((D, K))
+            )[0].astype(np.float32)
+            return w, None
+
+        mon = DriftMonitor(
+            reg, cfg, threshold=0.01, auto=False, refit=refit
+        )
+        mon.observe(
+            9.0, 10.0,
+            rows=np.ones((M * N, D), np.float32),
+        )
+        v2 = mon.refresh_now()
+        assert calls and v2 is not None
+        assert v2.lineage["supervised"] is False
