@@ -134,6 +134,24 @@ def build_parser() -> argparse.ArgumentParser:
                    "not checkpointable)")
     p.add_argument("--dim", type=int, default=1024,
                    help="feature dim for --data synthetic")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent compile cache root "
+                   "(PCAConfig.compile_cache_dir): wires JAX's "
+                   "persistent compilation cache under DIR/xla and the "
+                   "explicit AOT executable store under DIR/aot, so a "
+                   "SECOND process with the same shape signature "
+                   "starts warm — deserialize instead of compile, "
+                   "bit-identical results (bench.py --coldstart "
+                   "measures the win; docs/ARCHITECTURE.md 'Compile "
+                   "lifecycle')")
+    p.add_argument("--prewarm", action="store_true",
+                   help="compile expected signatures off the serving "
+                   "thread before traffic (runtime/prewarm.py): with "
+                   "--mode serve the query server's row-bucket kernels "
+                   "are prewarmed and the burst waits for readiness "
+                   "(first request: 0 compile misses); with --mode "
+                   "fleet the padded-bucket fleet program compiles "
+                   "before the timed fit. Other modes reject the flag.")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=5)
     p.add_argument("--resume", action="store_true",
@@ -797,11 +815,36 @@ def _fit_fleet_cli(args, data, truth) -> int:
                   else args.warm_start_iters)
         ),
         fleet_bucket_size=b,
+        compile_cache_dir=args.compile_cache,
     )
     problems = [
         data[t * per_tenant : (t + 1) * per_tenant] for t in range(b)
     ]
     fleet = FleetPCA(cfg)
+    prewarmed = False
+    if args.prewarm:
+        # compile the B-padded fleet program off-thread BEFORE the
+        # timed fit (runtime/prewarm.py) — the timed region then runs
+        # a ready executable, which is what a serving deployment sees
+        from distributed_eigenspaces_tpu.parallel.fleet import (
+            acquire_fleet_programs,
+            fleet_mesh,
+        )
+        from distributed_eigenspaces_tpu.runtime.prewarm import Prewarmer
+        from distributed_eigenspaces_tpu.utils.compile_cache import (
+            compile_cache_for,
+        )
+
+        with Prewarmer() as pw:
+            pw.submit(
+                ("fleet", repr(cfg)),
+                lambda: acquire_fleet_programs(
+                    cfg, fleet_mesh(b), masked=False, b_pad=b,
+                    fit_cache=fleet._fit_cache,
+                    compile_cache=compile_cache_for(cfg),
+                ),
+            )
+            prewarmed = pw.wait(timeout=600)
     t0 = time.time()
     fleet.fit(problems)
     elapsed = time.time() - t0
@@ -809,6 +852,7 @@ def _fit_fleet_cli(args, data, truth) -> int:
         "mode": "fleet",
         "tenants": b,
         "includes_compile": True,
+        **({"prewarmed": True} if prewarmed else {}),
         "fits_per_sec": round(b / elapsed, 2),
         "seconds": round(elapsed, 3),
         "steps_per_tenant": args.steps,
@@ -871,8 +915,25 @@ def _serve_cli(args, cfg, data, truth) -> int:
         for i in range(n_q)
     ]
     metrics = MetricsLogger(stream=sys.stderr if args.metrics else None)
+    from distributed_eigenspaces_tpu.utils.compile_cache import (
+        compile_cache_for,
+    )
+
+    cc = compile_cache_for(cfg)
+    if cc is not None:
+        metrics.attach_compile(cc)
+    prewarm_stats = None
     t0 = time.time()
-    with QueryServer(registry, cfg, metrics=metrics) as srv:
+    with QueryServer(
+        registry, cfg, metrics=metrics,
+        # expected dispatch sizes: one query, and a full micro-batch
+        prewarm=(r, r * cfg.serve_bucket_size) if args.prewarm else False,
+    ) as srv:
+        if args.prewarm:
+            # the zero-stall guarantee needs the fence: wait, THEN
+            # serve — the first request runs zero compiles
+            srv.wait_warm(timeout=600)
+            prewarm_stats = srv.prewarmer.stats()
         tickets = [srv.submit(q) for q in queries]
         results = [t.result(timeout=600) for t in tickets]
     elapsed = time.time() - t0
@@ -893,6 +954,10 @@ def _serve_cli(args, cfg, data, truth) -> int:
         "serve_seconds": round(elapsed, 3),
         "max_abs_err_vs_direct": max_err,
         **metrics.summary().get("serving", {}),
+        **({"prewarm": prewarm_stats} if prewarm_stats else {}),
+        **(
+            {"compile_cache": cc.stats()} if cc is not None else {}
+        ),
         "dim": cfg.dim,
         "k": cfg.k,
     }
@@ -977,6 +1042,16 @@ def main(argv=None) -> int:
         print(
             "error: --resume needs --checkpoint-dir (nowhere to restore "
             "from)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.prewarm and args.mode not in ("serve", "fleet"):
+        print(
+            "error: --prewarm applies to the serving modes (--mode "
+            "serve / fleet), where a background compile lane keeps XLA "
+            "off the dispatch thread; a plain --mode fit compiles "
+            "inline either way (use --compile-cache to make the NEXT "
+            "process start warm)",
             file=sys.stderr,
         )
         return 2
@@ -1075,6 +1150,7 @@ def main(argv=None) -> int:
         ),
         merge_interval=args.merge_interval,
         pipeline_merge=args.pipeline_merge,
+        compile_cache_dir=args.compile_cache,
     )
 
     if args.mode == "serve":

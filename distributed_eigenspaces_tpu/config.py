@@ -163,6 +163,19 @@ class PCAConfig:
       serve_keep_versions: how many published basis versions the
         ``serving/registry.py EigenbasisRegistry`` retains (append-only
         store, GC keeps the newest N; ``latest()`` never dangles).
+      compile_cache_dir: root of the persistent compile cache
+        (``utils/compile_cache.py``; CLI ``--compile-cache``). When
+        set, JAX's persistent compilation cache is wired under
+        ``<dir>/xla`` and the explicit AOT layer serializes compiled
+        executables under ``<dir>/aot`` keyed by (program kind, shape
+        signature, dtype, backend, jax version, program knobs) — so
+        the SECOND process with the same signature starts warm
+        (deserialize instead of compile; bit-identical results,
+        ``bench.py --coldstart`` measures the win). ``None`` (default)
+        keeps compilation per-process. A cache entry that fails
+        validation (version/backend mismatch, corruption) falls back
+        to a fresh compile with a warning — never a crash, never a
+        stale executable.
       pipeline_merge: software-pipelined steady state for the whole-fit
         scan trainer (``algo/scan.py``): step ``t``'s warm worker
         solves run against the one-step-STALE merged basis (merges
@@ -209,6 +222,7 @@ class PCAConfig:
     serve_bucket_size: int = 8
     serve_flush_s: float = 0.02
     serve_keep_versions: int = 4
+    compile_cache_dir: str | None = None
     seed: int = 0
 
     def __post_init__(self):
@@ -316,6 +330,13 @@ class PCAConfig:
             raise ValueError(
                 f"serve_keep_versions must be an int >= 1, got "
                 f"{self.serve_keep_versions!r}"
+            )
+        if self.compile_cache_dir is not None and not isinstance(
+            self.compile_cache_dir, str
+        ):
+            raise ValueError(
+                f"compile_cache_dir must be a path string or None, got "
+                f"{self.compile_cache_dir!r}"
             )
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")

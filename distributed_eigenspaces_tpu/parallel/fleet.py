@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Any, Iterable, Sequence
 
 import jax
@@ -70,6 +71,7 @@ __all__ = [
     "FleetResult",
     "FleetServer",
     "FleetPCA",
+    "acquire_fleet_programs",
     "fleet_mesh",
     "fleet_signature",
     "fit_fleet",
@@ -467,6 +469,10 @@ class FleetResult:
     states: OnlineState  # batched final online states (B real tenants)
     v_bars: np.ndarray  # (B, T, d, k) per-step merged bases
     batch: FleetBatch
+    #: wall ms this dispatch spent acquiring its compiled programs
+    #: (0.0 on a fit_cache hit — the steady state; the FleetServer
+    #: surfaces it as compile_stall_ms, per signature)
+    compile_ms: float = 0.0
 
     def __len__(self) -> int:
         return len(self.components)
@@ -481,6 +487,103 @@ def _make_extract_fleet(cfg: PCAConfig):
     return jax.jit(jax.vmap(lambda s: extract_dense(cfg, s)))
 
 
+def _fleet_cache_key(cfg: PCAConfig, masked: bool, b_pad: int, mesh):
+    """The in-process ``fit_cache`` key — everything that changes the
+    compiled program shape (ONE definition for fit_fleet and the
+    prewarm path, so a prewarmed program is the program dispatch
+    fetches)."""
+    return (
+        repr(cfg), masked, b_pad,
+        None if mesh is None else tuple(mesh.shape.items()),
+    )
+
+
+def acquire_fleet_programs(
+    cfg: PCAConfig,
+    mesh,
+    *,
+    masked: bool,
+    b_pad: int,
+    fit_cache: dict | None = None,
+    compile_cache=None,
+):
+    """Build — or fetch — the compiled fleet fit + extract programs for
+    one padded bucket shape; returns ``(fit, extract, build_ms)``.
+
+    ``build_ms`` is the wall time spent ACQUIRING the programs (0.0 on
+    a ``fit_cache`` hit) — the number :class:`FleetServer` reports as
+    ``compile_stall_ms`` so a first-signature stall is counted, never
+    silently folded into request latency.
+
+    With ``compile_cache`` (a ``utils.compile_cache.CompileCache``) the
+    programs are AOT-compiled NOW against the padded bucket shapes —
+    lowered, compiled, and backed by the persistent store, so a second
+    process deserializes instead of compiling and a
+    :class:`~..runtime.prewarm.Prewarmer` can make dispatch hit only
+    ready executables. Without one, the jit path is unchanged (compile
+    happens lazily at first call; ``DET_CHECKIFY`` builds also take
+    this path — checkified wrappers cannot AOT-lower).
+    """
+    key = _fleet_cache_key(cfg, masked, b_pad, mesh)
+    if fit_cache is not None and key in fit_cache:
+        fit, extract = fit_cache[key]
+        return fit, extract, 0.0
+    t0 = time.perf_counter()
+    fit = make_fleet_fit(cfg, mesh, masked=masked)
+    extract = _make_extract_fleet(cfg)
+    if compile_cache is not None and hasattr(fit, "lower"):
+        from distributed_eigenspaces_tpu.utils.compile_cache import (
+            config_knobs,
+            make_key,
+        )
+
+        d, k, m, n, t_steps = (
+            cfg.dim, cfg.k, cfg.num_workers, cfg.rows_per_worker,
+            cfg.num_steps,
+        )
+        mesh_shape = None if mesh is None else tuple(mesh.shape.items())
+        states_sds = jax.eval_shape(lambda: init_fleet_states(cfg, b_pad))
+        xs_sds = jax.ShapeDtypeStruct(
+            (b_pad, t_steps, m, n, d), jnp.float32
+        )
+        actives_sds = jax.ShapeDtypeStruct((b_pad, t_steps), jnp.float32)
+        fit_args = (states_sds, xs_sds)
+        if masked:
+            fit_args += (
+                jax.ShapeDtypeStruct((b_pad, t_steps, m), jnp.float32),
+            )
+        fit_args += (actives_sds,)
+        sig = (d, k, m, n, t_steps, b_pad, bool(masked), mesh_shape)
+        fit_l = fit
+        fit = compile_cache.get_or_build(
+            make_key(
+                "fleet_fit", sig, "float32", knobs=config_knobs(cfg)
+            ),
+            lambda: fit_l.lower(*fit_args),
+        )
+        if mesh is None:
+            # the extract program is AOT'd single-device only: its jit
+            # carries no shardings, so a sharded final state would hand
+            # a committed-layout array to an executable compiled for
+            # another — the mesh path keeps the lazy jit (its stall is
+            # dwarfed by the fit program's anyway)
+            sigma_sds = jax.ShapeDtypeStruct(
+                (b_pad, d, d), jnp.dtype(cfg.state_dtype)
+            )
+            extract_l = extract
+            extract = compile_cache.get_or_build(
+                make_key(
+                    "fleet_extract", (d, k, b_pad), "float32",
+                    knobs=config_knobs(cfg),
+                ),
+                lambda: extract_l.lower(sigma_sds),
+            )
+    build_ms = (time.perf_counter() - t0) * 1e3
+    if fit_cache is not None:
+        fit_cache[key] = (fit, extract)
+    return fit, extract, build_ms
+
+
 def fit_fleet(
     cfg: PCAConfig,
     problems: Sequence[Any],
@@ -490,6 +593,7 @@ def fit_fleet(
     supervisor=None,
     pad_to: int | None = None,
     fit_cache: dict | None = None,
+    compile_cache="auto",
 ) -> FleetResult:
     """Fit B independent problems sharing ``cfg``'s shape signature as
     ONE compiled fleet program; returns per-tenant results matching the
@@ -501,7 +605,10 @@ def fit_fleet(
     explicit mesh. ``fit_cache`` (a dict the caller owns) reuses
     compiled programs across calls keyed by (config, variant, B, mesh)
     — the :class:`FleetServer` passes its own so steady-state buckets
-    never recompile.
+    never recompile. ``compile_cache`` backs the program build with the
+    persistent AOT store (``"auto"`` resolves ``cfg.compile_cache_dir``
+    via ``utils.compile_cache.compile_cache_for``; pass an explicit
+    ``CompileCache`` or None).
     """
     batch = stage_fleet(
         cfg, problems, worker_masks=worker_masks, supervisor=supervisor,
@@ -517,17 +624,16 @@ def fit_fleet(
             f"{mesh.shape[WORKER_AXIS]}"
         )
 
-    key = (
-        repr(cfg), masked, b_pad,
-        None if mesh is None else tuple(mesh.shape.items()),
+    if compile_cache == "auto":
+        from distributed_eigenspaces_tpu.utils.compile_cache import (
+            compile_cache_for,
+        )
+
+        compile_cache = compile_cache_for(cfg)
+    fit, extract, build_ms = acquire_fleet_programs(
+        cfg, mesh, masked=masked, b_pad=b_pad,
+        fit_cache=fit_cache, compile_cache=compile_cache,
     )
-    if fit_cache is not None and key in fit_cache:
-        fit, extract = fit_cache[key]
-    else:
-        fit = make_fleet_fit(cfg, mesh, masked=masked)
-        extract = _make_extract_fleet(cfg)
-        if fit_cache is not None:
-            fit_cache[key] = (fit, extract)
 
     states = init_fleet_states(cfg, b_pad)
     xs = jnp.asarray(batch.xs)
@@ -557,6 +663,7 @@ def fit_fleet(
         states=states,
         v_bars=np.asarray(v_bars[:nreal]),
         batch=batch,
+        compile_ms=round(build_ms, 3),
     )
 
 
@@ -630,13 +737,30 @@ class FleetServer:
         num_lanes: int = 1,
         max_retries: int = 3,
         lease_timeout: float | None = None,
+        metrics=None,
+        compile_cache=None,
     ):
         from distributed_eigenspaces_tpu.runtime.scheduler import (
             ShapeBucketQueue,
         )
+        from distributed_eigenspaces_tpu.utils.compile_cache import (
+            CompileCache,
+            compile_cache_for,
+        )
 
         self.cfg = cfg
         self.mesh = mesh
+        self.metrics = metrics
+        # ALWAYS an AOT layer (a memory-only CompileCache when no
+        # compile_cache_dir is configured): program builds are compiled
+        # ahead-of-call with honest timing, so compile_stall_ms is a
+        # measured number and prewarmed buckets dispatch stall-free
+        self.compile_cache = (
+            compile_cache
+            or compile_cache_for(cfg)
+            or CompileCache(None)
+        )
+        self.prewarmer = None
         self.queue = ShapeBucketQueue(
             bucket_size=cfg.fleet_bucket_size,
             flush_deadline=cfg.fleet_flush_s,
@@ -665,6 +789,62 @@ class FleetServer:
             sig, _FleetRequest(cfg, problem, worker_masks)
         )
 
+    def pending_cfgs(self) -> list[PCAConfig]:
+        """One config per signature currently waiting in a bucket —
+        the live half of the prewarm feed (the queue's
+        ``pending_signatures`` name the shapes; the first queued
+        ticket's payload carries the config the compile needs)."""
+        with self.queue._lock:
+            return [
+                tickets[0].payload.cfg
+                for tickets in self.queue._buckets.values()
+                if tickets
+            ]
+
+    def prewarm(self, cfgs=None, *, prewarmer=None, masked: bool = False):
+        """Compile fleet programs OFF the dispatch thread for the given
+        configs (default: this server's own config plus every signature
+        already queuing — the ``ShapeBucketQueue`` feed), so buckets
+        hit only ready executables. Returns the
+        :class:`~..runtime.prewarm.Prewarmer`; call its ``wait()`` for
+        the zero-stall guarantee, or let it drain in the background (a
+        not-yet-ready signature compiles while its bucket waits out the
+        flush deadline — the dispatch thread never blocks on XLA it
+        could have avoided)."""
+        from distributed_eigenspaces_tpu.runtime.prewarm import Prewarmer
+
+        if prewarmer is None:
+            if self.prewarmer is None:
+                self.prewarmer = Prewarmer(metrics=self.metrics)
+            prewarmer = self.prewarmer
+        else:
+            self.prewarmer = prewarmer
+        todo = list(cfgs) if cfgs is not None else [self.cfg]
+        if cfgs is None:
+            todo.extend(self.pending_cfgs())
+        seen = set()
+        for cfg in todo:
+            key = (repr(cfg), masked)
+            if key in seen:
+                continue
+            seen.add(key)
+            mesh = self._resolve_mesh(cfg)
+            prewarmer.submit(
+                ("fleet", repr(cfg), masked),
+                lambda c=cfg, m=mesh: acquire_fleet_programs(
+                    c, m, masked=masked, b_pad=c.fleet_bucket_size,
+                    fit_cache=self._fit_cache,
+                    compile_cache=self.compile_cache,
+                ),
+            )
+        return prewarmer
+
+    def wait_warm(self, timeout: float | None = None) -> bool:
+        """Block until submitted prewarms finish (True when none)."""
+        if self.prewarmer is None:
+            return True
+        return self.prewarmer.wait(timeout)
+
     def close(self) -> None:
         """Flush partial buckets, drain, and join the dispatch lanes."""
         self.queue.close()
@@ -678,7 +858,16 @@ class FleetServer:
 
     # -- dispatch ------------------------------------------------------------
 
+    def _resolve_mesh(self, cfg: PCAConfig):
+        """The mesh a ``cfg.fleet_bucket_size``-padded bucket will run
+        on — shared by dispatch and prewarm so they compile the SAME
+        program."""
+        if self.mesh == "auto":
+            return fleet_mesh(cfg.fleet_bucket_size)
+        return self.mesh
+
     def _fit_bucket(self, bucket) -> list:
+        t0 = time.perf_counter()
         reqs = [t.payload for t in bucket.tickets]
         cfg = reqs[0].cfg
         masks = (
@@ -688,9 +877,26 @@ class FleetServer:
         result = fit_fleet(
             cfg,
             [r.problem for r in reqs],
-            mesh=self.mesh,
+            mesh=self._resolve_mesh(cfg),
             worker_masks=masks,
             pad_to=cfg.fleet_bucket_size,
             fit_cache=self._fit_cache,
+            compile_cache=self.compile_cache,
         )
+        if self.metrics is not None:
+            # the first-signature compile stall, counted per signature
+            # instead of silently inflating this bucket's latency
+            self.metrics.fleet({
+                "kind": "bucket",
+                "tenants": len(reqs),
+                "occupancy": round(
+                    len(reqs) / cfg.fleet_bucket_size, 4
+                ),
+                "signature": list(bucket.signature[0]),
+                "compile_misses": 1 if result.compile_ms else 0,
+                "compile_stall_ms": result.compile_ms,
+                "bucket_seconds": round(
+                    time.perf_counter() - t0, 6
+                ),
+            })
         return [result.components[i] for i in range(len(reqs))]

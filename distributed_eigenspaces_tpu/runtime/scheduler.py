@@ -413,6 +413,14 @@ class ShapeBucketQueue:
             self._lock.notify_all()
         return ticket
 
+    def pending_signatures(self) -> list:
+        """Signatures with an un-dispatched bucket right now — the
+        prewarm feed (``runtime/prewarm.py``): shapes traffic is
+        ALREADY queuing for are exactly the shapes worth compiling off
+        the dispatch thread before their bucket flushes."""
+        with self._lock:
+            return list(self._buckets)
+
     def flush_expired(self, now: float | None = None) -> int:
         """Dispatch every bucket whose oldest request has waited past
         the deadline; returns how many buckets flushed. The timer thread

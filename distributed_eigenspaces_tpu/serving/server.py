@@ -90,6 +90,9 @@ class QueryServer:
         max_retries: int = 3,
         lease_timeout: float | None = None,
         engine: TransformEngine | None = None,
+        compile_cache=None,
+        prewarm=False,
+        prewarmer=None,
     ):
         live = registry.latest()
         if d is None:
@@ -110,7 +113,42 @@ class QueryServer:
         self.bucket_size = bucket_size
         self.metrics = metrics
         self.drift = drift
-        self.engine = engine or TransformEngine(self.d, self.k, mesh=mesh)
+        if compile_cache is None and cfg is not None:
+            # cfg.compile_cache_dir wires the persistent store in
+            # without a second knob at every construction site
+            from distributed_eigenspaces_tpu.utils.compile_cache import (
+                compile_cache_for,
+            )
+
+            compile_cache = compile_cache_for(cfg)
+        self.compile_cache = compile_cache
+        self.engine = engine or TransformEngine(
+            self.d, self.k, mesh=mesh, cache=compile_cache,
+        )
+        # prewarm: compile the expected row-bucket kernels OFF this
+        # thread (runtime/prewarm.py) so the first request of a
+        # declared size runs zero compiles. `prewarm` is True (default
+        # bucket ladder: min_bucket .. 16*min_bucket) or an iterable of
+        # expected per-dispatch row counts; callers that need the
+        # zero-stall GUARANTEE call wait_warm() before serving.
+        self.prewarmer = prewarmer
+        self.prewarm_labels: list = []
+        if prewarm:
+            from distributed_eigenspaces_tpu.runtime.prewarm import (
+                Prewarmer,
+            )
+
+            if self.prewarmer is None:
+                self.prewarmer = Prewarmer(metrics=metrics)
+            mb = self.engine.min_bucket
+            rows = (
+                prewarm
+                if isinstance(prewarm, (list, tuple, range))
+                else (mb, 2 * mb, 4 * mb, 8 * mb, 16 * mb)
+            )
+            self.prewarm_labels = self.prewarmer.warm_engine(
+                self.engine, rows
+            )
         #: served-version bookkeeping: the last version a batch used and
         #: how many hot-swaps dispatch has observed
         self.swap_count = 0
@@ -162,6 +200,15 @@ class QueryServer:
             _QueryRequest(x=arr, t_submit=time.perf_counter()),
         )
 
+    def wait_warm(self, timeout: float | None = None) -> bool:
+        """Block until every prewarm compile submitted at construction
+        has finished — the fence before the first request when the
+        zero-stall guarantee matters (CI asserts it). True immediately
+        when prewarming was not requested."""
+        if self.prewarmer is None:
+            return True
+        return self.prewarmer.wait(timeout)
+
     def close(self) -> None:
         """Flush partial micro-batches, drain, join dispatch lanes."""
         self.queue.close()
@@ -189,6 +236,14 @@ class QueryServer:
 
     def _run_batch(self, bucket) -> list:
         t0 = time.perf_counter()
+        # first-signature compile stall, counted instead of silently
+        # folded into request latency: any program this batch has to
+        # BUILD (engine-local miss — a fresh XLA compile, or a cheap
+        # persistent-store deserialize) shows up as the delta below and
+        # rides the serve event per-signature. A prewarmed signature
+        # reads 0 misses / 0.0 ms here — the zero-cold-start contract.
+        stall_miss0 = self.engine.compile_misses
+        stall_ms0 = self.engine.compile_ms_total
         reqs = [t.payload for t in bucket.tickets]
         ver = self.registry.latest()
         if ver is None:
@@ -262,6 +317,13 @@ class QueryServer:
                 "rejected": len(fails),
                 "rows": int(sum(r.x.shape[0] for r in reqs)),
                 "batch_seconds": round(now - t0, 6),
+                "signature": [self.d, self.k],
+                "compile_misses": (
+                    self.engine.compile_misses - stall_miss0
+                ),
+                "compile_stall_ms": round(
+                    self.engine.compile_ms_total - stall_ms0, 3
+                ),
                 "query_latency_s": [
                     round(now - r.t_submit, 6) for r in reqs
                 ],

@@ -30,6 +30,8 @@ collectives by construction (audited like the fleet trainer, via
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -75,10 +77,22 @@ class TransformEngine:
     not recompile (``stats()["compile_misses"]`` unchanged) instead of
     hoping. ``mesh`` shards the padded row axis over the ``workers``
     mesh axis (zero collectives — the kernels are row-local).
+
+    ``cache`` (a ``utils.compile_cache.CompileCache``) gives the
+    in-process program dict a persistent backing store: a bucket
+    program another PROCESS already compiled deserializes instead of
+    compiling (the cross-process half of zero-cold-start). The engine's
+    own counters keep their meaning — ``compile_misses`` counts
+    program-ACQUISITION events (local dict misses) and
+    ``compile_ms_total`` the wall time they cost, so a disk hit shows
+    up as a miss that cost ~nothing, which is the point. A prewarmed
+    signature (``runtime/prewarm.Prewarmer.warm_engine``) serves with
+    ZERO misses and zero added ms — the serving tier's stall counters
+    (``compile_stall_ms``) are built on exactly these numbers.
     """
 
     def __init__(self, d: int, k: int, *, dtype=jnp.float32, mesh=None,
-                 min_bucket: int = 8):
+                 min_bucket: int = 8, cache=None):
         if not (0 < k <= d):
             raise ValueError(f"need 0 < k <= d, got k={k}, d={d}")
         self.d = int(d)
@@ -90,8 +104,10 @@ class TransformEngine:
             1 if mesh is None else int(mesh.shape[WORKER_AXIS])
         )
         self._cache: dict = {}
+        self._persist = cache
         self.compile_misses = 0
         self.cache_hits = 0
+        self.compile_ms_total = 0.0
         prec = _precision_for(self.dtype)
 
         def project(x, v):
@@ -126,20 +142,17 @@ class TransformEngine:
 
     # -- compile cache -------------------------------------------------------
 
-    def _compiled(self, kind: str, rows: int):
-        key = (kind, rows)
-        hit = self._cache.get(key)
-        if hit is not None:
-            self.cache_hits += 1
-            return hit
-        self.compile_misses += 1
+    def _lowered(self, kind: str, rows: int):
+        """The lowered (pre-compile) bucket program — the compile
+        itself runs through :meth:`_compiled`, where it is timed and
+        (optionally) backed by the persistent store."""
         fn, arg_like, second_shape = self._fns[kind]
         if kind == "residual":
             second = self._z_like(rows)
         else:
             second = jax.ShapeDtypeStruct(second_shape, jnp.float32)
         if self.mesh is None:
-            compiled = jax.jit(fn).lower(arg_like(rows), second).compile()
+            return jax.jit(fn).lower(arg_like(rows), second)
         else:
             # rows over the workers axis, basis replicated (the residual
             # kernel's second operand is the per-row projection — it
@@ -162,7 +175,7 @@ class TransformEngine:
                 out_specs=out_specs,
                 check_vma=False,
             )
-            compiled = (
+            return (
                 jax.jit(
                     inner,
                     in_shardings=(
@@ -170,8 +183,36 @@ class TransformEngine:
                     ),
                 )
                 .lower(arg_like(rows), second)
-                .compile()
             )
+
+    def _compiled(self, kind: str, rows: int):
+        key = (kind, rows)
+        hit = self._cache.get(key)
+        if hit is not None:
+            self.cache_hits += 1
+            return hit
+        self.compile_misses += 1
+        t0 = time.perf_counter()
+        if self._persist is not None:
+            from distributed_eigenspaces_tpu.utils.compile_cache import (
+                make_key,
+            )
+
+            ck = make_key(
+                f"transform_{kind}",
+                (
+                    self.d, self.k, rows,
+                    None if self.mesh is None
+                    else tuple(self.mesh.shape.items()),
+                ),
+                str(self.dtype),
+            )
+            compiled = self._persist.get_or_build(
+                ck, lambda: self._lowered(kind, rows)
+            )
+        else:
+            compiled = self._lowered(kind, rows).compile()
+        self.compile_ms_total += (time.perf_counter() - t0) * 1e3
         self._cache[key] = compiled
         return compiled
 
@@ -182,11 +223,15 @@ class TransformEngine:
         return self._compiled(kind, rows)
 
     def stats(self) -> dict:
-        return {
+        out = {
             "compile_misses": self.compile_misses,
             "cache_hits": self.cache_hits,
+            "compile_ms_total": round(self.compile_ms_total, 3),
             "buckets": sorted({r for _, r in self._cache}),
         }
+        if self._persist is not None:
+            out["persistent"] = self._persist.stats()
+        return out
 
     # -- padded dispatch -----------------------------------------------------
 

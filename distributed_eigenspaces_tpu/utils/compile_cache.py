@@ -1,0 +1,432 @@
+"""Compile-lifecycle subsystem: persistent cache + AOT executable store.
+
+The warm path of this system is fast (BENCH_r05: 0.307 ms/step) and the
+cold path is dominated by XLA compilation (~7.4 s first step on the CPU
+rig, BASELINE.md) — a cost every new process pays again, and one the
+serving tiers (``parallel/fleet.FleetServer``,
+``serving/server.QueryServer``) pay INLINE on the first request of each
+shape signature. TPU linear-algebra practice treats a compiled program
+as a one-time artifact to be cached and reused across processes
+(arXiv:2112.09017); this module is that artifact store, two layers deep:
+
+1. **XLA's persistent compilation cache**
+   (:func:`configure_persistent_cache`): ``jax_compilation_cache_dir``
+   pointed at ``<dir>/xla``. Transparent — every jit in the process
+   benefits — but it only skips the XLA backend compile; tracing and
+   lowering still run, and the cache key is XLA's, not ours.
+
+2. **An explicit AOT layer** (:class:`CompileCache`): compiled
+   executables serialized via ``jax.experimental.serialize_executable``
+   and keyed by ``(program kind, shape signature, dtype, backend, jax
+   version, relevant PCAConfig knobs)``. A warm process deserializes
+   the executable directly — no tracing, no lowering, no XLA — which is
+   where the order-of-magnitude cold-start win lives (measured in
+   ``bench.py --coldstart``). Results are bit-identical cached-vs-fresh
+   (pinned in tests): deserialization reloads the SAME executable bytes
+   a fresh compile would produce on this backend.
+
+Fallback ladder (every rung loud, no rung fatal): in-memory hit →
+disk hit (meta validated: key string, jax version, backend, format) →
+fresh compile (+ best-effort persist). A corrupt, truncated, or
+version-mismatched disk entry warns and falls through to the fresh
+compile — a cache must never change results or crash a run.
+
+**CPU portability guard.** On the CPU backend, an executable containing
+``custom_call`` sites (LAPACK eigh/Cholesky — every solver program
+here) embeds raw host function pointers: deserializing it in ANOTHER
+process calls into the old process's address space — measured as a
+segfault on this rig's jaxlib. The disk tier therefore persists a CPU
+executable only when its lowered module is custom-call-free (the
+transform kernels — pure matmuls — qualify; the fit programs do not).
+Non-portable programs still get the in-memory AOT tier, the Prewarmer,
+and layer 1's XLA persistent cache — which stores pre-link artifacts
+and relocates correctly, and is where the CPU rig's measured cold-start
+win on fit programs comes from (``bench.py --coldstart``). TPU/GPU
+executables serialize by design and skip the guard.
+
+Keys deliberately EXCLUDE ``PCAConfig.seed``: the AOT-cached programs
+(dense scan fit, fleet fit/extract, transform kernels) take all
+randomness-free inputs as operands — the subspace solver's cold init
+inside them is the fixed ``PRNGKey(0)`` basis, not a seed-derived
+constant. Programs that bake ``seed`` in (the feature-sharded
+trainers) are not AOT-cached here; they ride layer 1 only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+import warnings
+
+__all__ = [
+    "CacheKey",
+    "CompileCache",
+    "compile_cache_for",
+    "config_knobs",
+    "configure_persistent_cache",
+    "make_key",
+]
+
+#: bump when the on-disk entry layout changes: old entries then fail
+#: meta validation and fall back to a fresh compile instead of
+#: deserializing garbage
+_FORMAT_VERSION = 1
+
+#: PCAConfig fields that change the COMPILED PROGRAM for the AOT-cached
+#: kinds (shape fields ride in the key's signature; ``backend``/device
+#: ride in the key's backend; ``seed`` is deliberately absent — see the
+#: module docstring). Warm-start and warm-orth are keyed at their
+#: RESOLVED values so "auto" and its resolution can never alias two
+#: different programs under one key.
+_PROGRAM_KNOB_FIELDS = (
+    "discount",
+    "solver",
+    "subspace_iters",
+    "orth_method",
+    "compute_dtype",
+    "stage_dtype",
+    "dtype",
+    "state_dtype",
+    "collectives",
+    "merge_interval",
+    "pipeline_merge",
+)
+
+
+def config_knobs(cfg) -> tuple[tuple[str, str], ...]:
+    """The program-affecting PCAConfig knobs as a canonical
+    ``((name, repr), ...)`` tuple — the ``knobs`` half of every
+    config-derived :class:`CacheKey` (one definition, so two call sites
+    cannot disagree about which knobs invalidate the cache)."""
+    knobs = [(f, repr(getattr(cfg, f))) for f in _PROGRAM_KNOB_FIELDS]
+    knobs.append(("warm_start", repr(cfg.resolved_warm_start())))
+    knobs.append(("warm_orth", repr(cfg.resolved_warm_orth())))
+    return tuple(knobs)
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheKey:
+    """One AOT cache key: everything that must match for a serialized
+    executable to be valid to reuse. Two keys with ANY differing field
+    map to different digests — changing ``k``, a dtype, a solver knob,
+    the jax version, or the backend is a MISS by construction (pinned
+    in tests/test_compile_cache.py)."""
+
+    kind: str  # program kind: "scan_fit", "fleet_fit", "transform_project", ...
+    signature: tuple  # shape signature (kind-specific, hashable)
+    dtype: str  # primary operand dtype
+    backend: str  # jax.default_backend() at key time
+    jax_version: str  # jax.__version__ at key time
+    knobs: tuple = ()  # ((name, repr), ...) program-affecting config knobs
+
+    def string(self) -> str:
+        return (
+            f"fmt{_FORMAT_VERSION}|kind={self.kind}"
+            f"|sig={self.signature!r}|dtype={self.dtype}"
+            f"|backend={self.backend}|jax={self.jax_version}"
+            f"|knobs={self.knobs!r}"
+        )
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.string().encode()).hexdigest()[:32]
+
+
+def make_key(
+    kind: str,
+    signature: tuple,
+    dtype,
+    *,
+    knobs: tuple = (),
+    backend: str | None = None,
+    jax_version: str | None = None,
+) -> CacheKey:
+    """Build a :class:`CacheKey` with the runtime defaults resolved
+    (current backend, current jax version). Tests override both to
+    prove version/backend invalidation without actually swapping
+    runtimes."""
+    import jax
+
+    return CacheKey(
+        kind=kind,
+        signature=tuple(signature),
+        dtype=str(dtype),
+        backend=jax.default_backend() if backend is None else backend,
+        jax_version=jax.__version__ if jax_version is None else jax_version,
+        knobs=tuple(knobs),
+    )
+
+
+class CompileCache:
+    """Two-tier AOT executable cache: per-process memory + optional disk.
+
+    ``get_or_build(key, lower_fn)`` returns a compiled executable for
+    ``key``; ``lower_fn()`` must return a ``jax.stages.Lowered`` (i.e.
+    ``jax.jit(f).lower(*shape_structs)``) and is only invoked on a full
+    miss. Counters (:meth:`stats`) make the lifecycle auditable:
+    ``hits`` (memory), ``disk_hits`` (deserialized — the cross-process
+    warm start), ``misses`` (fresh compiles), ``fallbacks`` (disk
+    entries rejected loudly), and ``compile_ms_total`` (wall time spent
+    ACQUIRING programs — fresh compiles dominate it, disk hits barely
+    register, which is exactly the claim ``bench.py --coldstart``
+    measures).
+
+    ``cache_dir=None`` is a memory-only cache: same AOT discipline and
+    honest compile timing, no persistence — what the serving tiers use
+    when no ``compile_cache_dir`` is configured.
+    """
+
+    def __init__(self, cache_dir: str | None = None):
+        self.cache_dir = None if cache_dir is None else str(cache_dir)
+        if self.cache_dir is not None:
+            os.makedirs(self.cache_dir, exist_ok=True)
+        self._mem: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.disk_hits = 0
+        self.misses = 0
+        self.fallbacks = 0
+        self.not_portable = 0
+        self.compile_ms_total = 0.0
+        self.last_compile_ms = 0.0
+
+    # -- paths ---------------------------------------------------------------
+
+    def _paths(self, key: CacheKey) -> tuple[str, str]:
+        d = key.digest()
+        return (
+            os.path.join(self.cache_dir, f"{d}.json"),
+            os.path.join(self.cache_dir, f"{d}.bin"),
+        )
+
+    # -- disk tier -----------------------------------------------------------
+
+    def _load_disk(self, key: CacheKey):
+        """Deserialize a disk entry for ``key``, or None. EVERY failure
+        mode — missing files, corrupt/truncated pickle, meta whose key
+        string, jax version, backend, or format does not match the
+        current runtime — warns and returns None (the fresh-compile
+        fallback), never raises."""
+        if self.cache_dir is None:
+            return None
+        meta_path, bin_path = self._paths(key)
+        if not (os.path.exists(meta_path) and os.path.exists(bin_path)):
+            return None
+        import jax
+        from jax.experimental import serialize_executable
+
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+            bad = None
+            if meta.get("format") != _FORMAT_VERSION:
+                bad = f"format {meta.get('format')} != {_FORMAT_VERSION}"
+            elif meta.get("key") != key.string():
+                bad = "key string mismatch (digest collision or tamper)"
+            elif meta.get("jax_version") != jax.__version__:
+                bad = (
+                    f"jax {meta.get('jax_version')} != {jax.__version__}"
+                )
+            elif meta.get("backend") != jax.default_backend():
+                bad = (
+                    f"backend {meta.get('backend')} != "
+                    f"{jax.default_backend()}"
+                )
+            if bad is not None:
+                raise ValueError(bad)
+            with open(bin_path, "rb") as f:
+                payload, in_tree, out_tree = pickle.loads(f.read())
+            return serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree
+            )
+        except Exception as e:  # corrupt/truncated/mismatched: fall back
+            with self._lock:
+                self.fallbacks += 1
+            warnings.warn(
+                f"compile cache entry for {key.kind} {key.signature} is "
+                f"invalid ({e!r}) — falling back to a fresh compile "
+                "(results are unaffected; the entry will be rewritten)",
+                stacklevel=3,
+            )
+            return None
+
+    def _store_disk(self, key: CacheKey, compiled) -> None:
+        """Best-effort persist: a program that cannot serialize (or a
+        read-only cache dir) costs the NEXT process a compile, never
+        this one a crash."""
+        if self.cache_dir is None:
+            return
+        import jax
+        from jax.experimental import serialize_executable
+
+        try:
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled
+            )
+            meta_path, bin_path = self._paths(key)
+            tmp = bin_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(pickle.dumps((payload, in_tree, out_tree)))
+            os.replace(tmp, bin_path)  # atomic: readers never see a torn blob
+            with open(meta_path + ".tmp", "w") as f:
+                json.dump(
+                    {
+                        "format": _FORMAT_VERSION,
+                        "key": key.string(),
+                        "kind": key.kind,
+                        "jax_version": jax.__version__,
+                        "backend": jax.default_backend(),
+                        "written_at": time.time(),
+                    },
+                    f,
+                )
+            os.replace(meta_path + ".tmp", meta_path)
+        except Exception as e:
+            from distributed_eigenspaces_tpu.utils.metrics import log_line
+
+            log_line(
+                "compile cache persist failed (executable not "
+                "serializable or cache dir unwritable) — continuing "
+                "with the in-memory compile",
+                kind=key.kind,
+                error=repr(e),
+            )
+
+    # -- the one entry point -------------------------------------------------
+
+    def get_or_build(self, key: CacheKey, lower_fn):
+        """The compiled executable for ``key``: memory hit → disk hit
+        (deserialize) → fresh ``lower_fn().compile()`` (persisted
+        best-effort). ``lower_fn`` returns a ``jax.stages.Lowered``."""
+        s = key.string()
+        with self._lock:
+            hit = self._mem.get(s)
+            if hit is not None:
+                self.hits += 1
+                return hit
+        loaded = self._load_disk(key)
+        if loaded is not None:
+            with self._lock:
+                self.disk_hits += 1
+                self._mem[s] = loaded
+            return loaded
+        t0 = time.perf_counter()
+        lowered = lower_fn()
+        compiled = lowered.compile()
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if self._portable(key, lowered):
+            self._store_disk(key, compiled)
+        with self._lock:
+            self.misses += 1
+            self.compile_ms_total += dt_ms
+            self.last_compile_ms = dt_ms
+            self._mem[s] = compiled
+        return compiled
+
+    def _portable(self, key: CacheKey, lowered) -> bool:
+        """Whether ``lowered``'s executable may be deserialized by a
+        DIFFERENT process (the module docstring's CPU portability
+        guard). Conservative on inspection failure: not portable."""
+        if self.cache_dir is None:
+            return False  # memory-only cache: nothing to persist
+        if key.backend != "cpu":
+            return True
+        try:
+            portable = "custom_call" not in lowered.as_text()
+        except Exception:
+            portable = False
+        if not portable:
+            with self._lock:
+                self.not_portable += 1
+        return portable
+
+    def contains(self, key: CacheKey) -> bool:
+        """Whether ``key`` would be served without an XLA compile
+        (memory or a validatable disk entry) — the prewarm assertion's
+        question. Does not bump counters and does not deserialize."""
+        with self._lock:
+            if key.string() in self._mem:
+                return True
+        if self.cache_dir is None:
+            return False
+        meta_path, bin_path = self._paths(key)
+        return os.path.exists(meta_path) and os.path.exists(bin_path)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "disk_hits": self.disk_hits,
+                "misses": self.misses,
+                "fallbacks": self.fallbacks,
+                "not_portable": self.not_portable,
+                "compile_ms_total": round(self.compile_ms_total, 3),
+                "entries_mem": len(self._mem),
+                "dir": self.cache_dir,
+            }
+
+
+# -- wiring ------------------------------------------------------------------
+
+_CONFIGURED_DIRS: set[str] = set()
+_INSTANCES: dict[str, CompileCache] = {}
+_WIRING_LOCK = threading.Lock()
+
+
+def configure_persistent_cache(cache_dir: str) -> str:
+    """Point JAX's persistent compilation cache at ``<cache_dir>/xla``
+    (layer 1 of the module docstring). Thresholds are zeroed so even
+    the CPU rig's fast-compiling smoke programs land on disk — on a
+    real TPU every entry clears the default thresholds anyway.
+    Idempotent; returns the XLA cache dir."""
+    import jax
+
+    xla_dir = os.path.join(str(cache_dir), "xla")
+    with _WIRING_LOCK:
+        if xla_dir in _CONFIGURED_DIRS:
+            return xla_dir
+        os.makedirs(xla_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", xla_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        try:
+            # the cache object initializes LAZILY at the first compile
+            # and never re-reads the dir config: a process that compiled
+            # anything before this call would silently run with the
+            # cache pointed elsewhere (or nowhere) — measured as the
+            # entire cross-process warm-start win disappearing. Reset
+            # so the next compile re-initializes against xla_dir.
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _xla_cc,
+            )
+
+            _xla_cc.reset_cache()
+        except Exception:
+            pass  # older/newer jax: the config alone has to do
+        _CONFIGURED_DIRS.add(xla_dir)
+    return xla_dir
+
+
+def compile_cache_for(cfg) -> CompileCache | None:
+    """The process-wide :class:`CompileCache` for ``cfg``'s
+    ``compile_cache_dir`` (AOT blobs under ``<dir>/aot``, XLA cache
+    wired under ``<dir>/xla``), or None when the knob is unset. One
+    instance per directory, so the estimator, the fleet server, and the
+    query server of one process share counters and the memory tier."""
+    cache_dir = getattr(cfg, "compile_cache_dir", None)
+    if cache_dir is None:
+        return None
+    configure_persistent_cache(cache_dir)
+    aot_dir = os.path.abspath(os.path.join(str(cache_dir), "aot"))
+    with _WIRING_LOCK:
+        inst = _INSTANCES.get(aot_dir)
+        if inst is None:
+            inst = CompileCache(aot_dir)
+            _INSTANCES[aot_dir] = inst
+        return inst

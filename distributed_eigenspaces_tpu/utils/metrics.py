@@ -48,6 +48,13 @@ class MetricsLogger:
         #: serving/drift.py DriftMonitor refreshes) — surfaced by
         #: :meth:`summary` under "serving"
         self.serve_records: list[dict] = []
+        #: fleet-serving events (parallel/fleet.py FleetServer bucket
+        #: dispatches) — surfaced by :meth:`summary` under "fleet"
+        self.fleet_records: list[dict] = []
+        #: compile-lifecycle counters (utils/compile_cache.py
+        #: CompileCache), attached via :meth:`attach_compile` —
+        #: surfaced by :meth:`summary` under "compile"
+        self.compile_cache = None
         self._last_time = None
 
     def start(self) -> "MetricsLogger":
@@ -88,6 +95,27 @@ class MetricsLogger:
         state at call time)."""
         self.ingest_stats = stats
         return self
+
+    def attach_compile(self, cache) -> "MetricsLogger":
+        """Attach a live ``utils.compile_cache.CompileCache`` — its
+        hit/miss/compile-ms counters land in ``summary()["compile"]``
+        (read at summary time, like the ingest stats), so cold-start
+        cost and cache effectiveness are diagnosable from the run
+        report."""
+        self.compile_cache = cache
+        return self
+
+    def fleet(self, event: dict) -> None:
+        """Record one structured fleet-serving event — a dispatched fit
+        bucket (``kind="bucket"``: tenant count, occupancy, signature,
+        and the per-signature ``compile_stall_ms`` the dispatch paid
+        acquiring its programs). Rides the same JSON stream as step
+        records, tagged ``"fleet"``."""
+        rec = {"fleet": event.get("kind", "bucket"), **event}
+        rec.setdefault("t", time.perf_counter())
+        self.fleet_records.append(rec)
+        if self.stream is not None:
+            print(json.dumps(rec), file=self.stream, flush=True)
 
     def serve(self, event: dict) -> None:
         """Record one structured serving event — a dispatched query
@@ -140,6 +168,50 @@ class MetricsLogger:
             out["ingest"] = self.ingest_stats.as_dict()
         if self.serve_records:
             out["serving"] = self._serving_summary()
+        if self.fleet_records:
+            out["fleet"] = self._fleet_summary()
+        if self.compile_cache is not None:
+            out["compile"] = self.compile_cache.stats()
+        return out
+
+    @staticmethod
+    def _stall_fields(records: list[dict]) -> dict:
+        """Shared compile-stall aggregation for the serving and fleet
+        sections: total misses, total stall ms, and the per-signature
+        stall breakdown that makes a p99 regression attributable to
+        the exact shape that compiled inline."""
+        out: dict = {
+            "compile_misses": sum(
+                r.get("compile_misses", 0) for r in records
+            ),
+            "compile_stall_ms": round(
+                sum(r.get("compile_stall_ms", 0.0) for r in records), 3
+            ),
+        }
+        by_sig: dict[str, float] = {}
+        for r in records:
+            stall = r.get("compile_stall_ms", 0.0)
+            if stall and "signature" in r:
+                sig = str(tuple(r["signature"]))
+                by_sig[sig] = round(by_sig.get(sig, 0.0) + stall, 3)
+        if by_sig:
+            out["compile_stall_ms_by_signature"] = by_sig
+        return out
+
+    def _fleet_summary(self) -> dict:
+        """The ``summary()["fleet"]`` section (mirrors ``["serving"]``):
+        dispatched buckets, tenants served, mean bucket occupancy, and
+        the compile-stall ledger."""
+        buckets = [
+            r for r in self.fleet_records if r["fleet"] == "bucket"
+        ]
+        out: dict = {"buckets": len(buckets)}
+        if buckets:
+            out["tenants"] = sum(r.get("tenants", 0) for r in buckets)
+            occ = [r["occupancy"] for r in buckets if "occupancy" in r]
+            if occ:
+                out["mean_occupancy"] = round(sum(occ) / len(occ), 4)
+            out.update(self._stall_fields(buckets))
         return out
 
     def _serving_summary(self) -> dict:
@@ -178,6 +250,7 @@ class MetricsLogger:
             out["swaps"] = sum(1 for r in batches if r.get("swap"))
             versions = {r["version"] for r in batches if "version" in r}
             out["versions_served"] = sorted(versions)
+            out.update(self._stall_fields(batches))
         drifts = [r for r in self.serve_records if r["serve"] == "drift"]
         if drifts:
             out["drift_refreshes"] = len(drifts)

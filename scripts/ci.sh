@@ -29,12 +29,23 @@
 #      direct estimator.transform BIT-FOR-BIT and that the swap
 #      recompiled nothing; compared (qps normalized + p99 floor)
 #      against the committed BENCH_SERVE_SMOKE_CPU.json;
-#   5. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   5. bench.py --coldstart: the zero-cold-start smoke — subprocess A/B
+#      of first-fit / first-serve wall time with cold vs warm
+#      persistent compile cache (utils/compile_cache.py). The bench
+#      itself asserts the hard gates: results BIT-IDENTICAL
+#      cached-vs-fresh, the prewarmed QueryServer signature's first
+#      request at 0 compile misses / 0.0 ms stall, and warm first-fit
+#      >= 3x faster than cold; the compare checks the speedup against
+#      the committed BENCH_COLDSTART_SMOKE_CPU.json at the same
+#      CPU-tolerant floor (the speedup is dimensionless — rig speed
+#      divides itself out — so the floor only catches amortization
+#      drift, not session jitter);
+#   6. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/5] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/6] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -42,7 +53,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/5] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/6] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -52,7 +63,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/5] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/6] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -67,7 +78,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/5] serve equality + amortization smoke (CPU) =="
+echo "== [4/6] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -82,7 +93,22 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/5] graft entry + 8-device sharded dryrun =="
+echo "== [5/6] coldstart + prewarm smoke (CPU) =="
+# bench.py --coldstart asserts the zero-cold-start gates itself:
+# cached-vs-fresh results bit-identical, the prewarmed signature's
+# first request at 0 compile misses / 0.0 ms stall, warm first-fit
+# >= 3x cold. The compare checks the speedup against the committed
+# record (dimensionless ratio — CPU-tolerant 0.5 floor catches a
+# halved amortization, not rig jitter).
+if [[ -f BENCH_COLDSTART_SMOKE_CPU.json ]]; then
+    JAX_PLATFORMS=cpu python bench.py --coldstart \
+        --compare BENCH_COLDSTART_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    JAX_PLATFORMS=cpu python bench.py --coldstart
+fi
+
+echo "== [6/6] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

@@ -211,3 +211,47 @@ def test_serve_vs_fleet_metric_mismatch_skips(tmp_path, capsys):
     verdict = json.loads(capsys.readouterr().err.strip())
     assert verdict["compare"] == "skipped"
     assert "metric mismatch" in verdict["reason"]
+
+
+def _coldstart_report(speedup, serve_speedup):
+    return {
+        "metric": "pca_coldstart_speedup",
+        "value": speedup,
+        "coldstart_speedup": speedup,
+        "serve_coldstart_speedup": serve_speedup,
+        "bit_identical": True,
+        "prewarm_compile_misses": 0,
+        "prewarm_compile_stall_ms": 0.0,
+    }
+
+
+def test_coldstart_records_compare_dimensionless(tmp_path, capsys):
+    """Coldstart records compare speedup-to-speedup (warm/cold of one
+    session — rig speed divides itself out, no anchor) at the same
+    ratio floor; a halved amortization is a regression, session jitter
+    is not."""
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_coldstart_report(4.1, 4.06)))
+    assert bench.compare_reports(
+        str(old), _coldstart_report(3.9, 4.0), threshold=0.5
+    ) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["coldstart_speedup_old"] == 4.1
+    assert verdict["coldstart_speedup_new"] == 3.9
+    assert not verdict["regression"]
+
+    # the cache "works" but amortizes half of what the record shows
+    assert bench.compare_reports(
+        str(old), _coldstart_report(1.8, 1.7), threshold=0.5
+    ) == 1
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["regression"] is True
+
+
+def test_coldstart_vs_headline_metric_mismatch_skips(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_coldstart_report(4.1, 4.06)))
+    assert bench.compare_reports(str(old), _report(60e6, 120.0)) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]
