@@ -275,9 +275,16 @@ class OnlineDistributedPCA:
 
     # -- fitting ------------------------------------------------------------
 
-    def fit(self, data, *, on_step=None, worker_masks=None) -> "OnlineDistributedPCA":
+    def fit(
+        self, data, *, on_step=None, worker_masks=None, tracer=None
+    ) -> "OnlineDistributedPCA":
         """Fit on a (N, dim) array, streaming it as ``num_steps`` blocks of
         ``num_workers x rows_per_worker`` rows (advancing cursor — B6 fix).
+
+        ``tracer`` (a ``utils.telemetry.Tracer``) wraps the whole fit in
+        a root span on a fresh ``fit`` trace — the run's arc on the
+        exported timeline (CLI ``--trace-out``); ``None`` traces
+        nothing.
 
         ``fit`` starts fresh (sklearn semantics — prior state is discarded);
         use :meth:`fit_stream`/:meth:`partial_fit` to continue a run.
@@ -295,6 +302,22 @@ class OnlineDistributedPCA:
         generator/iterator keeps the per-step loop, whose contract is
         one ``next()`` per round.
         """
+        from distributed_eigenspaces_tpu.utils.telemetry import NULL_TRACER
+
+        tr = tracer if tracer is not None else NULL_TRACER
+        with tr.span(
+            "estimator_fit", trace_id=tr.new_trace("fit"),
+            category="fit", device=True,
+            attrs={"dim": self.cfg.dim, "k": self.cfg.k,
+                   "steps": self.cfg.num_steps},
+        ) as sp:
+            out = self._fit_impl(
+                data, on_step=on_step, worker_masks=worker_masks
+            )
+            sp.set(trainer=self.trainer_used_)
+            return out
+
+    def _fit_impl(self, data, *, on_step, worker_masks):
         self.state = None
         self._w = None
         cfg = self.cfg

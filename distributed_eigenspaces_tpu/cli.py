@@ -159,6 +159,20 @@ def build_parser() -> argparse.ArgumentParser:
                    "--checkpoint-dir")
     p.add_argument("--metrics", action="store_true",
                    help="print per-step JSON metrics to stderr")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="export the run's telemetry timeline "
+                   "(utils/telemetry.py: request-scoped spans with "
+                   "correlation ids across fit / fleet / serve / "
+                   "drift / compile) as Chrome trace-event JSON — "
+                   "open at ui.perfetto.dev or chrome://tracing "
+                   "(docs/OBSERVABILITY.md)")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="declared p99 request-latency SLO in ms "
+                   "(PCAConfig.serve_slo_p99_ms / fleet_slo_p99_ms): "
+                   "summary()['slo'] reports rolling-window attainment "
+                   "and error-budget burn against it — --mode serve "
+                   "gates warn-only (an SLO miss is reported, never a "
+                   "hard failure)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the fit into this "
                    "dir (TensorBoard-viewable; the det_* named regions "
@@ -306,6 +320,33 @@ def _resume_from(ckpt, want: str, k: int):
     return state, cursor, 0
 
 
+def _make_tracer(args):
+    """One ``utils.telemetry.Tracer`` per run when ``--trace-out`` is
+    set, else None — constructed before the instrumented components so
+    every span lands on one timeline."""
+    if not getattr(args, "trace_out", None):
+        return None
+    from distributed_eigenspaces_tpu.utils.telemetry import Tracer
+
+    return Tracer()
+
+
+def _export_trace(args, tracer) -> None:
+    """Write the Chrome trace-event timeline to ``--trace-out`` (and a
+    one-line stderr receipt), no-op without a tracer."""
+    if tracer is None:
+        return
+    path = tracer.export_chrome_trace(args.trace_out)
+    print(
+        json.dumps({
+            "trace_out": path,
+            "spans": len(tracer.spans),
+            "dropped_spans": tracer.dropped,
+        }),
+        file=sys.stderr,
+    )
+
+
 def _scan_mesh(cfg):
     import jax
 
@@ -388,15 +429,22 @@ def _fit_scan(args, cfg, data, truth) -> int:
         np.ascontiguousarray(data[:need]).reshape(T, m, n, dim)
     )
 
+    from distributed_eigenspaces_tpu.utils.telemetry import NULL_TRACER
     from distributed_eigenspaces_tpu.utils.tracing import profile_to
 
+    tracer = _make_tracer(args)
+    tr = tracer if tracer is not None else NULL_TRACER
     handle = make_whole_fit(cfg, "scan", _scan_mesh(cfg))
     t0 = time.time()
-    with profile_to(args.profile_dir):
+    with profile_to(args.profile_dir), tr.span(
+        "scan_fit", trace_id=tr.new_trace("fit"), category="fit",
+        device=True,
+        attrs={"dim": cfg.dim, "k": cfg.k, "steps": cfg.num_steps},
+    ):
         state = handle.fit(handle.init_state(), x_steps)
         float(jnp.sum(state.step))  # fence inside the capture
     elapsed = time.time() - t0
-    return _scan_result(
+    rc = _scan_result(
         args, cfg, state, truth, elapsed,
         {
             # one fit call: compile time is included (evals.py/bench.py
@@ -406,6 +454,8 @@ def _fit_scan(args, cfg, data, truth) -> int:
             "samples_per_sec": round(need / elapsed, 1),
         },
     )
+    _export_trace(args, tracer)
+    return rc
 
 
 def _fit_scan_segmented(args, cfg, data, truth) -> int:
@@ -451,11 +501,15 @@ def _fit_scan_segmented(args, cfg, data, truth) -> int:
         data[cursor : cursor + need]
     ).reshape(remaining, m, n, dim)
 
+    tracer = _make_tracer(args)
     metrics = MetricsLogger(
         samples_per_step=rows_per_step,
         stream=sys.stderr if args.metrics else None,
         reference_subspace=truth,
+        retention=cfg.metrics_retention,
     ).start()
+    if tracer is not None:
+        metrics.attach_tracer(tracer)
     last_t = {"t": done}
 
     def on_segment(t, st):
@@ -472,7 +526,7 @@ def _fit_scan_segmented(args, cfg, data, truth) -> int:
     with profile_to(args.profile_dir):
         state = handle.fit(state, x_steps, on_segment=on_segment)
     elapsed = time.time() - t0
-    return _scan_result(
+    rc = _scan_result(
         args, cfg, state, truth, elapsed,
         {
             "includes_compile": True,
@@ -481,6 +535,8 @@ def _fit_scan_segmented(args, cfg, data, truth) -> int:
             **metrics.summary(),
         },
     )
+    _export_trace(args, tracer)
+    return rc
 
 
 def _fit_feature_whole(args, cfg, data, truth) -> int:
@@ -694,11 +750,15 @@ def _fit_supervised(args, cfg, data, truth) -> int:
 
     trainer = "segmented" if args.trainer == "scan" else "step"
     rows_per_step = cfg.num_workers * cfg.rows_per_worker
+    tracer = _make_tracer(args)
     metrics = MetricsLogger(
         samples_per_step=rows_per_step,
         stream=sys.stderr if args.metrics else None,
         reference_subspace=truth,
+        retention=cfg.metrics_retention,
     ).start()
+    if tracer is not None:
+        metrics.attach_tracer(tracer)
 
     def factory(start_row):
         return block_stream(
@@ -737,6 +797,9 @@ def _fit_supervised(args, cfg, data, truth) -> int:
             ),
             file=sys.stderr,
         )
+        # the trace is MOST valuable on the failure path: the fault
+        # events and retry arcs are on it
+        _export_trace(args, tracer)
         return 3
     elapsed = time.time() - t0
 
@@ -759,6 +822,7 @@ def _fit_supervised(args, cfg, data, truth) -> int:
             4,
         )
     print(json.dumps(out))
+    _export_trace(args, tracer)
     if args.save:
         np.save(args.save, w_host)
     return 0
@@ -815,8 +879,10 @@ def _fit_fleet_cli(args, data, truth) -> int:
                   else args.warm_start_iters)
         ),
         fleet_bucket_size=b,
+        fleet_slo_p99_ms=args.slo_p99_ms,
         compile_cache_dir=args.compile_cache,
     )
+    tracer = _make_tracer(args)
     problems = [
         data[t * per_tenant : (t + 1) * per_tenant] for t in range(b)
     ]
@@ -845,8 +911,18 @@ def _fit_fleet_cli(args, data, truth) -> int:
                 ),
             )
             prewarmed = pw.wait(timeout=600)
+    from distributed_eigenspaces_tpu.utils.telemetry import (
+        NULL_TRACER,
+        slo_summary,
+    )
+
+    tr = tracer if tracer is not None else NULL_TRACER
     t0 = time.time()
-    fleet.fit(problems)
+    with tr.span(
+        "fleet_fit", trace_id=tr.new_trace("fleet"), category="fleet",
+        device=True, attrs={"tenants": b, "dim": dim, "k": args.rank},
+    ):
+        fleet.fit(problems)
     elapsed = time.time() - t0
     out = {
         "mode": "fleet",
@@ -859,6 +935,14 @@ def _fit_fleet_cli(args, data, truth) -> int:
         "dim": dim,
         "k": args.rank,
     }
+    if args.slo_p99_ms is not None:
+        # one bucket dispatch: every tenant's fit latency IS the
+        # dispatch wall time — report it against the declared target
+        out["slo"] = {
+            "fleet": slo_summary(
+                args.slo_p99_ms, [elapsed * 1e3] * b,
+            )
+        }
     if truth is not None:
         angles = [
             round(
@@ -876,6 +960,7 @@ def _fit_fleet_cli(args, data, truth) -> int:
         out["principal_angle_deg_max"] = max(angles)
         out["principal_angle_deg"] = angles
     print(json.dumps(out))
+    _export_trace(args, tracer)
     if args.save:
         np.save(args.save, fleet.components_)
     return 0
@@ -898,9 +983,10 @@ def _serve_cli(args, cfg, data, truth) -> int:
     )
     from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
 
+    tracer = _make_tracer(args)
     est = OnlineDistributedPCA(cfg)
     t0 = time.time()
-    est.fit(data)
+    est.fit(data, tracer=tracer)
     fit_s = time.time() - t0
     registry = EigenbasisRegistry(keep=cfg.serve_keep_versions)
     version = registry.publish_fit(est, lineage={"producer": "cli"})
@@ -914,7 +1000,12 @@ def _serve_cli(args, cfg, data, truth) -> int:
         )
         for i in range(n_q)
     ]
-    metrics = MetricsLogger(stream=sys.stderr if args.metrics else None)
+    metrics = MetricsLogger(
+        stream=sys.stderr if args.metrics else None,
+        retention=cfg.metrics_retention,
+    )
+    if tracer is not None:
+        metrics.attach_tracer(tracer)
     from distributed_eigenspaces_tpu.utils.compile_cache import (
         compile_cache_for,
     )
@@ -943,6 +1034,7 @@ def _serve_cli(args, cfg, data, truth) -> int:
         float(np.abs(res.z - np.asarray(est.transform(q))).max())
         for q, res in zip(queries, results)
     )
+    summary = metrics.summary()
     out = {
         "mode": "serve",
         "version": version.version,
@@ -953,7 +1045,10 @@ def _serve_cli(args, cfg, data, truth) -> int:
         "fit_seconds": round(fit_s, 3),
         "serve_seconds": round(elapsed, 3),
         "max_abs_err_vs_direct": max_err,
-        **metrics.summary().get("serving", {}),
+        **summary.get("serving", {}),
+        **(
+            {"slo": summary["slo"]} if "slo" in summary else {}
+        ),
         **({"prewarm": prewarm_stats} if prewarm_stats else {}),
         **(
             {"compile_cache": cc.stats()} if cc is not None else {}
@@ -977,6 +1072,7 @@ def _serve_cli(args, cfg, data, truth) -> int:
             4,
         )
     print(json.dumps(out))
+    _export_trace(args, tracer)
     if args.save:
         np.save(args.save, version.v)
     return 0 if max_err == 0.0 else 1
@@ -1017,6 +1113,13 @@ def main(argv=None) -> int:
         print(
             f"note: --broker {args.broker} ignored (no message broker; "
             "collectives ride ICI)",
+            file=sys.stderr,
+        )
+    if args.trace_out and args.mode in ("oneshot", "master"):
+        print(
+            "note: --trace-out covers the fit/fleet/serve modes; the "
+            "one-shot round is a single dispatch with nothing to "
+            "decompose — flag ignored",
             file=sys.stderr,
         )
     if (
@@ -1150,6 +1253,8 @@ def main(argv=None) -> int:
         ),
         merge_interval=args.merge_interval,
         pipeline_merge=args.pipeline_merge,
+        serve_slo_p99_ms=args.slo_p99_ms,
+        fleet_slo_p99_ms=args.slo_p99_ms,
         compile_cache_dir=args.compile_cache,
     )
 
@@ -1197,11 +1302,15 @@ def main(argv=None) -> int:
 
     rows_per_step = cfg.num_workers * cfg.rows_per_worker
     callbacks = []
+    tracer = _make_tracer(args)
     metrics = MetricsLogger(
         samples_per_step=rows_per_step,
         stream=sys.stderr if args.metrics else None,
         reference_subspace=truth,
+        retention=cfg.metrics_retention,
     ).start()
+    if tracer is not None:
+        metrics.attach_tracer(tracer)
     callbacks.append(metrics.on_step)
     cursor = 0
     if args.checkpoint_dir:
@@ -1244,11 +1353,22 @@ def main(argv=None) -> int:
         stream = iter(())  # budget exhausted or no unseen data left
     from distributed_eigenspaces_tpu.utils.tracing import profile_to
 
-    with profile_to(args.profile_dir):
+    from distributed_eigenspaces_tpu.utils.telemetry import NULL_TRACER
+
+    tr = tracer if tracer is not None else NULL_TRACER
+    fit_tid = tr.new_trace("fit")
+    if tracer is not None:
+        # per-step spans (metrics.on_step) join the run's trace
+        metrics._fit_trace = fit_tid
+    with profile_to(args.profile_dir), tr.span(
+        "fit_stream", trace_id=fit_tid, category="fit",
+        device=True, attrs={"dim": dim, "k": args.rank},
+    ):
         est.fit_stream(stream, on_step=on_step, max_steps=None)
 
     out = {"mode": "fit", **metrics.summary(), "dim": dim, "k": args.rank}
     print(json.dumps(out))
+    _export_trace(args, tracer)
     if args.save:
         np.save(args.save, np.asarray(est.components_))
     return 0

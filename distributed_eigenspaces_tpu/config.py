@@ -163,6 +163,23 @@ class PCAConfig:
       serve_keep_versions: how many published basis versions the
         ``serving/registry.py EigenbasisRegistry`` retains (append-only
         store, GC keeps the newest N; ``latest()`` never dangles).
+      serve_slo_p99_ms: declared p99 request-latency SLO for the query
+        server, in milliseconds (CLI ``--slo-p99-ms``). When set,
+        ``MetricsLogger.summary()["slo"]["serve"]`` reports
+        rolling-window attainment and error-budget burn against it,
+        and ``bench.py --serve`` gates on it warn-only (an SLO miss
+        prints a warning record, never fails the bench — the bench's
+        hard gates stay bit-exactness and zero-recompile swaps).
+        ``None`` (default) declares no target.
+      fleet_slo_p99_ms: the fleet equivalent — p99 fit-request latency
+        target for ``FleetServer`` bucket dispatches, surfaced as
+        ``summary()["slo"]["fleet"]``.
+      metrics_retention: ring-buffer retention per ``MetricsLogger``
+        event list (step / serve / fleet / fault records). Evicted
+        entries fold into running aggregates (counters + mergeable
+        log-bucket histograms — ``utils/telemetry.py``), so a
+        long-lived server's memory is bounded while ``summary()``
+        still covers the whole run.
       compile_cache_dir: root of the persistent compile cache
         (``utils/compile_cache.py``; CLI ``--compile-cache``). When
         set, JAX's persistent compilation cache is wired under
@@ -222,6 +239,9 @@ class PCAConfig:
     serve_bucket_size: int = 8
     serve_flush_s: float = 0.02
     serve_keep_versions: int = 4
+    serve_slo_p99_ms: float | None = None
+    fleet_slo_p99_ms: float | None = None
+    metrics_retention: int = 4096
     compile_cache_dir: str | None = None
     seed: int = 0
 
@@ -330,6 +350,24 @@ class PCAConfig:
             raise ValueError(
                 f"serve_keep_versions must be an int >= 1, got "
                 f"{self.serve_keep_versions!r}"
+            )
+        for slo_field in ("serve_slo_p99_ms", "fleet_slo_p99_ms"):
+            slo = getattr(self, slo_field)
+            if slo is not None and (
+                not isinstance(slo, (int, float))
+                or isinstance(slo, bool)
+                or slo <= 0
+            ):
+                raise ValueError(
+                    f"{slo_field} must be a positive latency in ms or "
+                    f"None, got {slo!r}"
+                )
+        if not isinstance(self.metrics_retention, int) or isinstance(
+            self.metrics_retention, bool
+        ) or self.metrics_retention < 1:
+            raise ValueError(
+                f"metrics_retention must be an int >= 1, got "
+                f"{self.metrics_retention!r}"
             )
         if self.compile_cache_dir is not None and not isinstance(
             self.compile_cache_dir, str

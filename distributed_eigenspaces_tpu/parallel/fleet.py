@@ -713,6 +713,11 @@ class _FleetRequest:
     cfg: PCAConfig
     problem: Any
     worker_masks: Any = None
+    #: admission stamp + correlation id for the request's span chain
+    #: (admit → queue_wait → dispatch → compute, utils/telemetry.py);
+    #: trace context rides the payload to the dispatch lane
+    t_submit: float = 0.0
+    trace_id: str | None = None
 
 
 class FleetServer:
@@ -751,6 +756,14 @@ class FleetServer:
         self.cfg = cfg
         self.mesh = mesh
         self.metrics = metrics
+        if (
+            metrics is not None
+            and getattr(cfg, "fleet_slo_p99_ms", None) is not None
+            and metrics.fleet_slo_p99_ms is None
+        ):
+            # declared fleet SLO: the logger reports bucket-dispatch
+            # request latency against it (summary()["slo"]["fleet"])
+            metrics.fleet_slo_p99_ms = cfg.fleet_slo_p99_ms
         # ALWAYS an AOT layer (a memory-only CompileCache when no
         # compile_cache_dir is configured): program builds are compiled
         # ahead-of-call with honest timing, so compile_stall_ms is a
@@ -785,9 +798,22 @@ class FleetServer:
         for the tenant's ``(d, k)`` components)."""
         cfg = self.cfg if cfg is None else cfg
         sig = (fleet_signature(cfg), repr(cfg))
-        return self.queue.submit(
-            sig, _FleetRequest(cfg, problem, worker_masks)
+        from distributed_eigenspaces_tpu.utils.telemetry import tracer_of
+
+        tr = tracer_of(self.metrics)
+        tid = tr.new_trace("fleet")
+        t0 = time.perf_counter()
+        ticket = self.queue.submit(
+            sig,
+            _FleetRequest(
+                cfg, problem, worker_masks, t_submit=t0, trace_id=tid
+            ),
         )
+        tr.record_span(
+            "admit", t0, time.perf_counter(), trace_id=tid,
+            category="fleet", attrs={"signature": str(fleet_signature(cfg))},
+        )
+        return ticket
 
     def pending_cfgs(self) -> list[PCAConfig]:
         """One config per signature currently waiting in a bucket —
@@ -867,6 +893,12 @@ class FleetServer:
         return self.mesh
 
     def _fit_bucket(self, bucket) -> list:
+        from distributed_eigenspaces_tpu.utils.telemetry import (
+            NULL_TRACER,
+            tracer_of,
+        )
+
+        tr = tracer_of(self.metrics)
         t0 = time.perf_counter()
         reqs = [t.payload for t in bucket.tickets]
         cfg = reqs[0].cfg
@@ -874,15 +906,57 @@ class FleetServer:
             [r.worker_masks for r in reqs]
             if any(r.worker_masks is not None for r in reqs) else None
         )
-        result = fit_fleet(
-            cfg,
-            [r.problem for r in reqs],
-            mesh=self._resolve_mesh(cfg),
-            worker_masks=masks,
-            pad_to=cfg.fleet_bucket_size,
-            fit_cache=self._fit_cache,
-            compile_cache=self.compile_cache,
-        )
+        with tr.span(
+            "fleet_compute", category="fleet", device=True,
+            attrs={"tenants": len(reqs),
+                   "signature": str(bucket.signature[0])},
+        ):
+            result = fit_fleet(
+                cfg,
+                [r.problem for r in reqs],
+                mesh=self._resolve_mesh(cfg),
+                worker_masks=masks,
+                pad_to=cfg.fleet_bucket_size,
+                fit_cache=self._fit_cache,
+                compile_cache=self.compile_cache,
+            )
+        now = time.perf_counter()
+        stall_s = result.compile_ms / 1e3
+        compute_s = max(0.0, (now - t0) - stall_s)
+        if tr is not NULL_TRACER:
+            # per-tenant span chain under each request's trace_id — the
+            # fleet twin of the QueryServer's (docs/OBSERVABILITY.md)
+            for req in reqs:
+                tid = req.trace_id
+                qw_attrs = {}
+                if bucket.t_dispatch is not None and req.t_submit:
+                    qw_attrs = {
+                        "bucket_wait_s": round(
+                            max(0.0, bucket.t_dispatch - req.t_submit), 6
+                        ),
+                        "lane_wait_s": round(
+                            max(0.0, t0 - bucket.t_dispatch), 6
+                        ),
+                    }
+                if req.t_submit:
+                    tr.record_span(
+                        "queue_wait", req.t_submit, t0, trace_id=tid,
+                        category="fleet", attrs=qw_attrs,
+                    )
+                dspan = tr.record_span(
+                    "dispatch", t0, now, trace_id=tid, category="fleet",
+                    attrs={"tenants": len(reqs)},
+                )
+                if result.compile_ms:
+                    tr.record_span(
+                        "compile_stall", t0, t0 + stall_s, trace_id=tid,
+                        parent=dspan, category="compile",
+                        attrs={"compile_stall_ms": result.compile_ms},
+                    )
+                tr.record_span(
+                    "compute", t0 + stall_s, now, trace_id=tid,
+                    parent=dspan, category="fleet",
+                )
         if self.metrics is not None:
             # the first-signature compile stall, counted per signature
             # instead of silently inflating this bucket's latency
@@ -895,8 +969,19 @@ class FleetServer:
                 "signature": list(bucket.signature[0]),
                 "compile_misses": 1 if result.compile_ms else 0,
                 "compile_stall_ms": result.compile_ms,
-                "bucket_seconds": round(
-                    time.perf_counter() - t0, 6
-                ),
+                "bucket_seconds": round(now - t0, 6),
+                # decomposition feed (utils/metrics.py): per-request
+                # latency = queue_wait + compile_stall + compute + other
+                "request_latency_s": [
+                    round(now - r.t_submit, 6) if r.t_submit else None
+                    for r in reqs
+                ],
+                "queue_wait_s": [
+                    round(max(0.0, t0 - r.t_submit), 6)
+                    if r.t_submit else None
+                    for r in reqs
+                ],
+                "compute_s": round(compute_s, 6),
+                "dispatch_s": round(now - t0, 6),
             })
         return [result.components[i] for i in range(len(reqs))]

@@ -221,12 +221,14 @@ class Prewarmer:
 
     def _loop(self) -> None:
         from distributed_eigenspaces_tpu.utils.metrics import log_line
+        from distributed_eigenspaces_tpu.utils.telemetry import tracer_of
 
         while True:
             item = self._q.get()
             if item is None:
                 return
             label, thunk = item
+            tr = tracer_of(self.metrics)  # re-resolved: late attach works
             t0 = time.perf_counter()
             try:
                 thunk()
@@ -239,7 +241,14 @@ class Prewarmer:
                     label=repr(label),
                     error=repr(e),
                 )
-            dt_ms = (time.perf_counter() - t0) * 1e3
+            t1 = time.perf_counter()
+            # the background compile lane on the shared timeline: what
+            # prewarm absorbed is exactly what requests did NOT stall on
+            tr.record_span(
+                "prewarm_compile", t0, t1, category="compile",
+                attrs={"label": repr(label), "status": status},
+            )
+            dt_ms = (t1 - t0) * 1e3
             with self._lock:
                 self._status[label] = status
                 self._outstanding -= 1

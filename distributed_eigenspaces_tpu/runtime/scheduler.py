@@ -293,6 +293,10 @@ class FleetTicket:
     def __init__(self, signature, payload: Any):
         self.signature = signature
         self.payload = payload
+        #: admission stamp (``time.perf_counter``) — the telemetry
+        #: layer's queue-wait anchor: dispatch lanes subtract it to
+        #: decompose request latency (docs/OBSERVABILITY.md)
+        self.t_submit = time.perf_counter()
         self._event = threading.Event()
         self._result: Any = None
         self._error: Exception | None = None
@@ -324,6 +328,11 @@ class Bucket:
 
     signature: Any
     tickets: list[FleetTicket]
+    #: flush stamp (``time.perf_counter``, set by the admission queue
+    #: when the bucket dispatches into the work queue): splits a
+    #: request's queue wait into bucket-fill wait (t_submit →
+    #: t_dispatch) vs lane wait (t_dispatch → execution start)
+    t_dispatch: float | None = None
 
     def __len__(self) -> int:
         return len(self.tickets)
@@ -455,7 +464,13 @@ class ShapeBucketQueue:
         tickets = self._buckets.pop(signature, None)
         self._deadlines.pop(signature, None)
         if tickets:
-            self.wq.add_task(Bucket(signature=signature, tickets=tickets))
+            self.wq.add_task(
+                Bucket(
+                    signature=signature,
+                    tickets=tickets,
+                    t_dispatch=time.perf_counter(),
+                )
+            )
 
     def _timer_loop(self) -> None:
         with self._lock:

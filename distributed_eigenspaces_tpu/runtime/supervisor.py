@@ -279,6 +279,10 @@ class Supervisor:
         self.ledger = FaultLedger()
         self.mask_feed = _MaskFeed()
         self._sleep = sleep if sleep is not None else time.sleep
+        #: correlation id of the run this supervisor polices (set by
+        #: ``supervised_fit`` when a tracer is attached): every fault /
+        #: retry / resume event lands on the run's timeline arc
+        self.trace_id = None
 
     # -- ledger --------------------------------------------------------------
 
@@ -286,6 +290,19 @@ class Supervisor:
         ev = self.ledger.record(kind, step, **detail)
         if self.metrics is not None:
             self.metrics.fault(ev)
+            from distributed_eigenspaces_tpu.utils.telemetry import (
+                tracer_of,
+            )
+
+            tracer_of(self.metrics).event(
+                f"fault:{kind}", trace_id=self.trace_id,
+                category="fault",
+                attrs={
+                    k: v
+                    for k, v in {"step": step, **detail}.items()
+                    if isinstance(v, (int, float, str, bool))
+                },
+            )
         if (
             self.fault_budget is not None
             and kind in BUDGET_KINDS
@@ -563,6 +580,14 @@ def supervised_fit(
         metrics=metrics,
         sleep=sleep,
     )
+    from distributed_eigenspaces_tpu.utils.telemetry import tracer_of
+
+    tr = tracer_of(metrics)
+    sup.trace_id = tr.new_trace("fit")
+    if metrics is not None and getattr(metrics, "_fit_trace", None) is None:
+        # per-step spans (MetricsLogger.on_step) join the SAME trace as
+        # the supervisor's fault/retry/resume events — one run, one arc
+        metrics._fit_trace = sup.trace_id
     rows_per_step = cfg.num_workers * cfg.rows_per_worker
 
     ckpt = None
@@ -587,37 +612,49 @@ def supervised_fit(
                 )
 
     resumes = 0
-    while True:
-        try:
-            if trainer == "segmented":
-                return (*_segmented_supervised(
-                    sup, stream_factory, cfg, state, cursor, ckpt,
-                    metrics, worker_masks, on_step,
-                    segment=checkpoint_every,
+    t_run0 = time.perf_counter()
+    try:
+        while True:
+            try:
+                if trainer == "segmented":
+                    return (*_segmented_supervised(
+                        sup, stream_factory, cfg, state, cursor, ckpt,
+                        metrics, worker_masks, on_step,
+                        segment=checkpoint_every,
+                    ), sup)
+                return (*_step_supervised(
+                    sup, stream_factory, cfg, state, cursor, ckpt, metrics,
+                    worker_masks, on_step, pool, max_steps,
                 ), sup)
-            return (*_step_supervised(
-                sup, stream_factory, cfg, state, cursor, ckpt, metrics,
-                worker_masks, on_step, pool, max_steps,
-            ), sup)
-        except _Escalation as esc:
-            if ckpt is None:
-                raise SupervisorError(
-                    f"{esc} — no checkpoint_dir, cannot auto-resume",
-                    sup.ledger,
-                ) from esc.cause
-            if resumes >= max_resumes:
-                raise SupervisorError(
-                    f"{esc} — {resumes} auto-resumes exhausted",
-                    sup.ledger,
-                ) from esc.cause
-            resumes += 1
-            latest = ckpt.latest()
-            state, cursor = latest if latest is not None else (None, 0)
-            sup.record(
-                "resume",
-                int(state.step) if state is not None else 0,
-                cursor=int(cursor), reason=str(esc), attempt=resumes,
-            )
+            except _Escalation as esc:
+                if ckpt is None:
+                    raise SupervisorError(
+                        f"{esc} — no checkpoint_dir, cannot auto-resume",
+                        sup.ledger,
+                    ) from esc.cause
+                if resumes >= max_resumes:
+                    raise SupervisorError(
+                        f"{esc} — {resumes} auto-resumes exhausted",
+                        sup.ledger,
+                    ) from esc.cause
+                resumes += 1
+                latest = ckpt.latest()
+                state, cursor = latest if latest is not None else (None, 0)
+                sup.record(
+                    "resume",
+                    int(state.step) if state is not None else 0,
+                    cursor=int(cursor), reason=str(esc), attempt=resumes,
+                )
+    finally:
+        # the whole supervised run (resume arcs included) as one span
+        # on the fit's trace — exits through success and through the
+        # terminal SupervisorError alike
+        tr.record_span(
+            "supervised_fit", t_run0, time.perf_counter(),
+            trace_id=sup.trace_id, category="fit",
+            attrs={"trainer": trainer, "resumes": resumes,
+                   "faults": len(sup.ledger.events)},
+        )
 
 
 def _step_supervised(sup, stream_factory, cfg, state, cursor, ckpt,

@@ -248,6 +248,9 @@ class DriftMonitor:
         """Run the refit + angle confirmation inline; publish and return
         the new version when the score clears the threshold, else None.
         Serializes with the auto-spawned background refresh."""
+        from distributed_eigenspaces_tpu.utils.telemetry import tracer_of
+
+        tr = tracer_of(self.metrics)
         with self._refresh_lock:
             with self._lock:
                 if not self._buffer:
@@ -260,21 +263,30 @@ class DriftMonitor:
             live = self.registry.latest()
             if live is None:
                 return None
-            w, state = self._run_refit(rows)
+            trace_id = tr.new_trace("drift")
+            with tr.span(
+                "drift_refresh", trace_id=trace_id, category="drift",
+                attrs={"refit_rows": int(len(rows)),
+                       "residual_drift": round(drift, 4),
+                       "base_version": live.version},
+            ):
+                with tr.span("refit", category="drift"):
+                    w, state = self._run_refit(rows)
 
-            from distributed_eigenspaces_tpu.ops.linalg import (
-                principal_angles_degrees,
-            )
+                from distributed_eigenspaces_tpu.ops.linalg import (
+                    principal_angles_degrees,
+                )
 
-            angle = float(
-                np.max(
-                    np.asarray(
-                        principal_angles_degrees(
-                            np.asarray(w), live.v
+                with tr.span("angle_confirm", category="drift"):
+                    angle = float(
+                        np.max(
+                            np.asarray(
+                                principal_angles_degrees(
+                                    np.asarray(w), live.v
+                                )
+                            )
                         )
                     )
-                )
-            )
             score = drift + angle / 90.0
             self.last_score = score
             self.refreshes += 1
@@ -302,9 +314,15 @@ class DriftMonitor:
                 with self._lock:
                     # re-anchor the tripwire on the new version
                     self._ewma = None
+                tr.event(
+                    "publish", trace_id=trace_id, category="drift",
+                    attrs={"version": published.version,
+                           "score": round(score, 4)},
+                )
             if self.metrics is not None:
                 self.metrics.serve({
                     "kind": "drift",
+                    "trace_id": trace_id,
                     "score": round(score, 4),
                     "residual_drift": round(drift, 4),
                     "angle_gap_deg": round(angle, 4),

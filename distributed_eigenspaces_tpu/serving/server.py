@@ -60,6 +60,11 @@ class ServedProjection:
 class _QueryRequest:
     x: np.ndarray  # (rows, d) host rows, width-validated at submit
     t_submit: float
+    #: correlation id for this request's span chain (admit → queue →
+    #: dispatch → compute → reply, utils/telemetry.py): born on the
+    #: submitting thread, consumed by the dispatch lane — trace context
+    #: rides the ticket payload, never thread-local state
+    trace_id: str | None = None
 
 
 class QueryServer:
@@ -113,6 +118,15 @@ class QueryServer:
         self.bucket_size = bucket_size
         self.metrics = metrics
         self.drift = drift
+        if (
+            metrics is not None
+            and cfg is not None
+            and getattr(cfg, "serve_slo_p99_ms", None) is not None
+            and metrics.slo_p99_ms is None
+        ):
+            # the declared SLO rides the config; the logger owns the
+            # attainment math (summary()["slo"]["serve"])
+            metrics.slo_p99_ms = cfg.serve_slo_p99_ms
         if compile_cache is None and cfg is not None:
             # cfg.compile_cache_dir wires the persistent store in
             # without a second knob at every construction site
@@ -195,10 +209,20 @@ class QueryServer:
             )
         if arr.shape[0] < 1:
             raise ValueError("empty query (zero rows)")
-        return self.queue.submit(
+        from distributed_eigenspaces_tpu.utils.telemetry import tracer_of
+
+        tr = tracer_of(self.metrics)
+        tid = tr.new_trace("query")
+        t0 = time.perf_counter()
+        ticket = self.queue.submit(
             (self.d, self.k),
-            _QueryRequest(x=arr, t_submit=time.perf_counter()),
+            _QueryRequest(x=arr, t_submit=t0, trace_id=tid),
         )
+        tr.record_span(
+            "admit", t0, time.perf_counter(), trace_id=tid,
+            category="serve", attrs={"rows": int(arr.shape[0])},
+        )
+        return ticket
 
     def wait_warm(self, timeout: float | None = None) -> bool:
         """Block until every prewarm compile submitted at construction
@@ -235,6 +259,14 @@ class QueryServer:
         return arr
 
     def _run_batch(self, bucket) -> list:
+        from distributed_eigenspaces_tpu.utils.telemetry import (
+            NULL_TRACER,
+            tracer_of,
+        )
+
+        tr = tracer_of(self.metrics)
+        if self.engine.tracer is None and tr is not NULL_TRACER:
+            self.engine.tracer = tr
         t0 = time.perf_counter()
         # first-signature compile stall, counted instead of silently
         # folded into request latency: any program this batch has to
@@ -280,14 +312,25 @@ class QueryServer:
                 )
 
         results: list[Any] = [None] * len(reqs)
+        t_c0 = t_c1 = None
         if good:
             v_dev = self._basis_device(ver)
             x = np.concatenate([reqs[i].x for i in good], axis=0)
-            z = self.engine.project(x, v_dev)
-            r_sq, e_sq = self.engine.residual_energy(x, z)
-            z = np.asarray(z)
-            r_sq = np.asarray(r_sq)
-            e_sq = np.asarray(e_sq)
+            t_c0 = time.perf_counter()
+            # device=True brackets the dispatch with a
+            # jax.profiler.TraceAnnotation, so a profiler capture run
+            # alongside shows this exact region on the device timeline
+            with tr.span(
+                "batch_compute", category="serve", device=True,
+                attrs={"rows": int(x.shape[0]), "queries": len(good),
+                       "version": ver.version},
+            ):
+                z = self.engine.project(x, v_dev)
+                r_sq, e_sq = self.engine.residual_energy(x, z)
+                z = np.asarray(z)
+                r_sq = np.asarray(r_sq)
+                e_sq = np.asarray(e_sq)
+            t_c1 = time.perf_counter()
             off = 0
             for i in good:
                 rows = reqs[i].x.shape[0]
@@ -310,6 +353,55 @@ class QueryServer:
             )
 
         now = time.perf_counter()
+        stall_ms = self.engine.compile_ms_total - stall_ms0
+        stall_s = stall_ms / 1e3
+        # compute time net of any inline compile that happened inside
+        # the dispatch (the stall is its own decomposition component)
+        compute_s = (
+            max(0.0, (t_c1 - t_c0) - stall_s) if t_c0 is not None else 0.0
+        )
+        if tr is not NULL_TRACER:
+            # per-request span chain: admit (recorded at submit) →
+            # queue_wait → dispatch(compute → reply), all under the
+            # request's trace_id — the acceptance contract of ISSUE 6
+            for i, req in enumerate(reqs):
+                tid = req.trace_id
+                qw_attrs = {}
+                if bucket.t_dispatch is not None:
+                    qw_attrs = {
+                        "bucket_wait_s": round(
+                            max(0.0, bucket.t_dispatch - req.t_submit), 6
+                        ),
+                        "lane_wait_s": round(
+                            max(0.0, t0 - bucket.t_dispatch), 6
+                        ),
+                    }
+                tr.record_span(
+                    "queue_wait", req.t_submit, t0, trace_id=tid,
+                    category="serve", attrs=qw_attrs,
+                )
+                dspan = tr.record_span(
+                    "dispatch", t0, now, trace_id=tid, category="serve",
+                    attrs={"version": ver.version,
+                           "queries": len(reqs),
+                           "rejected": i in fails},
+                )
+                if t_c0 is not None:
+                    if stall_ms > 0:
+                        tr.record_span(
+                            "compile_stall", t_c0, t_c0 + stall_s,
+                            trace_id=tid, parent=dspan,
+                            category="compile",
+                            attrs={"compile_stall_ms": round(stall_ms, 3)},
+                        )
+                    tr.record_span(
+                        "compute", t_c0, t_c1, trace_id=tid,
+                        parent=dspan, category="serve",
+                    )
+                    tr.record_span(
+                        "reply", t_c1, now, trace_id=tid,
+                        parent=dspan, category="serve",
+                    )
         if self.metrics is not None:
             self.metrics.serve({
                 "kind": "batch",
@@ -321,12 +413,18 @@ class QueryServer:
                 "compile_misses": (
                     self.engine.compile_misses - stall_miss0
                 ),
-                "compile_stall_ms": round(
-                    self.engine.compile_ms_total - stall_ms0, 3
-                ),
+                "compile_stall_ms": round(stall_ms, 3),
                 "query_latency_s": [
                     round(now - r.t_submit, 6) for r in reqs
                 ],
+                # the decomposition feed (utils/metrics.py): per-request
+                # queue wait plus the batch-shared compute — latency =
+                # queue_wait + compile_stall + compute + other
+                "queue_wait_s": [
+                    round(max(0.0, t0 - r.t_submit), 6) for r in reqs
+                ],
+                "compute_s": round(compute_s, 6),
+                "dispatch_s": round(now - t0, 6),
                 "occupancy": round(len(reqs) / self.bucket_size, 4),
                 "version": ver.version,
                 "swap": swap,

@@ -108,6 +108,11 @@ class TransformEngine:
         self.compile_misses = 0
         self.cache_hits = 0
         self.compile_ms_total = 0.0
+        #: optional ``utils.telemetry.Tracer`` (the QueryServer hands
+        #: its metrics' tracer down): engine-local compile misses land
+        #: as spans, so a bucket's first-shape stall is attributable
+        #: on the exported timeline
+        self.tracer = None
         prec = _precision_for(self.dtype)
 
         def project(x, v):
@@ -212,7 +217,14 @@ class TransformEngine:
             )
         else:
             compiled = self._lowered(kind, rows).compile()
-        self.compile_ms_total += (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        self.compile_ms_total += (t1 - t0) * 1e3
+        if self.tracer is not None:
+            self.tracer.record_span(
+                "engine_compile", t0, t1, category="compile",
+                attrs={"op": kind, "rows": rows,
+                       "signature": f"({self.d}, {self.k})"},
+            )
         self._cache[key] = compiled
         return compiled
 

@@ -12,6 +12,7 @@ from distributed_eigenspaces_tpu.utils.checkpoint import (
 from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
 from distributed_eigenspaces_tpu.utils.faults import FaultInjector
 from distributed_eigenspaces_tpu.utils.guards import checked_jit, checks_enabled
+from distributed_eigenspaces_tpu.utils.telemetry import Histogram, Tracer
 from distributed_eigenspaces_tpu.utils.tracing import named_scope, profile_to
 
 __all__ = [
@@ -20,6 +21,8 @@ __all__ = [
     "Checkpointer",
     "MetricsLogger",
     "FaultInjector",
+    "Histogram",
+    "Tracer",
     "checked_jit",
     "checks_enabled",
     "named_scope",

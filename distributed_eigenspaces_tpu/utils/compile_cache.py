@@ -193,6 +193,11 @@ class CompileCache:
         self.not_portable = 0
         self.compile_ms_total = 0.0
         self.last_compile_ms = 0.0
+        #: optional ``utils.telemetry.Tracer`` (set by
+        #: ``MetricsLogger.attach_compile``/``attach_tracer``): cache
+        #: hits land as instant events, fresh compiles as spans, so
+        #: compile stalls are attributable on the exported timeline
+        self.tracer = None
 
     # -- paths ---------------------------------------------------------------
 
@@ -302,22 +307,39 @@ class CompileCache:
         """The compiled executable for ``key``: memory hit → disk hit
         (deserialize) → fresh ``lower_fn().compile()`` (persisted
         best-effort). ``lower_fn`` returns a ``jax.stages.Lowered``."""
+        from distributed_eigenspaces_tpu.utils.telemetry import tracer_of
+
+        tr = tracer_of(self)
         s = key.string()
         with self._lock:
             hit = self._mem.get(s)
             if hit is not None:
                 self.hits += 1
+                tr.event(
+                    "compile_cache_hit", category="compile",
+                    attrs={"kind": key.kind, "tier": "memory"},
+                )
                 return hit
         loaded = self._load_disk(key)
         if loaded is not None:
             with self._lock:
                 self.disk_hits += 1
                 self._mem[s] = loaded
+            tr.event(
+                "compile_cache_hit", category="compile",
+                attrs={"kind": key.kind, "tier": "disk"},
+            )
             return loaded
         t0 = time.perf_counter()
         lowered = lower_fn()
         compiled = lowered.compile()
         dt_ms = (time.perf_counter() - t0) * 1e3
+        tr.record_span(
+            "compile", t0, time.perf_counter(), category="compile",
+            attrs={
+                "kind": key.kind, "signature": repr(key.signature),
+            },
+        )
         if self._portable(key, lowered):
             self._store_disk(key, compiled)
         with self._lock:

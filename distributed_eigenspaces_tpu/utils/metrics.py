@@ -5,6 +5,28 @@ Replaces the reference's observability — a dozen ``print`` calls
 wall-clock span (``distributed.py:93,131``) — with per-step structured
 records: throughput (the BASELINE.json samples/sec metric), step latency,
 and optional accuracy (principal angle vs a reference subspace).
+
+Since ISSUE 6 this is also the aggregation half of the unified
+telemetry layer (``utils/telemetry.py``):
+
+- every event list is a bounded :class:`~.telemetry.RingLog` — evicted
+  entries fold into running aggregates (counters + mergeable
+  log-bucket :class:`~.telemetry.Histogram`\\ s), so a long-lived
+  server never grows without limit and ``summary()`` stays correct
+  after eviction;
+- every event carries BOTH clocks: ``t_mono`` (``time.perf_counter``,
+  orders and subtracts correctly) and ``t_unix`` (``time.time``,
+  correlates across processes) — the pre-ISSUE-6 mix of one or the
+  other made merged JSON streams unsortable;
+- ``summary()["serving"]`` decomposes request latency into
+  queue_wait / compile_stall / compute / other per percentile, and
+  ``summary()["slo"]`` reports rolling-window attainment +
+  error-budget burn against declared p99 targets
+  (``cfg.serve_slo_p99_ms`` / ``cfg.fleet_slo_p99_ms``);
+- an attached :class:`~.telemetry.Tracer` (:meth:`attach_tracer`)
+  receives per-step spans and is handed to the compile cache, so the
+  exported Chrome-trace timeline covers fit, serve, fleet, drift and
+  compile events together.
 """
 
 from __future__ import annotations
@@ -13,6 +35,33 @@ import json
 import sys
 import time
 from typing import IO
+
+from distributed_eigenspaces_tpu.utils.telemetry import (
+    Histogram,
+    RingLog,
+    slo_summary,
+    tracer_of,
+)
+
+#: default ring-buffer retention per event list (overridable per logger
+#: and via ``PCAConfig.metrics_retention``)
+DEFAULT_RETENTION = 4096
+
+#: decomposition component keys, in report order: per-request latency =
+#: queue_wait + compile_stall + compute + other (pre/post dispatch
+#: overhead), all in seconds
+DECOMP_KEYS = ("queue_wait_s", "compile_stall_s", "compute_s", "other_s")
+
+
+def _stamp(rec: dict) -> dict:
+    """Both clocks on every event (ISSUE 6 satellite): ``t_mono`` for
+    ordering/durations, ``t_unix`` for cross-process correlation.
+    ``t`` stays the monotonic stamp for existing consumers."""
+    now_mono = time.perf_counter()
+    rec.setdefault("t_mono", now_mono)
+    rec.setdefault("t_unix", time.time())
+    rec.setdefault("t", rec["t_mono"])
+    return rec
 
 
 class MetricsLogger:
@@ -31,15 +80,30 @@ class MetricsLogger:
         samples_per_step: int = 0,
         stream: IO | None = None,
         reference_subspace=None,
+        retention: int = DEFAULT_RETENTION,
+        slo_p99_ms: float | None = None,
+        fleet_slo_p99_ms: float | None = None,
+        tracer=None,
     ):
         self.samples_per_step = samples_per_step
         self.stream = stream
         self.reference_subspace = reference_subspace
-        self.records: list[dict] = []
+        self.retention = retention
+        #: declared serving SLO target (p99 request latency, ms) —
+        #: ``summary()["slo"]["serve"]`` reports attainment against it
+        self.slo_p99_ms = slo_p99_ms
+        #: the fleet equivalent (p99 fit-request latency, ms)
+        self.fleet_slo_p99_ms = fleet_slo_p99_ms
+        #: optional ``telemetry.Tracer`` — per-step spans and compile
+        #: events land on its exported timeline (:meth:`attach_tracer`)
+        self.tracer = tracer
+        #: per-step records (ring buffer; evictions fold into running
+        #: throughput aggregates so the summary survives long runs)
+        self.records = RingLog(retention, self._evict_step)
         #: structured fault events (runtime/supervisor.py): quarantined
         #: workers, retried pulls/steps, resumes — the run's fault
         #: ledger, surfaced by :meth:`summary`
-        self.fault_records: list[dict] = []
+        self.fault_records = RingLog(retention, self._evict_fault)
         #: ingest-pipeline counters (runtime/prefetch.py PrefetchStats),
         #: attached via :meth:`attach_ingest` — surfaced by
         #: :meth:`summary` under "ingest"
@@ -47,15 +111,42 @@ class MetricsLogger:
         #: query-serving events (serving/server.py QueryServer batches,
         #: serving/drift.py DriftMonitor refreshes) — surfaced by
         #: :meth:`summary` under "serving"
-        self.serve_records: list[dict] = []
+        self.serve_records = RingLog(retention, self._evict_serve)
         #: fleet-serving events (parallel/fleet.py FleetServer bucket
         #: dispatches) — surfaced by :meth:`summary` under "fleet"
-        self.fleet_records: list[dict] = []
+        self.fleet_records = RingLog(retention, self._evict_fleet)
         #: compile-lifecycle counters (utils/compile_cache.py
         #: CompileCache), attached via :meth:`attach_compile` —
         #: surfaced by :meth:`summary` under "compile"
         self.compile_cache = None
         self._last_time = None
+        self._fit_trace = None
+        # evicted-entry aggregates: what the ring buffers folded away
+        self._step_agg = {
+            "steps": 0, "sps_sum": 0.0, "sps_n": 0, "sps_max": None,
+        }
+        self._fault_agg: dict = {"count": 0, "by_kind": {}}
+        self._serve_agg = self._fresh_dispatch_agg()
+        self._serve_agg["drifts"] = 0
+        self._fleet_agg = self._fresh_dispatch_agg()
+
+    @staticmethod
+    def _fresh_dispatch_agg() -> dict:
+        """Eviction aggregate shared by the serving and fleet sections:
+        counters plus mergeable latency histograms (total + the
+        decomposition components), so percentiles survive eviction."""
+        return {
+            "events": 0, "requests": 0, "rejected": 0, "swaps": 0,
+            "occ_sum": 0.0, "occ_n": 0,
+            "compile_misses": 0, "compile_stall_ms": 0.0,
+            "by_sig": {}, "t_min": None, "t_max": None,
+            "versions": set(),
+            "slo_requests": 0, "slo_violations": 0,
+            "hist": {
+                "total_s": Histogram(),
+                **{k: Histogram() for k in DECOMP_KEYS},
+            },
+        }
 
     def start(self) -> "MetricsLogger":
         self._last_time = time.perf_counter()
@@ -69,6 +160,14 @@ class MetricsLogger:
             rec["step_seconds"] = round(dt, 6)
             if self.samples_per_step:
                 rec["samples_per_sec"] = round(self.samples_per_step / dt, 1)
+            tr = tracer_of(self)
+            if self._fit_trace is None:
+                self._fit_trace = tr.new_trace("fit")
+            tr.record_span(
+                "pca_step", self._last_time, now,
+                trace_id=self._fit_trace, category="fit",
+                attrs={"step": int(t)},
+            )
         if self.reference_subspace is not None and v_bar is not None:
             from distributed_eigenspaces_tpu.ops.linalg import (
                 principal_angles_degrees,
@@ -83,6 +182,7 @@ class MetricsLogger:
                 4,
             )
         self._last_time = now
+        _stamp(rec)
         self.records.append(rec)
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
@@ -101,8 +201,24 @@ class MetricsLogger:
         hit/miss/compile-ms counters land in ``summary()["compile"]``
         (read at summary time, like the ingest stats), so cold-start
         cost and cache effectiveness are diagnosable from the run
-        report."""
+        report. An attached tracer is handed to the cache so compile
+        hits/misses land on the exported timeline too."""
         self.compile_cache = cache
+        if self.tracer is not None and getattr(cache, "tracer", None) is None:
+            cache.tracer = self.tracer
+        return self
+
+    def attach_tracer(self, tracer) -> "MetricsLogger":
+        """Attach a ``telemetry.Tracer``: per-step spans, serving /
+        fleet / drift / fault spans from the instrumented components,
+        and compile-cache events all record into ONE exportable
+        timeline (``tracer.export_chrome_trace``)."""
+        self.tracer = tracer
+        if (
+            self.compile_cache is not None
+            and getattr(self.compile_cache, "tracer", None) is None
+        ):
+            self.compile_cache.tracer = tracer
         return self
 
     def fleet(self, event: dict) -> None:
@@ -112,7 +228,7 @@ class MetricsLogger:
         acquiring its programs). Rides the same JSON stream as step
         records, tagged ``"fleet"``."""
         rec = {"fleet": event.get("kind", "bucket"), **event}
-        rec.setdefault("t", time.perf_counter())
+        _stamp(rec)
         self.fleet_records.append(rec)
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
@@ -120,12 +236,12 @@ class MetricsLogger:
     def serve(self, event: dict) -> None:
         """Record one structured serving event — a dispatched query
         micro-batch (``kind="batch"``: query count, per-query
-        latencies, occupancy, basis version, swap flag) or a drift
-        refresh (``kind="drift"``: score, angle gap, published
-        version). Rides the same JSON stream as step records, tagged
-        ``"serve"``."""
+        latencies + queue waits, occupancy, basis version, swap flag)
+        or a drift refresh (``kind="drift"``: score, angle gap,
+        published version). Rides the same JSON stream as step
+        records, tagged ``"serve"``."""
         rec = {"serve": event.get("kind", "batch"), **event}
-        rec.setdefault("t", time.perf_counter())
+        _stamp(rec)
         self.serve_records.append(rec)
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
@@ -135,19 +251,143 @@ class MetricsLogger:
         recovery action). Events ride the same JSON stream as step
         records, tagged ``"fault"`` so consumers can split them."""
         rec = {"fault": event.get("kind", "unknown"), **event}
+        _stamp(rec)
         self.fault_records.append(rec)
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
 
+    # -- eviction folds ------------------------------------------------------
+
+    def _evict_step(self, rec: dict) -> None:
+        agg = self._step_agg
+        agg["steps"] += 1
+        sps = rec.get("samples_per_sec")
+        if sps is not None:
+            agg["sps_sum"] += sps
+            agg["sps_n"] += 1
+            agg["sps_max"] = (
+                sps if agg["sps_max"] is None else max(agg["sps_max"], sps)
+            )
+
+    def _evict_fault(self, rec: dict) -> None:
+        agg = self._fault_agg
+        agg["count"] += 1
+        kind = rec.get("fault", "unknown")
+        agg["by_kind"][kind] = agg["by_kind"].get(kind, 0) + 1
+
+    def _evict_serve(self, rec: dict) -> None:
+        if rec.get("serve") == "drift":
+            self._serve_agg["drifts"] += 1
+            return
+        if rec.get("serve") == "batch":
+            self._fold_dispatch(
+                self._serve_agg, rec, "queries", self.slo_p99_ms
+            )
+
+    def _evict_fleet(self, rec: dict) -> None:
+        if rec.get("fleet") == "bucket":
+            self._fold_dispatch(
+                self._fleet_agg, rec, "tenants", self.fleet_slo_p99_ms
+            )
+
+    def _fold_dispatch(self, agg: dict, rec: dict, req_key: str,
+                       slo_ms: float | None) -> None:
+        """One evicted serve batch / fleet bucket into the running
+        aggregate — the counters :meth:`summary` adds back, and the
+        histograms its percentiles/decomposition merge with the live
+        window."""
+        agg["events"] += 1
+        agg["requests"] += rec.get(req_key, 0)
+        agg["rejected"] += rec.get("rejected", 0)
+        if rec.get("swap"):
+            agg["swaps"] += 1
+        if "occupancy" in rec:
+            agg["occ_sum"] += rec["occupancy"]
+            agg["occ_n"] += 1
+        agg["compile_misses"] += rec.get("compile_misses", 0)
+        stall = rec.get("compile_stall_ms", 0.0)
+        agg["compile_stall_ms"] += stall
+        if stall and "signature" in rec:
+            sig = str(tuple(rec["signature"]))
+            agg["by_sig"][sig] = round(
+                agg["by_sig"].get(sig, 0.0) + stall, 3
+            )
+        if "version" in rec:
+            agg["versions"].add(rec["version"])
+        t = rec.get("t_mono", rec.get("t"))
+        if t is not None:
+            agg["t_min"] = t if agg["t_min"] is None else min(agg["t_min"], t)
+            agg["t_max"] = t if agg["t_max"] is None else max(agg["t_max"], t)
+        for row in self._decomp_rows(rec):
+            agg["hist"]["total_s"].record(row["total_s"])
+            for k in DECOMP_KEYS:
+                if row.get(k) is not None:
+                    agg["hist"][k].record(row[k])
+            if slo_ms is not None:
+                agg["slo_requests"] += 1
+                if row["total_s"] * 1e3 > slo_ms:
+                    agg["slo_violations"] += 1
+
+    # -- decomposition -------------------------------------------------------
+
+    @staticmethod
+    def _decomp_rows(rec: dict) -> list[dict]:
+        """Per-request latency rows for one dispatch event. Every row
+        has ``total_s``; the component keys are present when the event
+        carried the ISSUE-6 fields (``queue_wait_s`` list +
+        ``compute_s``), and then satisfy
+        ``total = queue_wait + compile_stall + compute + other``
+        exactly — the batch's compile stall and compute are shared by
+        every request that rode it (each waited through both)."""
+        lats = rec.get("query_latency_s") or rec.get("request_latency_s")
+        if not lats:
+            return []
+        qws = rec.get("queue_wait_s")
+        stall_s = (rec.get("compile_stall_ms") or 0.0) / 1e3
+        compute = rec.get("compute_s")
+        rows = []
+        for i, lat in enumerate(lats):
+            if lat is None:
+                continue
+            row: dict = {"total_s": float(lat)}
+            qw = qws[i] if qws is not None and i < len(qws) else None
+            if qw is not None and compute is not None:
+                row["queue_wait_s"] = float(qw)
+                row["compile_stall_s"] = stall_s
+                row["compute_s"] = float(compute)
+                row["other_s"] = max(
+                    0.0, float(lat) - float(qw) - stall_s - float(compute)
+                )
+            rows.append(row)
+        return rows
+
     def summary(self) -> dict:
         """Aggregate: total steps, mean/max throughput, final accuracy,
-        and — when any fault was recorded — the fault ledger (count,
-        per-kind histogram, and the raw events)."""
-        out: dict = {"steps": len(self.records)}
-        sps = [r["samples_per_sec"] for r in self.records if "samples_per_sec" in r]
-        if sps:
-            out["mean_samples_per_sec"] = round(sum(sps) / len(sps), 1)
-            out["max_samples_per_sec"] = round(max(sps), 1)
+        the fault ledger when any fault was recorded, the serving /
+        fleet dispatch sections (latency percentiles + decomposition),
+        and — when an SLO target is declared — the ``"slo"`` section
+        (attainment, error-budget burn). Ring-buffer evictions are
+        already folded in: counts and percentiles cover the whole run,
+        not just the retained window."""
+        agg = self._step_agg
+        out: dict = {"steps": agg["steps"] + len(self.records)}
+        sps = [
+            r["samples_per_sec"] for r in self.records
+            if "samples_per_sec" in r
+        ]
+        sps_n = agg["sps_n"] + len(sps)
+        if sps_n:
+            out["mean_samples_per_sec"] = round(
+                (agg["sps_sum"] + sum(sps)) / sps_n, 1
+            )
+            live_max = max(sps) if sps else None
+            out["max_samples_per_sec"] = round(
+                max(
+                    v for v in (agg["sps_max"], live_max)
+                    if v is not None
+                ),
+                1,
+            )
         angles = [
             r["principal_angle_deg"]
             for r in self.records
@@ -155,40 +395,52 @@ class MetricsLogger:
         ]
         if angles:
             out["final_principal_angle_deg"] = angles[-1]
-        if self.fault_records:
-            by_kind: dict[str, int] = {}
+        if self.fault_records or self._fault_agg["count"]:
+            by_kind = dict(self._fault_agg["by_kind"])
             for r in self.fault_records:
                 by_kind[r["fault"]] = by_kind.get(r["fault"], 0) + 1
             out["faults"] = {
-                "count": len(self.fault_records),
+                "count": self._fault_agg["count"] + len(self.fault_records),
                 "by_kind": by_kind,
+                # the events list holds the RETAINED window; evicted
+                # events survive in count/by_kind above
                 "events": list(self.fault_records),
             }
+            if self.fault_records.evicted:
+                out["faults"]["events_evicted"] = self.fault_records.evicted
         if self.ingest_stats is not None:
             out["ingest"] = self.ingest_stats.as_dict()
-        if self.serve_records:
+        if self.serve_records or self._serve_agg["events"]:
             out["serving"] = self._serving_summary()
-        if self.fleet_records:
+        if self.fleet_records or self._fleet_agg["events"]:
             out["fleet"] = self._fleet_summary()
+        slo = self._slo_summary(out)
+        if slo:
+            out["slo"] = slo
         if self.compile_cache is not None:
             out["compile"] = self.compile_cache.stats()
         return out
 
+    # -- dispatch-section helpers --------------------------------------------
+
     @staticmethod
-    def _stall_fields(records: list[dict]) -> dict:
+    def _stall_fields(records: list[dict], agg: dict) -> dict:
         """Shared compile-stall aggregation for the serving and fleet
         sections: total misses, total stall ms, and the per-signature
         stall breakdown that makes a p99 regression attributable to
         the exact shape that compiled inline."""
         out: dict = {
-            "compile_misses": sum(
+            "compile_misses": agg["compile_misses"] + sum(
                 r.get("compile_misses", 0) for r in records
             ),
             "compile_stall_ms": round(
-                sum(r.get("compile_stall_ms", 0.0) for r in records), 3
+                agg["compile_stall_ms"] + sum(
+                    r.get("compile_stall_ms", 0.0) for r in records
+                ),
+                3,
             ),
         }
-        by_sig: dict[str, float] = {}
+        by_sig: dict[str, float] = dict(agg["by_sig"])
         for r in records:
             stall = r.get("compile_stall_ms", 0.0)
             if stall and "signature" in r:
@@ -198,35 +450,153 @@ class MetricsLogger:
             out["compile_stall_ms_by_signature"] = by_sig
         return out
 
+    def _latency_fields(self, records: list[dict], agg: dict) -> dict:
+        """p50/p99 + decomposition for one dispatch section. With no
+        evictions the percentiles are EXACT (sorted live latencies —
+        bit-compatible with the pre-ISSUE-6 summary); once the ring
+        has evicted, live rows merge into the eviction histograms and
+        the percentiles are log-bucket estimates (within one bucket
+        growth factor — ``telemetry.Histogram``)."""
+        out: dict = {}
+        rows = [row for r in records for row in self._decomp_rows(r)]
+        evicted = agg["hist"]["total_s"].count > 0
+        if not rows and not evicted:
+            return out
+        if not evicted:
+            lat = sorted(row["total_s"] for row in rows)
+            out["p50_latency_s"] = round(lat[len(lat) // 2], 6)
+            out["p99_latency_s"] = round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))], 6
+            )
+        else:
+            h = agg["hist"]["total_s"].copy()
+            h.record_many(row["total_s"] for row in rows)
+            out["p50_latency_s"] = round(h.quantile(0.5), 6)
+            out["p99_latency_s"] = round(h.quantile(0.99), 6)
+            out["latency_hist"] = h.as_dict()
+        decomp = self._decomposition(rows, agg, evicted)
+        if decomp:
+            out["latency_decomposition"] = decomp
+        return out
+
+    def _decomposition(self, rows: list[dict], agg: dict,
+                       evicted: bool) -> dict | None:
+        """The latency decomposition block: per-percentile component
+        breakdown. Exact mode reports the COMPONENTS OF the request at
+        the percentile rank (so they sum to its total, ±rounding);
+        histogram mode (after eviction) reports per-component
+        percentile estimates and labels itself accordingly."""
+        full = [r for r in rows if "queue_wait_s" in r]
+        if not evicted:
+            if not full:
+                return None
+            full.sort(key=lambda r: r["total_s"])
+            n = len(full)
+
+            def pick(rank: int) -> dict:
+                r = full[rank]
+                return {
+                    "total_s": round(r["total_s"], 6),
+                    **{k: round(r[k], 6) for k in DECOMP_KEYS},
+                }
+
+            mean = {
+                "total_s": round(
+                    sum(r["total_s"] for r in full) / n, 6
+                ),
+                **{
+                    k: round(sum(r[k] for r in full) / n, 6)
+                    for k in DECOMP_KEYS
+                },
+            }
+            return {
+                "source": "exact",
+                "requests": n,
+                "p50": pick(n // 2),
+                "p99": pick(min(n - 1, int(n * 0.99))),
+                "mean": mean,
+            }
+        # histogram mode: merge live rows into copies of the evicted
+        # histograms, report per-component estimates
+        hists = {k: agg["hist"][k].copy() for k in DECOMP_KEYS}
+        total = agg["hist"]["total_s"].copy()
+        for r in full:
+            for k in DECOMP_KEYS:
+                hists[k].record(r[k])
+        total.record_many(r["total_s"] for r in rows)
+        if not any(h.count for h in hists.values()):
+            return None
+
+        def est(q: float) -> dict:
+            return {
+                "total_s": round(total.quantile(q) or 0.0, 6),
+                **{
+                    k: round(hists[k].quantile(q) or 0.0, 6)
+                    for k in DECOMP_KEYS
+                },
+            }
+
+        return {
+            "source": "histogram",
+            "requests": total.count,
+            "p50": est(0.5),
+            "p99": est(0.99),
+            "mean": {
+                "total_s": round(total.mean or 0.0, 6),
+                **{
+                    k: round(hists[k].mean or 0.0, 6)
+                    for k in DECOMP_KEYS
+                },
+            },
+        }
+
     def _fleet_summary(self) -> dict:
         """The ``summary()["fleet"]`` section (mirrors ``["serving"]``):
-        dispatched buckets, tenants served, mean bucket occupancy, and
-        the compile-stall ledger."""
+        dispatched buckets, tenants served, mean bucket occupancy,
+        request-latency percentiles + decomposition, and the
+        compile-stall ledger."""
+        agg = self._fleet_agg
         buckets = [
             r for r in self.fleet_records if r["fleet"] == "bucket"
         ]
-        out: dict = {"buckets": len(buckets)}
-        if buckets:
-            out["tenants"] = sum(r.get("tenants", 0) for r in buckets)
+        out: dict = {"buckets": agg["events"] + len(buckets)}
+        if buckets or agg["events"]:
+            out["tenants"] = agg["requests"] + sum(
+                r.get("tenants", 0) for r in buckets
+            )
             occ = [r["occupancy"] for r in buckets if "occupancy" in r]
-            if occ:
-                out["mean_occupancy"] = round(sum(occ) / len(occ), 4)
-            out.update(self._stall_fields(buckets))
+            occ_n = agg["occ_n"] + len(occ)
+            if occ_n:
+                out["mean_occupancy"] = round(
+                    (agg["occ_sum"] + sum(occ)) / occ_n, 4
+                )
+            out.update(self._stall_fields(buckets, agg))
+            out.update(self._latency_fields(buckets, agg))
+        if self.fleet_records.evicted:
+            out["events_evicted"] = self.fleet_records.evicted
         return out
 
     def _serving_summary(self) -> dict:
         """The ``summary()["serving"]`` section (mirrors ``["ingest"]``):
-        qps over the served window, p50/p99 query latency, mean batch
+        qps over the served window, p50/p99 query latency decomposed
+        into queue_wait / compile_stall / compute / other, mean batch
         occupancy, hot-swap count, and the latest drift score."""
+        agg = self._serve_agg
         batches = [r for r in self.serve_records if r["serve"] == "batch"]
-        out: dict = {"batches": len(batches)}
-        if batches:
-            queries = sum(r.get("queries", 0) for r in batches)
+        out: dict = {"batches": agg["events"] + len(batches)}
+        if batches or agg["events"]:
+            live_q = sum(r.get("queries", 0) for r in batches)
+            queries = agg["requests"] + live_q
             out["queries"] = queries
-            out["rejected"] = sum(r.get("rejected", 0) for r in batches)
-            ts = [r["t"] for r in batches]
-            span = max(ts) - min(ts)
-            if len(batches) > 1 and span > 0:
+            out["rejected"] = agg["rejected"] + sum(
+                r.get("rejected", 0) for r in batches
+            )
+            ts = [r["t_mono"] for r in batches] + [
+                t for t in (agg["t_min"], agg["t_max"]) if t is not None
+            ]
+            span = (max(ts) - min(ts)) if ts else 0.0
+            n_events = agg["events"] + len(batches)
+            if n_events > 1 and span > 0:
                 # arrival-window rate; a single batch has no window, so
                 # its own dispatch time is the only honest denominator
                 out["qps"] = round(queries / span, 1)
@@ -234,35 +604,93 @@ class MetricsLogger:
                 secs = sum(r.get("batch_seconds", 0.0) for r in batches)
                 if secs > 0:
                     out["qps"] = round(queries / secs, 1)
-            lat = sorted(
-                l for r in batches for l in r.get("query_latency_s", ())
-            )
-            if lat:
-                out["p50_latency_s"] = round(
-                    lat[len(lat) // 2], 6
-                )
-                out["p99_latency_s"] = round(
-                    lat[min(len(lat) - 1, int(len(lat) * 0.99))], 6
-                )
             occ = [r["occupancy"] for r in batches if "occupancy" in r]
-            if occ:
-                out["mean_occupancy"] = round(sum(occ) / len(occ), 4)
-            out["swaps"] = sum(1 for r in batches if r.get("swap"))
-            versions = {r["version"] for r in batches if "version" in r}
+            occ_n = agg["occ_n"] + len(occ)
+            if occ_n:
+                out["mean_occupancy"] = round(
+                    (agg["occ_sum"] + sum(occ)) / occ_n, 4
+                )
+            out["swaps"] = agg["swaps"] + sum(
+                1 for r in batches if r.get("swap")
+            )
+            versions = set(agg["versions"]) | {
+                r["version"] for r in batches if "version" in r
+            }
             out["versions_served"] = sorted(versions)
-            out.update(self._stall_fields(batches))
+            out.update(self._stall_fields(batches, agg))
+            out.update(self._latency_fields(batches, agg))
         drifts = [r for r in self.serve_records if r["serve"] == "drift"]
+        if drifts or agg["drifts"]:
+            out["drift_refreshes"] = agg["drifts"] + len(drifts)
         if drifts:
-            out["drift_refreshes"] = len(drifts)
             out["drift_score"] = drifts[-1].get("score")
             out["drift_published"] = [
                 r["published"] for r in drifts
                 if r.get("published") is not None
             ]
+        if self.serve_records.evicted:
+            out["events_evicted"] = self.serve_records.evicted
         return out
+
+    def _slo_summary(self, out: dict) -> dict:
+        """The ``summary()["slo"]`` section: attainment + error-budget
+        burn against the declared p99 targets. The live ring buffers
+        are the rolling window; evicted requests count via the
+        aggregates (folded with the target in force at eviction
+        time)."""
+        slo: dict = {}
+        if self.slo_p99_ms is not None:
+            lats = [
+                lat * 1e3
+                for r in self.serve_records
+                if r.get("serve") == "batch"
+                for lat in (r.get("query_latency_s") or ())
+                if lat is not None
+            ]
+            agg = self._serve_agg
+            if lats or agg["slo_requests"]:
+                p99_s = out.get("serving", {}).get("p99_latency_s")
+                slo["serve"] = slo_summary(
+                    self.slo_p99_ms,
+                    lats,
+                    evicted_requests=agg["slo_requests"],
+                    evicted_violations=agg["slo_violations"],
+                    p99_ms=(
+                        round(p99_s * 1e3, 3) if p99_s is not None else None
+                    ),
+                )
+        if self.fleet_slo_p99_ms is not None:
+            lats = [
+                lat * 1e3
+                for r in self.fleet_records
+                if r.get("fleet") == "bucket"
+                for lat in (r.get("request_latency_s") or ())
+                if lat is not None
+            ]
+            agg = self._fleet_agg
+            if lats or agg["slo_requests"]:
+                p99_s = out.get("fleet", {}).get("p99_latency_s")
+                slo["fleet"] = slo_summary(
+                    self.fleet_slo_p99_ms,
+                    lats,
+                    evicted_requests=agg["slo_requests"],
+                    evicted_violations=agg["slo_violations"],
+                    p99_ms=(
+                        round(p99_s * 1e3, 3) if p99_s is not None else None
+                    ),
+                )
+        return slo
 
 
 def log_line(msg: str, **fields) -> None:
-    """One structured log line to stderr (replaces the reference's prints)."""
-    rec = {"msg": msg, "time": time.time(), **fields}
+    """One structured log line to stderr (replaces the reference's
+    prints). Carries both clocks like every other event (``time`` stays
+    for existing consumers; it is the unix stamp)."""
+    rec = {
+        "msg": msg,
+        "time": time.time(),
+        "t_unix": time.time(),
+        "t_mono": time.perf_counter(),
+        **fields,
+    }
     print(json.dumps(rec), file=sys.stderr, flush=True)

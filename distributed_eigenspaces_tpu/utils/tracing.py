@@ -4,6 +4,13 @@ The reference's tracing is print statements at protocol steps plus tqdm in
 the notebook. TPU-native: ``jax.named_scope`` annotations (show up in XLA/
 profiler timelines around shard compute and the merge) and ``jax.profiler``
 trace capture for TensorBoard.
+
+Since ISSUE 6 this module is also the DEVICE half of the unified
+telemetry layer: host-side spans (``utils/telemetry.Tracer``) opened
+with ``device=True`` enter :func:`trace_annotation`, so when a
+``jax.profiler`` capture (:func:`profile_to`) runs alongside, the same
+request-scoped names annotate the device timeline — one vocabulary
+across the exported Chrome trace and the XLA profile.
 """
 
 from __future__ import annotations
@@ -16,6 +23,15 @@ import jax
 def named_scope(name: str):
     """Annotate a region of traced computation (visible in profiles)."""
     return jax.named_scope(name)
+
+
+def trace_annotation(name: str):
+    """Annotate a region of HOST execution so it shows on the jax
+    profiler timeline (device-correlated). This is what merges
+    ``telemetry.Tracer`` spans into a ``profile_to`` capture: the span
+    name brackets the dispatch on the profiler's host track, next to
+    the ``named_scope`` regions it launched."""
+    return jax.profiler.TraceAnnotation(name)
 
 
 @contextlib.contextmanager

@@ -40,12 +40,18 @@
 #      CPU-tolerant floor (the speedup is dimensionless — rig speed
 #      divides itself out — so the floor only catches amortization
 #      drift, not session jitter);
-#   6. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   6. telemetry smoke: a serve burst with --trace-out — validates the
+#      emitted Chrome trace-event JSON parses, every served query's
+#      span chain (admit → queue_wait → dispatch → compute → reply)
+#      shares one trace_id, and the bench record's slo section is
+#      populated (docs/OBSERVABILITY.md names the span taxonomy this
+#      stage pins);
+#   7. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/6] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/7] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -53,7 +59,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/6] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/7] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -63,7 +69,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/6] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/7] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -78,7 +84,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/6] serve equality + amortization smoke (CPU) =="
+echo "== [4/7] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -93,7 +99,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/6] coldstart + prewarm smoke (CPU) =="
+echo "== [5/7] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -108,7 +114,52 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [6/6] graft entry + 8-device sharded dryrun =="
+echo "== [6/7] telemetry smoke: trace export + span-chain validation =="
+# A serve burst with --trace-out, then a structural validation of the
+# emitted timeline: the JSON must parse as Chrome trace-event format,
+# every served query's span chain (admit → queue_wait → dispatch →
+# compute → reply) must share one trace_id, and the bench record's
+# slo section must be populated. This pins the span taxonomy
+# (docs/OBSERVABILITY.md) — a rename or a dropped instrumentation
+# site fails CI here, not in a Perfetto tab three rounds later.
+DET_CI_TRACE="$(mktemp -d)/serve_trace.json"
+DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve \
+    --trace-out "$DET_CI_TRACE" --slo-p99-ms 5000 \
+    > "${DET_CI_TRACE%.json}_record.json"
+DET_CI_TRACE="$DET_CI_TRACE" python - <<'PY'
+import json, os, sys
+
+trace_path = os.environ["DET_CI_TRACE"]
+doc = json.load(open(trace_path))               # parses at all
+events = doc["traceEvents"]
+assert isinstance(events, list) and events, "empty traceEvents"
+for ev in events:
+    assert {"name", "ph", "pid", "tid"} <= set(ev), f"malformed: {ev}"
+chains = {}
+for ev in events:
+    tid = (ev.get("args") or {}).get("trace_id") or ""
+    if tid.startswith("query-"):
+        chains.setdefault(tid, set()).add(ev["name"])
+assert chains, "no query-* trace_ids on the timeline"
+need = {"admit", "queue_wait", "dispatch", "compute", "reply"}
+broken = {t: sorted(need - names) for t, names in chains.items()
+          if not need <= names}
+assert not broken, f"incomplete span chains: {broken}"
+
+record = json.load(open(trace_path[: -len(".json")] + "_record.json"))
+slo = (record.get("slo") or {}).get("serve") or {}
+assert slo.get("requests", 0) > 0, f"slo section not populated: {slo}"
+assert "attainment" in slo and "budget_burn" in slo, slo
+print(json.dumps({
+    "telemetry_smoke": "ok",
+    "query_chains": len(chains),
+    "spans": len(events),
+    "slo_requests": slo["requests"],
+    "slo_attained": slo.get("attained"),
+}))
+PY
+
+echo "== [7/7] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

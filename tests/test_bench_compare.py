@@ -203,6 +203,45 @@ def test_serve_records_compare_and_check_p99(tmp_path, capsys):
     assert bench.compare_reports(str(old), worse) == 1
 
 
+def test_serve_decomposition_passes_through_compare(tmp_path, capsys):
+    """ISSUE 6: the latency-decomposition/slo fields ride through the
+    compare verbatim — a new-field record vs an old record WITHOUT
+    them is not a metric mismatch (the metric name is the contract),
+    and the p99 components surface in the verdict so a regression is
+    attributable from the verdict alone."""
+    dec = {
+        "source": "exact",
+        "requests": 16,
+        "p50": {"total_s": 0.02, "queue_wait_s": 0.01,
+                "compile_stall_s": 0.0, "compute_s": 0.009,
+                "other_s": 0.001},
+        "p99": {"total_s": 0.041, "queue_wait_s": 0.03,
+                "compile_stall_s": 0.0, "compute_s": 0.01,
+                "other_s": 0.001},
+    }
+    old = tmp_path / "old.json"
+    # pre-ISSUE-6 record: no decomposition, no slo
+    old.write_text(json.dumps(_serve_report(25000.0, 0.1, 4.5, 0.04)))
+    new = {
+        **_serve_report(26000.0, 0.1, 4.2, 0.041),
+        "latency_decomposition": dec,
+        "slo": {"serve": {"target_p99_ms": 250.0, "attained": True}},
+    }
+    assert bench.compare_reports(str(old), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] != "skipped"
+    assert verdict["p99_decomposition_new"] == dec["p99"]
+    assert "p99_decomposition_old" not in verdict
+
+    # both sides carrying decomposition: both surfaced
+    old2 = tmp_path / "old2.json"
+    old2.write_text(json.dumps(new))
+    assert bench.compare_reports(str(old2), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["p99_decomposition_old"] == dec["p99"]
+    assert verdict["p99_decomposition_new"] == dec["p99"]
+
+
 def test_serve_vs_fleet_metric_mismatch_skips(tmp_path, capsys):
     old = tmp_path / "old.json"
     old.write_text(json.dumps(_serve_report(25000.0, 0.1, 4.5, 0.04)))

@@ -1,0 +1,656 @@
+"""Unified telemetry layer (utils/telemetry.py + the ISSUE-6
+MetricsLogger rewrite): span nesting/ordering, Chrome trace-event
+export validity, histogram quantile accuracy, ring-buffer eviction
+preserving summary aggregates, and SLO attainment math.
+
+The acceptance contract exercised end to end here: a served burst's
+span chain (admit → queue_wait → dispatch → compute → reply) shares
+one trace_id per query, and ``summary()["serving"]`` decomposes p99
+into queue_wait / compile_stall / compute / other components that sum
+to the measured request latency.
+"""
+
+import json
+import math
+import random
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.api.estimator import OnlineDistributedPCA
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.utils.metrics import (
+    DECOMP_KEYS,
+    MetricsLogger,
+)
+from distributed_eigenspaces_tpu.utils.telemetry import (
+    NULL_TRACER,
+    Histogram,
+    RingLog,
+    Tracer,
+    slo_summary,
+    tracer_of,
+)
+
+# -- spans -------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_implicit_nesting_same_thread(self):
+        tr = Tracer()
+        with tr.span("outer", trace_id=tr.new_trace("t")) as outer:
+            with tr.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.span.parent_id == outer.span_id
+        spans = {s.name: s for s in tr.snapshot()}
+        # inner closes first, so ordering in the buffer is inner, outer
+        assert [s.name for s in tr.snapshot()] == ["inner", "outer"]
+        assert spans["inner"].parent_id == spans["outer"].span_id
+        assert spans["outer"].parent_id is None
+        # containment: inner's interval inside outer's
+        assert spans["outer"].t_start_mono <= spans["inner"].t_start_mono
+        assert spans["inner"].t_end_mono <= spans["outer"].t_end_mono
+
+    def test_trace_ids_are_unique_and_kind_tagged(self):
+        tr = Tracer()
+        ids = [tr.new_trace("query") for _ in range(100)]
+        assert len(set(ids)) == 100
+        assert all(i.startswith("query-") for i in ids)
+
+    def test_record_span_cross_thread(self):
+        """The cross-thread form: submit stamps, dispatch lane records
+        after the fact — parenting works via explicit ids."""
+        tr = Tracer()
+        tid = tr.new_trace("query")
+        t0 = time.perf_counter()
+        stamps = {}
+
+        def lane():
+            t1 = time.perf_counter()
+            parent = tr.record_span(
+                "dispatch", t0, t1, trace_id=tid
+            )
+            tr.record_span(
+                "compute", t0, t1, trace_id=tid, parent=parent
+            )
+            stamps["parent"] = parent
+
+        th = threading.Thread(target=lane)
+        th.start()
+        th.join()
+        spans = {s.name: s for s in tr.snapshot()}
+        assert spans["compute"].parent_id == stamps["parent"]
+        assert spans["compute"].trace_id == tid == spans["dispatch"].trace_id
+
+    def test_events_are_instant(self):
+        tr = Tracer()
+        tr.event("fault:nan_block", attrs={"step": 3})
+        (sp,) = tr.snapshot()
+        assert sp.phase == "i"
+        assert sp.duration_s == 0.0
+        assert sp.attrs["step"] == 3
+
+    def test_both_clocks_on_every_span(self):
+        tr = Tracer()
+        with tr.span("a"):
+            pass
+        tr.event("b")
+        tr.record_span("c", 1.0, 2.0)
+        for sp in tr.snapshot():
+            assert sp.t_start_mono > 0
+            assert sp.t_start_unix > 1e9  # an actual epoch stamp
+
+    def test_bounded_buffer_drops_oldest_and_counts(self):
+        tr = Tracer(max_spans=64)
+        for i in range(200):
+            tr.event(f"e{i}")
+        assert len(tr.spans) <= 64
+        assert tr.dropped >= 200 - 64
+        # the tail survives — the drop takes the oldest
+        assert tr.snapshot()[-1].name == "e199"
+
+    def test_out_of_order_exit_does_not_corrupt_stack(self):
+        tr = Tracer()
+        a = tr.span("a")
+        b = tr.span("b")
+        a.__enter__(), b.__enter__()
+        a.__exit__(None, None, None)  # outer first
+        b.__exit__(None, None, None)
+        assert tr.current() is None
+        assert {s.name for s in tr.snapshot()} == {"a", "b"}
+
+    def test_null_tracer_is_total_noop(self):
+        with NULL_TRACER.span("x") as h:
+            h.set(a=1)
+            assert h.trace_id is None
+        NULL_TRACER.event("y")
+        assert NULL_TRACER.record_span("z", 0.0, 1.0) is None
+        assert NULL_TRACER.snapshot() == []
+        with pytest.raises(RuntimeError, match="no tracer attached"):
+            NULL_TRACER.export_chrome_trace("/tmp/never.json")
+
+    def test_tracer_of(self):
+        assert tracer_of(None) is NULL_TRACER
+        assert tracer_of(object()) is NULL_TRACER
+        m = MetricsLogger()
+        assert tracer_of(m) is NULL_TRACER
+        tr = Tracer()
+        m.attach_tracer(tr)
+        assert tracer_of(m) is tr
+
+
+# -- Chrome trace export -----------------------------------------------------
+
+
+class TestChromeExport:
+    def test_export_is_valid_trace_event_json(self, tmp_path):
+        tr = Tracer()
+        tid = tr.new_trace("query")
+        with tr.span("admit", trace_id=tid, category="serve"):
+            pass
+        tr.event("cache_hit", trace_id=tid, category="compile")
+        path = tr.export_chrome_trace(str(tmp_path / "trace.json"))
+        doc = json.load(open(path))
+        assert isinstance(doc["traceEvents"], list)
+        assert doc["displayTimeUnit"] == "ms"
+        phases = {e["ph"] for e in doc["traceEvents"]}
+        assert phases <= {"X", "i", "M"}
+        for e in doc["traceEvents"]:
+            # the trace-event schema every viewer requires
+            assert {"name", "ph", "pid", "tid"} <= set(e)
+            if e["ph"] == "X":
+                assert "ts" in e and "dur" in e and e["dur"] >= 0
+        args = [
+            e["args"] for e in doc["traceEvents"]
+            if e["ph"] in ("X", "i")
+        ]
+        assert all("trace_id" in a and "t_unix" in a for a in args)
+        assert doc["otherData"]["dropped_spans"] == 0
+
+    def test_monotonic_ts_offsets_from_anchor(self, tmp_path):
+        tr = Tracer()
+        with tr.span("a"):
+            time.sleep(0.002)
+        path = tr.export_chrome_trace(str(tmp_path / "t.json"))
+        (ev,) = [
+            e for e in json.load(open(path))["traceEvents"]
+            if e["ph"] == "X"
+        ]
+        assert 0 <= ev["ts"] < 60e6  # µs since tracer birth, not epoch
+        assert ev["dur"] >= 2e3
+
+
+# -- histogram ---------------------------------------------------------------
+
+
+class TestHistogram:
+    @pytest.mark.parametrize("dist,kw", [
+        ("uniform", dict(lo=0.001, hi=0.5)),
+        ("lognormal", dict(mu=-5.0, sigma=1.0)),
+        ("exponential", dict(scale=0.02)),
+    ])
+    def test_quantiles_within_one_growth_factor(self, dist, kw):
+        """The accuracy contract: a log-bucketed estimate is within one
+        ``growth`` factor of the exact quantile, by construction."""
+        rng = random.Random(7)
+        if dist == "uniform":
+            vals = [rng.uniform(kw["lo"], kw["hi"]) for _ in range(5000)]
+        elif dist == "lognormal":
+            vals = [rng.lognormvariate(kw["mu"], kw["sigma"])
+                    for _ in range(5000)]
+        else:
+            vals = [rng.expovariate(1.0 / kw["scale"])
+                    for _ in range(5000)]
+        h = Histogram()
+        h.record_many(vals)
+        s = sorted(vals)
+        for q in (0.5, 0.9, 0.99):
+            exact = s[min(len(s) - 1, math.ceil(q * len(s)) - 1)]
+            est = h.quantile(q)
+            assert exact / h.growth <= est <= exact * h.growth, (
+                f"{dist} q={q}: est {est} vs exact {exact}"
+            )
+
+    def test_merge_equals_combined_recording(self):
+        rng = random.Random(3)
+        a_vals = [rng.uniform(0.001, 1.0) for _ in range(500)]
+        b_vals = [rng.lognormvariate(-3, 1) for _ in range(500)]
+        a, b, both = Histogram(), Histogram(), Histogram()
+        a.record_many(a_vals)
+        b.record_many(b_vals)
+        both.record_many(a_vals + b_vals)
+        a.merge(b)
+        assert a.counts == both.counts
+        assert a.count == both.count
+        assert a.min == both.min and a.max == both.max
+        assert a.quantile(0.99) == both.quantile(0.99)
+
+    def test_merge_rejects_different_layouts(self):
+        with pytest.raises(ValueError, match="bucket layouts"):
+            Histogram().merge(Histogram(growth=2.0))
+
+    def test_bounded_memory(self):
+        h = Histogram()
+        n_buckets = len(h.counts)
+        h.record_many(float(i % 97 + 1) * 1e-4 for i in range(100_000))
+        assert len(h.counts) == n_buckets  # structure never grows
+        assert h.count == 100_000
+
+    def test_overflow_and_clamping(self):
+        h = Histogram(lo=1e-3, hi=1.0)
+        h.record(50.0)  # beyond hi -> overflow bucket
+        h.record(1e-9)  # below lo -> first bucket
+        assert h.count == 2
+        assert h.quantile(1.0) == 50.0  # overflow reports observed max
+        assert h.quantile(0.0) >= 1e-9  # clamped to observed min
+
+    def test_empty_and_validation(self):
+        h = Histogram()
+        assert h.quantile(0.5) is None
+        assert h.mean is None
+        assert h.as_dict() == {"count": 0, "sum": 0.0}
+        h.record(0.5)
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+        with pytest.raises(ValueError):
+            Histogram(lo=-1.0)
+
+
+# -- ring buffer -------------------------------------------------------------
+
+
+class TestRingLog:
+    def test_list_compatible_for_retained_window(self):
+        r = RingLog(retention=3)
+        for i in range(3):
+            r.append(i)
+        assert list(r) == [0, 1, 2]
+        assert len(r) == 3 and r[0] == 0 and bool(r)
+        assert RingLog(retention=1).evicted == 0
+        assert not RingLog(retention=1)
+
+    def test_eviction_folds_through_callback_in_order(self):
+        seen = []
+        r = RingLog(retention=2, on_evict=seen.append)
+        for i in range(5):
+            r.append(i)
+        assert seen == [0, 1, 2]  # oldest-first
+        assert list(r) == [3, 4]
+        assert r.evicted == 3
+
+    def test_rejects_bad_retention(self):
+        with pytest.raises(ValueError):
+            RingLog(retention=0)
+
+
+# -- eviction preserves summary aggregates -----------------------------------
+
+
+def _batch_event(i, *, queries=4, lat=0.010, qw=0.004, compute=0.003,
+                 stall_ms=0.0, version=0, swap=False):
+    """A synthetic serve batch shaped exactly like QueryServer emits."""
+    return {
+        "kind": "batch",
+        "queries": queries,
+        "rejected": 0,
+        "batch_seconds": lat,
+        "compile_misses": 1 if stall_ms else 0,
+        "compile_stall_ms": stall_ms,
+        "query_latency_s": [lat] * queries,
+        "queue_wait_s": [qw] * queries,
+        "compute_s": compute,
+        "signature": (32, 3),
+        "occupancy": queries / 4,
+        "version": version,
+        "swap": swap,
+    }
+
+
+class TestEvictionPreservesSummary:
+    def test_step_records_fold_into_throughput(self):
+        small = MetricsLogger(samples_per_step=100, retention=8).start()
+        big = MetricsLogger(samples_per_step=100, retention=10_000).start()
+        # inject deterministic step records (shaped like on_step's)
+        # directly so the fold math is exactly checkable
+        for t in range(64):
+            rec = {
+                "step": t,
+                "step_seconds": 0.01,
+                "samples_per_sec": 100.0 + t,
+                "t_mono": float(t),
+                "t_unix": 1e9 + t,
+                "t": float(t),
+            }
+            small.records.append(dict(rec))
+            big.records.append(dict(rec))
+        s_small, s_big = small.summary(), big.summary()
+        assert small.records.evicted == 64 - 8
+        assert s_small["steps"] == s_big["steps"] == 64
+        assert (
+            s_small["mean_samples_per_sec"]
+            == s_big["mean_samples_per_sec"]
+        )
+        assert (
+            s_small["max_samples_per_sec"]
+            == s_big["max_samples_per_sec"]
+        )
+
+    def test_serve_counters_identical_after_eviction(self):
+        small = MetricsLogger(retention=4)
+        big = MetricsLogger(retention=10_000)
+        for i in range(40):
+            ev = _batch_event(
+                i, version=i // 20, swap=(i == 20),
+                stall_ms=5.0 if i % 10 == 0 else 0.0,
+            )
+            small.serve(dict(ev))
+            big.serve(dict(ev))
+        s, b = small.summary()["serving"], big.summary()["serving"]
+        assert small.serve_records.evicted == 36
+        for key in ("batches", "queries", "rejected", "swaps",
+                    "compile_misses", "versions_served",
+                    "mean_occupancy"):
+            assert s[key] == b[key], key
+        assert s["compile_stall_ms"] == pytest.approx(
+            b["compile_stall_ms"]
+        )
+        assert s["events_evicted"] == 36
+        assert "events_evicted" not in b
+
+    def test_percentiles_survive_eviction_within_histogram_error(self):
+        small = MetricsLogger(retention=4)
+        big = MetricsLogger(retention=10_000)
+        rng = random.Random(11)
+        lats = [rng.lognormvariate(-4.5, 0.8) for _ in range(60)]
+        for i, lat in enumerate(lats):
+            ev = _batch_event(i, queries=1, lat=lat, qw=lat * 0.4,
+                              compute=lat * 0.5)
+            small.serve(dict(ev))
+            big.serve(dict(ev))
+        s, b = small.summary()["serving"], big.summary()["serving"]
+        growth = Histogram().growth
+        for key in ("p50_latency_s", "p99_latency_s"):
+            assert b[key] / growth <= s[key] <= b[key] * growth, key
+        # decomposition switches to labeled histogram mode
+        assert s["latency_decomposition"]["source"] == "histogram"
+        assert b["latency_decomposition"]["source"] == "exact"
+        assert (
+            s["latency_decomposition"]["requests"]
+            == b["latency_decomposition"]["requests"]
+            == 60
+        )
+
+    def test_fleet_section_folds_like_serving(self):
+        small = MetricsLogger(retention=2, fleet_slo_p99_ms=50.0)
+        big = MetricsLogger(retention=10_000, fleet_slo_p99_ms=50.0)
+        for i in range(20):
+            ev = {
+                "kind": "bucket",
+                "tenants": 8,
+                "occupancy": 1.0,
+                "compile_misses": 0,
+                "compile_stall_ms": 0.0,
+                "request_latency_s": [0.040 if i % 5 else 0.080] * 8,
+                "queue_wait_s": [0.010] * 8,
+                "compute_s": 0.025,
+            }
+            small.fleet(dict(ev))
+            big.fleet(dict(ev))
+        s, b = small.summary(), big.summary()
+        assert s["fleet"]["buckets"] == b["fleet"]["buckets"] == 20
+        assert s["fleet"]["tenants"] == b["fleet"]["tenants"] == 160
+        # SLO totals identical: evicted violations fold into the agg
+        assert s["slo"]["fleet"]["requests"] == 160
+        assert (
+            s["slo"]["fleet"]["violations"]
+            == b["slo"]["fleet"]["violations"]
+            == 32  # every 5th bucket's 8 tenants at 80 ms > 50 ms
+        )
+
+    def test_fault_ledger_counts_survive(self):
+        m = MetricsLogger(retention=3)
+        for i in range(10):
+            m.fault({"kind": "nan_block" if i % 2 else "retry",
+                     "step": i})
+        faults = m.summary()["faults"]
+        assert faults["count"] == 10
+        assert faults["by_kind"] == {"nan_block": 5, "retry": 5}
+        assert len(faults["events"]) == 3  # retained window only
+        assert faults["events_evicted"] == 7
+
+
+# -- latency decomposition ---------------------------------------------------
+
+
+class TestDecomposition:
+    def test_exact_components_sum_to_total(self):
+        m = MetricsLogger()
+        rng = random.Random(5)
+        for i in range(30):
+            lat = rng.uniform(0.005, 0.050)
+            qw = lat * rng.uniform(0.1, 0.5)
+            compute = lat * rng.uniform(0.1, 0.4)
+            stall = lat * 0.1 if i % 7 == 0 else 0.0
+            m.serve(_batch_event(
+                i, queries=1, lat=lat, qw=qw, compute=compute,
+                stall_ms=stall * 1e3,
+            ))
+        dec = m.summary()["serving"]["latency_decomposition"]
+        assert dec["source"] == "exact"
+        for pct in ("p50", "p99", "mean"):
+            row = dec[pct]
+            total = sum(row[k] for k in DECOMP_KEYS)
+            assert total == pytest.approx(row["total_s"], abs=5e-6), pct
+
+    def test_dual_timestamps_on_all_event_kinds(self):
+        m = MetricsLogger()
+        m.start()
+        m.on_step(0, None)
+        m.serve(_batch_event(0))
+        m.fleet({"kind": "bucket", "tenants": 1})
+        m.fault({"kind": "retry", "step": 1})
+        for recs in (m.records, m.serve_records, m.fleet_records,
+                     m.fault_records):
+            for r in recs:
+                assert "t_mono" in r and "t_unix" in r
+                assert r["t"] == r["t_mono"]
+                assert r["t_unix"] > 1e9
+                assert r["t_mono"] < 1e9  # perf_counter, not epoch
+
+
+# -- SLO math ----------------------------------------------------------------
+
+
+class TestSLO:
+    def test_attainment_and_burn(self):
+        # 100 requests, 3 over target, objective 0.99 -> burn 3.0
+        lats = [10.0] * 97 + [200.0] * 3
+        s = slo_summary(50.0, lats)
+        assert s["requests"] == 100 and s["violations"] == 3
+        assert s["attainment"] == pytest.approx(0.97)
+        assert s["budget_burn"] == pytest.approx(3.0)
+        assert s["attained"] is False  # p99 == 200 > 50
+        assert s["window"]["violations"] == 3
+
+    def test_attained_when_under_target(self):
+        s = slo_summary(50.0, [10.0] * 200)
+        assert s["attained"] is True
+        assert s["budget_burn"] == 0.0
+        assert s["attainment"] == 1.0
+
+    def test_evicted_counts_fold_into_lifetime(self):
+        s = slo_summary(
+            50.0, [10.0] * 50,
+            evicted_requests=950, evicted_violations=19,
+        )
+        assert s["requests"] == 1000 and s["violations"] == 19
+        assert s["attainment"] == pytest.approx(1 - 19 / 1000)
+        assert s["budget_burn"] == pytest.approx(1.9)
+        # rolling window reported separately, violations live-only
+        assert s["window"] == {
+            "requests": 50, "violations": 0, "attainment": 1.0,
+        }
+
+    def test_empty_window(self):
+        s = slo_summary(50.0, [])
+        assert s["requests"] == 0
+        assert "attainment" not in s and "p99_ms" not in s
+
+    def test_logger_surfaces_serve_slo(self):
+        m = MetricsLogger(slo_p99_ms=15.0)
+        for i in range(20):
+            m.serve(_batch_event(i, lat=0.010 if i % 4 else 0.020))
+        slo = m.summary()["slo"]["serve"]
+        assert slo["target_p99_ms"] == 15.0
+        assert slo["requests"] == 80
+        assert slo["violations"] == 20  # every 4th batch's 4 queries
+        assert slo["attained"] is False
+
+    def test_cfg_slo_validation(self):
+        with pytest.raises(ValueError, match="serve_slo_p99_ms"):
+            PCAConfig(dim=8, k=2, serve_slo_p99_ms=-1.0)
+        with pytest.raises(ValueError, match="fleet_slo_p99_ms"):
+            PCAConfig(dim=8, k=2, fleet_slo_p99_ms=0)
+        with pytest.raises(ValueError, match="metrics_retention"):
+            PCAConfig(dim=8, k=2, metrics_retention=0)
+        cfg = PCAConfig(dim=8, k=2, serve_slo_p99_ms=25.0,
+                        metrics_retention=128)
+        assert cfg.serve_slo_p99_ms == 25.0
+
+
+# -- end-to-end: served burst on one timeline --------------------------------
+
+D, K, M, N, T = 32, 3, 2, 16, 4
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    cfg = PCAConfig(
+        dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=T,
+        serve_bucket_size=4, serve_flush_s=0.02, serve_slo_p99_ms=5e3,
+    )
+    spec = planted_spectrum(D, k_planted=K, gap=20.0, noise=0.01, seed=0)
+    data = np.asarray(spec.sample(jax.random.PRNGKey(1), T * M * N))
+    est = OnlineDistributedPCA(cfg).fit(data)
+    return cfg, spec, est
+
+
+class TestServeBurstTimeline:
+    def test_span_chain_per_query_and_decomposition(
+        self, fitted, tmp_path
+    ):
+        from distributed_eigenspaces_tpu.serving import (
+            EigenbasisRegistry,
+            QueryServer,
+        )
+
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        tracer = Tracer()
+        metrics = MetricsLogger(retention=cfg.metrics_retention)
+        metrics.attach_tracer(tracer)
+        queries = [
+            np.asarray(
+                spec.sample(jax.random.PRNGKey(100 + i), 5), np.float32
+            )
+            for i in range(12)
+        ]
+        with QueryServer(reg, cfg, metrics=metrics) as srv:
+            tickets = [srv.submit(q) for q in queries]
+            results = [t.result(timeout=60) for t in tickets]
+        assert all(r.z is not None for r in results)
+
+        # SLO picked up from cfg.serve_slo_p99_ms at construction
+        assert metrics.slo_p99_ms == cfg.serve_slo_p99_ms
+        summary = metrics.summary()
+        slo = summary["slo"]["serve"]
+        assert slo["requests"] == 12
+        assert slo["attained"] is True  # 5 s target on a local burst
+
+        # decomposition sums to measured latency (exact mode)
+        dec = summary["serving"]["latency_decomposition"]
+        assert dec["source"] == "exact" and dec["requests"] == 12
+        for pct in ("p50", "p99"):
+            total = sum(dec[pct][k] for k in DECOMP_KEYS)
+            assert total == pytest.approx(
+                dec[pct]["total_s"], rel=0.05, abs=5e-6
+            )
+
+        # every query's chain shares one trace_id, required names all
+        # present, queue_wait precedes compute within each chain
+        path = tracer.export_chrome_trace(str(tmp_path / "burst.json"))
+        doc = json.load(open(path))
+        chains: dict = {}
+        for ev in doc["traceEvents"]:
+            tid = (ev.get("args") or {}).get("trace_id")
+            if tid and tid.startswith("query-"):
+                chains.setdefault(tid, {})[ev["name"]] = ev
+        assert len(chains) == 12
+        for tid, evs in chains.items():
+            assert {"admit", "queue_wait", "dispatch", "compute",
+                    "reply"} <= set(evs), tid
+            assert evs["admit"]["ts"] <= evs["compute"]["ts"]
+            assert evs["queue_wait"]["ts"] <= evs["compute"]["ts"]
+            # compute/reply parent to the dispatch span
+            assert (
+                evs["compute"]["args"]["parent_id"]
+                == evs["dispatch"]["args"]["span_id"]
+            )
+
+    def test_fleet_server_span_chain_and_slo(self, fitted):
+        """The fleet twin of the query chain: every fleet ticket's
+        spans (admit → queue_wait → dispatch → compute) share one
+        fleet-… trace_id, and the declared fleet SLO is picked up
+        from cfg at construction."""
+        from distributed_eigenspaces_tpu.parallel.fleet import FleetServer
+
+        cfg, spec, _ = fitted
+        fcfg = PCAConfig(
+            dim=D, k=K, num_workers=M, rows_per_worker=N, num_steps=T,
+            fleet_bucket_size=2, fleet_flush_s=0.1,
+            fleet_slo_p99_ms=60_000.0,
+        )
+        tracer = Tracer()
+        metrics = MetricsLogger()
+        metrics.attach_tracer(tracer)
+        probs = [
+            np.asarray(spec.sample(jax.random.PRNGKey(40 + b), T * M * N))
+            for b in range(2)
+        ]
+        with FleetServer(fcfg, mesh=None, metrics=metrics) as srv:
+            tickets = [srv.submit(p) for p in probs]
+            ws = [t.result(timeout=300) for t in tickets]
+        assert all(w is not None for w in ws)
+        assert metrics.fleet_slo_p99_ms == 60_000.0
+        summary = metrics.summary()
+        assert summary["slo"]["fleet"]["requests"] == 2
+        dec = summary["fleet"]["latency_decomposition"]
+        assert dec["source"] == "exact" and dec["requests"] == 2
+        chains: dict = {}
+        for sp in tracer.snapshot():
+            if sp.trace_id and sp.trace_id.startswith("fleet-"):
+                chains.setdefault(sp.trace_id, set()).add(sp.name)
+        assert len(chains) == 2
+        for tid, names in chains.items():
+            assert {"admit", "queue_wait", "dispatch",
+                    "compute"} <= names, tid
+
+    def test_estimator_fit_lands_on_timeline(self, fitted):
+        cfg, spec, _ = fitted
+        tracer = Tracer()
+        data = np.asarray(
+            spec.sample(jax.random.PRNGKey(2), T * M * N)
+        )
+        OnlineDistributedPCA(cfg).fit(data, tracer=tracer)
+        spans = {s.name for s in tracer.snapshot()}
+        assert "estimator_fit" in spans
+        (root,) = [
+            s for s in tracer.snapshot() if s.name == "estimator_fit"
+        ]
+        assert root.trace_id.startswith("fit-")
+        assert root.attrs["trainer"]
