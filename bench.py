@@ -883,6 +883,373 @@ def measure_serve(profile_dir=None, trace_out=None, slo_p99_ms=None):
     return result, ok
 
 
+def _chaos_serve_cfg():
+    """Chaos-serve workload: small enough that the whole scenario suite
+    (subprocess kill -9 + restart, overload burst, breaker, lane kill)
+    stays inside a CI minute; the measured quantities are recovery
+    time and shed behavior, not device throughput."""
+    from distributed_eigenspaces_tpu.config import PCAConfig
+
+    d, k = (32, 3) if _os.environ.get("DET_BENCH_SMALL") == "1" else (64, 4)
+    return PCAConfig(
+        dim=d, k=k, num_workers=2, rows_per_worker=32, num_steps=2,
+        backend="local", serve_bucket_size=4, serve_flush_s=0.01,
+    )
+
+
+def _chaos_queries(cfg, count=8, rows=4):
+    import jax
+
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+
+    spec = planted_spectrum(
+        cfg.dim, k_planted=cfg.k, gap=20.0, noise=0.01, seed=7
+    )
+    key = jax.random.PRNGKey(23)
+    out = []
+    for _ in range(count):
+        key, sub = jax.random.split(key)
+        out.append(np.asarray(spec.sample(sub, rows), np.float32))
+    return out
+
+
+def chaos_serve_child(workdir: str) -> int:
+    """``--chaos-serve-child``: the process the parent kill -9's.
+
+    Fits, publishes version 1 into the DURABLE registry under
+    ``workdir``, serves a burst (results recorded to ``precrash.npz``
+    — the parent's bit-exactness reference), then starts publishing
+    version 2 and SIGKILLs itself between the payload write and the
+    commit marker — the torn-snapshot crash window the recovery scan
+    must survive. Never returns.
+    """
+    import signal
+
+    from distributed_eigenspaces_tpu.api.estimator import (
+        OnlineDistributedPCA,
+    )
+    from distributed_eigenspaces_tpu.serving import (
+        EigenbasisRegistry,
+        QueryServer,
+    )
+
+    cfg = _chaos_serve_cfg()
+    fit_rows = cfg.num_steps * cfg.num_workers * cfg.rows_per_worker
+    import jax
+
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+
+    spec = planted_spectrum(
+        cfg.dim, k_planted=cfg.k, gap=20.0, noise=0.01, seed=7
+    )
+    est = OnlineDistributedPCA(cfg).fit(
+        np.asarray(spec.sample(jax.random.PRNGKey(1), fit_rows))
+    )
+    registry = EigenbasisRegistry(
+        keep=cfg.serve_keep_versions,
+        registry_dir=_os.path.join(workdir, "registry"),
+    )
+    v1 = registry.publish_fit(est, lineage={"producer": "chaos_child"})
+    queries = _chaos_queries(cfg)
+    with QueryServer(registry, cfg) as srv:
+        served = [srv.submit(q).result(timeout=60) for q in queries]
+    np.savez(
+        _os.path.join(workdir, "precrash.npz"),
+        version=v1.version,
+        basis=np.asarray(v1.v),
+        **{f"z{i}": np.asarray(s.z) for i, s in enumerate(served)},
+    )
+
+    # publish #2 dies between payload and commit marker: the torn
+    # window a real mid-publish SIGKILL hits
+    def die_before_commit(self, vdir, bv, checksum):
+        _os.kill(_os.getpid(), signal.SIGKILL)
+
+    EigenbasisRegistry._write_meta = die_before_commit
+    registry.publish(np.asarray(v1.v), step=v1.step + 1)
+    return 3  # unreachable: SIGKILL above
+
+
+def measure_chaos_serve():
+    """``--chaos-serve``: the read-path resilience A/B (ISSUE 7). Four
+    chaos scenarios, every gate asserted by the bench itself:
+
+    1. **Durable restart.** A child process publishes to the durable
+       registry, serves, and is SIGKILLed mid-second-publish. The
+       parent recovers the store (torn v2 skipped loudly), warm-serves
+       the SAME queries against the recovered latest with ZERO refit,
+       asserts bit-exactness vs the child's pre-crash results, and
+       reports the measured recovery time (registry scan → first
+       served result). A checksum-corrupted copy of the store must
+       quarantine the damaged version.
+    2. **Overload burst.** ≥4x the admission capacity submitted at
+       once: sheds counted, rejected requests get clean
+       ``ServerOverloaded`` errors, every ACCEPTED request resolves
+       with p99 inside the declared SLO, and the queue never grows
+       past ``serve_queue_depth`` (bounded by construction; the gauge
+       must read 0 after the burst).
+    3. **Poisoned signature.** A server whose every dispatch fails
+       trips its per-signature breaker and fast-fails with
+       ``BreakerOpen``, while a second signature sharing the metrics
+       fabric keeps serving bit-exact.
+    4. **Lane kill.** A KillSwitch in the dispatch lane: the watchdog
+       restarts the lane, the leased bucket re-leases, tickets
+       resolve, and the lane recovery time is reported.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    from distributed_eigenspaces_tpu.serving import (
+        BreakerOpen,
+        EigenbasisRegistry,
+        QueryServer,
+        ServerOverloaded,
+    )
+    from distributed_eigenspaces_tpu.utils.faults import (
+        ServeChaosHook,
+        ServeChaosPlan,
+        corrupt_version_file,
+    )
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    cfg = _chaos_serve_cfg()
+    queries = _chaos_queries(cfg)
+    workdir = tempfile.mkdtemp(prefix="det_chaos_serve_")
+    gates: dict[str, bool] = {}
+    try:
+        # -- 1. kill -9 mid-publish → durable restart ------------------------
+        env = dict(_os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, _os.path.abspath(__file__),
+             "--chaos-serve-child", workdir],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+        gates["child_sigkilled"] = proc.returncode == -9
+        pre = np.load(_os.path.join(workdir, "precrash.npz"))
+        t0 = time.perf_counter()
+        registry = EigenbasisRegistry(
+            keep=cfg.serve_keep_versions,
+            registry_dir=_os.path.join(workdir, "registry"),
+        )
+        metrics = MetricsLogger()
+        with QueryServer(registry, cfg, metrics=metrics) as srv:
+            served = [
+                srv.submit(q).result(timeout=60) for q in queries
+            ]
+        recovery_ms = (time.perf_counter() - t0) * 1e3
+        gates["torn_snapshot_skipped"] = bool(registry.torn_skipped)
+        gates["recovered_latest_served"] = (
+            registry.latest() is not None
+            and registry.latest().version == int(pre["version"])
+        )
+        gates["restart_bit_exact_zero_refit"] = all(
+            np.array_equal(s.z, pre[f"z{i}"])
+            for i, s in enumerate(served)
+        )  # zero refit is structural: the parent never ran a fit
+
+        # corruption quarantine on a COPY of the recovered store
+        qdir = _os.path.join(workdir, "registry_corrupt")
+        shutil.copytree(_os.path.join(workdir, "registry"), qdir)
+        corrupt_version_file(
+            _os.path.join(qdir, f"v{int(pre['version']):08d}")
+        )
+        reg_c = EigenbasisRegistry(
+            keep=cfg.serve_keep_versions, registry_dir=qdir
+        )
+        gates["corrupt_version_quarantined"] = (
+            bool(reg_c.quarantined) and reg_c.latest() is None
+        )
+
+        # -- 2. overload burst -----------------------------------------------
+        depth = 8
+        burst = 4 * depth
+        slo_ms = float(
+            _os.environ.get("DET_BENCH_CHAOS_SLO_MS")
+            or 3.0 * cfg.serve_flush_s * 1e3 + 2000.0
+        )
+        m2 = MetricsLogger(slo_p99_ms=slo_ms)
+        reg2 = EigenbasisRegistry()
+        reg2.publish(np.asarray(pre["basis"]))
+
+        def busy_hook(bucket):  # each dispatch holds the lane briefly:
+            time.sleep(0.01)    # the burst arrives FASTER than service
+
+        shed = 0
+        accepted = []
+        clean_rejects = True
+        with QueryServer(
+            reg2, cfg, metrics=m2, queue_depth=depth, bucket_size=1,
+            flush_s=0.0, fault_hook=busy_hook,
+        ) as srv2:
+            for i in range(burst):
+                try:
+                    accepted.append(srv2.submit(queries[i % len(queries)]))
+                except ServerOverloaded as e:
+                    shed += 1
+                    clean_rejects &= "load shedding" in str(e)
+                except Exception:
+                    clean_rejects = False
+                    shed += 1
+            results2 = [t.result(timeout=120) for t in accepted]
+            inflight_after = srv2.health()["inflight"]
+        lat_ms = sorted(
+            lat * 1e3
+            for r in m2.serve_records if r.get("serve") == "batch"
+            for lat in (r.get("query_latency_s") or ())
+        )
+        p99_ms = (
+            lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))]
+            if lat_ms else None
+        )
+        shed_rate = round(shed / burst, 4)
+        gates["overload_sheds_counted"] = shed > 0
+        gates["overload_clean_rejects"] = clean_rejects
+        gates["overload_all_accepted_served"] = (
+            len(results2) == len(accepted) and inflight_after == 0
+        )
+        gates["overload_accepted_p99_within_slo"] = (
+            p99_ms is not None and p99_ms <= slo_ms
+        )
+        health2 = m2.summary()["serving"]["health"]
+
+        # -- 3. poisoned signature trips its breaker, neighbor unaffected ----
+        m3 = MetricsLogger()
+        cfg_b = cfg.replace(dim=max(16, cfg.dim // 2), k=2)
+        reg3a, reg3b = EigenbasisRegistry(), EigenbasisRegistry()
+        reg3a.publish(np.asarray(pre["basis"]))
+        rng = np.random.default_rng(5)
+        basis_b = np.linalg.qr(
+            rng.standard_normal((cfg_b.dim, cfg_b.k))
+        )[0].astype(np.float32)
+        reg3b.publish(basis_b)
+        poison = ServeChaosHook(
+            ServeChaosPlan(fail_signatures=((cfg.dim, cfg.k),))
+        )
+        srv_a = QueryServer(
+            reg3a, cfg, metrics=m3, breaker_threshold=3,
+            breaker_cooldown_s=5.0, max_retries=0, bucket_size=1,
+            flush_s=0.0, fault_hook=poison,
+        )
+        srv_b = QueryServer(
+            reg3b, cfg_b, metrics=m3, breaker_threshold=3,
+            bucket_size=1, flush_s=0.0,
+        )
+        try:
+            poisoned_failures = 0
+            for q in queries[:4]:
+                try:
+                    srv_a.submit(q).result(timeout=30)
+                except Exception:
+                    poisoned_failures += 1
+            t_ff = time.perf_counter()
+            try:
+                srv_a.submit(queries[0])
+                fast_failed = False
+            except BreakerOpen:
+                fast_failed = True
+            fast_fail_ms = (time.perf_counter() - t_ff) * 1e3
+            qb = queries[0][:, : cfg_b.dim]
+            rb = srv_b.submit(qb).result(timeout=30)
+            neighbor_exact = np.array_equal(
+                rb.z,
+                np.asarray(
+                    _hi_matmul(qb, basis_b)
+                ),
+            )
+        finally:
+            srv_a.close()
+            srv_b.close()
+        health3 = m3.summary()["serving"]["health"]
+        breaker_a = (health3.get("breakers") or {}).get(
+            str((cfg.dim, cfg.k)), {}
+        )
+        gates["breaker_tripped_fast_fails"] = (
+            fast_failed and breaker_a.get("state") == "open"
+        )
+        gates["breaker_neighbor_unaffected"] = bool(neighbor_exact)
+
+        # -- 4. lane kill → watchdog restart ---------------------------------
+        m4 = MetricsLogger()
+        reg4 = EigenbasisRegistry()
+        reg4.publish(np.asarray(pre["basis"]))
+        kill_hook = ServeChaosHook(ServeChaosPlan(kill_lane_at_batch=1))
+        t0 = time.perf_counter()
+        with QueryServer(
+            reg4, cfg, metrics=m4, fault_hook=kill_hook,
+            lease_timeout=0.3,
+        ) as srv4:
+            r4 = srv4.submit(queries[0]).result(timeout=60)
+            lane_recovery_ms = (time.perf_counter() - t0) * 1e3
+            restarts = srv4._watchdog.restarts
+        gates["lane_killed_recovered"] = (
+            restarts >= 1
+            and np.array_equal(
+                r4.z, np.asarray(_hi_matmul(queries[0], pre["basis"]))
+            )
+        )
+        health4 = m4.summary()["serving"]["health"]
+        gates["health_reports_restarts"] = (
+            health4.get("lane_restarts", 0) >= 1
+        )
+
+        ok = all(gates.values())
+        result = {
+            "metric": "pca_chaos_serve_recovery",
+            "value": round(recovery_ms, 1),
+            "unit": "ms",
+            "recovery_ms": round(recovery_ms, 1),
+            "shed_rate": shed_rate,
+            "restart": {
+                "recovery_ms": round(recovery_ms, 1),
+                "recovered_version": int(pre["version"]),
+                "torn_skipped": registry.torn_skipped,
+                "quarantined_on_corrupt_copy": reg_c.quarantined,
+                "refits": 0,
+            },
+            "overload": {
+                "capacity": depth,
+                "submitted": burst,
+                "accepted": len(accepted),
+                "sheds": shed,
+                "shed_rate": shed_rate,
+                "p99_ms": round(p99_ms, 3) if p99_ms else None,
+                "slo_ms": slo_ms,
+                "health": health2,
+            },
+            "breaker": {
+                "poisoned_failures": poisoned_failures,
+                "fast_fail_ms": round(fast_fail_ms, 3),
+                "state": breaker_a,
+            },
+            "lane": {
+                "restarts": restarts,
+                "recovery_ms": round(lane_recovery_ms, 1),
+            },
+            "gates": gates,
+        }
+        if not ok:
+            result["chaos_fail"] = sorted(
+                g for g, passed in gates.items() if not passed
+            )
+        return result, ok
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _hi_matmul(x, v):
+    """The direct-projection reference at the transform kernels'
+    precision (HIGHEST for fp32) — what served z must equal bit for
+    bit."""
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.matmul(
+        jnp.asarray(x, jnp.float32), jnp.asarray(v, jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
 def _coldstart_cfg(cache_dir):
     """The coldstart A/B's FIXED shape signature: a dense subspace-solver
     scan fit (pipeline_merge on — the heaviest-compiling steady-state
@@ -1161,6 +1528,28 @@ def main():
             return 2
         return coldstart_child(args[i + 1])
 
+    # --chaos-serve-child: the process the chaos-serve A/B kill -9's
+    # (publishes to the durable registry, then dies mid-publish)
+    if "--chaos-serve-child" in args:
+        i = args.index("--chaos-serve-child")
+        if i + 1 >= len(args):
+            print("usage: bench.py --chaos-serve-child WORKDIR",
+                  file=sys.stderr)
+            return 2
+        return chaos_serve_child(args[i + 1])
+
+    # --chaos-serve: the read-path resilience A/B (ISSUE 7) — durable
+    # restart after kill -9, overload shed, breaker isolation, lane
+    # kill; every gate asserted by the measurement itself
+    if "--chaos-serve" in args:
+        result, ok = measure_chaos_serve()
+        print(json.dumps(result))
+        if not ok:
+            return 1
+        if compare_path is not None:
+            return compare_reports(compare_path, result, compare_threshold)
+        return 0
+
     # --coldstart: the zero-cold-start A/B — subprocess-measured
     # first-fit / first-serve wall time, cold vs warm persistent cache
     # (bit-identity + prewarm gates asserted by the measurement itself)
@@ -1340,6 +1729,44 @@ def compare_reports(old_path: str, result: dict,
             file=sys.stderr,
         )
         return 0
+    if "pca_chaos_serve_recovery" in (old_metric, new_metric):
+        # chaos-serve records carry a recovery TIME (ms — lower is
+        # better) plus a shed rate; both surface in the verdict. The
+        # ratio check is old/new (faster recovery now => >1), but a
+        # regression additionally requires recovery to blow past a
+        # structural bound: recovery on the CPU rig is dominated by
+        # lease/backoff constants, so small-ms jitter must not flap CI.
+        r_old, r_new = old.get("recovery_ms"), result.get("recovery_ms")
+        if r_old is None or r_new is None:
+            print(
+                json.dumps({"compare": "skipped",
+                            "reason": "missing recovery_ms"}),
+                file=sys.stderr,
+            )
+            return 0
+        ratio = r_old / max(r_new, 1e-9)
+        structural_ms = float(
+            _os.environ.get("DET_CHAOS_RECOVERY_BOUND_MS") or 5000.0
+        )
+        verdict = {
+            "compare": old_path,
+            "recovery_ms_old": r_old,
+            "recovery_ms_new": r_new,
+            "shed_rate_old": old.get("shed_rate"),
+            "shed_rate_new": result.get("shed_rate"),
+            "normalized_ratio": round(ratio, 3),
+            "threshold": threshold,
+            "structural_bound_ms": structural_ms,
+            # the bench itself already failed on the hard gates
+            # (bit-exactness, sheds counted, breaker isolation); the
+            # compare catches recovery-time drift that still "works"
+            "regression": bool(
+                ratio < threshold and r_new > structural_ms
+            ),
+        }
+        print(json.dumps(verdict), file=sys.stderr)
+        return 1 if verdict["regression"] else 0
+
     if "coldstart_speedup" in old or "coldstart_speedup" in result:
         # coldstart records carry a dimensionless speedup (warm/cold of
         # the SAME session, so rig speed divides itself out — no anchor

@@ -58,6 +58,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="--mode serve: admission deadline for partial "
                    "micro-batches (PCAConfig.serve_flush_s; 0 = one "
                    "query per dispatch)")
+    p.add_argument("--registry-dir", default=None, metavar="DIR",
+                   help="durable eigenbasis registry root "
+                   "(PCAConfig.registry_dir): publishes commit to disk "
+                   "(tmp-file + atomic rename + checksummed meta.json "
+                   "marker) BEFORE becoming visible, and a restarted "
+                   "--mode serve recovers the committed latest and "
+                   "warm-serves it bit-exact with ZERO refit; torn "
+                   "snapshots are skipped loudly, checksum mismatches "
+                   "quarantined (docs/ROBUSTNESS.md 'Read-path "
+                   "resilience')")
+    p.add_argument("--serve-queue-depth", type=int, default=None,
+                   help="bounded admission for --mode serve "
+                   "(PCAConfig.serve_queue_depth): max un-resolved "
+                   "requests before reject-newest load shedding with a "
+                   "clean ServerOverloaded (unset = unbounded); with "
+                   "--slo-p99-ms also drops requests that blew the SLO "
+                   "before compute")
+    p.add_argument("--breaker-threshold", type=int, default=None,
+                   help="per-signature circuit breaker "
+                   "(PCAConfig.serve_breaker_threshold): consecutive "
+                   "dispatch failures before a signature fast-fails "
+                   "with BreakerOpen while other signatures keep "
+                   "serving; a half-open probe recovers it (unset = "
+                   "disabled)")
     p.add_argument("--broker", default=None,
                    help="ignored — no broker on a TPU mesh (kept for "
                    "reference CLI compatibility)")
@@ -984,12 +1008,26 @@ def _serve_cli(args, cfg, data, truth) -> int:
     from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
 
     tracer = _make_tracer(args)
-    est = OnlineDistributedPCA(cfg)
-    t0 = time.time()
-    est.fit(data, tracer=tracer)
-    fit_s = time.time() - t0
-    registry = EigenbasisRegistry(keep=cfg.serve_keep_versions)
-    version = registry.publish_fit(est, lineage={"producer": "cli"})
+    registry = EigenbasisRegistry(
+        keep=cfg.serve_keep_versions, registry_dir=cfg.registry_dir
+    )
+    live = registry.latest()
+    warm_restart = (
+        live is not None and live.signature == (cfg.dim, cfg.k)
+    )
+    est = None
+    fit_s = 0.0
+    if warm_restart:
+        # durable-registry restart: the committed latest serves
+        # bit-exact with ZERO refit — the crash-recovery contract
+        # (docs/ROBUSTNESS.md "Read-path resilience")
+        version = live
+    else:
+        est = OnlineDistributedPCA(cfg)
+        t0 = time.time()
+        est.fit(data, tracer=tracer)
+        fit_s = time.time() - t0
+        version = registry.publish_fit(est, lineage={"producer": "cli"})
 
     r = max(1, args.serve_rows)
     n_q = max(1, args.serve_queries)
@@ -1029,9 +1067,23 @@ def _serve_cli(args, cfg, data, truth) -> int:
         results = [t.result(timeout=600) for t in tickets]
     elapsed = time.time() - t0
 
-    # served projections must match the direct transform exactly
+    # served projections must match the direct transform exactly (the
+    # warm-restart path has no estimator — the recovered basis IS the
+    # direct reference, at the transform kernels' HIGHEST precision)
+    def direct(q):
+        if est is not None:
+            return np.asarray(est.transform(q))
+        import jax
+
+        return np.asarray(
+            jnp.matmul(
+                jnp.asarray(q, jnp.float32), jnp.asarray(version.v),
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        )
+
     max_err = max(
-        float(np.abs(res.z - np.asarray(est.transform(q))).max())
+        float(np.abs(res.z - direct(q)).max())
         for q, res in zip(queries, results)
     )
     summary = metrics.summary()
@@ -1039,6 +1091,22 @@ def _serve_cli(args, cfg, data, truth) -> int:
         "mode": "serve",
         "version": version.version,
         "signature": list(version.signature),
+        **(
+            {
+                "warm_restart": True,
+                "recovered_versions": registry.recovered_versions,
+                "refits": 0,
+            }
+            if warm_restart else {}
+        ),
+        **(
+            {"registry_torn_skipped": registry.torn_skipped}
+            if registry.torn_skipped else {}
+        ),
+        **(
+            {"registry_quarantined": registry.quarantined}
+            if registry.quarantined else {}
+        ),
         "queries": n_q,
         "rows_per_query": r,
         "includes_compile": True,
@@ -1262,6 +1330,9 @@ def main(argv=None) -> int:
         cfg = cfg.replace(
             serve_bucket_size=args.serve_bucket,
             serve_flush_s=args.serve_flush_s,
+            registry_dir=args.registry_dir,
+            serve_queue_depth=args.serve_queue_depth,
+            serve_breaker_threshold=args.breaker_threshold,
         )
         return _serve_cli(args, cfg, data, truth)
 

@@ -163,6 +163,35 @@ class PCAConfig:
       serve_keep_versions: how many published basis versions the
         ``serving/registry.py EigenbasisRegistry`` retains (append-only
         store, GC keeps the newest N; ``latest()`` never dangles).
+      registry_dir: durable root of the eigenbasis registry (CLI
+        ``--registry-dir``). When set, every ``publish()`` commits to
+        disk BEFORE the in-memory swap — payload via tmp-file + atomic
+        rename, then a ``meta.json`` commit marker carrying a sha256
+        checksum (the ``utils/checkpoint.py`` discipline) — and a
+        restarted process recovers every committed, checksum-valid
+        version bit-exact: warm serving with ZERO refit after a crash.
+        Torn snapshots (publisher killed mid-publish) are skipped
+        loudly; checksum mismatches are quarantined loudly. ``None``
+        (default) keeps the registry in-memory only (a restart refits).
+      serve_queue_depth: bounded admission for the serving tier (CLI
+        ``--serve-queue-depth``): the maximum un-resolved requests
+        (queued + dispatched) a ``QueryServer`` / ``FleetServer``
+        accepts. Excess submissions are LOAD-SHED reject-newest with a
+        clean ``ServerOverloaded`` — under an overload burst the queue
+        stays bounded and admitted requests keep their latency budget
+        instead of everyone's p99 growing without bound. With an SLO
+        declared (``serve_slo_p99_ms``), requests that already blew the
+        target while queued are additionally dropped before compute
+        (``DeadlineExceeded``). ``None`` (default) = unbounded
+        admission (the pre-ISSUE-7 behavior).
+      serve_breaker_threshold: per-signature circuit breaker (CLI
+        ``--breaker-threshold``): after this many CONSECUTIVE dispatch
+        failures for one admission signature, that signature fast-fails
+        new submissions with ``BreakerOpen`` (clear error naming the
+        signature, streak, and probe ETA) while every other signature
+        keeps serving; a half-open probe re-closes it on recovery
+        (docs/ROBUSTNESS.md "Read-path resilience"). ``None`` (default)
+        disables the breaker.
       serve_slo_p99_ms: declared p99 request-latency SLO for the query
         server, in milliseconds (CLI ``--slo-p99-ms``). When set,
         ``MetricsLogger.summary()["slo"]["serve"]`` reports
@@ -239,6 +268,9 @@ class PCAConfig:
     serve_bucket_size: int = 8
     serve_flush_s: float = 0.02
     serve_keep_versions: int = 4
+    registry_dir: str | None = None
+    serve_queue_depth: int | None = None
+    serve_breaker_threshold: int | None = None
     serve_slo_p99_ms: float | None = None
     fleet_slo_p99_ms: float | None = None
     metrics_retention: int = 4096
@@ -351,6 +383,23 @@ class PCAConfig:
                 f"serve_keep_versions must be an int >= 1, got "
                 f"{self.serve_keep_versions!r}"
             )
+        if self.registry_dir is not None and not isinstance(
+            self.registry_dir, str
+        ):
+            raise ValueError(
+                f"registry_dir must be a path string or None, got "
+                f"{self.registry_dir!r}"
+            )
+        for depth_field in ("serve_queue_depth", "serve_breaker_threshold"):
+            val = getattr(self, depth_field)
+            if val is not None and (
+                not isinstance(val, int) or isinstance(val, bool)
+                or val < 1
+            ):
+                raise ValueError(
+                    f"{depth_field} must be an int >= 1 or None, got "
+                    f"{val!r}"
+                )
         for slo_field in ("serve_slo_p99_ms", "fleet_slo_p99_ms"):
             slo = getattr(self, slo_field)
             if slo is not None and (

@@ -774,11 +774,20 @@ class FleetServer:
             or CompileCache(None)
         )
         self.prewarmer = None
+        # read-path resilience (ISSUE 7): per-bucket failure isolation
+        # (a poisoned signature fails ITS tickets, everyone else keeps
+        # serving), bounded admission + per-signature breakers from the
+        # shared serve-tier knobs
         self.queue = ShapeBucketQueue(
             bucket_size=cfg.fleet_bucket_size,
             flush_deadline=cfg.fleet_flush_s,
             max_retries=max_retries,
             lease_timeout=lease_timeout,
+            isolate_failures=True,
+            max_depth=getattr(cfg, "serve_queue_depth", None),
+            breaker_threshold=getattr(
+                cfg, "serve_breaker_threshold", None
+            ),
         )
         self._fit_cache: dict = {}
         self._thread = threading.Thread(
@@ -798,17 +807,42 @@ class FleetServer:
         for the tenant's ``(d, k)`` components)."""
         cfg = self.cfg if cfg is None else cfg
         sig = (fleet_signature(cfg), repr(cfg))
+        from distributed_eigenspaces_tpu.runtime.scheduler import (
+            QueueClosed,
+            QueueFull,
+        )
         from distributed_eigenspaces_tpu.utils.telemetry import tracer_of
 
         tr = tracer_of(self.metrics)
         tid = tr.new_trace("fleet")
         t0 = time.perf_counter()
-        ticket = self.queue.submit(
-            sig,
-            _FleetRequest(
-                cfg, problem, worker_masks, t_submit=t0, trace_id=tid
-            ),
-        )
+        try:
+            ticket = self.queue.submit(
+                sig,
+                _FleetRequest(
+                    cfg, problem, worker_masks, t_submit=t0, trace_id=tid
+                ),
+            )
+        except QueueClosed as e:
+            from distributed_eigenspaces_tpu.serving.server import (
+                ServerClosed,
+            )
+
+            raise ServerClosed(
+                "submit on a closed FleetServer (close() already ran; "
+                "in-flight buckets drained first) — construct a new "
+                "server to keep admitting fits"
+            ) from e
+        except QueueFull as e:
+            from distributed_eigenspaces_tpu.serving.server import (
+                ServerOverloaded,
+            )
+
+            raise ServerOverloaded(
+                f"fit request shed: {self.queue.inflight} requests "
+                f"already in flight >= serve_queue_depth "
+                f"{self.queue.max_depth} (reject-newest load shedding)"
+            ) from e
         tr.record_span(
             "admit", t0, time.perf_counter(), trace_id=tid,
             category="fleet", attrs={"signature": str(fleet_signature(cfg))},

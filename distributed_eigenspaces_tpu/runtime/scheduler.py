@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Any, Callable, Sequence
 
+from distributed_eigenspaces_tpu.utils.faults import KillSwitch
+
 
 @dataclasses.dataclass
 class TaskRecord:
@@ -47,10 +49,28 @@ class TaskRecord:
     done: bool = False
     result: Any = None
     last_exc: Exception | None = None
+    #: isolation mode only: this task exhausted its retries and was
+    #: failed ALONE (the queue kept serving everyone else)
+    failed: bool = False
 
 
 class SchedulerError(RuntimeError):
     pass
+
+
+class QueueClosed(SchedulerError):
+    """Admission after close(): the task would be unreachable to
+    already-exiting lanes. Server frontends (``serving/server.py
+    QueryServer``, ``parallel/fleet.py FleetServer``) translate this to
+    their documented ``ServerClosed`` error at the API boundary."""
+
+
+class QueueFull(SchedulerError):
+    """Bounded admission refused a new task: ``max_depth`` requests are
+    already in flight. The load-shedding signal — reject-NEWEST, so
+    requests already queued keep their latency budget instead of
+    everyone's p99 growing without bound. Server frontends translate
+    this to ``ServerOverloaded``."""
 
 
 class WorkQueue:
@@ -69,6 +89,7 @@ class WorkQueue:
         max_retries: int = 3,
         lease_timeout: float | None = None,
         open_ended: bool = False,
+        isolate_failures: bool = False,
     ):
         if order not in ("fifo", "lifo"):
             raise ValueError(f"unknown order: {order!r}")
@@ -87,6 +108,18 @@ class WorkQueue:
         self.order = order
         self.max_retries = max_retries
         self.lease_timeout = lease_timeout
+        # failure-isolation mode (the serving tier's choice): a task
+        # that exhausts its retries is failed ALONE — marked done with
+        # ``failed=True`` and reported through ``on_terminal`` — instead
+        # of poisoning the whole queue. The default (False) keeps the
+        # pre-existing fail-fast semantics: one terminal task aborts the
+        # run (the right call for a one-shot round, fatal for a server).
+        self.isolate_failures = isolate_failures
+        #: isolation-mode callback ``(record, exc)`` invoked under the
+        #: queue lock when a task terminally fails — must be cheap and
+        #: must not re-enter the queue (ShapeBucketQueue fails the
+        #: bucket's tickets here, which is a plain Event.set per ticket)
+        self.on_terminal: Callable[[TaskRecord, Exception], None] | None = None
         self._lock = threading.Condition()
         self._pending: list[int] = list(range(len(self.records)))
         # task_id -> (lease deadline, attempt number that holds the lease)
@@ -104,7 +137,7 @@ class WorkQueue:
         close() would be silently unreachable to already-exiting lanes."""
         with self._lock:
             if self._closed:
-                raise SchedulerError("add_task on a closed WorkQueue")
+                raise QueueClosed("add_task on a closed WorkQueue")
             rec = TaskRecord(task_id=len(self.records), payload=payload)
             self.records.append(rec)
             self._pending.append(rec.task_id)
@@ -172,9 +205,10 @@ class WorkQueue:
 
     def fail(
         self, task_id: int, exc: Exception, attempt: int | None = None
-    ) -> None:
+    ) -> bool:
         """Report a lane failure; the task is re-queued (at-least-once)
-        unless its retry budget is exhausted.
+        unless its retry budget is exhausted. Returns True when the
+        failure was TERMINAL for the task.
 
         ``attempt`` (from the :meth:`acquire` snapshot's ``attempts``)
         scopes the failure to this lane's lease: if the lease already
@@ -185,19 +219,36 @@ class WorkQueue:
             rec = self.records[task_id]
             lease = self._leases.get(task_id)
             if attempt is not None and lease is not None and lease[1] != attempt:
-                return  # stale: a newer attempt owns this task now
+                return False  # stale: a newer attempt owns this task now
             self._leases.pop(task_id, None)
             rec.last_exc = exc
             if rec.done:
-                return
+                return False
             if rec.attempts > self.max_retries:
-                self._failed = SchedulerError(
+                term = SchedulerError(
                     f"task {task_id} failed after {rec.attempts} attempts"
                 )
-                self._failed.__cause__ = exc
+                term.__cause__ = exc
+                if self.isolate_failures:
+                    self._terminal_locked(rec, term)
+                else:
+                    self._failed = term
+                self._lock.notify_all()
+                return True
             elif rec.task_id not in self._pending:
                 self._pending.append(rec.task_id)
             self._lock.notify_all()
+            return False
+
+    def _terminal_locked(self, rec: TaskRecord, exc: Exception) -> None:
+        """Isolation mode: retire ONE task as failed-done (the queue
+        keeps serving) and hand its waiters the cause via
+        ``on_terminal``."""
+        rec.done = True
+        rec.failed = True
+        rec.last_exc = exc
+        if self.on_terminal is not None:
+            self.on_terminal(rec, exc)
 
     # -- internals -----------------------------------------------------------
 
@@ -216,11 +267,15 @@ class WorkQueue:
             rec = self.records[tid]
             if not rec.done:
                 if rec.attempts > self.max_retries:
-                    self._failed = SchedulerError(
+                    term = SchedulerError(
                         f"task {tid} leased {rec.attempts} times with no "
                         f"result (lease_timeout={self.lease_timeout}s)"
                     )
-                    self._failed.__cause__ = rec.last_exc
+                    term.__cause__ = rec.last_exc
+                    if self.isolate_failures:
+                        self._terminal_locked(rec, term)
+                    else:
+                        self._failed = term
                 elif tid not in self._pending:
                     self._pending.append(tid)  # requeue: liveness recovery
 
@@ -260,6 +315,14 @@ class WorkQueue:
                     return
                 try:
                     out = worker_fn(rec.payload)
+                except KillSwitch as e:
+                    # hard lane death (chaos-harness SIGKILL semantics):
+                    # the lane dies WITHOUT failing its task — exactly
+                    # what a real killed thread does — so the task stays
+                    # leased and lease expiry re-queues it for the
+                    # supervisor-restarted lane (liveness, not loss)
+                    errors.append(e)
+                    return
                 except Exception as e:
                     self.fail(rec.task_id, e, attempt=rec.attempts)
                     continue
@@ -300,14 +363,26 @@ class FleetTicket:
         self._event = threading.Event()
         self._result: Any = None
         self._error: Exception | None = None
+        #: admission bookkeeping hook (set by ShapeBucketQueue when
+        #: bounded admission is on): fires exactly once, at the FIRST
+        #: resolve/fail, so the in-flight depth count stays honest even
+        #: when a rejected slot is later back-filled by the batch fold
+        self._on_done: Callable[["FleetTicket"], None] | None = None
+
+    def _done_once(self) -> None:
+        cb, self._on_done = self._on_done, None
+        if cb is not None:
+            cb(self)
 
     def resolve(self, result: Any) -> None:
         self._result = result
         self._event.set()
+        self._done_once()
 
     def fail(self, exc: Exception) -> None:
         self._error = exc
         self._event.set()
+        self._done_once()
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -370,6 +445,11 @@ class ShapeBucketQueue:
         lease_timeout: float | None = None,
         prefetch_depth: int = 5,
         start_timer: bool = True,
+        max_depth: int | None = None,
+        isolate_failures: bool = False,
+        breaker_threshold: int | None = None,
+        breaker_cooldown_s: float = 1.0,
+        on_event: Callable[[str, dict], None] | None = None,
     ):
         if bucket_size < 1:
             raise ValueError(f"bucket_size must be >= 1: {bucket_size}")
@@ -377,6 +457,8 @@ class ShapeBucketQueue:
             raise ValueError(
                 f"flush_deadline must be >= 0: {flush_deadline}"
             )
+        if max_depth is not None and max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1: {max_depth}")
         self.bucket_size = bucket_size
         self.flush_deadline = flush_deadline
         self.wq = WorkQueue(
@@ -386,7 +468,29 @@ class ShapeBucketQueue:
             max_retries=max_retries,
             lease_timeout=lease_timeout,
             open_ended=True,
+            isolate_failures=isolate_failures,
         )
+        if isolate_failures:
+            # a bucket that exhausts its retries fails ITS tickets and
+            # feeds its signature's breaker; the queue keeps serving
+            # every other bucket (the per-signature isolation the
+            # serving tier needs — the fail-fast default would abort
+            # the whole dispatch loop on one poisoned signature)
+            self.wq.on_terminal = self._bucket_terminal
+        #: bounded admission: max un-resolved tickets in the system
+        #: (queued + dispatched); None = unbounded (pre-existing
+        #: behavior). Excess submissions shed via QueueFull.
+        self.max_depth = max_depth
+        self._inflight = 0
+        #: load-shed counters by reason (the health report's feed)
+        self.sheds = {"overload": 0, "breaker": 0}
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
+        #: per-signature circuit breakers (lazy; only with a threshold)
+        self.breakers: dict[Any, Any] = {}
+        #: optional event sink ``(kind, detail)`` — shed / breaker
+        #: transitions, wired by the serving tier into MetricsLogger
+        self.on_event = on_event
         self._lock = threading.Condition()
         self._buckets: dict[Any, list[FleetTicket]] = {}
         self._deadlines: dict[Any, float] = {}
@@ -398,16 +502,115 @@ class ShapeBucketQueue:
             )
             self._timer.start()
 
+    # -- resilience plumbing -------------------------------------------------
+
+    @property
+    def inflight(self) -> int:
+        """Un-resolved tickets currently in the system (the bounded
+        admission's depth gauge)."""
+        with self._lock:
+            return self._inflight
+
+    def _ticket_done(self, _ticket) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            self._lock.notify_all()
+
+    def _emit(self, kind: str, detail: dict) -> None:
+        cb = self.on_event
+        if cb is not None:
+            try:
+                cb(kind, detail)
+            except Exception:
+                pass  # telemetry must never take down admission
+
+    def breaker_for(self, signature):
+        """The signature's breaker (created on first use), or None when
+        breakers are disabled."""
+        if self.breaker_threshold is None:
+            return None
+        with self._lock:
+            br = self.breakers.get(signature)
+            if br is None:
+                from distributed_eigenspaces_tpu.runtime.supervisor import (
+                    CircuitBreaker,
+                )
+
+                br = self.breakers[signature] = CircuitBreaker(
+                    threshold=self.breaker_threshold,
+                    cooldown_s=self.breaker_cooldown_s,
+                )
+            return br
+
+    def _bucket_terminal(self, rec: TaskRecord, exc: Exception) -> None:
+        """Isolation-mode terminal failure of ONE bucket: fail its
+        tickets with the cause (Event.set per ticket — safe under the
+        work-queue lock) so waiters unblock loudly while every other
+        signature keeps serving."""
+        bucket = rec.payload
+        if isinstance(bucket, Bucket):
+            for t in bucket.tickets:
+                if not t.done():
+                    t.fail(exc)
+
     # -- admission -----------------------------------------------------------
 
     def submit(self, signature: Any, payload: Any) -> FleetTicket:
         """Admit one request; returns its ticket. A full bucket
         dispatches immediately; ``flush_deadline == 0`` dispatches every
-        submission immediately (padded solo serving)."""
+        submission immediately (padded solo serving).
+
+        Resilience gates (both opt-in, both REJECT-NEWEST): a signature
+        whose circuit breaker is open fast-fails with
+        :class:`~..runtime.supervisor.BreakerOpen`; with ``max_depth``
+        set, admission past the depth sheds with :class:`QueueFull` —
+        the queue never grows without bound under an overload burst.
+        """
+        br = self.breaker_for(signature)
+        if br is not None and not br.allow():
+            with self._lock:
+                self.sheds["breaker"] += 1
+            self._emit("shed", {
+                "reason": "breaker", "signature": signature,
+                "breaker": br.snapshot(),
+            })
+            from distributed_eigenspaces_tpu.runtime.supervisor import (
+                BreakerOpen,
+            )
+
+            snap = br.snapshot()
+            raise BreakerOpen(
+                f"signature {signature!r} is fast-failing: its circuit "
+                f"breaker is {snap['state']} after "
+                f"{snap['consecutive_failures']} consecutive dispatch "
+                f"failures (threshold {br.threshold}; last error: "
+                f"{snap.get('last_error')}); other signatures keep "
+                "serving — a half-open probe retries in "
+                f"{snap.get('retry_in_s', 0.0)}s",
+                br,
+            )
         ticket = FleetTicket(signature, payload)
         with self._lock:
             if self._closed:
-                raise SchedulerError("submit on a closed ShapeBucketQueue")
+                raise QueueClosed("submit on a closed ShapeBucketQueue")
+            if (
+                self.max_depth is not None
+                and self._inflight >= self.max_depth
+            ):
+                self.sheds["overload"] += 1
+                depth = self._inflight
+                self._emit("shed", {
+                    "reason": "overload", "signature": signature,
+                    "inflight": depth, "max_depth": self.max_depth,
+                })
+                raise QueueFull(
+                    f"admission shed: {depth} requests already in "
+                    f"flight >= max_depth {self.max_depth} "
+                    "(reject-newest load shedding — retry with backoff)"
+                )
+            if self.max_depth is not None:
+                ticket._on_done = self._ticket_done
+                self._inflight += 1
             pending = self._buckets.setdefault(signature, [])
             if not pending:
                 self._deadlines[signature] = (
@@ -514,25 +717,70 @@ class ShapeBucketQueue:
             for ticket, res in zip(bucket.tickets, results):
                 ticket.resolve(res)
 
-        try:
-            self.wq.run(
-                lambda bucket: (bucket, fit_bucket(bucket)),
-                num_lanes=num_lanes,
-                on_result=fold,
-            )
-        finally:
-            # terminal scheduler failure (retries exhausted, poisoned
-            # fold): fail every unresolved ticket so waiters unblock
-            # with the cause instead of deadlocking on .result()
-            err = self.wq._failed or SchedulerError(
-                "fleet dispatch aborted"
-            )
+        def dispatch(bucket):
+            # breaker feedback rides the dispatch itself: every failed
+            # attempt feeds the signature's consecutive count (so a
+            # poisoned signature trips within one retry ladder), every
+            # success resets it. A KillSwitch is lane death, not a
+            # dispatch verdict — it bypasses the breaker.
+            br = self.breaker_for(bucket.signature)
+            try:
+                out = fit_bucket(bucket)
+            except KillSwitch:
+                raise
+            except Exception as e:
+                if br is not None and br.record_failure(e):
+                    self._emit("breaker", {
+                        "event": "open", "signature": bucket.signature,
+                        "breaker": br.snapshot(),
+                    })
+                raise
+            if br is not None and br.state != "closed":
+                self._emit("breaker", {
+                    "event": "closed", "signature": bucket.signature,
+                })
+            if br is not None:
+                br.record_success()
+            return bucket, out
+
+        def fail_unresolved(err, *, only_done_tasks=False):
             for rec in self.wq.records:
                 payload = rec.payload
+                if only_done_tasks and not rec.done:
+                    continue  # still leased/pending: a restarted lane
+                    # re-serves it (supervised lane recovery)
                 if isinstance(payload, Bucket):
                     for t in payload.tickets:
                         if not t.done():
                             t.fail(err)
+
+        try:
+            self.wq.run(
+                dispatch,
+                num_lanes=num_lanes,
+                on_result=fold,
+            )
+        except Exception as e:
+            if self.wq._failed is not None:
+                # terminal scheduler failure (fail-fast mode retries
+                # exhausted): every waiter unblocks with the cause
+                fail_unresolved(self.wq._failed)
+            else:
+                # lane death (KillSwitch) or a poisoned fold: fail only
+                # tickets whose task already COMPLETED (their results
+                # can never be folded again); in-flight buckets keep
+                # their tickets — a supervised re-entry of serve()
+                # re-leases and resolves them
+                fail_unresolved(e, only_done_tasks=True)
+            raise
+        else:
+            # normal drain (closed + everything executed): any ticket
+            # still unresolved belongs to an isolation-mode terminal
+            # task whose on_terminal already failed it — the sweep is a
+            # belt-and-braces guard against hung waiters
+            fail_unresolved(
+                self.wq._failed or SchedulerError("fleet dispatch aborted")
+            )
 
 
 def run_dynamic_round(

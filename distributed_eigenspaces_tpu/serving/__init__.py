@@ -22,19 +22,31 @@ closed end-to-end: ingest → fit → publish → serve → drift → refit.
 from distributed_eigenspaces_tpu.serving.registry import (
     BasisVersion,
     EigenbasisRegistry,
+    VersionRetired,
 )
 from distributed_eigenspaces_tpu.serving.transform import (
     TransformEngine,
     bucket_rows,
 )
-from distributed_eigenspaces_tpu.serving.server import QueryServer
+from distributed_eigenspaces_tpu.serving.server import (
+    BreakerOpen,
+    DeadlineExceeded,
+    QueryServer,
+    ServerClosed,
+    ServerOverloaded,
+)
 from distributed_eigenspaces_tpu.serving.drift import DriftMonitor
 
 __all__ = [
     "BasisVersion",
-    "EigenbasisRegistry",
-    "TransformEngine",
-    "bucket_rows",
-    "QueryServer",
+    "BreakerOpen",
+    "DeadlineExceeded",
     "DriftMonitor",
+    "EigenbasisRegistry",
+    "QueryServer",
+    "ServerClosed",
+    "ServerOverloaded",
+    "TransformEngine",
+    "VersionRetired",
+    "bucket_rows",
 ]

@@ -194,9 +194,30 @@ class DriftMonitor:
     def _spawn_refresh(self) -> None:
         if self._refresh_lock.locked():
             return  # one background refresh in flight at a time
-        t = threading.Thread(target=self.refresh_now, daemon=True)
+        t = threading.Thread(target=self._refresh_guarded, daemon=True)
         self._refresh_thread = t
         t.start()
+
+    def _refresh_guarded(self) -> None:
+        """Background-thread wrapper: a refresh that dies (refit
+        failure past the supervisor's budget, a durable-registry IO
+        error on publish) must land in the telemetry stream, not
+        vanish with a daemon thread (ISSUE 7 — no silent lane
+        deaths anywhere on the read path). Serving continues on the
+        stale version either way; the next armed batch retries."""
+        try:
+            self.refresh_now()
+        except Exception as e:
+            from distributed_eigenspaces_tpu.utils.metrics import (
+                log_line,
+            )
+
+            log_line("drift refresh failed", error=repr(e))
+            if self.metrics is not None:
+                self.metrics.serve({
+                    "kind": "drift", "error": repr(e),
+                    "published": None,
+                })
 
     def join_refresh(self, timeout: float | None = None) -> None:
         """Wait for an in-flight background refresh (tests / shutdown)."""

@@ -16,17 +16,47 @@ version records which trainer/checkpoint/fit made it, its step count,
 and an explained-variance summary — the registry is the system of
 record connecting the fit fleet's write side to the query tier's read
 side.
+
+**Durability (ISSUE 7).** With ``registry_dir`` set the registry gains a
+disk tier: every accepted publish lands as one per-version directory
+(``v00000042/``) holding the payload (``basis.npz`` — the frozen arrays,
+written tmp-file + atomic-rename) and a ``meta.json`` commit marker
+(signature, step, lineage, and a sha256 checksum of the payload bytes —
+the ``utils/checkpoint.py`` discipline: a crash at ANY point leaves
+either a fully committed version or no marker at all, never a committed
+half-write). A restarted process constructing
+``EigenbasisRegistry(registry_dir=...)`` recovers by scanning the store:
+committed, checksum-valid versions load bit-exact (np.savez float32
+round-trips exactly, so a warm-restarted server's transforms equal the
+pre-crash ones bit for bit — zero refit); a TORN snapshot (payload, no
+marker — a publisher killed mid-publish) is skipped loudly and removed;
+a checksum-MISMATCHED version (tampering, disk rot) is quarantined
+loudly (renamed ``*.quarantined``, evidence preserved) and never served.
+GC applies to the disk tier too: the newest ``keep`` versions survive.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import os
+import re
+import shutil
 import threading
 from typing import Any, Mapping
 
 import numpy as np
 
-__all__ = ["BasisVersion", "EigenbasisRegistry"]
+__all__ = ["BasisVersion", "EigenbasisRegistry", "VersionRetired"]
+
+_VERSION_DIR_RE = re.compile(r"^v(\d{8})$")
+
+
+class VersionRetired(KeyError):
+    """A version id outside the registry's retention window (GC'd, or
+    never published). A KeyError subclass so pre-existing callers keep
+    working, but the message names the knob that widens the window."""
 
 
 def _frozen_array(a, dtype=np.float32) -> np.ndarray:
@@ -81,16 +111,186 @@ class EigenbasisRegistry:
     assigns the next id and the ``latest`` pointer inside it, and GCs
     down to the newest ``keep`` versions. ``latest()`` is a plain
     attribute read — never blocked by a publisher, never a torn value.
+
+    ``registry_dir`` adds the crash-safe disk tier (module docstring):
+    publish commits to disk BEFORE the in-memory swap (a publish the
+    disk rejected is a loud error, not a version that would vanish on
+    restart), and construction recovers every committed, checksum-valid
+    version — ``recovered_versions`` / ``torn_skipped`` /
+    ``quarantined`` report what the scan found.
     """
 
-    def __init__(self, *, keep: int = 4):
+    def __init__(self, *, keep: int = 4, registry_dir: str | None = None,
+                 metrics=None):
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
         self.keep = keep
+        self.registry_dir = registry_dir
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._versions: dict[int, BasisVersion] = {}
         self._latest: BasisVersion | None = None
         self._next_id = 1
+        #: recovery report (populated when ``registry_dir`` is set):
+        #: version ids loaded from disk, torn snapshot dirs removed,
+        #: and quarantined (checksum-mismatch) dir names
+        self.recovered_versions: list[int] = []
+        self.torn_skipped: list[str] = []
+        self.quarantined: list[str] = []
+        if registry_dir is not None:
+            os.makedirs(registry_dir, exist_ok=True)
+            self._recover()
+
+    # -- disk tier -----------------------------------------------------------
+
+    def _version_dir(self, version: int) -> str:
+        return os.path.join(self.registry_dir, f"v{version:08d}")
+
+    @staticmethod
+    def _payload_checksum(payload_path: str) -> str:
+        h = hashlib.sha256()
+        with open(payload_path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    def _write_payload(self, vdir: str, bv: BasisVersion) -> str:
+        """The version's arrays via tmp + atomic rename; returns the
+        committed payload's checksum."""
+        os.makedirs(vdir, exist_ok=True)
+        arrays = {"v": bv.v}
+        if bv.sigma_tilde is not None:
+            arrays["sigma_tilde"] = bv.sigma_tilde
+        tmp = os.path.join(vdir, "basis.tmp.npz")
+        np.savez(tmp, **arrays)
+        final = os.path.join(vdir, "basis.npz")
+        os.replace(tmp, final)
+        return self._payload_checksum(final)
+
+    def _write_meta(self, vdir: str, bv: BasisVersion,
+                    checksum: str) -> None:
+        """The commit marker (tmp + atomic rename): a version without
+        it is torn and recovery treats the publish as never having
+        happened — exactly the ``utils/checkpoint.py`` contract."""
+        meta = {
+            "format_version": 1,
+            "version": bv.version,
+            "signature": list(bv.signature),
+            "step": bv.step,
+            "explained_variance": bv.explained_variance,
+            # tuples JSON-round-trip as lists; lineage consumers treat
+            # it as data, not identity, so that is acceptable loss
+            "lineage": json.loads(
+                json.dumps(bv.lineage, default=str)
+            ),
+            "checksum": checksum,
+        }
+        tmp = os.path.join(vdir, "meta.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=2)
+        os.replace(tmp, os.path.join(vdir, "meta.json"))
+
+    def _persist(self, bv: BasisVersion) -> None:
+        vdir = self._version_dir(bv.version)
+        checksum = self._write_payload(vdir, bv)
+        self._write_meta(vdir, bv, checksum)
+
+    def _delete_version_dir(self, version: int) -> None:
+        shutil.rmtree(self._version_dir(version), ignore_errors=True)
+
+    def _log(self, msg: str, **fields) -> None:
+        from distributed_eigenspaces_tpu.utils.metrics import log_line
+
+        log_line(msg, **fields)
+        if self.metrics is not None:
+            self.metrics.serve({"kind": "registry", "event": msg, **fields})
+
+    def _recover(self) -> None:
+        """Scan the store: load committed, checksum-valid versions
+        (newest ``keep``), remove torn snapshots loudly, quarantine
+        checksum mismatches loudly. ``_next_id`` advances past EVERY id
+        seen on disk — a quarantined id is never reused."""
+        entries = []
+        max_seen = 0
+        for name in sorted(os.listdir(self.registry_dir)):
+            m = _VERSION_DIR_RE.match(name)
+            if not m:
+                continue
+            version = int(m.group(1))
+            max_seen = max(max_seen, version)
+            path = os.path.join(self.registry_dir, name)
+            meta_path = os.path.join(path, "meta.json")
+            if not os.path.exists(meta_path):
+                # torn: a publisher died between payload and marker —
+                # the publish never happened; clear the debris
+                self.torn_skipped.append(name)
+                self._log(
+                    "registry recovery: torn snapshot skipped",
+                    version=version, path=path,
+                )
+                shutil.rmtree(path, ignore_errors=True)
+                continue
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                payload = os.path.join(path, "basis.npz")
+                checksum = self._payload_checksum(payload)
+                if checksum != meta.get("checksum"):
+                    raise ValueError(
+                        f"checksum mismatch: payload {checksum[:12]}... "
+                        f"!= committed {str(meta.get('checksum'))[:12]}..."
+                    )
+                with np.load(payload) as z:
+                    v = _frozen_array(z["v"])
+                    st = (
+                        _frozen_array(z["sigma_tilde"])
+                        if "sigma_tilde" in z.files else None
+                    )
+                sig = tuple(meta["signature"])
+                if v.shape != sig:
+                    raise ValueError(
+                        f"payload shape {v.shape} != committed "
+                        f"signature {sig}"
+                    )
+                bv = BasisVersion(
+                    version=version,
+                    v=v,
+                    sigma_tilde=st,
+                    signature=(int(sig[0]), int(sig[1])),
+                    step=int(meta.get("step", 0)),
+                    explained_variance=dict(
+                        meta.get("explained_variance") or {}
+                    ),
+                    lineage=dict(meta.get("lineage") or {}),
+                )
+            except Exception as e:
+                # corrupt-but-committed (tamper, rot, truncation):
+                # quarantine — never serve it, never silently delete
+                # the evidence
+                qpath = path + ".quarantined"
+                shutil.rmtree(qpath, ignore_errors=True)
+                os.replace(path, qpath)
+                self.quarantined.append(os.path.basename(qpath))
+                self._log(
+                    "registry recovery: corrupt version quarantined",
+                    version=version, path=qpath, error=repr(e),
+                )
+                continue
+            entries.append(bv)
+        entries.sort(key=lambda b: b.version)
+        for bv in entries[:-self.keep] if len(entries) > self.keep else []:
+            self._delete_version_dir(bv.version)
+        entries = entries[-self.keep:]
+        self._versions = {bv.version: bv for bv in entries}
+        self._latest = entries[-1] if entries else None
+        self._next_id = max_seen + 1
+        self.recovered_versions = [bv.version for bv in entries]
+        if entries:
+            self._log(
+                "registry recovery: warm store loaded",
+                versions=self.recovered_versions,
+                latest=self._latest.version,
+            )
 
     # -- write side ----------------------------------------------------------
 
@@ -148,12 +348,26 @@ class EigenbasisRegistry:
         with self._lock:
             bv = BasisVersion(version=self._next_id, **bv_partial)
             self._next_id += 1
+        if self.registry_dir is not None:
+            # durable FIRST: commit to disk before the in-memory swap,
+            # so a version readers can observe is always a version a
+            # restart recovers (an IO failure raises here and the
+            # registry is untouched — the id gap is harmless)
+            self._persist(bv)
+        gc_ids: list[int] = []
+        with self._lock:
             self._versions[bv.version] = bv
             # single reference assignment = the atomic hot-swap point
-            self._latest = bv
+            # (guarded so racing publishers can't move latest backwards)
+            if self._latest is None or bv.version > self._latest.version:
+                self._latest = bv
             while len(self._versions) > self.keep:
                 oldest = min(self._versions)
                 del self._versions[oldest]
+                gc_ids.append(oldest)
+        if self.registry_dir is not None:
+            for vid in gc_ids:  # disk GC mirrors memory GC (best effort)
+                self._delete_version_dir(vid)
         return bv
 
     def publish_fit(self, estimator, *, lineage: Mapping[str, Any] | None = None,
@@ -223,9 +437,22 @@ class EigenbasisRegistry:
         return self._latest
 
     def get(self, version: int) -> BasisVersion:
-        """A retained version by id; KeyError once GC'd."""
+        """A retained version by id. A GC'd (or never-published) id
+        raises :class:`VersionRetired` — a KeyError that NAMES the
+        retention window and the knob that widens it, instead of a bare
+        integer a 3am page can't act on."""
         with self._lock:
-            return self._versions[version]
+            try:
+                return self._versions[version]
+            except KeyError:
+                retained = sorted(self._versions)
+                raise VersionRetired(
+                    f"version {version} is not retained: the registry "
+                    f"keeps the newest {self.keep} versions "
+                    f"(cfg.serve_keep_versions={self.keep}; currently "
+                    f"retained: {retained}) — raise serve_keep_versions "
+                    "to widen the retention window"
+                ) from None
 
     def versions(self) -> list[int]:
         """Retained version ids, oldest first."""

@@ -25,6 +25,31 @@ Correctness properties (each pinned by tests):
   ITS ticket (with the offending row indices) and is excluded from the
   batch; its neighbors' projections are untouched — the exact dual of
   the fleet's per-tenant quarantine.
+
+Read-path resilience (ISSUE 7, docs/ROBUSTNESS.md "Read-path
+resilience"):
+
+- **Supervised serve lane.** The dispatch loop runs under a
+  ``runtime/supervisor.LaneWatchdog``: a lane death (an exception
+  escaping the serve loop — the chaos harness injects
+  ``utils.faults.KillSwitch``) restarts the lane with capped backoff,
+  the killed lane's leased bucket is re-leased by lease timeout, and
+  its tickets still resolve. Exhausting the restart budget closes
+  admission and fails pending waiters LOUDLY instead of hanging them.
+- **Bounded admission + load shedding.** ``cfg.serve_queue_depth``
+  bounds un-resolved requests; excess submissions shed reject-newest
+  with a clean :class:`ServerOverloaded`. With an SLO declared
+  (``cfg.serve_slo_p99_ms``) AND shedding enabled, a request that
+  already blew the SLO while queued is dropped before compute
+  (:class:`DeadlineExceeded`) — its device time would be pure waste.
+- **Per-signature circuit breaker.** ``cfg.serve_breaker_threshold``
+  consecutive dispatch failures trip the admission signature's breaker:
+  new submissions fast-fail with ``BreakerOpen`` (naming the signature,
+  the failure streak, and the half-open probe ETA) while other
+  signatures keep serving; a half-open probe closes it on recovery.
+- Every shed / breaker transition / lane restart is evented through the
+  Tracer + MetricsLogger, and ``summary()["serving"]["health"]``
+  reports sheds, breaker states, lane restarts, and recovery time.
 """
 
 from __future__ import annotations
@@ -37,11 +62,48 @@ from typing import Any
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_eigenspaces_tpu.runtime.scheduler import ShapeBucketQueue
+from distributed_eigenspaces_tpu.runtime.scheduler import (
+    QueueClosed,
+    QueueFull,
+    ShapeBucketQueue,
+)
+from distributed_eigenspaces_tpu.runtime.supervisor import (
+    BreakerOpen,
+    FaultLedger,
+    LaneWatchdog,
+)
 from distributed_eigenspaces_tpu.serving.registry import EigenbasisRegistry
 from distributed_eigenspaces_tpu.serving.transform import TransformEngine
 
-__all__ = ["QueryServer", "ServedProjection"]
+__all__ = [
+    "BreakerOpen",
+    "DeadlineExceeded",
+    "QueryServer",
+    "ServedProjection",
+    "ServerClosed",
+    "ServerOverloaded",
+]
+
+
+class ServerClosed(RuntimeError):
+    """submit() after close(): the documented server-boundary error
+    (instead of a raw SchedulerError escaping from three layers down —
+    ISSUE 7 satellite). The request was never admitted; construct a new
+    server (or route to a live replica) to keep serving."""
+
+
+class ServerOverloaded(RuntimeError):
+    """Load shed: bounded admission (``cfg.serve_queue_depth``) refused
+    the NEWEST request so already-admitted requests keep their latency
+    budget. Clean and immediate — the client should back off and retry;
+    the queue never grows without bound."""
+
+
+class DeadlineExceeded(ServerOverloaded):
+    """Deadline-aware shed: the request waited past the declared SLO
+    (``cfg.serve_slo_p99_ms``) before its bucket dispatched — serving
+    it now would burn device time on an answer the caller has already
+    given up on. Dropped before compute, counted as a shed."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,6 +160,12 @@ class QueryServer:
         compile_cache=None,
         prewarm=False,
         prewarmer=None,
+        queue_depth: int | None = None,
+        breaker_threshold: int | None = None,
+        breaker_cooldown_s: float = 1.0,
+        supervise: bool = True,
+        max_lane_restarts: int = 3,
+        fault_hook=None,
     ):
         live = registry.latest()
         if d is None:
@@ -167,38 +235,196 @@ class QueryServer:
         #: how many hot-swaps dispatch has observed
         self.swap_count = 0
         self._served_version: int | None = None
+        # -- read-path resilience wiring (ISSUE 7) ---------------------------
+        if queue_depth is None and cfg is not None:
+            queue_depth = getattr(cfg, "serve_queue_depth", None)
+        if breaker_threshold is None and cfg is not None:
+            breaker_threshold = getattr(
+                cfg, "serve_breaker_threshold", None
+            )
+        self.queue_depth = queue_depth
+        self._slo_ms = (
+            metrics.slo_p99_ms if metrics is not None else (
+                getattr(cfg, "serve_slo_p99_ms", None)
+                if cfg is not None else None
+            )
+        )
+        #: chaos-injection point (``utils.faults.ServeChaosHook``):
+        #: called with the bucket at the top of every dispatch; a
+        #: KillSwitch here is a lane death, anything else a dispatch
+        #: failure (breaker food). None in production.
+        self.fault_hook = fault_hook
+        #: fault ledger (PR 1's form): lane restarts/deaths + sheds
+        self.ledger = FaultLedger()
+        self._sheds = {"overload": 0, "deadline": 0, "breaker": 0}
+        self._last_lane_death: float | None = None
+        self.last_recovery_ms: float | None = None
+        self._closed = False
+        if supervise and lease_timeout is None:
+            # liveness default: a bucket leased to a killed lane must
+            # re-lease for the restarted lane — an infinite lease would
+            # hang its waiters forever (the reference's exact bug)
+            lease_timeout = 60.0
         self.queue = ShapeBucketQueue(
             bucket_size=bucket_size,
             flush_deadline=flush_s,
             max_retries=max_retries,
             lease_timeout=lease_timeout,
+            max_depth=queue_depth,
+            isolate_failures=supervise,
+            breaker_threshold=breaker_threshold,
+            breaker_cooldown_s=breaker_cooldown_s,
+            on_event=self._queue_event,
         )
         self._num_lanes = max(num_lanes, 1)
-        self._thread = threading.Thread(
-            target=self._serve_loop, daemon=True
-        )
-        self._thread.start()
+        self._watchdog: LaneWatchdog | None = None
+        if supervise:
+            self._watchdog = LaneWatchdog(
+                "query-serve",
+                self._serve_loop,
+                max_restarts=max_lane_restarts,
+                ledger=self.ledger,
+                on_restart=self._lane_restarted,
+                on_dead=self._lane_dead,
+            ).start()
+            self._thread = self._watchdog._thread
+        else:
+            self._thread = threading.Thread(
+                target=self._serve_loop_logged, daemon=True
+            )
+            self._thread.start()
+        if metrics is not None:
+            # summary()["serving"]["health"] reads the live state
+            metrics.attach_serve_health(self.health)
 
     def _serve_loop(self) -> None:
+        """One supervised serve-lane entry: exceptions propagate to the
+        watchdog (lane death → restart), a clean return is the closed
+        queue draining."""
+        self.queue.serve(self._run_batch, num_lanes=self._num_lanes)
+
+    def _serve_loop_logged(self) -> None:
         try:
-            self.queue.serve(self._run_batch, num_lanes=self._num_lanes)
+            self._serve_loop()
         except Exception as e:
-            # terminal dispatch failure (retries exhausted): every
-            # unresolved ticket was already failed with the cause by
-            # ShapeBucketQueue.serve — waiters see it; the lane thread
-            # logs instead of dying through the unhandled-thread hook
+            # unsupervised mode (supervise=False): keep the pre-ISSUE-7
+            # behavior — log instead of dying through the
+            # unhandled-thread hook; tickets were failed by the queue
             from distributed_eigenspaces_tpu.utils.metrics import (
                 log_line,
             )
 
             log_line("query server dispatch aborted", error=repr(e))
 
+    # -- resilience event plumbing -------------------------------------------
+
+    def _tracer(self):
+        from distributed_eigenspaces_tpu.utils.telemetry import tracer_of
+
+        return tracer_of(self.metrics)
+
+    def _queue_event(self, kind: str, detail: dict) -> None:
+        """Shed / breaker transitions from the admission queue →
+        ledger + MetricsLogger + Tracer (one merged timeline)."""
+        if kind == "shed":
+            reason = detail.get("reason", "overload")
+            self._sheds[reason] = self._sheds.get(reason, 0) + 1
+        self.ledger.record(kind, None, **{
+            k: v for k, v in detail.items()
+            if isinstance(v, (int, float, str, bool))
+        })
+        self._tracer().event(
+            f"serve_{kind}", category="serve",
+            attrs={
+                k: v for k, v in detail.items()
+                if isinstance(v, (int, float, str, bool))
+            },
+        )
+        if self.metrics is not None:
+            self.metrics.serve({
+                "kind": kind,
+                "signature": [self.d, self.k],
+                **{
+                    k: v for k, v in detail.items()
+                    if k != "signature"
+                },
+            })
+
+    def _lane_restarted(self, event: dict) -> None:
+        self._last_lane_death = time.perf_counter()
+        self._tracer().event(
+            "serve_lane_restart", category="fault",
+            attrs={"attempt": event.get("attempt"),
+                   "error": event.get("error")},
+        )
+        if self.metrics is not None:
+            self.metrics.serve({
+                "kind": "lane", "event": "restart",
+                "attempt": event.get("attempt"),
+                "error": event.get("error"),
+                "backoff_s": event.get("backoff_s"),
+            })
+
+    def _lane_dead(self, exc: Exception) -> None:
+        """Restart budget exhausted: close admission and fail pending
+        waiters loudly — a dead server that still accepts submissions
+        would hang every new caller."""
+        err = ServerClosed(
+            f"query server serve lane is dead after "
+            f"{self._watchdog.restarts} restarts (last error: "
+            f"{exc!r}); pending requests failed, admission closed"
+        )
+        err.__cause__ = exc
+        if self.metrics is not None:
+            self.metrics.serve({
+                "kind": "lane", "event": "dead", "error": repr(exc),
+                "restarts": self._watchdog.restarts,
+            })
+        self._closed = True
+        try:
+            self.queue.close()
+        finally:
+            for rec in self.queue.wq.records:
+                payload = rec.payload
+                if hasattr(payload, "tickets"):
+                    for t in payload.tickets:
+                        if not t.done():
+                            t.fail(err)
+
+    def health(self) -> dict:
+        """Live resilience state — surfaced as
+        ``summary()["serving"]["health"]`` via the attached
+        MetricsLogger: sheds by reason, per-signature breaker
+        snapshots, lane restarts, last recovery time."""
+        out: dict = {
+            "sheds": dict(self._sheds),
+            "shed_count": sum(self._sheds.values()),
+            "inflight": self.queue.inflight,
+            "lane_alive": self._thread.is_alive(),
+        }
+        if self.queue_depth is not None:
+            out["queue_depth"] = self.queue_depth
+        if self.queue.breakers:
+            out["breakers"] = {
+                str(sig): br.snapshot()
+                for sig, br in self.queue.breakers.items()
+            }
+        if self._watchdog is not None:
+            out["lane_restarts"] = self._watchdog.restarts
+            out["lane_dead"] = self._watchdog.dead
+        if self.last_recovery_ms is not None:
+            out["last_recovery_ms"] = round(self.last_recovery_ms, 3)
+        return out
+
     # -- client API ----------------------------------------------------------
 
     def submit(self, x):
         """Admit one query; returns its ticket. Width is validated HERE
         (a malformed request must fail its caller at the door, not a
-        batch three layers down)."""
+        batch three layers down). Admission failures are the documented
+        server-boundary errors: :class:`ServerClosed` after
+        ``close()``, :class:`ServerOverloaded` when bounded admission
+        sheds, ``BreakerOpen`` when this signature is fast-failing."""
         arr = np.asarray(x, np.float32)
         if arr.ndim == 1:
             arr = arr[None, :]
@@ -214,10 +440,23 @@ class QueryServer:
         tr = tracer_of(self.metrics)
         tid = tr.new_trace("query")
         t0 = time.perf_counter()
-        ticket = self.queue.submit(
-            (self.d, self.k),
-            _QueryRequest(x=arr, t_submit=t0, trace_id=tid),
-        )
+        try:
+            ticket = self.queue.submit(
+                (self.d, self.k),
+                _QueryRequest(x=arr, t_submit=t0, trace_id=tid),
+            )
+        except QueueClosed as e:
+            raise ServerClosed(
+                "submit on a closed QueryServer (close() already ran; "
+                "in-flight requests drained first) — construct a new "
+                "server, or route to a live replica"
+            ) from e
+        except QueueFull as e:
+            raise ServerOverloaded(
+                f"query shed: {self.queue.inflight} requests already "
+                f"in flight >= serve_queue_depth {self.queue_depth} "
+                "(reject-newest load shedding; back off and retry)"
+            ) from e
         tr.record_span(
             "admit", t0, time.perf_counter(), trace_id=tid,
             category="serve", attrs={"rows": int(arr.shape[0])},
@@ -234,7 +473,12 @@ class QueryServer:
         return self.prewarmer.wait(timeout)
 
     def close(self) -> None:
-        """Flush partial micro-batches, drain, join dispatch lanes."""
+        """Flush partial micro-batches, drain, join dispatch lanes.
+        Marks the shutdown intentional FIRST, so a lane exiting during
+        close is a clean drain, never a restartable death."""
+        self._closed = True
+        if self._watchdog is not None:
+            self._watchdog.close()
         self.queue.close()
         self._thread.join()
 
@@ -267,7 +511,26 @@ class QueryServer:
         tr = tracer_of(self.metrics)
         if self.engine.tracer is None and tr is not NULL_TRACER:
             self.engine.tracer = tr
+        if self.fault_hook is not None:
+            # chaos-injection point: KillSwitch = lane death (watchdog
+            # restarts, lease re-queues the bucket), anything else = a
+            # dispatch failure (retry ladder + breaker food)
+            self.fault_hook(bucket)
         t0 = time.perf_counter()
+        if self._last_lane_death is not None:
+            # first dispatch after a lane restart: the measured
+            # recovery time (death -> served again), health-reported
+            self.last_recovery_ms = (t0 - self._last_lane_death) * 1e3
+            self._last_lane_death = None
+            self.ledger.record(
+                "lane_recovered", None,
+                recovery_ms=round(self.last_recovery_ms, 3),
+            )
+            if self.metrics is not None:
+                self.metrics.serve({
+                    "kind": "lane", "event": "recovered",
+                    "recovery_ms": round(self.last_recovery_ms, 3),
+                })
         # first-signature compile stall, counted instead of silently
         # folded into request latency: any program this batch has to
         # BUILD (engine-local miss — a fresh XLA compile, or a cheap
@@ -296,11 +559,46 @@ class QueryServer:
             self.swap_count += 1
         self._served_version = ver.version
 
+        # deadline-aware load shedding (active when bounded admission
+        # AND an SLO are declared): a request that already waited past
+        # the declared p99 target is dropped BEFORE compute — its
+        # device time would be spent on an answer the caller has
+        # already written off, at the expense of requests still inside
+        # their budget
+        dropped: dict[int, Exception] = {}
+        if self.queue_depth is not None and self._slo_ms is not None:
+            for i, req in enumerate(reqs):
+                waited_ms = (t0 - req.t_submit) * 1e3
+                if waited_ms > self._slo_ms:
+                    dropped[i] = DeadlineExceeded(
+                        f"request shed before compute: queued "
+                        f"{waited_ms:.1f} ms > declared SLO "
+                        f"{self._slo_ms} ms (cfg.serve_slo_p99_ms)"
+                    )
+            if dropped:
+                self._sheds["deadline"] += len(dropped)
+                for i, exc in dropped.items():
+                    bucket.tickets[i].fail(exc)
+                    tr.event(
+                        "serve_shed", trace_id=reqs[i].trace_id,
+                        category="serve",
+                        attrs={"reason": "deadline"},
+                    )
+                if self.metrics is not None:
+                    self.metrics.serve({
+                        "kind": "shed", "reason": "deadline",
+                        "dropped": len(dropped),
+                        "signature": [self.d, self.k],
+                    })
+
         # per-request quarantine: a non-finite query fails ITS ticket
         # and leaves the batch; everyone else is served normally
         good: list[int] = []
         fails: dict[int, Exception] = {}
         for i, req in enumerate(reqs):
+            if i in dropped:
+                fails[i] = dropped[i]  # already failed; skip compute
+                continue
             finite = np.isfinite(req.x).all(axis=1)
             if finite.all():
                 good.append(i)

@@ -263,21 +263,36 @@ class TransformEngine:
             x = jnp.zeros((padded, width), self.dtype).at[:rows].set(x)
         return x, rows
 
+    def _check_basis(self, v):
+        """Loud signature check at the kernel boundary (ISSUE 7): a
+        mis-shaped basis would otherwise surface as an XLA shape error
+        deep inside a dispatch lane — breaker food with a post-mortem
+        that starts three layers too low."""
+        v = jnp.asarray(v, jnp.float32)
+        if tuple(v.shape) != (self.d, self.k):
+            raise ValueError(
+                f"basis shape {tuple(v.shape)} does not match this "
+                f"engine's signature ({self.d}, {self.k})"
+            )
+        return v
+
     def project(self, x, v) -> jax.Array:
         """``(n, d) -> (n, k)`` against basis ``v`` — pad, dispatch the
         bucket program, slice. Numerically the direct ``x @ V`` (same
         precision), bit-for-bit regardless of padding."""
+        v = self._check_basis(v)
         x_pad, rows = self._pad(x, self.d)
         z = self._compiled("project", int(x_pad.shape[0]))(
-            x_pad, jnp.asarray(v, jnp.float32)
+            x_pad, v
         )
         return z[:rows]
 
     def reconstruct(self, z, v) -> jax.Array:
         """``(n, k) -> (n, d)`` back-projection against basis ``v``."""
+        v = self._check_basis(v)
         z_pad, rows = self._pad(z, self.k)
         x = self._compiled("reconstruct", int(z_pad.shape[0]))(
-            z_pad, jnp.asarray(v, jnp.float32)
+            z_pad, v
         )
         return x[:rows]
 

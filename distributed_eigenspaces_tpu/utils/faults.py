@@ -107,6 +107,78 @@ class ChaosPlan:
     kill_at: int | None = None
 
 
+@dataclasses.dataclass
+class ServeChaosPlan:
+    """Deterministic fault schedule for the SERVE tier (ISSUE 7 — the
+    read-path dual of :class:`ChaosPlan`), consumed by
+    :class:`ServeChaosHook` wired into ``QueryServer(fault_hook=...)``.
+
+    ``kill_lane_at_batch``: the Nth dispatched bucket raises
+    :class:`KillSwitch` — a hard serve-lane death (the lane thread
+    exits without failing its bucket, exactly like a killed thread; the
+    watchdog restarts the lane and lease expiry re-queues the bucket).
+    Fires ONCE, so the restarted lane sails past — the restart IS the
+    recovery under test.
+    ``fail_signatures``: admission signatures whose every dispatch
+    raises ``OSError`` — the poisoned-signature class the per-signature
+    circuit breaker must isolate.
+    ``fail_error``: the poisoned dispatch's message.
+    """
+
+    kill_lane_at_batch: int | None = None
+    fail_signatures: tuple = ()
+    fail_error: str = "chaos: poisoned dispatch"
+
+
+class ServeChaosHook:
+    """Stateful dispatch-time injector for a :class:`ServeChaosPlan`.
+    Counts dispatched buckets; thread-safe (dispatch lanes may be
+    concurrent)."""
+
+    def __init__(self, plan: ServeChaosPlan):
+        import threading
+
+        self.plan = plan
+        self.batches = 0
+        self.killed = False
+        self._lock = threading.Lock()
+
+    def __call__(self, bucket) -> None:
+        with self._lock:
+            self.batches += 1
+            n = self.batches
+            kill = (
+                self.plan.kill_lane_at_batch is not None
+                and n >= self.plan.kill_lane_at_batch
+                and not self.killed
+            )
+            if kill:
+                self.killed = True
+        if kill:
+            raise KillSwitch(f"chaos: serve lane killed at batch {n}")
+        if bucket.signature in tuple(self.plan.fail_signatures):
+            raise OSError(self.plan.fail_error)
+
+
+def corrupt_version_file(version_dir: str, *, offset: int = -8,
+                         flip: int = 0xFF) -> str:
+    """Flip one byte of a committed registry version's payload
+    (``basis.npz``) IN PLACE, leaving its commit marker intact — the
+    checksum-mismatch fault class registry recovery must quarantine
+    (disk rot / tamper, as opposed to the torn-snapshot class a killed
+    publisher leaves). Returns the corrupted payload path."""
+    import os
+
+    path = os.path.join(version_dir, "basis.npz")
+    with open(path, "r+b") as f:
+        f.seek(offset, os.SEEK_END if offset < 0 else os.SEEK_SET)
+        pos = f.tell()
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ flip]))
+    return path
+
+
 class ChaosStream:
     """Apply a :class:`ChaosPlan` to a block stream.
 

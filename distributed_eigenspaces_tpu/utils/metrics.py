@@ -119,6 +119,11 @@ class MetricsLogger:
         #: CompileCache), attached via :meth:`attach_compile` —
         #: surfaced by :meth:`summary` under "compile"
         self.compile_cache = None
+        #: live read-path health sources (serving/server.py
+        #: ``QueryServer.health``), attached via
+        #: :meth:`attach_serve_health` — merged into
+        #: ``summary()["serving"]["health"]``
+        self.serve_health_sources: list = []
         self._last_time = None
         self._fit_trace = None
         # evicted-entry aggregates: what the ring buffers folded away
@@ -128,6 +133,14 @@ class MetricsLogger:
         self._fault_agg: dict = {"count": 0, "by_kind": {}}
         self._serve_agg = self._fresh_dispatch_agg()
         self._serve_agg["drifts"] = 0
+        # read-path health eviction aggregates (ISSUE 7): sheds by
+        # reason, lane restart/death counts, breaker transitions — so
+        # summary()["serving"]["health"] covers the whole run even
+        # after ring-buffer eviction
+        self._serve_agg["sheds_by_reason"] = {}
+        self._serve_agg["lane_restarts"] = 0
+        self._serve_agg["lane_deaths"] = 0
+        self._serve_agg["breaker_trips"] = 0
         self._fleet_agg = self._fresh_dispatch_agg()
 
     @staticmethod
@@ -208,6 +221,16 @@ class MetricsLogger:
             cache.tracer = self.tracer
         return self
 
+    def attach_serve_health(self, source) -> "MetricsLogger":
+        """Attach a live read-path health source (a zero-arg callable
+        returning a dict — ``QueryServer.health``). Multiple servers
+        may attach (one per served signature); ``summary()["serving"]
+        ["health"]`` merges them: counters sum, breaker states union,
+        and the event-ledger counts (sheds / lane restarts / breaker
+        trips) cover the whole run via the ring-buffer aggregates."""
+        self.serve_health_sources.append(source)
+        return self
+
     def attach_tracer(self, tracer) -> "MetricsLogger":
         """Attach a ``telemetry.Tracer``: per-step spans, serving /
         fleet / drift / fault spans from the instrumented components,
@@ -278,6 +301,21 @@ class MetricsLogger:
     def _evict_serve(self, rec: dict) -> None:
         if rec.get("serve") == "drift":
             self._serve_agg["drifts"] += 1
+            return
+        if rec.get("serve") == "shed":
+            reason = rec.get("reason", "overload")
+            by = self._serve_agg["sheds_by_reason"]
+            by[reason] = by.get(reason, 0) + rec.get("dropped", 1)
+            return
+        if rec.get("serve") == "lane":
+            if rec.get("event") == "restart":
+                self._serve_agg["lane_restarts"] += 1
+            elif rec.get("event") == "dead":
+                self._serve_agg["lane_deaths"] += 1
+            return
+        if rec.get("serve") == "breaker":
+            if rec.get("event") == "open":
+                self._serve_agg["breaker_trips"] += 1
             return
         if rec.get("serve") == "batch":
             self._fold_dispatch(
@@ -619,6 +657,9 @@ class MetricsLogger:
             out["versions_served"] = sorted(versions)
             out.update(self._stall_fields(batches, agg))
             out.update(self._latency_fields(batches, agg))
+        health = self._health_summary()
+        if health:
+            out["health"] = health
         drifts = [r for r in self.serve_records if r["serve"] == "drift"]
         if drifts or agg["drifts"]:
             out["drift_refreshes"] = agg["drifts"] + len(drifts)
@@ -630,6 +671,73 @@ class MetricsLogger:
             ]
         if self.serve_records.evicted:
             out["events_evicted"] = self.serve_records.evicted
+        return out
+
+    def _health_summary(self) -> dict:
+        """``summary()["serving"]["health"]`` (ISSUE 7): the read
+        path's resilience report. Counters (sheds by reason, lane
+        restarts/deaths, breaker trips, recovery time) come from the
+        EVENT stream — live window plus eviction aggregates, so they
+        cover the whole run; the live snapshot (breaker states,
+        in-flight depth, lane liveness) comes from the attached
+        :meth:`attach_serve_health` sources — states, not counts, so
+        multi-server merges never double-count."""
+        agg = self._serve_agg
+        sheds = dict(agg["sheds_by_reason"])
+        lane_restarts = agg["lane_restarts"]
+        lane_deaths = agg["lane_deaths"]
+        breaker_trips = agg["breaker_trips"]
+        recovery_ms = None
+        for r in self.serve_records:
+            kind = r.get("serve")
+            if kind == "shed":
+                reason = r.get("reason", "overload")
+                sheds[reason] = sheds.get(reason, 0) + r.get("dropped", 1)
+            elif kind == "lane":
+                if r.get("event") == "restart":
+                    lane_restarts += 1
+                elif r.get("event") == "dead":
+                    lane_deaths += 1
+                elif r.get("event") == "recovered":
+                    recovery_ms = r.get("recovery_ms")
+            elif kind == "breaker" and r.get("event") == "open":
+                breaker_trips += 1
+        out: dict = {}
+        if sheds:
+            out["sheds"] = sheds
+            out["shed_count"] = sum(sheds.values())
+        if lane_restarts:
+            out["lane_restarts"] = lane_restarts
+        if lane_deaths:
+            out["lane_deaths"] = lane_deaths
+        if breaker_trips:
+            out["breaker_trips"] = breaker_trips
+        if recovery_ms is not None:
+            out["recovery_ms"] = recovery_ms
+        # live state from attached servers: breaker states union,
+        # in-flight sum, lane liveness
+        breakers: dict = {}
+        inflight = 0
+        lanes_alive: list[bool] = []
+        for src in self.serve_health_sources:
+            try:
+                live = src()
+            except Exception:
+                continue
+            breakers.update(live.get("breakers") or {})
+            inflight += live.get("inflight", 0)
+            if "lane_alive" in live:
+                lanes_alive.append(bool(live["lane_alive"]))
+            if live.get("last_recovery_ms") is not None:
+                recovery_ms = live["last_recovery_ms"]
+                out["recovery_ms"] = recovery_ms
+        if breakers:
+            out["breakers"] = breakers
+        if self.serve_health_sources:
+            out["inflight"] = inflight
+            out["servers"] = len(self.serve_health_sources)
+            if lanes_alive:
+                out["lanes_alive"] = all(lanes_alive)
         return out
 
     def _slo_summary(self, out: dict) -> dict:

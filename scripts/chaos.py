@@ -23,10 +23,30 @@ It then checks the contract the docs promise (docs/ROBUSTNESS.md):
 
 Exit code 0 iff every check passes; the JSON report carries the ledger.
 
+``--mode serve`` (ISSUE 7) runs the READ-path chaos suite instead —
+the serve-tier duals of the fit-side faults:
+
+- **publisher crash mid-publish**: a torn snapshot (payload, no commit
+  marker) in the durable registry; recovery must skip it loudly and a
+  restarted registry must serve the prior latest BIT-EXACT with zero
+  refit;
+- **registry file corruption**: a committed version's payload with a
+  flipped byte; recovery must quarantine it loudly, never serve it;
+- **lane kill**: a KillSwitch inside the dispatch lane; the watchdog
+  must restart the lane and the killed lane's bucket must still
+  resolve (lease re-queue);
+- **overload burst**: 4x the admission capacity at once; the queue
+  must stay bounded, sheds must be clean ``ServerOverloaded`` errors,
+  and every accepted request must resolve;
+- **poisoned signature**: every dispatch fails; the signature's
+  breaker must trip and fast-fail while a neighbor signature serves
+  bit-exact.
+
 Usage::
 
     JAX_PLATFORMS=cpu python scripts/chaos.py --trainer segmented
     python scripts/chaos.py --dim 256 --steps 20 --kill-step 13
+    JAX_PLATFORMS=cpu python scripts/chaos.py --mode serve
 """
 
 from __future__ import annotations
@@ -48,6 +68,11 @@ sys.path.insert(
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--mode", choices=["fit", "serve"], default="fit",
+                   help="fit: the write-path recovery contract "
+                   "(supervisor kill/quarantine/resume); serve: the "
+                   "read-path suite (durable-registry crash recovery, "
+                   "lane kill, overload shed, breaker isolation)")
     p.add_argument("--dim", type=int, default=64)
     p.add_argument("--k", type=int, default=3)
     p.add_argument("--workers", type=int, default=4)
@@ -76,12 +101,210 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def serve_chaos(args) -> int:
+    """``--mode serve``: the read-path chaos suite (module docstring).
+    In-process faults — the subprocess kill -9 variant lives in
+    ``bench.py --chaos-serve`` (CI stage 7); here the torn snapshot is
+    the on-disk state a killed publisher leaves (payload committed, no
+    marker), written directly."""
+    import time
+
+    import jax
+
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.serving import (
+        BreakerOpen,
+        EigenbasisRegistry,
+        QueryServer,
+        ServerOverloaded,
+    )
+    from distributed_eigenspaces_tpu.utils.faults import (
+        ServeChaosHook,
+        ServeChaosPlan,
+        corrupt_version_file,
+    )
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    d, k = args.dim, args.k
+    cfg = PCAConfig(
+        dim=d, k=k, num_workers=2, rows_per_worker=32, num_steps=2,
+        backend="local", serve_bucket_size=4, serve_flush_s=0.01,
+    )
+    rng = np.random.default_rng(args.seed)
+    basis = np.linalg.qr(rng.standard_normal((d, k)))[0].astype(
+        np.float32
+    )
+    spec = planted_spectrum(d, k_planted=k, gap=20.0, noise=0.01,
+                            seed=args.seed)
+    queries = [
+        np.asarray(spec.sample(jax.random.PRNGKey(100 + i), 4),
+                   np.float32)
+        for i in range(8)
+    ]
+
+    def hi(x, v):
+        return np.asarray(
+            jax.numpy.matmul(
+                jax.numpy.asarray(x), jax.numpy.asarray(v),
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        )
+
+    keep_dir = args.keep_dir
+    root = keep_dir or tempfile.mkdtemp(prefix="det_chaos_serve_")
+    reg_dir = os.path.join(root, "registry")
+    checks: dict[str, bool] = {}
+
+    # -- 1. publisher crash mid-publish + registry corruption -------------
+    reg = EigenbasisRegistry(keep=4, registry_dir=reg_dir)
+    v1 = reg.publish(basis, step=7, lineage={"producer": "chaos"})
+    with QueryServer(reg, cfg) as srv:
+        pre = [srv.submit(q).result(timeout=60).z for q in queries]
+    # a committed version with a flipped payload byte (rot/tamper)
+    v2 = reg.publish(basis, step=8)
+    corrupt_version_file(reg._version_dir(v2.version))
+    # the killed-publisher state: payload written, marker never landed
+    # (an id past every committed one, like a real in-flight publish)
+    import dataclasses as _dc
+
+    torn = _dc.replace(v1, version=v2.version + 1)
+    reg._write_payload(reg._version_dir(torn.version), torn)
+
+    t0 = time.perf_counter()
+    reg2 = EigenbasisRegistry(keep=4, registry_dir=reg_dir)
+    with QueryServer(reg2, cfg) as srv2:
+        post = [srv2.submit(q).result(timeout=60).z for q in queries]
+    recovery_ms = (time.perf_counter() - t0) * 1e3
+    checks["torn_snapshot_skipped"] = bool(reg2.torn_skipped)
+    checks["corrupt_version_quarantined"] = bool(reg2.quarantined)
+    checks["recovered_latest_is_committed"] = (
+        reg2.latest() is not None
+        and reg2.latest().version == v1.version
+    )
+    checks["restart_bit_exact_zero_refit"] = all(
+        np.array_equal(a, b) for a, b in zip(pre, post)
+    )
+
+    # -- 2. lane kill → watchdog restart ----------------------------------
+    m_lane = MetricsLogger()
+    reg_mem = EigenbasisRegistry()
+    reg_mem.publish(basis)
+    hook = ServeChaosHook(ServeChaosPlan(kill_lane_at_batch=1))
+    t0 = time.perf_counter()
+    with QueryServer(
+        reg_mem, cfg, metrics=m_lane, fault_hook=hook,
+        lease_timeout=0.3,
+    ) as srv3:
+        r = srv3.submit(queries[0]).result(timeout=60)
+        lane_ms = (time.perf_counter() - t0) * 1e3
+        restarts = srv3._watchdog.restarts
+    checks["lane_killed_recovered"] = restarts >= 1 and np.array_equal(
+        r.z, hi(queries[0], basis)
+    )
+    checks["health_reports_restart"] = (
+        m_lane.summary()["serving"]["health"].get("lane_restarts", 0)
+        >= 1
+    )
+
+    # -- 3. overload burst --------------------------------------------------
+    m_over = MetricsLogger()
+    depth, burst = 4, 16
+
+    def busy(bucket):
+        time.sleep(0.01)
+
+    shed, accepted, clean = 0, [], True
+    with QueryServer(
+        reg_mem, cfg, metrics=m_over, queue_depth=depth,
+        bucket_size=1, flush_s=0.0, fault_hook=busy,
+    ) as srv4:
+        for i in range(burst):
+            try:
+                accepted.append(
+                    srv4.submit(queries[i % len(queries)])
+                )
+            except ServerOverloaded:
+                shed += 1
+            except Exception:
+                clean = False
+        done = [t.result(timeout=60) for t in accepted]
+    checks["overload_sheds_clean_and_bounded"] = (
+        shed > 0 and clean and len(done) == len(accepted)
+    )
+
+    # -- 4. poisoned signature: breaker trips, neighbor unaffected ----------
+    m_brk = MetricsLogger()
+    poison = ServeChaosHook(
+        ServeChaosPlan(fail_signatures=((d, k),))
+    )
+    srv_a = QueryServer(
+        reg_mem, cfg, metrics=m_brk, breaker_threshold=2,
+        breaker_cooldown_s=10.0, max_retries=0, bucket_size=1,
+        flush_s=0.0, fault_hook=poison,
+    )
+    cfg_b = cfg.replace(dim=max(8, d // 2), k=max(1, k - 1))
+    basis_b = np.linalg.qr(
+        rng.standard_normal((cfg_b.dim, cfg_b.k))
+    )[0].astype(np.float32)
+    reg_b = EigenbasisRegistry()
+    reg_b.publish(basis_b)
+    srv_b = QueryServer(
+        reg_b, cfg_b, metrics=m_brk, breaker_threshold=2,
+        bucket_size=1, flush_s=0.0,
+    )
+    try:
+        for q in queries[:3]:
+            try:
+                srv_a.submit(q).result(timeout=30)
+            except Exception:
+                pass
+        try:
+            srv_a.submit(queries[0])
+            fast_failed = False
+        except BreakerOpen:
+            fast_failed = True
+        qb = queries[0][:, : cfg_b.dim]
+        rb = srv_b.submit(qb).result(timeout=30)
+        checks["breaker_trips_fast_fails"] = fast_failed
+        checks["breaker_neighbor_unaffected"] = np.array_equal(
+            rb.z, hi(qb, basis_b)
+        )
+    finally:
+        srv_a.close()
+        srv_b.close()
+    health = m_brk.summary()["serving"]["health"]
+
+    report = {
+        "mode": "serve",
+        "recovery_ms": round(recovery_ms, 1),
+        "lane_recovery_ms": round(lane_ms, 1),
+        "lane_restarts": restarts,
+        "overload": {"submitted": burst, "accepted": len(accepted),
+                     "sheds": shed},
+        "breaker_health": health.get("breakers"),
+        "torn_skipped": reg2.torn_skipped,
+        "quarantined": reg2.quarantined,
+        "checks": checks,
+        "ok": all(checks.values()),
+        "registry_dir": reg_dir if keep_dir else None,
+    }
+    print(json.dumps(report, indent=2))
+    if not keep_dir:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if os.environ.get("JAX_PLATFORMS"):
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if args.mode == "serve":
+        return serve_chaos(args)
     import jax
 
     from distributed_eigenspaces_tpu.config import PCAConfig

@@ -46,12 +46,18 @@
 #      shares one trace_id, and the bench record's slo section is
 #      populated (docs/OBSERVABILITY.md names the span taxonomy this
 #      stage pins);
-#   7. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   7. bench.py --chaos-serve: the read-path resilience smoke (ISSUE
+#      7) — kill -9 mid-publish + durable-registry restart-recovery
+#      (bit-exact, zero refit), overload load-shed, per-signature
+#      breaker isolation, and serve-lane kill + watchdog restart, all
+#      gated by the bench itself; compared (recovery_ms ratio +
+#      structural bound) against the committed BENCH_CHAOS_SMOKE_CPU;
+#   8. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/7] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/8] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -59,7 +65,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/7] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/8] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -69,7 +75,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/7] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/8] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -84,7 +90,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/7] serve equality + amortization smoke (CPU) =="
+echo "== [4/8] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -99,7 +105,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/7] coldstart + prewarm smoke (CPU) =="
+echo "== [5/8] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -114,7 +120,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [6/7] telemetry smoke: trace export + span-chain validation =="
+echo "== [6/8] telemetry smoke: trace export + span-chain validation =="
 # A serve burst with --trace-out, then a structural validation of the
 # emitted timeline: the JSON must parse as Chrome trace-event format,
 # every served query's span chain (admit → queue_wait → dispatch →
@@ -159,7 +165,26 @@ print(json.dumps({
 }))
 PY
 
-echo "== [7/7] graft entry + 8-device sharded dryrun =="
+echo "== [7/8] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
+# bench.py --chaos-serve asserts the read-path resilience gates itself
+# (ISSUE 7): a kill -9'd publisher's store recovers (torn snapshot
+# skipped, checksum corruption quarantined) and the restarted server
+# serves BIT-EXACT with zero refit; a 4x-capacity overload burst is
+# shed reject-newest with clean errors while accepted p99 stays inside
+# the SLO; a poisoned signature trips its breaker without touching its
+# neighbor; a killed serve lane restarts and its bucket still resolves.
+# The compare checks recovery-time drift against the committed record
+# (old/new ratio + a 5 s structural bound so lease/backoff jitter
+# can't flap CI).
+if [[ -f BENCH_CHAOS_SMOKE_CPU.json ]]; then
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve \
+        --compare BENCH_CHAOS_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve
+fi
+
+echo "== [8/8] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

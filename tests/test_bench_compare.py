@@ -294,3 +294,68 @@ def test_coldstart_vs_headline_metric_mismatch_skips(tmp_path, capsys):
     verdict = json.loads(capsys.readouterr().err.strip())
     assert verdict["compare"] == "skipped"
     assert "metric mismatch" in verdict["reason"]
+
+
+def _chaos_report(recovery_ms, shed_rate=0.75):
+    return {
+        "metric": "pca_chaos_serve_recovery",
+        "value": recovery_ms,
+        "unit": "ms",
+        "recovery_ms": recovery_ms,
+        "shed_rate": shed_rate,
+    }
+
+
+def test_chaos_serve_records_compare_recovery_and_shed_rate(
+    tmp_path, capsys
+):
+    """ISSUE-7 satellite: chaos-serve records compare recovery TIME
+    (old/new — faster now is fine) with a structural bound so
+    lease/backoff jitter can't flap CI, and surface shed_rate on both
+    sides of the verdict."""
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_chaos_report(320.0)))
+    # slightly slower recovery, still far under the structural bound
+    assert bench.compare_reports(
+        str(old), _chaos_report(450.0, shed_rate=0.7), threshold=0.5
+    ) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["recovery_ms_old"] == 320.0
+    assert verdict["recovery_ms_new"] == 450.0
+    assert verdict["shed_rate_old"] == 0.75
+    assert verdict["shed_rate_new"] == 0.7
+    assert not verdict["regression"]
+
+    # recovery blew past the structural bound AND the ratio floor:
+    # a stuck restart, not jitter
+    assert bench.compare_reports(
+        str(old), _chaos_report(9000.0), threshold=0.5
+    ) == 1
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["regression"] is True
+
+
+def test_chaos_serve_vs_serve_metric_mismatch_skips(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_chaos_report(320.0)))
+    new = {
+        "metric": "pca_serve_queries_per_sec", "value": 100.0,
+        "anchor_tflops": 1.0, "value_per_anchor": 100.0,
+    }
+    assert bench.compare_reports(str(old), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]
+
+
+def test_chaos_serve_missing_recovery_skips_loudly(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    rep = _chaos_report(320.0)
+    del rep["recovery_ms"]
+    old.write_text(json.dumps(rep))
+    assert bench.compare_reports(
+        str(old), _chaos_report(300.0)
+    ) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "recovery_ms" in verdict["reason"]

@@ -1,0 +1,599 @@
+"""Read-path resilience (ISSUE 7): durable crash-safe registry,
+supervised serving with load shedding + circuit breakers, serve-lane
+watchdog recovery.
+
+The contracts under test are the ISSUE-7 acceptance gates: a kill -9'd
+publisher leaves a recoverable store (torn snapshot skipped, prior
+latest served bit-exact with zero refit), checksum tampering is
+quarantined loudly, overload bursts shed reject-newest with clean
+``ServerOverloaded`` errors while the queue stays bounded, a poisoned
+signature trips its breaker without touching its neighbors, a killed
+serve lane restarts under the watchdog with its bucket re-leased — and
+all of it visible in ``summary()["serving"]["health"]``.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.runtime.scheduler import (
+    QueueClosed,
+    QueueFull,
+    SchedulerError,
+    ShapeBucketQueue,
+)
+from distributed_eigenspaces_tpu.runtime.supervisor import (
+    BreakerOpen,
+    CircuitBreaker,
+    LaneWatchdog,
+)
+from distributed_eigenspaces_tpu.serving import (
+    DeadlineExceeded,
+    EigenbasisRegistry,
+    QueryServer,
+    ServerClosed,
+    ServerOverloaded,
+    VersionRetired,
+)
+from distributed_eigenspaces_tpu.utils.faults import (
+    ServeChaosHook,
+    ServeChaosPlan,
+    corrupt_version_file,
+)
+from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+D, K = 16, 2
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=D, k=K, num_workers=2, rows_per_worker=8, num_steps=2,
+        serve_bucket_size=2, serve_flush_s=0.01,
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+def _basis(d=D, k=K, seed=0):
+    rng = np.random.default_rng(seed)
+    return np.linalg.qr(rng.standard_normal((d, k)))[0].astype(
+        np.float32
+    )
+
+
+def _query(rows=3, d=D, seed=1):
+    return np.random.default_rng(seed).standard_normal(
+        (rows, d)
+    ).astype(np.float32)
+
+
+# -- durable registry --------------------------------------------------------
+
+
+class TestDurableRegistry:
+    def test_publish_recover_bit_exact(self, tmp_path):
+        """A restarted registry serves the committed latest BIT-EXACT:
+        the float32 npz round-trip is lossless, so warm restart = zero
+        refit."""
+        rd = str(tmp_path / "reg")
+        reg = EigenbasisRegistry(keep=3, registry_dir=rd)
+        w = _basis()
+        st = (w @ w.T).astype(np.float32)
+        v1 = reg.publish(
+            w, sigma_tilde=st, step=9,
+            lineage={"producer": "test", "fleet_signature": (1, 2)},
+        )
+        reg2 = EigenbasisRegistry(keep=3, registry_dir=rd)
+        assert reg2.recovered_versions == [v1.version]
+        live = reg2.latest()
+        assert live.version == v1.version
+        assert live.step == 9
+        np.testing.assert_array_equal(live.v, v1.v)
+        np.testing.assert_array_equal(live.sigma_tilde, v1.sigma_tilde)
+        assert live.lineage["producer"] == "test"
+        # recovered arrays are frozen like published ones
+        with pytest.raises((ValueError, RuntimeError)):
+            live.v[0, 0] = 1.0
+
+    def test_gc_applies_to_disk(self, tmp_path):
+        rd = str(tmp_path / "reg")
+        reg = EigenbasisRegistry(keep=2, registry_dir=rd)
+        for i in range(5):
+            reg.publish(_basis(seed=i))
+        dirs = sorted(
+            n for n in os.listdir(rd) if n.startswith("v")
+        )
+        assert dirs == ["v00000004", "v00000005"]
+        reg2 = EigenbasisRegistry(keep=2, registry_dir=rd)
+        assert reg2.recovered_versions == [4, 5]
+
+    def test_torn_snapshot_skipped_loudly(self, tmp_path, capsys):
+        """The killed-publisher state — payload committed, no marker —
+        is skipped (the publish never happened) and the prior latest
+        recovers."""
+        rd = str(tmp_path / "reg")
+        reg = EigenbasisRegistry(keep=4, registry_dir=rd)
+        v1 = reg.publish(_basis())
+        torn_dir = os.path.join(rd, "v00000002")
+        os.makedirs(torn_dir)
+        np.savez(
+            os.path.join(torn_dir, "basis.npz"),
+            v=np.zeros((D, K), np.float32),
+        )
+        reg2 = EigenbasisRegistry(keep=4, registry_dir=rd)
+        assert reg2.torn_skipped == ["v00000002"]
+        assert reg2.latest().version == v1.version
+        assert not os.path.exists(torn_dir)  # debris cleared
+        assert "torn snapshot skipped" in capsys.readouterr().err
+        # the torn id is never reused by a later publish
+        assert reg2.publish(_basis()).version == 3
+
+    def test_checksum_tamper_quarantined_loudly(self, tmp_path, capsys):
+        rd = str(tmp_path / "reg")
+        reg = EigenbasisRegistry(keep=4, registry_dir=rd)
+        v1 = reg.publish(_basis(seed=1))
+        v2 = reg.publish(_basis(seed=2))
+        corrupt_version_file(os.path.join(rd, f"v{v2.version:08d}"))
+        reg2 = EigenbasisRegistry(keep=4, registry_dir=rd)
+        assert reg2.quarantined == [f"v{v2.version:08d}.quarantined"]
+        assert os.path.exists(
+            os.path.join(rd, f"v{v2.version:08d}.quarantined")
+        )  # evidence preserved, never served
+        assert reg2.latest().version == v1.version
+        np.testing.assert_array_equal(reg2.latest().v, v1.v)
+        assert "quarantined" in capsys.readouterr().err
+
+    def test_subprocess_kill9_mid_publish_recovers(self, tmp_path):
+        """The real thing: a publisher SIGKILLed between the payload
+        write and the commit marker leaves a store whose recovery
+        serves the prior latest — the ISSUE-7 crash window."""
+        rd = str(tmp_path / "reg")
+        w = _basis(seed=3)
+        np.save(tmp_path / "w.npy", w)
+        child = f"""
+import os, signal
+import numpy as np
+from distributed_eigenspaces_tpu.serving.registry import EigenbasisRegistry
+
+w = np.load({str(tmp_path / 'w.npy')!r})
+reg = EigenbasisRegistry(keep=4, registry_dir={rd!r})
+reg.publish(w, step=1)                      # committed
+def die(self, vdir, bv, checksum):          # v2: die before commit
+    os.kill(os.getpid(), signal.SIGKILL)
+EigenbasisRegistry._write_meta = die
+reg.publish(np.zeros_like(w), step=2)
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", child],
+            env=env, capture_output=True, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+        assert proc.returncode == -signal.SIGKILL
+        reg2 = EigenbasisRegistry(keep=4, registry_dir=rd)
+        assert reg2.torn_skipped == ["v00000002"]
+        assert reg2.recovered_versions == [1]
+        np.testing.assert_array_equal(reg2.latest().v, w)
+
+    def test_restart_warm_serve_bit_exact_vs_precrash(self, tmp_path):
+        """End to end: transforms served by a restarted QueryServer
+        equal the pre-crash ones bit for bit, with zero refit."""
+        rd = str(tmp_path / "reg")
+        cfg = _cfg()
+        reg = EigenbasisRegistry(keep=4, registry_dir=rd)
+        reg.publish(_basis(seed=4))
+        qs = [_query(seed=s) for s in range(4)]
+        with QueryServer(reg, cfg) as srv:
+            pre = [srv.submit(q).result(timeout=60).z for q in qs]
+        reg2 = EigenbasisRegistry(keep=4, registry_dir=rd)
+        with QueryServer(reg2, cfg) as srv2:
+            post = [srv2.submit(q).result(timeout=60).z for q in qs]
+        for a, b in zip(pre, post):
+            assert np.array_equal(a, b)
+
+
+class TestVersionRetired:
+    def test_gcd_get_names_retention_window(self):
+        """ISSUE-7 satellite: a GC'd version's get() explains the
+        window instead of a bare KeyError."""
+        reg = EigenbasisRegistry(keep=2)
+        for i in range(4):
+            reg.publish(_basis(seed=i))
+        with pytest.raises(KeyError):  # still a KeyError for old code
+            reg.get(1)
+        with pytest.raises(
+            VersionRetired,
+            match=r"keeps the newest 2 versions.*serve_keep_versions=2"
+            r".*retained: \[3, 4\]",
+        ):
+            reg.get(1)
+
+
+# -- server-boundary errors (satellite) --------------------------------------
+
+
+class TestServerClosed:
+    def test_query_server_submit_after_close(self):
+        reg = EigenbasisRegistry()
+        reg.publish(_basis())
+        srv = QueryServer(reg, _cfg())
+        srv.close()
+        with pytest.raises(ServerClosed, match="closed QueryServer"):
+            srv.submit(_query())
+
+    def test_fleet_server_submit_after_close(self):
+        from distributed_eigenspaces_tpu.parallel.fleet import (
+            FleetServer,
+        )
+
+        cfg = _cfg(fleet_bucket_size=2, fleet_flush_s=0.01)
+        srv = FleetServer(cfg, mesh=None)
+        srv.close()
+        with pytest.raises(ServerClosed, match="closed FleetServer"):
+            srv.submit(np.zeros((cfg.num_steps * 16, D), np.float32))
+
+    def test_raw_scheduler_error_stays_internal(self):
+        """The queue-level error is still a SchedulerError subclass —
+        internal callers keep their semantics, server callers get the
+        documented boundary error."""
+        q = ShapeBucketQueue(
+            bucket_size=2, flush_deadline=0.0, start_timer=False
+        )
+        q.close()
+        with pytest.raises(QueueClosed):
+            q.submit(("s",), 0)
+        assert issubclass(QueueClosed, SchedulerError)
+
+
+# -- bounded admission + load shedding ---------------------------------------
+
+
+class TestLoadShedding:
+    def test_overload_sheds_reject_newest_clean(self):
+        reg = EigenbasisRegistry()
+        reg.publish(_basis())
+        gate = threading.Event()
+        metrics = MetricsLogger()
+        with QueryServer(
+            reg, _cfg(), metrics=metrics, queue_depth=2,
+            bucket_size=1, flush_s=0.0,
+            fault_hook=lambda bucket: gate.wait(20),
+        ) as srv:
+            accepted, sheds = [], 0
+            for i in range(8):
+                try:
+                    accepted.append(srv.submit(_query(seed=i)))
+                except ServerOverloaded as e:
+                    sheds += 1
+                    assert "load shedding" in str(e)
+            gate.set()
+            results = [t.result(timeout=60) for t in accepted]
+            assert srv.health()["inflight"] == 0  # bounded, drained
+        assert len(accepted) == 2 and sheds == 6
+        assert len(results) == 2
+        health = metrics.summary()["serving"]["health"]
+        assert health["sheds"]["overload"] == 6
+        assert health["shed_count"] == 6
+
+    def test_deadline_blown_requests_dropped_before_compute(self):
+        """With bounded admission AND an SLO declared, a request that
+        waited past the SLO is shed before compute."""
+        reg = EigenbasisRegistry()
+        reg.publish(_basis())
+        gate = threading.Event()
+        metrics = MetricsLogger(slo_p99_ms=30.0)
+        fired = {"n": 0}
+
+        def hold_first(bucket):
+            if fired["n"] == 0:
+                fired["n"] += 1
+                gate.wait(20)
+
+        with QueryServer(
+            reg, _cfg(), metrics=metrics, queue_depth=8,
+            bucket_size=1, flush_s=0.0, fault_hook=hold_first,
+        ) as srv:
+            stale = srv.submit(_query())
+            time.sleep(0.1)  # let it blow the 30 ms SLO while queued
+            gate.set()
+            with pytest.raises(
+                DeadlineExceeded, match="shed before compute"
+            ):
+                stale.result(timeout=60)
+            fresh = srv.submit(_query()).result(timeout=60)
+            assert fresh.z.shape == (3, K)
+        health = metrics.summary()["serving"]["health"]
+        assert health["sheds"]["deadline"] >= 1
+
+    def test_unbounded_default_never_sheds(self):
+        reg = EigenbasisRegistry()
+        reg.publish(_basis())
+        with QueryServer(reg, _cfg()) as srv:
+            tickets = [srv.submit(_query(seed=i)) for i in range(16)]
+            assert all(
+                t.result(timeout=60) is not None for t in tickets
+            )
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_state_machine(self):
+        now = {"t": 0.0}
+        br = CircuitBreaker(
+            threshold=2, cooldown_s=1.0, clock=lambda: now["t"]
+        )
+        assert br.allow()
+        br.record_failure(OSError("x"))
+        assert br.state == "closed" and br.allow()
+        br.record_failure(OSError("y"))
+        assert br.state == "open"
+        assert not br.allow()  # fast-fail
+        now["t"] = 1.5
+        assert br.allow()      # the half-open probe
+        assert not br.allow()  # only ONE probe
+        br.record_failure(OSError("probe died"))
+        assert br.state == "open"  # failed probe: straight back open
+        now["t"] = 3.0
+        assert br.allow()
+        br.record_success()
+        assert br.state == "closed" and br.allow()
+        snap = br.snapshot()
+        assert snap["trips"] == 2 and snap["fast_fails"] == 2
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(threshold=3)
+        br.record_failure("a")
+        br.record_failure("b")
+        br.record_success()
+        br.record_failure("c")
+        br.record_failure("d")
+        assert br.state == "closed"  # lossy, not poisoned
+
+    def test_poisoned_signature_fast_fails_neighbor_serves(self):
+        """The acceptance gate: one signature's dispatch is poisoned;
+        its breaker trips and fast-fails new submissions while the
+        other signature (same metrics fabric) serves bit-exact —
+        visible in summary()["serving"]["health"]."""
+        metrics = MetricsLogger()
+        reg_a, reg_b = EigenbasisRegistry(), EigenbasisRegistry()
+        w_a, w_b = _basis(seed=1), _basis(d=8, k=1, seed=2)
+        reg_a.publish(w_a)
+        reg_b.publish(w_b)
+        poison = ServeChaosHook(
+            ServeChaosPlan(fail_signatures=((D, K),))
+        )
+        srv_a = QueryServer(
+            reg_a, _cfg(), metrics=metrics, breaker_threshold=2,
+            breaker_cooldown_s=30.0, max_retries=0, bucket_size=1,
+            flush_s=0.0, fault_hook=poison,
+        )
+        srv_b = QueryServer(
+            reg_b, _cfg(dim=8, k=1), metrics=metrics,
+            breaker_threshold=2, bucket_size=1, flush_s=0.0,
+        )
+        try:
+            for i in range(2):
+                with pytest.raises(Exception):
+                    srv_a.submit(_query(seed=i)).result(timeout=30)
+            with pytest.raises(BreakerOpen, match="fast-failing"):
+                srv_a.submit(_query())
+            qb = _query(d=8)
+            rb = srv_b.submit(qb).result(timeout=30)
+            import jax
+            import jax.numpy as jnp
+
+            assert np.array_equal(
+                rb.z,
+                np.asarray(jnp.matmul(
+                    jnp.asarray(qb), jnp.asarray(w_b),
+                    precision=jax.lax.Precision.HIGHEST,
+                )),
+            )
+        finally:
+            srv_a.close()
+            srv_b.close()
+        health = metrics.summary()["serving"]["health"]
+        assert health["breakers"][str((D, K))]["state"] == "open"
+        assert health["breaker_trips"] >= 1
+        assert health["sheds"]["breaker"] >= 1
+
+    def test_half_open_probe_recovers(self):
+        reg = EigenbasisRegistry()
+        reg.publish(_basis())
+        poison = ServeChaosHook(
+            ServeChaosPlan(fail_signatures=((D, K),))
+        )
+        with QueryServer(
+            reg, _cfg(), breaker_threshold=2,
+            breaker_cooldown_s=0.15, max_retries=0, bucket_size=1,
+            flush_s=0.0, fault_hook=poison,
+        ) as srv:
+            for i in range(2):
+                with pytest.raises(Exception):
+                    srv.submit(_query(seed=i)).result(timeout=30)
+            with pytest.raises(BreakerOpen):
+                srv.submit(_query())
+            poison.plan = ServeChaosPlan()  # fault clears
+            time.sleep(0.2)
+            r = srv.submit(_query()).result(timeout=30)  # the probe
+            assert r.z.shape == (3, K)
+            assert srv.health()["breakers"][str((D, K))][
+                "state"
+            ] == "closed"
+
+
+# -- lane watchdog -----------------------------------------------------------
+
+
+class TestLaneWatchdog:
+    def test_killed_lane_restarts_and_bucket_resolves(self):
+        reg = EigenbasisRegistry()
+        w = _basis(seed=7)
+        reg.publish(w)
+        metrics = MetricsLogger()
+        hook = ServeChaosHook(ServeChaosPlan(kill_lane_at_batch=1))
+        with QueryServer(
+            reg, _cfg(), metrics=metrics, fault_hook=hook,
+            lease_timeout=0.3,
+        ) as srv:
+            q = _query()
+            r = srv.submit(q).result(timeout=60)
+            import jax
+            import jax.numpy as jnp
+
+            assert np.array_equal(
+                r.z,
+                np.asarray(jnp.matmul(
+                    jnp.asarray(q), jnp.asarray(w),
+                    precision=jax.lax.Precision.HIGHEST,
+                )),
+            )
+            assert srv._watchdog.restarts >= 1
+            h = srv.health()
+            assert h["lane_restarts"] >= 1
+            assert h["last_recovery_ms"] is not None
+        health = metrics.summary()["serving"]["health"]
+        assert health["lane_restarts"] >= 1
+        assert health["recovery_ms"] is not None
+
+    def test_restart_budget_exhausted_fails_loudly(self):
+        """A lane that keeps dying closes admission and fails pending
+        waiters with ServerClosed — never a silent hang."""
+        reg = EigenbasisRegistry()
+        reg.publish(_basis())
+        hook = ServeChaosHook(
+            ServeChaosPlan(kill_lane_at_batch=1)
+        )
+        # re-arm the kill on every dispatch: the lane can never serve
+        orig = hook.__call__
+
+        def always_kill(bucket):
+            hook.killed = False
+            orig(bucket)
+
+        srv = QueryServer(
+            reg, _cfg(), fault_hook=always_kill, lease_timeout=0.1,
+            max_lane_restarts=1, bucket_size=1, flush_s=0.0,
+        )
+        try:
+            t = srv.submit(_query())
+            with pytest.raises(ServerClosed, match="lane is dead"):
+                t.result(timeout=60)
+            with pytest.raises((ServerClosed,)):
+                srv.submit(_query())
+        finally:
+            srv._watchdog.join(timeout=10)
+
+    def test_unsupervised_mode_keeps_plain_thread(self):
+        reg = EigenbasisRegistry()
+        reg.publish(_basis())
+        with QueryServer(reg, _cfg(), supervise=False) as srv:
+            assert srv._watchdog is None
+            r = srv.submit(_query()).result(timeout=60)
+            assert r.z.shape == (3, K)
+
+
+class TestLaneWatchdogUnit:
+    def test_clean_return_is_not_a_death(self):
+        ran = []
+        wd = LaneWatchdog("t", lambda: ran.append(1)).start()
+        wd.join(timeout=5)
+        assert ran == [1] and wd.restarts == 0 and not wd.dead
+
+    def test_restarts_then_dead(self):
+        calls = {"n": 0}
+
+        def dies():
+            calls["n"] += 1
+            raise RuntimeError(f"boom {calls['n']}")
+
+        dead = []
+        wd = LaneWatchdog(
+            "t", dies, max_restarts=2, backoff_base=0.0,
+            on_dead=dead.append,
+        ).start()
+        wd.join(timeout=5)
+        assert calls["n"] == 3  # initial + 2 restarts
+        assert wd.restarts == 2 and wd.dead
+        assert dead and isinstance(dead[0], RuntimeError)
+        kinds = [e["kind"] for e in wd.ledger.events]
+        assert kinds.count("lane_restart") == 2
+        assert kinds.count("lane_dead") == 1
+
+
+# -- scheduler isolation -----------------------------------------------------
+
+
+class TestFailureIsolation:
+    def test_poisoned_bucket_does_not_kill_the_queue(self):
+        """Isolation mode: signature 'bad' exhausts retries and fails
+        ITS tickets; signature 'good' keeps serving through the same
+        queue — the fragility ISSUE 7 names, fixed."""
+        q = ShapeBucketQueue(
+            bucket_size=1, flush_deadline=0.0, max_retries=1,
+            start_timer=False, isolate_failures=True,
+        )
+
+        def fit(bucket):
+            if bucket.signature == "bad":
+                raise OSError("poisoned")
+            return [p.payload * 10 for p in bucket.tickets]
+
+        t_bad = q.submit("bad", 1)
+        t_good = q.submit("good", 2)
+        t_good2 = q.submit("good", 3)
+        q.close()
+        q.serve(fit)  # must NOT raise: the bad bucket is isolated
+        with pytest.raises(SchedulerError, match="failed after"):
+            t_bad.result(timeout=5)
+        assert t_good.result(timeout=5) == 20
+        assert t_good2.result(timeout=5) == 30
+
+    def test_fail_fast_default_unchanged(self):
+        q = ShapeBucketQueue(
+            bucket_size=1, flush_deadline=0.0, max_retries=0,
+            start_timer=False,
+        )
+        t = q.submit("s", 0)
+        q.close()
+        with pytest.raises(SchedulerError):
+            q.serve(lambda b: (_ for _ in ()).throw(OSError("x")))
+        with pytest.raises(SchedulerError):
+            t.result(timeout=5)
+
+    def test_queue_full_depth_accounting(self):
+        q = ShapeBucketQueue(
+            bucket_size=4, flush_deadline=60.0, start_timer=False,
+            max_depth=2,
+        )
+        q.submit("s", 0)
+        q.submit("s", 1)
+        with pytest.raises(QueueFull, match="load shedding"):
+            q.submit("s", 2)
+        assert q.inflight == 2 and q.sheds["overload"] == 1
+
+
+# -- health summary ----------------------------------------------------------
+
+
+def test_health_survives_ring_eviction():
+    """Shed/lane/breaker events folded out of the ring buffer still
+    count in summary()["serving"]["health"]."""
+    m = MetricsLogger(retention=2)
+    for i in range(6):
+        m.serve({"kind": "shed", "reason": "overload"})
+    m.serve({"kind": "lane", "event": "restart", "attempt": 1})
+    m.serve({"kind": "breaker", "event": "open"})
+    health = m.summary()["serving"]["health"]
+    assert health["sheds"]["overload"] == 6
+    assert health["lane_restarts"] == 1
+    assert health["breaker_trips"] == 1
