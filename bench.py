@@ -1250,6 +1250,265 @@ def _hi_matmul(x, v):
     )
 
 
+def _chaos_churn_cfg():
+    """Churn-chaos workload (ISSUE 8): small enough that both scenarios
+    (elastic churn fit + quorum-loss/auto-resume) stay inside a CI
+    minute; the measured quantities are liveness-detection and recovery
+    latency plus accuracy under churn, not device throughput. The
+    timing constants are the contract under test: heartbeat 100 ms
+    (suspect at 1x, dead at 2x), round deadline 40 ms, quorum floor
+    0.5 — so killing 30% of 10 workers keeps quorum and killing 60%
+    loses it."""
+    from distributed_eigenspaces_tpu.config import PCAConfig
+
+    d, k = (32, 3) if _os.environ.get("DET_BENCH_SMALL") == "1" else (64, 4)
+    return PCAConfig(
+        dim=d, k=k, num_workers=10, rows_per_worker=16, num_steps=14,
+        backend="local", solver="eigh", prefetch_depth=0,
+        heartbeat_timeout_ms=100.0, round_deadline_ms=40.0,
+        min_quorum_frac=0.5,
+    )
+
+
+def measure_chaos_churn():
+    """``--chaos-churn``: the fit-tier elastic-membership chaos A/B
+    (ISSUE 8). Two scenarios, every gate asserted by the bench itself:
+
+    1. **Churn fit.** 30% of the fleet crash-killed mid-run (liveness
+       detection via lease expiry, never a graceful goodbye), two of
+       them rejoin through the dead→join→admit protocol, one flaps
+       (kill + immediate rejoin — the suspect-recovers path), and one
+       worker is a PERSISTENT straggler whose delivery misses every
+       round deadline. The run must finish all T steps inside the
+       existing angle budget vs planted truth, never deadlock on a
+       dead worker (every round closes — deadline-bounded), fold the
+       straggler one-step-stale instead of stalling, and the
+       post-churn rejoin must contribute to a later merge — all
+       asserted via ``summary()["membership"]``.
+
+    2. **Quorum loss.** 60% killed at once: live membership falls
+       below ``min_quorum_frac`` and the run must raise a LOUD
+       ``QuorumLost`` within ``2 x heartbeat_timeout`` of the kill
+       (measured from the membership event stream), then — once the
+       workers rejoin — auto-resume from the latest checkpoint and
+       complete. ``churn_recovery_ms`` (quorum-lost → resumed) is the
+       record's headline value; lower is better.
+    """
+    import tempfile
+    import threading
+
+    import jax
+
+    from distributed_eigenspaces_tpu.data.stream import block_stream
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+    from distributed_eigenspaces_tpu.runtime.membership import (
+        ElasticStream,
+        MembershipTable,
+    )
+    from distributed_eigenspaces_tpu.runtime.supervisor import (
+        supervised_fit,
+    )
+    from distributed_eigenspaces_tpu.utils.faults import ChurnPlan
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    cfg = _chaos_churn_cfg()
+    m, n, T = cfg.num_workers, cfg.rows_per_worker, cfg.num_steps
+    spec = planted_spectrum(
+        cfg.dim, k_planted=cfg.k, gap=20.0, noise=0.01, seed=7
+    )
+    data = np.asarray(spec.sample(jax.random.PRNGKey(1), m * n * T))
+    truth = spec.top_k(cfg.k)
+    gates: dict[str, bool] = {}
+
+    def factory(table, churn, metrics):
+        def make(start_row):
+            raw = block_stream(
+                data, num_workers=m, rows_per_worker=n,
+                start_row=start_row, device=False,
+            )
+            return ElasticStream(
+                raw, table, cfg, churn=churn,
+                first_step=start_row // (m * n) + 1, metrics=metrics,
+            )
+
+        return make
+
+    # -- 1. churn fit: 30% loss + dead->join rejoin + flap + straggler ----
+    metrics1 = MetricsLogger()
+    table1 = MembershipTable(
+        m, heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+        min_quorum_frac=cfg.min_quorum_frac, metrics=metrics1,
+    )
+    metrics1.attach_membership(table1)
+    churn1 = ChurnPlan(
+        # 30% crash at step 3; slot 3 flaps at step 9 (out ~3 rounds —
+        # long enough to go suspect, short enough to recover in place)
+        kill_at={3: [0, 1, 2], 9: [3]},
+        rejoin_at={9: [0, 1], 12: [3]},      # dead->join->admit; flap back
+        slow={9: 0.08},                      # persistent straggler, >deadline
+    )
+    t0 = time.perf_counter()
+    w1, st1, sup1 = supervised_fit(
+        factory(table1, churn1, metrics1), cfg,
+        metrics=metrics1, membership=table1,
+    )
+    churn_fit_s = time.perf_counter() - t0
+    angle1 = float(
+        jax.numpy.max(
+            principal_angles_degrees(jax.numpy.asarray(w1), truth)
+        )
+    )
+    ms = metrics1.summary()["membership"]
+    rounds_closed = [
+        r for r in metrics1.membership_records
+        if r["membership"] == "round_closed"
+    ]
+    admit_steps = {
+        r["slot"]: r["t_mono"]
+        for r in metrics1.membership_records
+        if r["membership"] == "admit"
+    }
+    rejoined_contributes = False
+    if 0 in admit_steps:
+        rejoined_contributes = any(
+            0 in r.get("arrived_slots", ())
+            and r["t_mono"] > admit_steps[0]
+            for r in rounds_closed
+        )
+    gates["churn_completed_all_steps"] = int(st1.step) == T
+    gates["churn_angle_within_budget"] = angle1 <= 1.0
+    gates["churn_no_deadlock"] = (
+        ms["rounds"] == T and churn_fit_s < 60.0
+    )
+    gates["churn_straggler_folds_stale"] = ms["stale_folds"] >= 3
+    gates["churn_deadline_closes_rounds"] = ms["deadline_closed"] >= 3
+    gates["churn_deaths_detected"] = ms["by_kind"].get("dead", 0) >= 3
+    gates["churn_rejoin_admitted"] = ms["by_kind"].get("admit", 0) >= 2
+    gates["churn_rejoin_contributes_next_merge"] = rejoined_contributes
+    gates["churn_flap_recovers"] = ms["by_kind"].get("recovered", 0) >= 1
+
+    # -- 2. quorum loss: loud within 2x heartbeat, auto-resume on rejoin --
+    metrics2 = MetricsLogger()
+    table2 = MembershipTable(
+        m, heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+        min_quorum_frac=cfg.min_quorum_frac, metrics=metrics2,
+    )
+    metrics2.attach_membership(table2)
+    killed = [0, 1, 2, 3, 4, 5]  # 60% -> live 40% < 50% floor
+    churn2 = ChurnPlan(kill_at={4: killed})
+
+    def rejoiner():
+        # a real operator bringing capacity back: wait for the loud
+        # quorum loss, then rejoin slots as their leases fully expire
+        deadline = time.monotonic() + 30.0
+        while table2.quorum_ok() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        joined: set = set()
+        while len(joined) < 4 and time.monotonic() < deadline:
+            table2.sweep()
+            for s in killed:
+                if s not in joined and table2.state(s) == "dead":
+                    table2.join(s)
+                    joined.add(s)
+            time.sleep(0.01)
+
+    rejoin_thread = threading.Thread(target=rejoiner, daemon=True)
+    rejoin_thread.start()
+    with tempfile.TemporaryDirectory(prefix="det_churn_ck_") as ck:
+        w2, st2, sup2 = supervised_fit(
+            factory(table2, churn2, metrics2), cfg,
+            metrics=metrics2, membership=table2, checkpoint_dir=ck,
+        )
+    rejoin_thread.join(timeout=30.0)
+    kinds2 = sup2.ledger.by_kind
+    mrecs = list(metrics2.membership_records)
+    frecs = list(metrics2.fault_records)
+
+    def first_t(records, key, kind):
+        return next(
+            (r["t_mono"] for r in records if r.get(key) == kind), None
+        )
+
+    t_kill = first_t(mrecs, "membership", "churn_kill")
+    t_lost = first_t(mrecs, "membership", "quorum_lost")
+    t_resume = next(
+        (
+            r["t_mono"] for r in frecs
+            if r.get("fault") == "resume"
+            and r.get("reason") == "quorum_restored"
+        ),
+        None,
+    )
+    quorum_detect_ms = (
+        (t_lost - t_kill) * 1e3
+        if t_kill is not None and t_lost is not None else None
+    )
+    churn_recovery_ms = (
+        (t_resume - t_lost) * 1e3
+        if t_lost is not None and t_resume is not None else None
+    )
+    gates["quorum_lost_raised"] = kinds2.get("quorum_lost", 0) >= 1
+    gates["quorum_detected_within_2x_heartbeat"] = (
+        quorum_detect_ms is not None
+        and quorum_detect_ms <= 2.0 * cfg.heartbeat_timeout_ms
+    )
+    gates["quorum_resumed_and_completed"] = (
+        kinds2.get("quorum_restored", 0) >= 1 and int(st2.step) == T
+    )
+    angle2 = float(
+        jax.numpy.max(
+            principal_angles_degrees(jax.numpy.asarray(w2), truth)
+        )
+    )
+    gates["quorum_run_angle_within_budget"] = angle2 <= 1.0
+
+    ok = all(gates.values())
+    result = {
+        "metric": "pca_chaos_churn_recovery",
+        "value": (
+            round(churn_recovery_ms, 1)
+            if churn_recovery_ms is not None else None
+        ),
+        "unit": "ms",
+        "churn_recovery_ms": (
+            round(churn_recovery_ms, 1)
+            if churn_recovery_ms is not None else None
+        ),
+        "quorum_detect_ms": (
+            round(quorum_detect_ms, 1)
+            if quorum_detect_ms is not None else None
+        ),
+        "heartbeat_timeout_ms": cfg.heartbeat_timeout_ms,
+        "round_deadline_ms": cfg.round_deadline_ms,
+        "min_quorum_frac": cfg.min_quorum_frac,
+        "churn": {
+            "workers": m,
+            "killed_frac": 0.3,
+            "angle_deg": round(angle1, 4),
+            "fit_seconds": round(churn_fit_s, 3),
+            "rounds": ms["rounds"],
+            "deadline_closed": ms["deadline_closed"],
+            "stale_folds": ms["stale_folds"],
+            "by_kind": ms["by_kind"],
+            "arrival_hist": ms["arrival_hist"],
+        },
+        "quorum": {
+            "killed_frac": 0.6,
+            "angle_deg": round(angle2, 4),
+            "faults_by_kind": kinds2,
+        },
+        "gates": gates,
+    }
+    if not ok:
+        result["chaos_fail"] = sorted(
+            g for g, passed in gates.items() if not passed
+        )
+    return result, ok
+
+
 def _coldstart_cfg(cache_dir):
     """The coldstart A/B's FIXED shape signature: a dense subspace-solver
     scan fit (pipeline_merge on — the heaviest-compiling steady-state
@@ -1550,6 +1809,19 @@ def main():
             return compare_reports(compare_path, result, compare_threshold)
         return 0
 
+    # --chaos-churn: the fit-tier elastic-membership chaos A/B (ISSUE
+    # 8) — 30% worker loss + flapping rejoin + persistent straggler
+    # inside the angle budget, quorum loss loud within 2x heartbeat
+    # timeout + auto-resume; every gate asserted by the measurement
+    if "--chaos-churn" in args:
+        result, ok = measure_chaos_churn()
+        print(json.dumps(result))
+        if not ok:
+            return 1
+        if compare_path is not None:
+            return compare_reports(compare_path, result, compare_threshold)
+        return 0
+
     # --coldstart: the zero-cold-start A/B — subprocess-measured
     # first-fit / first-serve wall time, cold vs warm persistent cache
     # (bit-identity + prewarm gates asserted by the measurement itself)
@@ -1760,6 +2032,48 @@ def compare_reports(old_path: str, result: dict,
             # the bench itself already failed on the hard gates
             # (bit-exactness, sheds counted, breaker isolation); the
             # compare catches recovery-time drift that still "works"
+            "regression": bool(
+                ratio < threshold and r_new > structural_ms
+            ),
+        }
+        print(json.dumps(verdict), file=sys.stderr)
+        return 1 if verdict["regression"] else 0
+
+    if "pca_chaos_churn_recovery" in (old_metric, new_metric):
+        # churn records carry a recovery TIME (quorum-lost → resumed,
+        # ms — lower is better) plus the quorum-loss DETECTION latency
+        # (bounded by 2x heartbeat timeout — the bench's own hard
+        # gate); both surface in the verdict. Like the chaos-serve
+        # compare, the ratio check is old/new and a regression
+        # additionally requires recovery past a structural bound:
+        # recovery on the CPU rig is dominated by lease/grace
+        # constants, so small-ms jitter must not flap CI.
+        r_old, r_new = old.get("churn_recovery_ms"), result.get(
+            "churn_recovery_ms"
+        )
+        if r_old is None or r_new is None:
+            print(
+                json.dumps({"compare": "skipped",
+                            "reason": "missing churn_recovery_ms"}),
+                file=sys.stderr,
+            )
+            return 0
+        ratio = r_old / max(r_new, 1e-9)
+        structural_ms = float(
+            _os.environ.get("DET_CHURN_RECOVERY_BOUND_MS") or 10000.0
+        )
+        verdict = {
+            "compare": old_path,
+            "churn_recovery_ms_old": r_old,
+            "churn_recovery_ms_new": r_new,
+            "quorum_detect_ms_old": old.get("quorum_detect_ms"),
+            "quorum_detect_ms_new": result.get("quorum_detect_ms"),
+            "normalized_ratio": round(ratio, 3),
+            "threshold": threshold,
+            "structural_bound_ms": structural_ms,
+            # the bench itself already failed on the hard gates (angle
+            # budget, detection bound, rejoin-contributes); the compare
+            # catches recovery-time drift that still "works"
             "regression": bool(
                 ratio < threshold and r_new > structural_ms
             ),

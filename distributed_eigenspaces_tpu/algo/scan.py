@@ -464,26 +464,47 @@ def make_scan_fit(
 
     if mesh is None:
         # checked_jit == jax.jit unless DET_CHECKIFY=1 (NaN guards, §5.2)
-        return checked_jit(make_fit(axis_name=None))
+        fitted = checked_jit(make_fit(axis_name=None))
+    else:
+        # one shard_map around the whole scan: the worker axis stays
+        # device-resident across all T steps and only the k-width merge
+        # crosses ICI each step
+        rep = NamedSharding(mesh, P())
+        x_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
+        extra = (P(),) if (gather or masked) else ()  # idx / (T, m) masks
+        in_specs = (P(), P(None, WORKER_AXIS)) + extra
+        in_shardings = (rep, x_sharding) + (
+            (rep,) if (gather or masked) else ()
+        )
+        inner = shard_map(
+            make_fit(axis_name=WORKER_AXIS),
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        fitted = checked_jit(
+            inner, in_shardings=in_shardings, out_shardings=(rep, rep)
+        )
+    if not masked:
+        return fitted
 
-    # one shard_map around the whole scan: the worker axis stays
-    # device-resident across all T steps and only the k-width merge
-    # crosses ICI each step
-    rep = NamedSharding(mesh, P())
-    x_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
-    extra = (P(),) if (gather or masked) else ()  # idx / (T, m) masks
-    in_specs = (P(), P(None, WORKER_AXIS)) + extra
-    in_shardings = (rep, x_sharding) + ((rep,) if (gather or masked) else ())
-    inner = shard_map(
-        make_fit(axis_name=WORKER_AXIS),
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    return checked_jit(
-        inner, in_shardings=in_shardings, out_shardings=(rep, rep)
-    )
+    def fit_masked_elastic(state, x_steps, masks, membership_masks=None):
+        """Masked whole-fit entry with the elastic-membership mask
+        threaded exactly like the worker mask (ISSUE 8): a recorded
+        elastic run's ``(T, m)`` per-round membership masks
+        (``summary()["membership"]`` / ``ElasticStream``) compose
+        multiplicatively with the quarantine masks BEFORE the program
+        — membership ∧ quarantine is the same masked mean, so elastic
+        runs replay through the unchanged compiled masked program
+        (scan-compatible by construction)."""
+        if membership_masks is not None:
+            masks = jnp.asarray(masks, jnp.float32) * jnp.asarray(
+                membership_masks, jnp.float32
+            )
+        return fitted(state, x_steps, masks)
+
+    return fit_masked_elastic
 
 
 class SegmentState(NamedTuple):

@@ -230,6 +230,24 @@ def build_parser() -> argparse.ArgumentParser:
                      "attempt)")
     sup.add_argument("--backoff-max", type=float, default=2.0,
                      help="retry delay cap in seconds")
+    sup.add_argument("--heartbeat-timeout-ms", type=float, default=1000.0,
+                     help="elastic-membership lease duration "
+                     "(runtime/membership.py): a worker missing this "
+                     "many ms of heartbeats goes suspect (excluded "
+                     "from merges), then dead one timeout later (slot "
+                     "joinable; a rejoin re-enters at the next round)")
+    sup.add_argument("--round-deadline-ms", type=float, default=250.0,
+                     help="elastic merge-round deadline: each round "
+                     "closes after this many ms with whatever quorum "
+                     "arrived; a late straggler's contribution folds "
+                     "into the NEXT merge (one-step-stale). 0 disables "
+                     "the deadline (rounds wait for every live member)")
+    sup.add_argument("--min-quorum-frac", type=float, default=0.5,
+                     help="quorum floor: live membership below this "
+                     "fraction raises a loud QuorumLost (within ~2x "
+                     "the heartbeat timeout); supervised runs wait "
+                     "bounded for quorum and auto-resume from the "
+                     "latest checkpoint")
     return p
 
 
@@ -1324,6 +1342,11 @@ def main(argv=None) -> int:
         serve_slo_p99_ms=args.slo_p99_ms,
         fleet_slo_p99_ms=args.slo_p99_ms,
         compile_cache_dir=args.compile_cache,
+        heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+        round_deadline_ms=(
+            None if args.round_deadline_ms == 0 else args.round_deadline_ms
+        ),
+        min_quorum_frac=args.min_quorum_frac,
     )
 
     if args.mode == "serve":

@@ -222,6 +222,30 @@ class PCAConfig:
         validation (version/backend mismatch, corruption) falls back
         to a fresh compile with a warning — never a crash, never a
         stale executable.
+      heartbeat_timeout_ms: elastic-membership lease duration
+        (``runtime/membership.py MembershipTable``; CLI
+        ``--heartbeat-timeout-ms``): a worker that misses this many
+        milliseconds of heartbeats is marked SUSPECT (excluded from
+        merges, still owns its slot) and DEAD one more timeout later
+        (lease released, slot joinable — a rejoining worker re-enters
+        at the next round with a fresh lease on the same slot id).
+        Only engaged by elastic runs (an ``ElasticStream`` /
+        ``MembershipTable`` in the loop); plain fits never consult it.
+      round_deadline_ms: elastic merge-round deadline: each round
+        closes after this many milliseconds with whatever quorum
+        arrived — the masked-mean fold handles absentees bit-correctly
+        — and a late straggler's contribution folds into the NEXT
+        merge (one-step-stale, the PR 2 pipeline rule), so a slow
+        worker degrades to a one-round lag instead of stalling every
+        barrier. ``None`` disables the deadline (rounds wait for every
+        live member — the pre-elastic barrier).
+      min_quorum_frac: the quorum floor: when live membership falls
+        below this fraction of ``num_workers``, the round raises a
+        loud ``QuorumLost`` (within ~2x the heartbeat timeout of the
+        crash) instead of silently averaging a sliver of the fleet;
+        ``supervised_fit(membership=...)`` waits a bounded time for
+        quorum to return and auto-resumes from the latest checkpoint
+        under the existing resume budget.
       pipeline_merge: software-pipelined steady state for the whole-fit
         scan trainer (``algo/scan.py``): step ``t``'s warm worker
         solves run against the one-step-STALE merged basis (merges
@@ -275,6 +299,9 @@ class PCAConfig:
     fleet_slo_p99_ms: float | None = None
     metrics_retention: int = 4096
     compile_cache_dir: str | None = None
+    heartbeat_timeout_ms: float = 1000.0
+    round_deadline_ms: float | None = 250.0
+    min_quorum_frac: float = 0.5
     seed: int = 0
 
     def __post_init__(self):
@@ -424,6 +451,31 @@ class PCAConfig:
             raise ValueError(
                 f"compile_cache_dir must be a path string or None, got "
                 f"{self.compile_cache_dir!r}"
+            )
+        if not isinstance(self.heartbeat_timeout_ms, (int, float)) or (
+            isinstance(self.heartbeat_timeout_ms, bool)
+            or self.heartbeat_timeout_ms <= 0
+        ):
+            raise ValueError(
+                f"heartbeat_timeout_ms must be a positive duration in "
+                f"ms, got {self.heartbeat_timeout_ms!r}"
+            )
+        if self.round_deadline_ms is not None and (
+            not isinstance(self.round_deadline_ms, (int, float))
+            or isinstance(self.round_deadline_ms, bool)
+            or self.round_deadline_ms <= 0
+        ):
+            raise ValueError(
+                f"round_deadline_ms must be a positive duration in ms "
+                f"or None, got {self.round_deadline_ms!r}"
+            )
+        if not isinstance(self.min_quorum_frac, (int, float)) or (
+            isinstance(self.min_quorum_frac, bool)
+            or not 0.0 < self.min_quorum_frac <= 1.0
+        ):
+            raise ValueError(
+                f"min_quorum_frac must be a fraction in (0, 1], got "
+                f"{self.min_quorum_frac!r}"
             )
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")

@@ -291,6 +291,7 @@ class WorkerPool:
 
     def round(
         self, x_blocks: jax.Array, k: int, worker_mask=None,
+        membership_mask=None,
         v0: jax.Array | None = None, iters: int | None = None,
         orth: str | None = None, merge: bool = True,
     ):
@@ -300,7 +301,14 @@ class WorkerPool:
         computes and then discards, ``distributed.py:126-131`` / B4);
         ``v_bar`` is its top-k eigenspace (what the pseudocode actually
         needs). ``worker_mask`` (m,) of {0,1} excludes failed workers from
-        the merge. ``v0`` (d, k) warm-starts every worker's subspace
+        the merge. ``membership_mask`` (m,) is the ELASTIC-fleet
+        exclusion (``runtime/membership.py``: dead/suspect/joining
+        slots, deadline-missed arrivals) — semantically a PERSISTENT
+        drop where ``worker_mask`` is this round's quarantine; they
+        compose by multiplication into the same masked mean, so
+        elastic rounds reuse the identical merge program (the §5.3
+        mechanism, no second code path). ``v0`` (d, k) warm-starts
+        every worker's subspace
         iteration (online callers pass the previous round's merged
         estimate), ``iters`` overrides the pool's iteration count for
         this round, and ``orth`` overrides the orthonormalization (the
@@ -325,6 +333,10 @@ class WorkerPool:
             )
         if worker_mask is None:
             worker_mask = jnp.ones((m,), dtype=jnp.float32)
+        if membership_mask is not None:
+            worker_mask = worker_mask * jnp.asarray(
+                membership_mask, jnp.float32
+            )
         if not merge:
             sigma_bar = self._fold_fn(
                 x_blocks, worker_mask, k=k, v0=v0, step_iters=iters,

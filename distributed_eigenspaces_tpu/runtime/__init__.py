@@ -11,6 +11,11 @@ from distributed_eigenspaces_tpu.runtime.native import (
     to_f32,
     ChunkReader,
 )
+from distributed_eigenspaces_tpu.runtime.membership import (
+    ElasticStream,
+    MembershipTable,
+    QuorumLost,
+)
 from distributed_eigenspaces_tpu.runtime.prefetch import prefetch_stream
 from distributed_eigenspaces_tpu.runtime.scheduler import (
     WorkQueue,
@@ -29,6 +34,9 @@ __all__ = [
     "to_f32",
     "ChunkReader",
     "prefetch_stream",
+    "ElasticStream",
+    "MembershipTable",
+    "QuorumLost",
     "WorkQueue",
     "run_dynamic_round",
     "FaultLedger",

@@ -36,6 +36,18 @@ detection → policy → recovery loops:
    number of in-process resumes guards against crash loops; exhaustion
    raises :class:`SupervisorError` with the ledger.
 
+Since ISSUE 8 the supervised run also speaks the ELASTIC-membership
+protocol (``runtime/membership.py``): a ``MembershipTable`` in the loop
+(attached explicitly via ``supervised_fit(membership=...)`` or detected
+on the stream) turns dead workers into PERSISTENT worker-mask drops
+riding the same mask feed as the per-round NaN quarantine (the two
+compose by multiplication and stay distinguishable in the ledger: every
+fault event records each worker's membership state at fault time), and
+a ``QuorumLost`` from the stream is handled as a fourth loop: wait a
+bounded time for quorum to return (rejoiners are admitted during the
+wait), then auto-resume from the latest checkpoint under the existing
+resume budget.
+
 Every fault event (quarantined worker, retried pull/step, resume) lands
 as a structured record in the supervisor's ledger and — when a
 ``MetricsLogger`` is attached — in ``MetricsLogger.summary()['faults']``.
@@ -55,6 +67,8 @@ from collections import deque
 from typing import Any, Callable, Iterable
 
 import numpy as np
+
+from distributed_eigenspaces_tpu.runtime.membership import QuorumLost
 
 __all__ = [
     "BreakerOpen",
@@ -464,6 +478,12 @@ class Supervisor:
         ``min(backoff_max, backoff_base * 2**(attempt-1))`` seconds.
       metrics: optional ``MetricsLogger`` — fault events mirror into its
         ``summary()['faults']`` ledger.
+      membership: optional ``runtime.membership.MembershipTable`` — when
+        attached, every ledger event that names workers also records
+        each worker's membership state AT FAULT TIME (so a post-mortem
+        can tell "NaN from a live worker" from "lease expired
+        mid-block"), and ``supervised_fit`` handles ``QuorumLost``
+        against it.
       sleep: injectable sleep (tests pass a recorder; default
         ``time.sleep``).
     """
@@ -477,6 +497,7 @@ class Supervisor:
         backoff_base: float = 0.05,
         backoff_max: float = 2.0,
         metrics=None,
+        membership=None,
         sleep: Callable[[float], None] | None = None,
     ):
         if fault_budget is not None and fault_budget < 0:
@@ -489,6 +510,7 @@ class Supervisor:
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
         self.metrics = metrics
+        self.membership = membership
         self.ledger = FaultLedger()
         self.mask_feed = _MaskFeed()
         self._sleep = sleep if sleep is not None else time.sleep
@@ -500,6 +522,21 @@ class Supervisor:
     # -- ledger --------------------------------------------------------------
 
     def record(self, kind: str, step: int | None = None, **detail) -> None:
+        if self.membership is not None and "workers" in detail:
+            # ledger schema (ISSUE 8, pinned in tests): fault events
+            # that name workers carry each slot's membership state at
+            # fault time + the live count — "NaN from a live worker"
+            # and "lease expired mid-block" are different post-mortems
+            detail.setdefault(
+                "membership",
+                {
+                    int(w): self.membership.state(int(w))
+                    for w in detail["workers"]
+                },
+            )
+            detail.setdefault(
+                "membership_live", self.membership.live_count()
+            )
         ev = self.ledger.record(kind, step, **detail)
         if self.metrics is not None:
             self.metrics.fault(ev)
@@ -709,6 +746,39 @@ class Supervisor:
         )
 
 
+# -- elastic-membership composition (ISSUE 8) --------------------------------
+
+
+def _compose_base_masks(stream, worker_masks, first_step: int):
+    """Fold an elastic stream's per-round membership masks
+    (``ElasticStream.membership_masks`` — membership ∧ arrived) into the
+    externally injected ``worker_masks``, multiplicatively: a dead
+    worker is a PERSISTENT drop, a quarantined one a per-round drop,
+    and the guarded stream sees one composed base mask per block. A
+    plain stream passes ``worker_masks`` through untouched."""
+    feed = getattr(stream, "membership_masks", None)
+    if feed is None:
+        return worker_masks
+    mm_it = feed()
+    if worker_masks is None:
+        return mm_it
+    indexable = hasattr(worker_masks, "__getitem__")
+    wm_it = None if indexable else iter(worker_masks)
+
+    def gen():
+        idx = first_step - 1
+        for m in mm_it:
+            if indexable:
+                w = worker_masks[idx] if idx < len(worker_masks) else None
+            else:
+                w = next(wm_it, None)
+            idx += 1
+            m = np.asarray(m, np.float32)
+            yield m if w is None else m * np.asarray(w, np.float32)
+
+    return gen()
+
+
 # -- detection loop 3: auto-resume ------------------------------------------
 
 
@@ -732,6 +802,8 @@ def supervised_fit(
     backoff_max: float = 2.0,
     sleep: Callable[[float], None] | None = None,
     supervisor: Supervisor | None = None,
+    membership=None,
+    quorum_wait_s: float | None = None,
 ):
     """Run a fit under full supervision: quarantine + retry + resume.
 
@@ -762,6 +834,18 @@ def supervised_fit(
       max_resumes: in-process auto-resumes before an escalation is
         terminal. Resumes triggered by a true process restart are not
         counted (each fresh process gets the full allowance).
+      membership: optional ``runtime.membership.MembershipTable`` for
+        elastic runs (detected from the stream's ``table`` attribute
+        when omitted): ledger events gain per-worker membership state,
+        and a ``QuorumLost`` raised by the stream waits
+        ``quorum_wait_s`` (bounded) for quorum to return — rejoiners
+        are admitted during the wait — then auto-resumes from the
+        latest checkpoint, counted against ``max_resumes``. Quorum
+        never restored, no checkpoint_dir, or budget exhausted →
+        terminal ``SupervisorError`` with the ledger.
+      quorum_wait_s: bound on the quorum wait; ``None`` resolves to
+        ``max(1.0, 20 x heartbeat_timeout)`` of the table that lost
+        quorum.
 
     Returns:
       ``(w, state, supervisor)`` — the final ``(d, k)`` estimate, final
@@ -791,8 +875,11 @@ def supervised_fit(
         backoff_base=backoff_base,
         backoff_max=backoff_max,
         metrics=metrics,
+        membership=membership,
         sleep=sleep,
     )
+    if membership is not None and sup.membership is None:
+        sup.membership = membership
     from distributed_eigenspaces_tpu.utils.telemetry import tracer_of
 
     tr = tracer_of(metrics)
@@ -858,6 +945,51 @@ def supervised_fit(
                     int(state.step) if state is not None else 0,
                     cursor=int(cursor), reason=str(esc), attempt=resumes,
                 )
+            except QuorumLost as ql:
+                # detection loop 4 (ISSUE 8): bounded-time loud quorum
+                # loss → wait for quorum to return (rejoiners admitted
+                # during the wait) → auto-resume under the SAME resume
+                # budget as any other escalation
+                if sup.membership is None:
+                    sup.membership = ql.table
+                sup.record(
+                    "quorum_lost", ql.step, live=ql.live,
+                    frac=round(ql.frac, 4), required=ql.required,
+                )
+                if ckpt is None:
+                    raise SupervisorError(
+                        f"{ql} — no checkpoint_dir, cannot auto-resume",
+                        sup.ledger,
+                    ) from ql
+                if resumes >= max_resumes:
+                    raise SupervisorError(
+                        f"{ql} — {resumes} auto-resumes exhausted",
+                        sup.ledger,
+                    ) from ql
+                wait_s = (
+                    quorum_wait_s if quorum_wait_s is not None
+                    else max(1.0, 20.0 * ql.table.heartbeat_timeout_s)
+                )
+                if not ql.table.wait_for_quorum(wait_s):
+                    raise SupervisorError(
+                        f"quorum not restored within {wait_s:.1f}s "
+                        f"after {ql}",
+                        sup.ledger,
+                    ) from ql
+                sup.record(
+                    "quorum_restored", None,
+                    live=ql.table.live_count(),
+                    frac=round(ql.table.live_frac(), 4),
+                )
+                resumes += 1
+                latest = ckpt.latest()
+                state, cursor = latest if latest is not None else (None, 0)
+                sup.record(
+                    "resume",
+                    int(state.step) if state is not None else 0,
+                    cursor=int(cursor), reason="quorum_restored",
+                    attempt=resumes,
+                )
     finally:
         # the whole supervised run (resume arcs included) as one span
         # on the fit's trace — exits through success and through the
@@ -891,8 +1023,14 @@ def _step_supervised(sup, stream_factory, cfg, state, cursor, ckpt,
         metrics.attach_ingest(ingest)
 
     done = int(state.step) if state is not None else 0
+    raw = stream_factory(cursor)
+    if sup.membership is None:
+        # elastic streams carry their table — attach it so ledger
+        # events record membership state without extra wiring
+        sup.membership = getattr(raw, "table", None)
     guarded = sup.guard_stream(
-        stream_factory(cursor), base_masks=worker_masks,
+        raw,
+        base_masks=_compose_base_masks(raw, worker_masks, done + 1),
         first_step=done + 1,
     )
     callbacks = []
@@ -942,8 +1080,12 @@ def _segmented_supervised(sup, stream_factory, cfg, state, cursor, ckpt,
     done = int(state.step)
     remaining = max(0, cfg.num_steps - done)
     if remaining:
+        raw = stream_factory(cursor)
+        if sup.membership is None:
+            sup.membership = getattr(raw, "table", None)
         guarded = sup.guard_stream(
-            stream_factory(cursor), base_masks=worker_masks,
+            raw,
+            base_masks=_compose_base_masks(raw, worker_masks, done + 1),
             first_step=done + 1,
         )
         try:

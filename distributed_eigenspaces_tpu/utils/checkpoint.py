@@ -13,21 +13,36 @@ complete resumable state is tiny and explicit:
 
 Storage is a plain ``state.npz`` plus an atomically-renamed ``meta.json``
 commit marker (a crash mid-write leaves no meta.json, so the checkpoint is
-simply not found). The payload is gathered to host on save, so restore works
-on any topology — state saved from an 8-device mesh restores onto 1 device
-or 64. States are a few d*r floats; orbax's async machinery buys nothing at
-this size.
+simply not found). Since ISSUE 8 the marker also carries a sha256 of the
+payload, and :meth:`Checkpointer.latest` is a RESUME LADDER: a committed
+checkpoint whose payload is torn or checksum-bad is quarantined loudly
+(renamed ``*.quarantined`` — evidence kept, the PR 7 registry
+discipline) and the ladder steps back to the newest checkpoint that
+actually restores, instead of failing the resume on damaged bytes. The
+payload is gathered to host on save, so restore works on any topology —
+state saved from an 8-device mesh restores onto 1 device or 64. States
+are a few d*r floats; orbax's async machinery buys nothing at this size.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 from typing import Any
 
 import jax
 import numpy as np
+
+from distributed_eigenspaces_tpu.utils.metrics import log_line
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A COMMITTED checkpoint whose payload does not restore: torn /
+    truncated npz, checksum mismatch, or missing fields. Distinct from
+    "no committed checkpoint" (FileNotFoundError): the marker landed
+    but the bytes are damaged — disk rot, tamper, or a partial copy."""
 
 from distributed_eigenspaces_tpu.algo.online import OnlineState
 from distributed_eigenspaces_tpu.algo.scan import SegmentState
@@ -113,12 +128,18 @@ def _write_checkpoint(path, host, kind, cursor, extra):
     # tmp name must keep the .npz suffix (np.savez appends it otherwise)
     state_tmp = os.path.join(path, "state.tmp.npz")
     np.savez(state_tmp, **{f: getattr(host, f) for f in host._fields})
+    with open(state_tmp, "rb") as f:
+        checksum = hashlib.sha256(f.read()).hexdigest()
     os.replace(state_tmp, os.path.join(path, "state.npz"))
     meta = {
         "state_type": kind,
         "cursor": int(cursor),
         "step": int(host.step),
         "format_version": 1,
+        # payload sha256: lets restore tell torn/rotted bytes from a
+        # valid commit (ISSUE 8 resume ladder; absent on older
+        # checkpoints — those restore unverified, back-compat)
+        "checksum": checksum,
     }
     if extra:
         meta["extra"] = extra
@@ -141,10 +162,34 @@ def restore_checkpoint(path: str):
     with open(meta_path) as f:
         meta = json.load(f)
     cls = _STATE_TYPES[meta["state_type"]]
-    with np.load(os.path.join(path, "state.npz")) as z:
-        import jax.numpy as jnp
+    payload = os.path.join(path, "state.npz")
+    want = meta.get("checksum")
+    if want is not None:
+        try:
+            with open(payload, "rb") as f:
+                got = hashlib.sha256(f.read()).hexdigest()
+        except OSError as e:
+            raise CheckpointCorrupt(
+                f"committed checkpoint at {path!r} has an unreadable "
+                f"payload: {e!r}"
+            ) from e
+        if got != want:
+            raise CheckpointCorrupt(
+                f"committed checkpoint at {path!r} failed its payload "
+                f"checksum (sha256 {got[:12]}… != recorded "
+                f"{want[:12]}…): torn or rotted bytes"
+            )
+    try:
+        with np.load(payload) as z:
+            import jax.numpy as jnp
 
-        state = cls(**{f: jnp.asarray(z[f]) for f in cls._fields})
+            state = cls(**{f: jnp.asarray(z[f]) for f in cls._fields})
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # torn zip, missing field, bad dtype...
+        raise CheckpointCorrupt(
+            f"committed checkpoint at {path!r} does not restore: {e!r}"
+        ) from e
     return state, meta["cursor"]
 
 
@@ -173,20 +218,40 @@ class Checkpointer:
         self._gc()
 
     def latest(self):
-        """Restore the newest committed checkpoint, or None."""
-        steps = self._steps()
-        if not steps:
-            return None
-        return restore_checkpoint(
-            os.path.join(self.directory, f"step_{steps[-1]:08d}")
-        )
+        """Restore the newest committed checkpoint that actually
+        RESTORES, or None — the resume ladder (ISSUE 8): a committed
+        step whose payload is torn or checksum-bad is quarantined
+        loudly (directory renamed ``*.quarantined`` — evidence kept,
+        never silently deleted) and the ladder steps back to the next
+        newest, so one rotted file degrades the resume by a few steps
+        instead of failing it."""
+        for step in reversed(self._steps()):
+            path = os.path.join(self.directory, f"step_{step:08d}")
+            try:
+                return restore_checkpoint(path)
+            except CheckpointCorrupt as e:
+                quarantined = path + ".quarantined"
+                try:
+                    os.replace(path, quarantined)
+                except OSError:
+                    quarantined = None
+                log_line(
+                    "checkpoint quarantined: stepping the resume "
+                    "ladder back",
+                    step=step, error=str(e), quarantined=quarantined,
+                )
+            except FileNotFoundError:
+                continue  # lost a GC race — older steps still stand
+        return None
 
     def _steps(self):
         if not os.path.isdir(self.directory):
             return []
         out = []
         for name in os.listdir(self.directory):
-            if name.startswith("step_"):
+            # "step_NNNNNNNN" only — quarantined dirs keep the prefix
+            # but grow a suffix, and must never re-enter the ladder
+            if name.startswith("step_") and name[5:].isdigit():
                 if os.path.exists(
                     os.path.join(self.directory, name, "meta.json")
                 ):

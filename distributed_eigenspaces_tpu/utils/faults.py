@@ -107,6 +107,55 @@ class ChaosPlan:
     kill_at: int | None = None
 
 
+@dataclasses.dataclass(frozen=True)
+class ChurnPlan:
+    """Deterministic membership-churn schedule for the FIT tier
+    (ISSUE 8), consumed by ``runtime/membership.py ElasticStream``
+    (1-based absolute steps, resume-safe like :class:`ChaosPlan`).
+
+    ``kill_at``: ``{step: [slots]}`` — the listed workers CRASH before
+    that round: their heartbeats stop and the membership table finds
+    out via lease expiry (suspect after ``heartbeat_timeout_ms``, dead
+    one grace later) — the liveness-detection path under test.
+    ``leave_at``: graceful departures — the slot goes dead immediately
+    (the worker said goodbye; no detection lag).
+    ``rejoin_at``: the listed workers come back: they re-claim their
+    old slot (``MembershipTable.join``) and are admitted at the NEXT
+    round with a fresh lease — flapping is kills and rejoins
+    interleaved on the same slot.
+    ``straggle``: ``{step: {slot: delay_s}}`` — one-off delivery
+    delays past the round start; a delay beyond
+    ``cfg.round_deadline_ms`` misses the round and the rows fold into
+    the NEXT merge.
+    ``slow``: ``{slot: delay_s}`` — persistent stragglers (the delay
+    applies every round; beyond the deadline this is a steady
+    one-round lag, never a stall).
+    """
+
+    kill_at: dict[int, list[int]] = dataclasses.field(
+        default_factory=dict
+    )
+    leave_at: dict[int, list[int]] = dataclasses.field(
+        default_factory=dict
+    )
+    rejoin_at: dict[int, list[int]] = dataclasses.field(
+        default_factory=dict
+    )
+    straggle: dict[int, dict[int, float]] = dataclasses.field(
+        default_factory=dict
+    )
+    slow: dict[int, float] = dataclasses.field(default_factory=dict)
+
+    def delay(self, step: int, slot: int) -> float:
+        """Delivery delay (seconds past round start) for ``slot`` at
+        ``step``: the scheduled one-off wins over the persistent
+        rate."""
+        d = self.straggle.get(step, {}).get(slot)
+        if d is not None:
+            return float(d)
+        return float(self.slow.get(slot, 0.0))
+
+
 @dataclasses.dataclass
 class ServeChaosPlan:
     """Deterministic fault schedule for the SERVE tier (ISSUE 7 — the

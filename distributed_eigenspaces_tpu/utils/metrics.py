@@ -115,6 +115,16 @@ class MetricsLogger:
         #: fleet-serving events (parallel/fleet.py FleetServer bucket
         #: dispatches) — surfaced by :meth:`summary` under "fleet"
         self.fleet_records = RingLog(retention, self._evict_fleet)
+        #: elastic-membership events (runtime/membership.py
+        #: MembershipTable / ElasticStream): joins, leaves,
+        #: suspect→dead transitions, deadline-closed rounds — surfaced
+        #: by :meth:`summary` under "membership"
+        self.membership_records = RingLog(
+            retention, self._evict_membership
+        )
+        #: live membership table (attach_membership) — its snapshot
+        #: (states, generations, quorum) rides the summary
+        self.membership_table = None
         #: compile-lifecycle counters (utils/compile_cache.py
         #: CompileCache), attached via :meth:`attach_compile` —
         #: surfaced by :meth:`summary` under "compile"
@@ -142,6 +152,15 @@ class MetricsLogger:
         self._serve_agg["lane_deaths"] = 0
         self._serve_agg["breaker_trips"] = 0
         self._fleet_agg = self._fresh_dispatch_agg()
+        # elastic-membership eviction aggregates (ISSUE 8): event
+        # counts by kind, round outcomes (deadline closes, stale
+        # folds), and the per-round arrival histogram — so
+        # summary()["membership"] covers the whole run after eviction
+        self._membership_agg = {
+            "count": 0, "by_kind": {}, "rounds": 0,
+            "deadline_closed": 0, "stale_folds": 0,
+            "arrival_hist": {},
+        }
 
     @staticmethod
     def _fresh_dispatch_agg() -> dict:
@@ -269,6 +288,25 @@ class MetricsLogger:
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
 
+    def attach_membership(self, table) -> "MetricsLogger":
+        """Attach a live ``runtime.membership.MembershipTable`` — its
+        snapshot (per-slot states, generations, quorum) lands in
+        ``summary()["membership"]["table"]`` (read at summary time,
+        like the ingest stats)."""
+        self.membership_table = table
+        return self
+
+    def membership(self, event: dict) -> None:
+        """Record one structured membership event (an elastic-fleet
+        lifecycle action or a closed round — ``runtime/membership.py``).
+        Rides the same JSON stream as step records, tagged
+        ``"membership"``."""
+        rec = {"membership": event.get("kind", "unknown"), **event}
+        _stamp(rec)
+        self.membership_records.append(rec)
+        if self.stream is not None:
+            print(json.dumps(rec), file=self.stream, flush=True)
+
     def fault(self, event: dict) -> None:
         """Record one structured fault event (a supervisor detection /
         recovery action). Events ride the same JSON stream as step
@@ -297,6 +335,26 @@ class MetricsLogger:
         agg["count"] += 1
         kind = rec.get("fault", "unknown")
         agg["by_kind"][kind] = agg["by_kind"].get(kind, 0) + 1
+
+    def _evict_membership(self, rec: dict) -> None:
+        agg = self._membership_agg
+        agg["count"] += 1
+        kind = rec.get("membership", "unknown")
+        agg["by_kind"][kind] = agg["by_kind"].get(kind, 0) + 1
+        if kind == "round_closed":
+            self._fold_membership_round(agg, rec)
+
+    @staticmethod
+    def _fold_membership_round(agg: dict, rec: dict) -> None:
+        agg["rounds"] += 1
+        if rec.get("deadline_closed"):
+            agg["deadline_closed"] += 1
+        agg["stale_folds"] += len(rec.get("stale") or ())
+        arrived = rec.get("arrived")
+        if arrived is not None:
+            key = str(int(arrived))
+            hist = agg["arrival_hist"]
+            hist[key] = hist.get(key, 0) + 1
 
     def _evict_serve(self, rec: dict) -> None:
         if rec.get("serve") == "drift":
@@ -448,6 +506,12 @@ class MetricsLogger:
                 out["faults"]["events_evicted"] = self.fault_records.evicted
         if self.ingest_stats is not None:
             out["ingest"] = self.ingest_stats.as_dict()
+        if (
+            self.membership_records
+            or self._membership_agg["count"]
+            or self.membership_table is not None
+        ):
+            out["membership"] = self._membership_summary()
         if self.serve_records or self._serve_agg["events"]:
             out["serving"] = self._serving_summary()
         if self.fleet_records or self._fleet_agg["events"]:
@@ -587,6 +651,41 @@ class MetricsLogger:
                 },
             },
         }
+
+    def _membership_summary(self) -> dict:
+        """The ``summary()["membership"]`` section (ISSUE 8): event
+        counts by kind (joins, admits, leaves, suspect→dead, quorum
+        transitions), round outcomes (deadline-closed rounds, stale
+        straggler folds, per-round arrival histogram), the retained
+        event window, and — when a table is attached — its live
+        snapshot. Evictions are folded in, so the counts cover the
+        whole run."""
+        agg = self._membership_agg
+        by_kind = dict(agg["by_kind"])
+        rounds = {
+            "rounds": agg["rounds"],
+            "deadline_closed": agg["deadline_closed"],
+            "stale_folds": agg["stale_folds"],
+            "arrival_hist": dict(agg["arrival_hist"]),
+        }
+        for r in self.membership_records:
+            kind = r.get("membership", "unknown")
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+            if kind == "round_closed":
+                self._fold_membership_round(rounds, r)
+        out: dict = {
+            "events": agg["count"] + len(self.membership_records),
+            "by_kind": by_kind,
+            **rounds,
+            # the retained window — evicted events survive in the
+            # counters above (the faults-section rule)
+            "recent": list(self.membership_records),
+        }
+        if self.membership_records.evicted:
+            out["events_evicted"] = self.membership_records.evicted
+        if self.membership_table is not None:
+            out["table"] = self.membership_table.snapshot()
+        return out
 
     def _fleet_summary(self) -> dict:
         """The ``summary()["fleet"]`` section (mirrors ``["serving"]``):

@@ -23,6 +23,18 @@ It then checks the contract the docs promise (docs/ROBUSTNESS.md):
 
 Exit code 0 iff every check passes; the JSON report carries the ledger.
 
+``--mode churn`` (ISSUE 8) runs the ELASTIC-MEMBERSHIP chaos suite
+(``runtime/membership.py``): the lease state machine under a
+deterministic clock (live → suspect → dead → join → admit, stable slot
+ids, generation bumps), a supervised elastic fit under a ChurnPlan
+(crash-kills detected by lease expiry, dead→join→admit rejoins
+contributing to later merges, a persistent straggler folded one-step-
+stale by the round deadline, NaN corruption composed with membership so
+the ledger distinguishes "NaN from a live worker" from "lease
+expired"), and a quorum-loss arc (loud ``QuorumLost`` within 2x the
+heartbeat timeout, auto-resume from the latest checkpoint once the
+workers rejoin).
+
 ``--mode serve`` (ISSUE 7) runs the READ-path chaos suite instead —
 the serve-tier duals of the fit-side faults:
 
@@ -68,11 +80,15 @@ sys.path.insert(
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--mode", choices=["fit", "serve"], default="fit",
+    p.add_argument("--mode", choices=["fit", "serve", "churn"],
+                   default="fit",
                    help="fit: the write-path recovery contract "
                    "(supervisor kill/quarantine/resume); serve: the "
                    "read-path suite (durable-registry crash recovery, "
-                   "lane kill, overload shed, breaker isolation)")
+                   "lane kill, overload shed, breaker isolation); "
+                   "churn: the elastic-membership suite (lease "
+                   "liveness, deadline rounds, straggler folds, "
+                   "quorum loss + auto-resume)")
     p.add_argument("--dim", type=int, default=64)
     p.add_argument("--k", type=int, default=3)
     p.add_argument("--workers", type=int, default=4)
@@ -297,6 +313,221 @@ def serve_chaos(args) -> int:
     return 0 if report["ok"] else 1
 
 
+def churn_chaos(args) -> int:
+    """``--mode churn``: the elastic-membership chaos suite (module
+    docstring). In-process; the gated CI variant with timing
+    measurements lives in ``bench.py --chaos-churn`` (CI stage 8)."""
+    import time
+
+    import jax
+
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.data.stream import block_stream
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+    from distributed_eigenspaces_tpu.runtime.membership import (
+        ElasticStream,
+        MembershipTable,
+    )
+    from distributed_eigenspaces_tpu.runtime.supervisor import (
+        supervised_fit,
+    )
+    from distributed_eigenspaces_tpu.utils.faults import (
+        ChaosPlan,
+        ChaosStream,
+        ChurnPlan,
+    )
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    checks: dict[str, bool] = {}
+
+    # -- 1. lease state machine under a deterministic clock ----------------
+    t = [0.0]
+    tab = MembershipTable(
+        4, heartbeat_timeout_ms=100, min_quorum_frac=0.5,
+        clock=lambda: t[0],
+    )
+    t[0] = 0.15
+    for s in (1, 2, 3):
+        tab.heartbeat(s)
+    tab.sweep()
+    checks["missed_lease_goes_suspect"] = tab.state(0) == "suspect"
+    t[0] = 0.30
+    for s in (1, 2, 3):
+        tab.heartbeat(s)
+    tab.sweep()
+    checks["suspect_grace_goes_dead"] = tab.state(0) == "dead"
+    tab.heartbeat(0)  # stale heartbeat from a dead incarnation
+    checks["dead_heartbeat_ignored"] = tab.state(0) == "dead"
+    slot = tab.join(0)
+    checks["rejoin_keeps_slot_id"] = (
+        slot == 0 and tab.state(0) == "joining" and tab.generation(0) == 1
+    )
+    tab.begin_round(9)
+    checks["joiner_admitted_next_round"] = tab.state(0) == "live"
+
+    # -- 2. supervised elastic fit under churn + NaN corruption ------------
+    m, n, d, T = args.workers + 4, args.rows_per_worker // 2 or 8, args.dim, 12
+    cfg = PCAConfig(
+        dim=d, k=args.k, num_workers=m, rows_per_worker=n, num_steps=T,
+        backend="local", solver=args.solver, prefetch_depth=0,
+        heartbeat_timeout_ms=100.0, round_deadline_ms=40.0,
+        min_quorum_frac=0.4,
+    )
+    spec = planted_spectrum(
+        d, k_planted=args.k, gap=20.0, noise=0.01, seed=args.seed
+    )
+    data = np.asarray(
+        spec.sample(jax.random.PRNGKey(args.seed + 1), m * n * T)
+    )
+    rows_per_step = m * n
+    churn = ChurnPlan(
+        kill_at={3: [0, 1]},
+        rejoin_at={9: [0]},
+        slow={m - 1: 0.08},
+    )
+    nan_step = 5
+
+    def factory(metrics, table, with_nan):
+        def make(start_row):
+            raw = block_stream(
+                data, num_workers=m, rows_per_worker=n,
+                start_row=start_row, device=False,
+            )
+            first = start_row // rows_per_step + 1
+            if with_nan:
+                raw = ChaosStream(
+                    raw, ChaosPlan(nan_blocks={nan_step: [3]}),
+                    first_step=first,
+                )
+            return ElasticStream(
+                raw, table, cfg, churn=churn, first_step=first,
+                metrics=metrics,
+            )
+
+        return make
+
+    metrics = MetricsLogger()
+    table = MembershipTable(
+        m, heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+        min_quorum_frac=cfg.min_quorum_frac, metrics=metrics,
+    )
+    metrics.attach_membership(table)
+    w, st, sup = supervised_fit(
+        factory(metrics, table, True), cfg, metrics=metrics,
+        membership=table,
+    )
+    angle = float(
+        jax.numpy.max(
+            principal_angles_degrees(
+                jax.numpy.asarray(np.asarray(w)), spec.top_k(args.k)
+            )
+        )
+    )
+    ms = metrics.summary()["membership"]
+    checks["churn_run_completes"] = int(st.step) == T
+    checks["churn_angle_within_tol"] = angle <= args.tol_deg
+    checks["deaths_detected_and_rejoined"] = (
+        ms["by_kind"].get("dead", 0) >= 1
+        and ms["by_kind"].get("admit", 0) >= 1
+    )
+    checks["straggler_folds_stale"] = ms["stale_folds"] >= 1
+    nan_events = [
+        e for e in sup.ledger.events
+        if e["kind"] == "quarantine_nonfinite"
+    ]
+    checks["ledger_carries_membership_state"] = bool(nan_events) and all(
+        "membership" in e and "membership_live" in e
+        and set(e["membership"]) == set(e["workers"])
+        for e in nan_events
+    )
+
+    # -- 3. quorum loss: loud, bounded, auto-resume on rejoin --------------
+    import tempfile
+    import threading
+
+    metrics2 = MetricsLogger()
+    table2 = MembershipTable(
+        m, heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+        min_quorum_frac=cfg.min_quorum_frac, metrics=metrics2,
+    )
+    killed = list(range(int(m * 0.7)))  # below the 0.4 quorum floor
+    churn2 = ChurnPlan(kill_at={4: killed})
+
+    def factory2(start_row):
+        raw = block_stream(
+            data, num_workers=m, rows_per_worker=n,
+            start_row=start_row, device=False,
+        )
+        return ElasticStream(
+            raw, table2, cfg, churn=churn2,
+            first_step=start_row // rows_per_step + 1, metrics=metrics2,
+        )
+
+    def rejoiner():
+        deadline = time.monotonic() + 30.0
+        while table2.quorum_ok() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        joined: set = set()
+        while len(joined) < len(killed) and time.monotonic() < deadline:
+            table2.sweep()
+            for s in killed:
+                if s not in joined and table2.state(s) == "dead":
+                    table2.join(s)
+                    joined.add(s)
+            time.sleep(0.01)
+
+    threading.Thread(target=rejoiner, daemon=True).start()
+    with tempfile.TemporaryDirectory(prefix="det_churn_") as ck:
+        w2, st2, sup2 = supervised_fit(
+            factory2, cfg, metrics=metrics2, membership=table2,
+            checkpoint_dir=ck,
+        )
+    kinds2 = sup2.ledger.by_kind
+    mrecs = list(metrics2.membership_records)
+    t_kill = next(
+        (r["t_mono"] for r in mrecs if r["membership"] == "churn_kill"),
+        None,
+    )
+    t_lost = next(
+        (r["t_mono"] for r in mrecs if r["membership"] == "quorum_lost"),
+        None,
+    )
+    detect_ms = (
+        (t_lost - t_kill) * 1e3
+        if t_kill is not None and t_lost is not None else None
+    )
+    checks["quorum_lost_loud_and_bounded"] = (
+        kinds2.get("quorum_lost", 0) >= 1
+        and detect_ms is not None
+        and detect_ms <= 2.0 * cfg.heartbeat_timeout_ms
+    )
+    checks["quorum_auto_resumed"] = (
+        kinds2.get("quorum_restored", 0) >= 1 and int(st2.step) == T
+    )
+
+    report = {
+        "mode": "churn",
+        "angle_vs_truth_deg": round(angle, 6),
+        "quorum_detect_ms": (
+            round(detect_ms, 1) if detect_ms is not None else None
+        ),
+        "membership": {
+            "by_kind": ms["by_kind"],
+            "rounds": ms["rounds"],
+            "deadline_closed": ms["deadline_closed"],
+            "stale_folds": ms["stale_folds"],
+        },
+        "quorum_faults": kinds2,
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if os.environ.get("JAX_PLATFORMS"):
@@ -305,6 +536,8 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     if args.mode == "serve":
         return serve_chaos(args)
+    if args.mode == "churn":
+        return churn_chaos(args)
     import jax
 
     from distributed_eigenspaces_tpu.config import PCAConfig

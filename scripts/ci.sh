@@ -52,12 +52,18 @@
 #      breaker isolation, and serve-lane kill + watchdog restart, all
 #      gated by the bench itself; compared (recovery_ms ratio +
 #      structural bound) against the committed BENCH_CHAOS_SMOKE_CPU;
-#   8. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   8. bench.py --chaos-churn: the fit-tier elastic-membership smoke
+#      (ISSUE 8) — 30% worker loss + flapping rejoin + persistent
+#      straggler inside the angle budget with zero deadlocks, quorum
+#      loss loud within 2x heartbeat timeout + checkpoint auto-resume,
+#      all gated by the bench itself; compared (churn_recovery_ms
+#      ratio + structural bound) vs the committed BENCH_CHURN_SMOKE_CPU;
+#   9. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/8] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/9] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -65,7 +71,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/8] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/9] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -75,7 +81,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/8] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/9] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -90,7 +96,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/8] serve equality + amortization smoke (CPU) =="
+echo "== [4/9] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -105,7 +111,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/8] coldstart + prewarm smoke (CPU) =="
+echo "== [5/9] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -120,7 +126,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [6/8] telemetry smoke: trace export + span-chain validation =="
+echo "== [6/9] telemetry smoke: trace export + span-chain validation =="
 # A serve burst with --trace-out, then a structural validation of the
 # emitted timeline: the JSON must parse as Chrome trace-event format,
 # every served query's span chain (admit → queue_wait → dispatch →
@@ -165,7 +171,7 @@ print(json.dumps({
 }))
 PY
 
-echo "== [7/8] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
+echo "== [7/9] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
 # bench.py --chaos-serve asserts the read-path resilience gates itself
 # (ISSUE 7): a kill -9'd publisher's store recovers (torn snapshot
 # skipped, checksum corruption quarantined) and the restarted server
@@ -184,7 +190,27 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve
 fi
 
-echo "== [8/8] graft entry + 8-device sharded dryrun =="
+echo "== [8/9] chaos-churn smoke: elastic membership under churn (CPU) =="
+# bench.py --chaos-churn asserts the fit-tier elastic-membership gates
+# itself (ISSUE 8): a run with 30% mid-run worker loss, flapping
+# rejoins, and a persistent straggler finishes all steps inside the
+# angle budget with zero deadlocks (every round deadline-closes; the
+# straggler folds one-step-stale); a rejoined worker contributes to a
+# later merge (asserted via summary()["membership"]); 60% loss raises
+# a loud QuorumLost within 2x the heartbeat timeout and auto-resumes
+# from the latest checkpoint once the workers rejoin. The compare
+# checks churn_recovery_ms drift against the committed record (old/new
+# ratio + a 10 s structural bound so lease/grace jitter can't flap CI)
+# and surfaces the quorum-loss detection latency in the verdict.
+if [[ -f BENCH_CHURN_SMOKE_CPU.json ]]; then
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-churn \
+        --compare BENCH_CHURN_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-churn
+fi
+
+echo "== [9/9] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

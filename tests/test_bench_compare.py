@@ -359,3 +359,86 @@ def test_chaos_serve_missing_recovery_skips_loudly(tmp_path, capsys):
     verdict = json.loads(capsys.readouterr().err.strip())
     assert verdict["compare"] == "skipped"
     assert "recovery_ms" in verdict["reason"]
+
+
+def _churn_report(recovery_ms, detect_ms=130.0):
+    """A bench.py --chaos-churn record (the ISSUE-8 shape)."""
+    return {
+        "metric": "pca_chaos_churn_recovery",
+        "value": recovery_ms,
+        "unit": "ms",
+        "churn_recovery_ms": recovery_ms,
+        "quorum_detect_ms": detect_ms,
+    }
+
+
+def test_chaos_churn_records_compare_recovery_and_detection(
+    tmp_path, capsys
+):
+    """ISSUE-8 satellite: churn records compare churn_recovery_ms
+    (old/new ratio with a structural bound — lease/grace jitter must
+    not flap CI) and surface the quorum-loss detection latency on both
+    sides of the verdict."""
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_churn_report(115.0)))
+    # slower recovery, still far under the structural bound: no flap
+    assert bench.compare_reports(
+        str(old), _churn_report(400.0, detect_ms=150.0), threshold=0.5
+    ) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["churn_recovery_ms_old"] == 115.0
+    assert verdict["churn_recovery_ms_new"] == 400.0
+    assert verdict["quorum_detect_ms_old"] == 130.0
+    assert verdict["quorum_detect_ms_new"] == 150.0
+    assert not verdict["regression"]
+
+    # recovery past BOTH the ratio floor and the structural bound:
+    # a stuck resume, not jitter
+    assert bench.compare_reports(
+        str(old), _churn_report(15_000.0), threshold=0.5
+    ) == 1
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["regression"] is True
+    assert verdict["structural_bound_ms"] == 10_000.0
+
+
+def test_chaos_churn_vs_headline_mismatch_skips_both_directions(
+    tmp_path, capsys
+):
+    headline = _report(60e6, 120.0)
+    churn = _churn_report(115.0)
+    old = tmp_path / "old.json"
+
+    old.write_text(json.dumps(churn))
+    assert bench.compare_reports(str(old), headline) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]
+
+    old.write_text(json.dumps(headline))
+    assert bench.compare_reports(str(old), churn) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]
+
+
+def test_chaos_churn_vs_chaos_serve_mismatch_skips(tmp_path, capsys):
+    # the two chaos records carry different recovery semantics (serve
+    # restart vs fit-tier quorum resume) — never cross-compared
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_chaos_report(320.0)))
+    assert bench.compare_reports(str(old), _churn_report(115.0)) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]
+
+
+def test_chaos_churn_missing_recovery_skips_loudly(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    rep = _churn_report(115.0)
+    del rep["churn_recovery_ms"]
+    old.write_text(json.dumps(rep))
+    assert bench.compare_reports(str(old), _churn_report(120.0)) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "churn_recovery_ms" in verdict["reason"]

@@ -1,0 +1,773 @@
+"""Elastic fleet membership (ISSUE 8): lease liveness, quorum merges,
+deadline rounds with straggler folds, churn chaos wiring — plus the
+satellite contracts (ledger membership schema, mask-feed replay under
+churn, the checkpoint resume ladder)."""
+
+import os
+import tempfile
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.algo.online import (
+    OnlineState,
+    online_distributed_pca,
+)
+from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.stream import block_stream
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.parallel.worker_pool import WorkerPool
+from distributed_eigenspaces_tpu.runtime.membership import (
+    ElasticStream,
+    MembershipTable,
+    QuorumLost,
+)
+from distributed_eigenspaces_tpu.runtime.supervisor import (
+    Supervisor,
+    SupervisorError,
+    supervised_fit,
+)
+from distributed_eigenspaces_tpu.utils.checkpoint import (
+    CheckpointCorrupt,
+    Checkpointer,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from distributed_eigenspaces_tpu.utils.faults import ChurnPlan
+from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=16, k=2, num_workers=4, rows_per_worker=8, num_steps=6,
+        backend="local", prefetch_depth=0,
+        heartbeat_timeout_ms=100.0, round_deadline_ms=30.0,
+        min_quorum_frac=0.5,
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+def _data(cfg, seed=0, steps=None):
+    spec = planted_spectrum(
+        cfg.dim, k_planted=cfg.k, gap=20.0, noise=0.01, seed=seed
+    )
+    T = steps if steps is not None else cfg.num_steps
+    rows = cfg.num_workers * cfg.rows_per_worker * T
+    return np.asarray(spec.sample(jax.random.PRNGKey(seed + 1), rows)), spec
+
+
+def _clocked_table(m=4, timeout_ms=100.0, quorum=0.5):
+    t = [0.0]
+    tab = MembershipTable(
+        m, heartbeat_timeout_ms=timeout_ms, min_quorum_frac=quorum,
+        clock=lambda: t[0],
+    )
+    return tab, t
+
+
+# -- MembershipTable state machine -------------------------------------------
+
+
+class TestMembershipTable:
+    def test_lease_expiry_suspect_then_dead(self):
+        tab, t = _clocked_table()
+        assert tab.mask().tolist() == [1.0] * 4
+        t[0] = 0.15
+        for s in (1, 2, 3):
+            tab.heartbeat(s)
+        tab.sweep()
+        assert tab.state(0) == "suspect"
+        assert tab.mask().tolist() == [0.0, 1.0, 1.0, 1.0]
+        t[0] = 0.22  # inside the suspect grace: still suspect
+        tab.sweep()
+        assert tab.state(0) == "suspect"
+        t[0] = 0.30
+        tab.sweep()
+        assert tab.state(0) == "dead"
+
+    def test_suspect_recovers_in_place(self):
+        tab, t = _clocked_table()
+        t[0] = 0.15
+        for s in (1, 2, 3):
+            tab.heartbeat(s)
+        tab.sweep()
+        assert tab.state(0) == "suspect"
+        tab.heartbeat(0)  # the flap path: never lost the slot
+        assert tab.state(0) == "live"
+        assert tab.generation(0) == 0
+
+    def test_rejoin_protocol_stable_slot_fresh_generation(self):
+        tab, t = _clocked_table()
+        t[0] = 0.25
+        for s in (1, 2, 3):
+            tab.heartbeat(s)
+        tab.sweep()
+        t[0] = 0.50
+        for s in (1, 2, 3):
+            tab.heartbeat(s)
+        tab.sweep()
+        assert tab.state(0) == "dead"
+        tab.heartbeat(0)  # stale heartbeat from the dead incarnation
+        assert tab.state(0) == "dead"
+        slot = tab.join(0)
+        assert slot == 0 and tab.state(0) == "joining"
+        assert tab.generation(0) == 1
+        # joining is NOT live until the next round boundary
+        assert tab.mask().tolist() == [0.0, 1.0, 1.0, 1.0]
+        tab.begin_round(7)
+        assert tab.state(0) == "live"
+        assert tab.mask().tolist() == [1.0] * 4
+
+    def test_join_rejects_member_slots_and_full_table(self):
+        tab, _ = _clocked_table()
+        with pytest.raises(ValueError, match="not dead"):
+            tab.join(0)
+        with pytest.raises(ValueError, match="no dead slot"):
+            tab.join()
+
+    def test_leave_is_immediate_and_joinable(self):
+        tab, _ = _clocked_table()
+        tab.leave(2)
+        assert tab.state(2) == "dead"
+        assert tab.join() == 2
+
+    def test_quorum_lost_raises_loudly(self):
+        tab, t = _clocked_table(quorum=0.75)
+        t[0] = 0.5
+        tab.heartbeat(3)
+        with pytest.raises(QuorumLost, match="min_quorum_frac"):
+            tab.begin_round(4)
+        ev_kinds = [e["kind"] for e in tab.events]
+        assert "quorum_lost" in ev_kinds
+
+    def test_wait_for_quorum_admits_joiners(self):
+        tab, t = _clocked_table(quorum=1.0)
+        tab.leave(0)
+        assert not tab.quorum_ok()
+        tab.join(0)
+        assert tab.wait_for_quorum(timeout_s=0.0)
+        assert tab.state(0) == "live"
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            MembershipTable(4, heartbeat_timeout_ms=0)
+        with pytest.raises(ValueError):
+            MembershipTable(4, min_quorum_frac=0.0)
+        with pytest.raises(ValueError):
+            MembershipTable(0)
+
+
+# -- config knobs ------------------------------------------------------------
+
+
+class TestConfigKnobs:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="heartbeat_timeout_ms"):
+            _cfg(heartbeat_timeout_ms=-1)
+        with pytest.raises(ValueError, match="round_deadline_ms"):
+            _cfg(round_deadline_ms=0)
+        with pytest.raises(ValueError, match="min_quorum_frac"):
+            _cfg(min_quorum_frac=1.5)
+        assert _cfg(round_deadline_ms=None).round_deadline_ms is None
+
+
+# -- ElasticStream: deadline rounds + straggler folds ------------------------
+
+
+class TestElasticStream:
+    def test_no_churn_is_identity_with_full_masks(self):
+        cfg = _cfg()
+        data, _ = _data(cfg)
+        table = MembershipTable(cfg.num_workers)
+        raw = list(
+            block_stream(
+                data, num_workers=cfg.num_workers,
+                rows_per_worker=cfg.rows_per_worker, device=False,
+            )
+        )
+        es = ElasticStream(iter(raw), table, cfg)
+        masks = es.membership_masks()
+        for want in raw:
+            got = next(es)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+            assert next(masks).tolist() == [1.0] * cfg.num_workers
+
+    def test_straggler_folds_into_next_merge(self):
+        cfg = _cfg(num_steps=3)
+        m, n, d = cfg.num_workers, cfg.rows_per_worker, cfg.dim
+        # encode the step number in the block so the stale splice is
+        # observable: block[t][s] == t + s/10
+        blocks = [
+            np.fromfunction(
+                lambda s, i, j: (t + 1) + s / 10.0, (m, n, d),
+                dtype=np.float32,
+            ).astype(np.float32)
+            for t in range(3)
+        ]
+        table = MembershipTable(m, heartbeat_timeout_ms=10_000)
+        churn = ChurnPlan(slow={2: 0.05})  # slot 2 misses every deadline
+        sleeps = []
+        es = ElasticStream(
+            iter(blocks), table, cfg, churn=churn,
+            sleep=sleeps.append,
+        )
+        masks = es.membership_masks()
+        b1 = next(es)
+        m1 = next(masks)
+        # round 1: slot 2 late -> excluded, no contribution yet
+        assert m1.tolist() == [1.0, 1.0, 0.0, 1.0]
+        np.testing.assert_array_equal(b1[2], blocks[0][2])
+        b2 = next(es)
+        m2 = next(masks)
+        # round 2: slot 2 contributes round 1's rows (one-step stale)
+        assert m2.tolist() == [1.0] * 4
+        np.testing.assert_array_equal(b2[2], blocks[0][2])
+        np.testing.assert_array_equal(b2[1], blocks[1][1])
+        b3 = next(es)
+        next(masks)
+        np.testing.assert_array_equal(b3[2], blocks[1][2])
+        # deadline-closed rounds slept exactly the deadline, never more
+        assert sleeps and all(
+            s <= cfg.round_deadline_ms / 1e3 + 1e-9 for s in sleeps
+        )
+
+    def test_crashed_worker_contributes_nothing_and_dies(self):
+        # a persistent straggler keeps every round sleeping the 5 ms
+        # deadline, so the 2 ms lease + grace reliably expire across
+        # the remaining rounds (a dead slot alone never delays rounds
+        # — that is the point — so it can't drive its own clock)
+        cfg = _cfg(num_steps=6, heartbeat_timeout_ms=2.0,
+                   round_deadline_ms=5.0)
+        data, _ = _data(cfg)
+        metrics = MetricsLogger()
+        table = MembershipTable(
+            cfg.num_workers, heartbeat_timeout_ms=2.0,
+            min_quorum_frac=0.25, metrics=metrics,
+        )
+        es = ElasticStream(
+            block_stream(
+                data, num_workers=cfg.num_workers,
+                rows_per_worker=cfg.rows_per_worker, device=False,
+            ),
+            table, cfg,
+            churn=ChurnPlan(kill_at={2: [0]}, slow={3: 0.01}),
+            metrics=metrics,
+        )
+        masks = [
+            (next(es), next(es.membership_masks()))[1] for _ in range(6)
+        ]
+        # excluded from the very round of the crash (no arrival), and
+        # permanently once the lease expires
+        assert all(mk[0] == 0.0 for mk in masks[1:])
+        assert table.state(0) == "dead"
+        summ = metrics.summary()["membership"]
+        assert summ["by_kind"]["dead"] >= 1
+        assert summ["rounds"] == 6
+
+    def test_mask_feed_lockstep_guard(self):
+        cfg = _cfg()
+        data, _ = _data(cfg)
+        table = MembershipTable(cfg.num_workers)
+        es = ElasticStream(
+            block_stream(
+                data, num_workers=cfg.num_workers,
+                rows_per_worker=cfg.rows_per_worker, device=False,
+            ),
+            table, cfg,
+        )
+        with pytest.raises(RuntimeError, match="lockstep"):
+            next(es.membership_masks())
+
+
+# -- mask threading: pool round + masked scan --------------------------------
+
+
+class TestMaskThreading:
+    def test_pool_round_membership_mask_composes(self):
+        cfg = _cfg()
+        data, _ = _data(cfg, steps=1)
+        block = data.reshape(
+            cfg.num_workers, cfg.rows_per_worker, cfg.dim
+        )
+        pool = WorkerPool(cfg.num_workers, backend="local")
+        quarantine = np.asarray([1, 0, 1, 1], np.float32)
+        membership = np.asarray([1, 1, 0, 1], np.float32)
+        s_a, v_a = pool.round(
+            jnp.asarray(block), cfg.k, worker_mask=quarantine,
+            membership_mask=membership,
+        )
+        s_b, v_b = pool.round(
+            jnp.asarray(block), cfg.k,
+            worker_mask=quarantine * membership,
+        )
+        np.testing.assert_array_equal(np.asarray(s_a), np.asarray(s_b))
+        np.testing.assert_array_equal(np.asarray(v_a), np.asarray(v_b))
+
+    def test_masked_scan_threads_membership_masks(self):
+        cfg = _cfg(num_steps=4)
+        data, _ = _data(cfg)
+        x = jnp.asarray(
+            data.reshape(
+                cfg.num_steps, cfg.num_workers, cfg.rows_per_worker,
+                cfg.dim,
+            )
+        )
+        rng = np.random.default_rng(0)
+        quarantine = (rng.random((4, 4)) > 0.2).astype(np.float32)
+        membership = np.ones((4, 4), np.float32)
+        membership[2:, 1] = 0.0  # slot 1 dies at step 3
+        quarantine[:, 0] = 1.0  # keep at least one live worker per row
+        membership[:, 0] = 1.0
+        fit = make_scan_fit(cfg, masked=True)
+        st0 = OnlineState.initial(cfg.dim, cfg.state_dtype)
+        st_a, v_a = fit(
+            st0, x, jnp.asarray(quarantine),
+            membership_masks=jnp.asarray(membership),
+        )
+        st_b, v_b = fit(st0, x, jnp.asarray(quarantine * membership))
+        np.testing.assert_array_equal(
+            np.asarray(st_a.sigma_tilde), np.asarray(st_b.sigma_tilde)
+        )
+        np.testing.assert_array_equal(np.asarray(v_a), np.asarray(v_b))
+
+
+# -- supervised elastic runs -------------------------------------------------
+
+
+def _factory(data, cfg, table, churn=None, metrics=None):
+    rows_per_step = cfg.num_workers * cfg.rows_per_worker
+
+    def make(start_row):
+        raw = block_stream(
+            data, num_workers=cfg.num_workers,
+            rows_per_worker=cfg.rows_per_worker,
+            start_row=start_row, device=False,
+        )
+        return ElasticStream(
+            raw, table, cfg, churn=churn,
+            first_step=start_row // rows_per_step + 1, metrics=metrics,
+        )
+
+    return make
+
+
+class TestSupervisedElastic:
+    def test_no_churn_matches_plain_supervised_bitwise(self):
+        cfg = _cfg()
+        data, _ = _data(cfg)
+
+        def plain(start_row):
+            return block_stream(
+                data, num_workers=cfg.num_workers,
+                rows_per_worker=cfg.rows_per_worker,
+                start_row=start_row, device=False,
+            )
+
+        w_ref, st_ref, _ = supervised_fit(plain, cfg)
+        table = MembershipTable(
+            cfg.num_workers,
+            heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+            min_quorum_frac=cfg.min_quorum_frac,
+        )
+        w, st, _ = supervised_fit(
+            _factory(data, cfg, table), cfg, membership=table
+        )
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(w_ref))
+        np.testing.assert_array_equal(
+            np.asarray(st.sigma_tilde), np.asarray(st_ref.sigma_tilde)
+        )
+
+    def test_dead_worker_is_persistent_drop_and_rejoin_contributes(self):
+        # timing margins: deadline rounds sleep 40 ms each, so by the
+        # step-8 rejoin the step-2 kill is ~240 ms stale — past the
+        # 80 ms lease + 80 ms grace, i.e. reliably DEAD (the
+        # join/admit protocol under test, not the flap-recover path)
+        cfg = _cfg(num_workers=6, num_steps=10, min_quorum_frac=0.3,
+                   heartbeat_timeout_ms=80.0, round_deadline_ms=40.0)
+        data, spec = _data(cfg)
+        metrics = MetricsLogger()
+        table = MembershipTable(
+            6, heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+            min_quorum_frac=0.3, metrics=metrics,
+        )
+        metrics.attach_membership(table)
+        churn = ChurnPlan(
+            kill_at={2: [0]}, rejoin_at={8: [0]}, slow={5: 0.05}
+        )
+        w, st, sup = supervised_fit(
+            _factory(data, cfg, table, churn, metrics), cfg,
+            metrics=metrics, membership=table,
+        )
+        assert int(st.step) == cfg.num_steps
+        summ = metrics.summary()["membership"]
+        assert summ["by_kind"].get("dead", 0) >= 1
+        assert summ["by_kind"].get("admit", 0) >= 1
+        assert summ["stale_folds"] >= 1
+        rounds = [
+            r for r in metrics.membership_records
+            if r["membership"] == "round_closed"
+        ]
+        admit_t = next(
+            r["t_mono"] for r in metrics.membership_records
+            if r["membership"] == "admit" and r["slot"] == 0
+        )
+        # the rejoined slot contributes to a merge AFTER its admission
+        assert any(
+            0 in r["arrived_slots"] and r["t_mono"] > admit_t
+            for r in rounds
+        )
+        # and was absent from every round while dead
+        dead_rounds = [
+            r for r in rounds if r["t_mono"] < admit_t and r["step"] > 2
+        ]
+        assert dead_rounds and all(
+            0 not in r["arrived_slots"] for r in dead_rounds
+        )
+        from distributed_eigenspaces_tpu.ops.linalg import (
+            principal_angles_degrees,
+        )
+
+        angle = float(
+            jnp.max(principal_angles_degrees(w, spec.top_k(cfg.k)))
+        )
+        assert angle <= 2.0
+
+    def test_quorum_lost_auto_resumes_when_quorum_returns(self):
+        cfg = _cfg(num_workers=6, num_steps=8)
+        data, _ = _data(cfg)
+        metrics = MetricsLogger()
+        table = MembershipTable(
+            6, heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+            min_quorum_frac=cfg.min_quorum_frac, metrics=metrics,
+        )
+        killed = [0, 1, 2, 3]
+        churn = ChurnPlan(kill_at={3: killed})
+
+        def rejoiner():
+            deadline = time.monotonic() + 20.0
+            while table.quorum_ok() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            joined = set()
+            while len(joined) < 3 and time.monotonic() < deadline:
+                table.sweep()
+                for s in killed:
+                    if s not in joined and table.state(s) == "dead":
+                        table.join(s)
+                        joined.add(s)
+                time.sleep(0.01)
+
+        threading.Thread(target=rejoiner, daemon=True).start()
+        with tempfile.TemporaryDirectory() as ck:
+            w, st, sup = supervised_fit(
+                _factory(data, cfg, table, churn, metrics), cfg,
+                metrics=metrics, membership=table, checkpoint_dir=ck,
+            )
+        kinds = sup.ledger.by_kind
+        assert kinds.get("quorum_lost") == 1
+        assert kinds.get("quorum_restored") == 1
+        assert kinds.get("resume", 0) >= 1
+        assert int(st.step) == cfg.num_steps
+
+    def test_quorum_never_returns_is_terminal_with_ledger(self):
+        cfg = _cfg(num_workers=4, num_steps=8,
+                   heartbeat_timeout_ms=30.0, round_deadline_ms=10.0)
+        data, _ = _data(cfg)
+        table = MembershipTable(
+            4, heartbeat_timeout_ms=30.0,
+            min_quorum_frac=cfg.min_quorum_frac,
+        )
+        churn = ChurnPlan(kill_at={2: [0, 1, 2]})
+        with tempfile.TemporaryDirectory() as ck:
+            with pytest.raises(SupervisorError, match="quorum"):
+                supervised_fit(
+                    _factory(data, cfg, table, churn), cfg,
+                    checkpoint_dir=ck, quorum_wait_s=0.2,
+                )
+
+
+# -- satellite: ledger schema (slot id + membership state at fault time) -----
+
+
+class TestLedgerMembershipSchema:
+    def test_quarantine_event_schema_pinned(self):
+        cfg = _cfg()
+        table = MembershipTable(
+            cfg.num_workers,
+            heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+        )
+        table.leave(3)  # lease expired before the fault
+        sup = Supervisor(cfg, membership=table)
+        m, n, d = cfg.num_workers, cfg.rows_per_worker, cfg.dim
+        block = np.ones((m, n, d), np.float32)
+        block[1] = np.nan  # NaN from a LIVE worker
+        block[3] = np.nan  # NaN from the DEAD slot
+        out = sup.screen_block(block, 5)
+        assert out is not None
+        (ev,) = sup.ledger.events
+        # the pinned schema: kind/step/workers plus the membership
+        # state of EACH named worker at fault time and the live count
+        assert ev["kind"] == "quarantine_nonfinite"
+        assert ev["step"] == 5
+        assert ev["workers"] == [1, 3]
+        assert ev["membership"] == {1: "live", 3: "dead"}
+        assert ev["membership_live"] == 3
+        assert set(ev) == {
+            "kind", "step", "workers", "membership", "membership_live",
+        }
+
+    def test_no_membership_attached_keeps_old_schema(self):
+        cfg = _cfg()
+        sup = Supervisor(cfg)
+        m, n, d = cfg.num_workers, cfg.rows_per_worker, cfg.dim
+        block = np.ones((m, n, d), np.float32)
+        block[2] = np.inf
+        sup.screen_block(block, 1)
+        (ev,) = sup.ledger.events
+        assert "membership" not in ev and "membership_live" not in ev
+
+
+# -- satellite: mask-feed replay under a membership change -------------------
+
+
+class TestMaskFeedReplayUnderChurn:
+    def test_retry_sees_the_pre_churn_mask(self):
+        """A retried step must replay the SAME composed mask it failed
+        under — not the post-churn one (the mask feed's arm_replay
+        contract, extended to membership composition)."""
+        cfg = _cfg(num_steps=2)
+        data, _ = _data(cfg)
+        table = MembershipTable(
+            cfg.num_workers, heartbeat_timeout_ms=60_000.0
+        )
+        sup = Supervisor(cfg, membership=table)
+        es = ElasticStream(
+            block_stream(
+                data, num_workers=cfg.num_workers,
+                rows_per_worker=cfg.rows_per_worker, device=False,
+            ),
+            table, cfg,
+        )
+        from distributed_eigenspaces_tpu.runtime.supervisor import (
+            _compose_base_masks,
+        )
+
+        guarded = sup.guard_stream(
+            es, base_masks=_compose_base_masks(es, None, 1)
+        )
+        next(guarded)  # step 1's block screened; its mask is queued
+        m1 = next(sup.mask_feed)
+        assert m1.tolist() == [1.0] * 4
+        # the step fails -> the retry re-pulls its mask; MEANWHILE the
+        # membership changes (worker 2 leaves)
+        sup.mask_feed.arm_replay()
+        table.leave(2)
+        replayed = next(sup.mask_feed)
+        np.testing.assert_array_equal(replayed, m1)
+        # the NEXT round sees the post-churn membership
+        next(guarded)
+        m2 = next(sup.mask_feed)
+        assert m2.tolist() == [1.0, 1.0, 0.0, 1.0]
+
+    def test_step_retry_replays_membership_mask_end_to_end(self):
+        """A step that fails AFTER pulling its mask is retried under the
+        SAME composed mask even though the membership changed between
+        the failure and the retry; the following round sees the
+        post-churn membership."""
+        cfg = _cfg(num_steps=4, num_workers=4, min_quorum_frac=0.25)
+        data, _ = _data(cfg)
+        table = MembershipTable(
+            cfg.num_workers, heartbeat_timeout_ms=60_000.0,
+            min_quorum_frac=0.25,
+        )
+        seen, failed = [], []
+        sup = Supervisor(cfg, membership=table, sleep=lambda s: None)
+
+        def hook(step_fn, state, x, t):
+            def spy(st, xb):
+                mask = next(sup.mask_feed)
+                seen.append((t, np.asarray(mask).copy()))
+                if t == 2 and not failed:
+                    failed.append(t)
+                    table.leave(3)  # churn lands mid-failure
+                    raise OSError("chaos: transient step failure")
+                sup.mask_feed.arm_replay()  # hand it back to the step
+                return step_fn(st, xb)
+
+            return sup.step_hook(spy, state, x, t)
+
+        raw = _factory(data, cfg, table)(0)
+        from distributed_eigenspaces_tpu.runtime.supervisor import (
+            _compose_base_masks,
+        )
+
+        guarded = sup.guard_stream(
+            raw, base_masks=_compose_base_masks(raw, None, 1)
+        )
+        w, st = online_distributed_pca(
+            guarded, cfg, worker_masks=sup.mask_feed, step_hook=hook
+        )
+        assert int(st.step) == cfg.num_steps
+        t2 = [m for t, m in seen if t == 2]
+        assert len(t2) == 2  # failed once, retried once
+        np.testing.assert_array_equal(t2[0], t2[1])
+        assert t2[1][3] == 1.0  # the PRE-churn mask, not the new one
+        (t3,) = [m for t, m in seen if t == 3]
+        assert t3[3] == 0.0  # the next round sees the leave
+
+
+# -- satellite: checkpoint resume ladder -------------------------------------
+
+
+class TestCheckpointResumeLadder:
+    def _commit(self, d, steps):
+        ck = Checkpointer(d, every=1, keep=len(steps) + 1)
+        for t in steps:
+            st = OnlineState(
+                sigma_tilde=jnp.full((4, 4), float(t)),
+                step=jnp.asarray(t, jnp.int32),
+            )
+            ck.on_step(t, st)
+        return ck
+
+    def test_truncated_checkpoint_steps_back_loudly(self, tmp_path):
+        d = str(tmp_path)
+        ck = self._commit(d, [1, 2, 3])
+        p = os.path.join(d, "step_00000003", "state.npz")
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        state, cursor = ck.latest()
+        assert int(state.step) == 2
+        # evidence kept, never silently deleted — and out of the ladder
+        assert os.path.isdir(
+            os.path.join(d, "step_00000003.quarantined")
+        )
+        assert ck._steps() == [1, 2]
+
+    def test_checksum_mismatch_quarantined(self, tmp_path):
+        d = str(tmp_path)
+        ck = self._commit(d, [1, 2])
+        p = os.path.join(d, "step_00000002", "state.npz")
+        with open(p, "r+b") as f:
+            f.seek(-5, os.SEEK_END)
+            b = f.read(1)
+            f.seek(-5, os.SEEK_END)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(CheckpointCorrupt, match="checksum"):
+            restore_checkpoint(os.path.join(d, "step_00000002"))
+        state, _ = ck.latest()
+        assert int(state.step) == 1
+
+    def test_all_bad_returns_none(self, tmp_path):
+        d = str(tmp_path)
+        ck = self._commit(d, [1])
+        p = os.path.join(d, "step_00000001", "state.npz")
+        with open(p, "r+b") as f:
+            f.truncate(3)
+        assert ck.latest() is None
+
+    def test_pre_checksum_checkpoints_still_restore(self, tmp_path):
+        # back-compat: a marker without "checksum" restores unverified
+        d = str(tmp_path / "ck")
+        st = OnlineState(
+            sigma_tilde=jnp.zeros((4, 4)), step=jnp.asarray(3, jnp.int32)
+        )
+        save_checkpoint(d, st, cursor=12)
+        import json
+
+        meta_p = os.path.join(d, "meta.json")
+        with open(meta_p) as f:
+            meta = json.load(f)
+        assert "checksum" in meta
+        del meta["checksum"]
+        with open(meta_p, "w") as f:
+            json.dump(meta, f)
+        state, cursor = restore_checkpoint(d)
+        assert int(state.step) == 3 and cursor == 12
+
+    def test_supervised_resume_rides_the_ladder(self):
+        """End to end: a torn newest checkpoint must not kill the
+        auto-resume — the run restores the older valid commit and
+        still completes."""
+        cfg = _cfg(num_steps=6)
+        data, _ = _data(cfg)
+        from distributed_eigenspaces_tpu.utils.faults import (
+            ChaosPlan,
+            ChaosStream,
+            KillSwitch,
+        )
+
+        rows_per_step = cfg.num_workers * cfg.rows_per_worker
+        killed = {"fired": False}
+
+        def factory(start_row):
+            plan = ChaosPlan(
+                kill_at=None if killed["fired"] else 4
+            )
+            return ChaosStream(
+                block_stream(
+                    data, num_workers=cfg.num_workers,
+                    rows_per_worker=cfg.rows_per_worker,
+                    start_row=start_row, device=False,
+                ),
+                plan,
+                first_step=start_row // rows_per_step + 1,
+            )
+
+        with tempfile.TemporaryDirectory() as ck:
+            with pytest.raises(KillSwitch):
+                supervised_fit(factory, cfg, checkpoint_dir=ck)
+            killed["fired"] = True
+            # tear the newest commit before the "restarted process"
+            steps = sorted(
+                n for n in os.listdir(ck) if n[5:].isdigit()
+            )
+            newest = os.path.join(ck, steps[-1], "state.npz")
+            with open(newest, "r+b") as f:
+                f.truncate(os.path.getsize(newest) // 2)
+            w, st, sup = supervised_fit(factory, cfg, checkpoint_dir=ck)
+        assert int(st.step) == cfg.num_steps
+        resume = next(
+            e for e in sup.ledger.events if e["kind"] == "resume"
+        )
+        # resumed from the OLDER valid step, not the torn newest
+        assert resume["step"] < int(steps[-1][5:]) + 1
+
+
+# -- summary section ---------------------------------------------------------
+
+
+class TestMembershipSummary:
+    def test_eviction_preserves_counts(self):
+        metrics = MetricsLogger(retention=4)
+        for i in range(10):
+            metrics.membership(
+                {"kind": "round_closed", "step": i + 1,
+                 "arrived": 3, "deadline_closed": i % 2 == 0,
+                 "stale": [0] if i % 3 == 0 else []}
+            )
+        metrics.membership({"kind": "dead", "slot": 2})
+        summ = metrics.summary()["membership"]
+        assert summ["events"] == 11
+        assert summ["rounds"] == 10
+        assert summ["by_kind"]["round_closed"] == 10
+        assert summ["by_kind"]["dead"] == 1
+        assert summ["deadline_closed"] == 5
+        assert summ["stale_folds"] == 4
+        assert summ["arrival_hist"] == {"3": 10}
+        assert summ["events_evicted"] > 0
+        assert len(summ["recent"]) <= 4
+
+    def test_table_snapshot_rides_summary(self):
+        metrics = MetricsLogger()
+        table = MembershipTable(3, metrics=metrics)
+        metrics.attach_membership(table)
+        table.leave(1)
+        summ = metrics.summary()["membership"]
+        assert summ["table"]["states"] == ["live", "dead", "live"]
+        assert summ["table"]["live"] == 2
