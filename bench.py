@@ -789,6 +789,12 @@ def measure_serve(profile_dir=None, trace_out=None, slo_p99_ms=None):
     metrics = MetricsLogger(slo_p99_ms=slo_p99_ms)
     tracer = Tracer()
     metrics.attach_tracer(tracer)
+    # ISSUE 10: the contract verdict rides the run report — the engine
+    # audit is a zero-arg callable so summary() sees every bucket
+    # program the burst actually compiled, including late ones
+    from distributed_eigenspaces_tpu.analysis.report import engine_report
+
+    metrics.attach_analysis(lambda: engine_report(engine))
     misses_before = None
     with QueryServer(
         registry, cfg, metrics=metrics, engine=engine
@@ -855,6 +861,7 @@ def measure_serve(profile_dir=None, trace_out=None, slo_p99_ms=None):
         "swap_compile_misses": swap_compile_misses,
         "bit_exact_vs_direct": bool(exact),
         "anchor_tflops": anchor,
+        "analysis": full_summary.get("analysis"),
     }
     _add_value_per_anchor(result)
     if trace_out:
@@ -2182,6 +2189,21 @@ def compare_reports(old_path: str, result: dict,
             if p99_ratio < threshold and structural:
                 verdict["regression"] = True
                 verdict["p99_regression"] = True
+    if "analysis" in old or "analysis" in result:
+        # ISSUE 10: the static-analysis verdict rides through the
+        # compare condensed (ok / violation count / audited programs).
+        # A pre-PR-10 record without it is NOT a metric mismatch — the
+        # metric name stays the contract — and a record whose attached
+        # contract audit failed is surfaced even when every throughput
+        # ratio passes.
+        for side, rep in (("old", old), ("new", result)):
+            ana = rep.get("analysis")
+            if isinstance(ana, dict):
+                verdict[f"analysis_{side}"] = {
+                    "ok": ana.get("ok"),
+                    "n_violations": ana.get("n_violations"),
+                    "programs": sorted(ana.get("programs") or {}),
+                }
     print(json.dumps(verdict), file=sys.stderr)
     return 1 if verdict["regression"] else 0
 

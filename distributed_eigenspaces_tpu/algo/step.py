@@ -13,7 +13,6 @@ This is the function the benchmark times and ``__graft_entry__`` exposes.
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

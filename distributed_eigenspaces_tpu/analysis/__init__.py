@@ -1,0 +1,61 @@
+"""Static program-contract analysis (ISSUE 10).
+
+Audits every program kind the system compiles — solo step/scan,
+masked/pipelined scan, feature-sharded cores, fleet vmapped fit, serve
+transform — against declarative **program contracts**, without
+executing them, plus AST lints over the threaded runtime. Four passes:
+
+1. **collective-schedule contracts** (:mod:`.contracts` over
+   :mod:`.hlo`): per-program expected collective op kinds and payload
+   bounds as functions of ``(d, k, m, B)``, checked against the
+   SPMD-partitioned HLO — the generalization of the old
+   ``utils/collectives_audit`` tripwire into a registry;
+2. **memory-footprint contracts** (:mod:`.contracts`): jaxpr +
+   HLO-buffer + ``compiled.memory_analysis()`` walk asserting no
+   per-device dense ``d x d`` temp exists in programs documented as
+   factor-only — the enforcement mechanism the d-ceiling work
+   (ROADMAP: d >= 32k distributed eigensolve) builds against;
+3. **recompile/host-sync lints** (:mod:`.jaxpr_lints` /
+   :mod:`.ast_lints`): large baked-in jaxpr constants (closure-captured
+   arrays that should be operands — they also poison ``CompileCache``
+   keys) and host-sync calls (``.item()``, ``np.asarray``, …) inside
+   jitted code paths;
+4. **concurrency lints** (:mod:`.ast_lints`): the repo's lock
+   discipline over the threaded runtime — single lock order, no
+   blocking calls while holding a lock, shared mutable attributes
+   touched only under their documented lock.
+
+``scripts/analyze.py`` drives all four over the config matrix and the
+gate is self-testing: :mod:`.mutations` seeds one violation per class
+(a dense ``psum``, a ``d x d`` temp, a baked-in constant, a blocking
+call under lock, …) and requires the checker to catch each one.
+
+The package ``__init__`` stays lazy: :mod:`.hlo` and the lint modules
+are import-cheap, but :mod:`.programs` pulls the trainer builders —
+resolved on first attribute access so the ``utils/collectives_audit``
+back-compat shim can import :mod:`.hlo` without dragging the world in.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "hlo": "distributed_eigenspaces_tpu.analysis.hlo",
+    "contracts": "distributed_eigenspaces_tpu.analysis.contracts",
+    "programs": "distributed_eigenspaces_tpu.analysis.programs",
+    "jaxpr_lints": "distributed_eigenspaces_tpu.analysis.jaxpr_lints",
+    "ast_lints": "distributed_eigenspaces_tpu.analysis.ast_lints",
+    "report": "distributed_eigenspaces_tpu.analysis.report",
+    "mutations": "distributed_eigenspaces_tpu.analysis.mutations",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name])
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,435 @@
+"""Declarative program contracts + the checkers that enforce them.
+
+A **contract** states, per program kind, what the compiled artifact is
+allowed to look like — the structural claims the docs make, as data:
+
+- *collective schedule*: which collective op kinds may appear in the
+  SPMD-partitioned HLO and the per-device payload ceiling as a
+  function of the program's ``(d, k, m, B, …)`` parameters. The scan
+  family moves ONLY the ``(m, d, k)`` factor stack; the
+  feature-sharded cores add k-wide reductions bounded by the factor
+  stack; fleet and serve programs contain ZERO collectives by
+  construction.
+- *memory footprint*: ``factor_only`` programs may not hold ANY buffer
+  (jaxpr aval or per-device HLO buffer) with two or more axes each
+  ``>= dense_dim`` — the shape class a materialized ``d x d``
+  projector/Gram falls into. ``dense_state`` programs (the solo/fleet
+  trainers whose carried state IS ``sigma_tilde (d, d)``) skip the
+  shape rule but still report ``memory_analysis()`` numbers.
+- *baked constants*: no closure-captured array constant above
+  ``max_const_elems`` may ride in the jaxpr — a baked-in basis both
+  recompiles on every publish and poisons ``CompileCache`` keys.
+
+Checkers return :class:`Violation` records (never raise on contract
+breach — the driver aggregates and formats), each naming the program,
+the rule, and the offending HLO line / jaxpr eqn, so a CI failure is
+actionable from the message alone.
+
+The audited config matrix deliberately keeps every non-feature
+dimension (``m``, ``n``, ``T``, ``B``, ``k``, serve rows) BELOW
+``dense_dim`` — that is what makes "two axes >= dense_dim" exactly the
+dense-matrix shape class with zero false positives; ``check_program``
+validates the premise loudly rather than trusting the matrix author.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable
+
+from distributed_eigenspaces_tpu.analysis import hlo as _hlo
+
+
+@dataclass(frozen=True)
+class ProgramParams:
+    """The shape parameters a contract's bounds are functions of."""
+
+    d: int
+    k: int
+    m: int = 1
+    n: int = 1
+    T: int = 1
+    B: int = 1
+    rows: int = 1
+    n_feature_shards: int = 1
+    n_workers_mesh: int = 1
+    sketch_width: int = 0
+
+    @property
+    def d_local(self) -> int:
+        return self.d // max(self.n_feature_shards, 1)
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One contract breach, formatted to be actionable from CI output
+    alone: program + rule + where."""
+
+    program: str
+    rule: str  # collective-op / collective-payload / dense-buffer / ...
+    message: str
+    location: str = ""  # HLO line, jaxpr eqn, or file:line for lints
+
+    def format(self) -> str:
+        loc = f" [{self.location}]" if self.location else ""
+        return f"{self.program}: {self.rule}: {self.message}{loc}"
+
+
+@dataclass(frozen=True)
+class ProgramContract:
+    """What one program kind's compiled artifact must look like."""
+
+    name: str
+    description: str
+    #: collective op kinds allowed in the partitioned HLO (empty =
+    #: zero collectives by construction)
+    allowed_collectives: frozenset[str] = frozenset()
+    #: per-device payload ceiling in ELEMENTS as a function of params;
+    #: None with empty allowed_collectives (nothing to bound)
+    max_payload_elems: Callable[[ProgramParams], int] | None = None
+    #: a sharded build must actually contain collectives — guards
+    #: against the audit passing vacuously on an unsharded build
+    require_collectives: bool = False
+    #: "factor_only": no buffer with >= 2 axes each >= dense_dim;
+    #: "dense_state": the carried state is legitimately d x d (solo /
+    #: fleet trainers) — shape rule skipped, footprint still reported
+    memory_policy: str = "factor_only"
+    #: the dimension the dense-buffer rule measures against (defaults
+    #: to the PER-DEVICE feature width — d_local on feature-sharded
+    #: programs, d elsewhere)
+    dense_dim: Callable[[ProgramParams], int] = field(
+        default=lambda p: p.d_local
+    )
+    #: largest array constant allowed baked into the jaxpr, in elements
+    max_const_elems: Callable[[ProgramParams], int] = field(
+        default=lambda p: p.d
+    )
+
+
+def _factor_stack(p: ProgramParams) -> int:
+    """The merge's gathered factor stack — the payload ceiling every
+    trainer contract quotes: ``m * d_local * max(k, sketch_width)``."""
+    return p.m * p.d_local * max(p.k, p.sketch_width)
+
+
+# -- the registry ------------------------------------------------------------
+
+#: Contract per program KIND (programs.py maps each config-matrix entry
+#: to one of these). Declaring a contract for a new program = one entry
+#: here + a builder in programs.py (docs/ANALYSIS.md walks through it).
+CONTRACTS: dict[str, ProgramContract] = {
+    "scan_fit": ProgramContract(
+        name="scan_fit",
+        description=(
+            "whole-fit scan (solo / masked / pipelined / interval): the "
+            "only collective is the per-step all-gather of the "
+            "(m, d, k) factor stack; dense d x d state is carried but "
+            "never crosses the mesh"
+        ),
+        allowed_collectives=frozenset({"all-gather"}),
+        max_payload_elems=_factor_stack,
+        require_collectives=True,
+        memory_policy="dense_state",
+    ),
+    "feature_sharded": ProgramContract(
+        name="feature_sharded",
+        description=(
+            "feature-sharded scan/sketch cores: k-wide reductions and "
+            "the per-shard factor gather only, every payload bounded "
+            "by the factor stack; NO dense d x d buffer exists on any "
+            "device (the low-rank carry is the whole point)"
+        ),
+        allowed_collectives=frozenset({"all-gather", "all-reduce"}),
+        max_payload_elems=_factor_stack,
+        require_collectives=True,
+        memory_policy="factor_only",
+    ),
+    "fleet_fit": ProgramContract(
+        name="fleet_fit",
+        description=(
+            "B-tenant vmapped whole fit: pure data parallelism over "
+            "the fleet axis — ZERO collectives by construction; dense "
+            "per-tenant state is carried but never crosses the mesh"
+        ),
+        allowed_collectives=frozenset(),
+        memory_policy="dense_state",
+    ),
+    "serve_transform": ProgramContract(
+        name="serve_transform",
+        description=(
+            "serving kernels (project / reconstruct / residual): "
+            "row-local matmuls — ZERO collectives, and factor-only "
+            "memory (no program may materialize V V^T)"
+        ),
+        allowed_collectives=frozenset(),
+        memory_policy="factor_only",
+        dense_dim=lambda p: p.d,
+    ),
+}
+
+
+# -- checkers ----------------------------------------------------------------
+
+
+def check_collectives(
+    contract: ProgramContract,
+    params: ProgramParams,
+    hlo_text: str,
+    *,
+    program: str,
+) -> tuple[list[Violation], dict]:
+    """Pass 1: the per-program collective schedule against the
+    partitioned HLO. Returns (violations, metrics)."""
+    out: list[Violation] = []
+    ops = _hlo.parse_collectives(hlo_text)
+    metrics = {
+        "n_collectives": len(ops),
+        "max_payload_elems": max((o.elems for o in ops), default=0),
+        "ops": {},
+    }
+    for o in ops:
+        key = f"{o.op} {o.dtype}[{','.join(map(str, o.shape))}]"
+        metrics["ops"][key] = metrics["ops"].get(key, 0) + 1
+    for o in ops:
+        if o.op not in contract.allowed_collectives:
+            allowed = sorted(contract.allowed_collectives) or ["<none>"]
+            out.append(Violation(
+                program=program,
+                rule="collective-op",
+                message=(
+                    f"{o.op} {o.dtype}{list(o.shape)} is not in the "
+                    f"contract's allowed set {allowed} "
+                    f"(contract {contract.name!r})"
+                ),
+                location=o.line.strip(),
+            ))
+    if contract.max_payload_elems is not None:
+        bound = contract.max_payload_elems(params)
+        for o in ops:
+            if o.elems > bound:
+                out.append(Violation(
+                    program=program,
+                    rule="collective-payload",
+                    message=(
+                        f"{o.op} payload {o.elems} elems exceeds the "
+                        f"contract bound {bound} (= factor stack at "
+                        f"d={params.d}, k={params.k}, m={params.m}) — "
+                        "the merge must move factors, not dense "
+                        f"matrices (contract {contract.name!r})"
+                    ),
+                    location=o.line.strip(),
+                ))
+    if contract.require_collectives and not ops:
+        out.append(Violation(
+            program=program,
+            rule="collective-schedule",
+            message=(
+                "sharded build contains no collectives at all — the "
+                "audit would pass vacuously (was the program actually "
+                f"partitioned?) (contract {contract.name!r})"
+            ),
+        ))
+    return out, metrics
+
+
+def _dense_shapes(
+    shapes, threshold: int
+) -> list[tuple[tuple[int, ...], str]]:
+    """Shapes with >= 2 axes each >= threshold — the dense-matrix class
+    a materialized d x d projector/Gram falls into."""
+    hits = []
+    for dtype, dims, where in shapes:
+        if sum(1 for s in dims if s >= threshold) >= 2:
+            hits.append((dims, where))
+    return hits
+
+
+def _iter_jaxpr_avals(closed_jaxpr):
+    """Every aval in a closed jaxpr, recursively through sub-jaxprs
+    (scan/while/cond bodies, pjit calls, shard_map inner jaxprs —
+    where shapes are PER-DEVICE). Yields (aval, eqn_str)."""
+    import jax.core  # noqa: F401  (aval types live on the objects)
+
+    seen: set[int] = set()
+
+    def walk(jaxpr):
+        if id(jaxpr) in seen:
+            return
+        seen.add(id(jaxpr))
+        for v in list(jaxpr.invars) + list(jaxpr.constvars):
+            yield getattr(v, "aval", None), "<input>"
+        for eqn in jaxpr.eqns:
+            es = None
+            for v in eqn.outvars:
+                if es is None:
+                    es = f"{eqn.primitive.name}"
+                yield getattr(v, "aval", None), es
+            for p in eqn.params.values():
+                for sub in _sub_jaxprs(p):
+                    yield from walk(sub)
+
+    def _sub_jaxprs(param):
+        out = []
+        stack = [param]
+        while stack:
+            p = stack.pop()
+            if hasattr(p, "jaxpr") and hasattr(p.jaxpr, "eqns"):
+                out.append(p.jaxpr)  # ClosedJaxpr
+            elif hasattr(p, "eqns"):
+                out.append(p)  # bare Jaxpr
+            elif isinstance(p, (tuple, list)):
+                stack.extend(p)
+        return out
+
+    inner = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    yield from walk(inner)
+
+
+def check_memory(
+    contract: ProgramContract,
+    params: ProgramParams,
+    *,
+    program: str,
+    hlo_text: str | None = None,
+    closed_jaxpr=None,
+    memory_stats=None,
+) -> tuple[list[Violation], dict]:
+    """Pass 2: the memory-footprint contract. Walks the closed jaxpr
+    (global + per-device shapes via sub-jaxprs) and the compiled HLO's
+    per-device buffer shapes; ``factor_only`` programs may not hold any
+    dense ``>= (t, t)`` buffer. ``memory_analysis()`` aggregates ride
+    along in the metrics either way."""
+    out: list[Violation] = []
+    t = contract.dense_dim(params)
+    # the premise that makes the shape rule exact: every non-feature
+    # config dimension sits below the threshold (see module docstring)
+    small = {"m": params.m, "n": params.n, "T": params.T, "B": params.B,
+             "k": params.k, "rows": params.rows}
+    offenders = {nm: v for nm, v in small.items() if v >= t}
+    if offenders:
+        raise ValueError(
+            f"audit config for {program!r} breaks the dense-shape "
+            f"premise: {offenders} >= dense_dim {t} — shrink the "
+            "audited shapes (analysis/programs.py) so the two-large-"
+            "axes rule stays exactly the dense-matrix class"
+        )
+    metrics: dict = {"dense_dim": t}
+    if memory_stats is not None:
+        metrics["temp_bytes_per_device"] = int(
+            getattr(memory_stats, "temp_size_in_bytes", 0)
+        )
+        metrics["argument_bytes_per_device"] = int(
+            getattr(memory_stats, "argument_size_in_bytes", 0)
+        )
+        metrics["output_bytes_per_device"] = int(
+            getattr(memory_stats, "output_size_in_bytes", 0)
+        )
+    if contract.memory_policy != "factor_only":
+        metrics["policy"] = contract.memory_policy
+        return out, metrics
+    metrics["policy"] = "factor_only"
+    if closed_jaxpr is not None:
+        for aval, where in _iter_jaxpr_avals(closed_jaxpr):
+            dims = tuple(getattr(aval, "shape", ()) or ())
+            if sum(1 for s in dims if isinstance(s, int) and s >= t) >= 2:
+                out.append(Violation(
+                    program=program,
+                    rule="dense-buffer",
+                    message=(
+                        f"jaxpr materializes a dense buffer "
+                        f"{list(dims)} (>= 2 axes >= {t}) in a "
+                        f"factor-only program — the d-ceiling "
+                        "invariant is that no device ever holds a "
+                        f"d x d (contract {contract.name!r})"
+                    ),
+                    location=f"jaxpr eqn: {where}",
+                ))
+    if hlo_text is not None:
+        shapes = _hlo.parse_buffer_shapes(hlo_text)
+        for dims, where in _dense_shapes(shapes, t):
+            out.append(Violation(
+                program=program,
+                rule="dense-buffer",
+                message=(
+                    f"compiled HLO holds a per-device buffer "
+                    f"{list(dims)} (>= 2 axes >= {t}) in a "
+                    f"factor-only program (contract {contract.name!r})"
+                ),
+                location=where.strip(),
+            ))
+    return out, metrics
+
+
+def check_consts(
+    contract: ProgramContract,
+    params: ProgramParams,
+    closed_jaxpr,
+    *,
+    program: str,
+) -> tuple[list[Violation], dict]:
+    """Pass 3a: large baked-in constants. A closure-captured array in a
+    jitted program recompiles on every value change AND poisons
+    ``CompileCache`` keys (the key hashes shapes/knobs, not baked
+    values — two runs with different baked bases would collide).
+    Anything above ``max_const_elems`` should be an operand."""
+    out: list[Violation] = []
+    bound = contract.max_const_elems(params)
+    consts = list(getattr(closed_jaxpr, "consts", ()) or ())
+    sizes = []
+    for c in consts:
+        shape = tuple(getattr(c, "shape", ()) or ())
+        elems = math.prod(shape) if shape else 1
+        sizes.append(elems)
+        if elems > bound:
+            out.append(Violation(
+                program=program,
+                rule="baked-constant",
+                message=(
+                    f"jaxpr bakes in a {list(shape)} array constant "
+                    f"({elems} elems > bound {bound}) — closure-"
+                    "captured arrays recompile on every value change "
+                    "and poison CompileCache keys; pass it as an "
+                    f"operand instead (contract {contract.name!r})"
+                ),
+                location=f"const dtype={getattr(c, 'dtype', '?')}",
+            ))
+    return out, {
+        "n_consts": len(consts),
+        "max_const_elems": max(sizes, default=0),
+        "const_bound": bound,
+    }
+
+
+def check_program(built) -> tuple[list[Violation], dict]:
+    """All static passes over one :class:`~.programs.BuiltProgram`:
+    collectives + memory + baked constants. Returns
+    ``(violations, metrics)`` — the driver aggregates."""
+    contract = CONTRACTS[built.contract]
+    params = built.params
+    hlo_text = built.hlo_text()
+    violations: list[Violation] = []
+    v, col = check_collectives(
+        contract, params, hlo_text, program=built.name
+    )
+    violations += v
+    jaxpr = built.jaxpr()
+    v, mem = check_memory(
+        contract, params,
+        program=built.name,
+        hlo_text=hlo_text,
+        closed_jaxpr=jaxpr,
+        memory_stats=built.memory_stats(),
+    )
+    violations += v
+    v, const = check_consts(
+        contract, params, jaxpr, program=built.name
+    )
+    violations += v
+    return violations, {
+        "contract": contract.name,
+        "ok": not violations,
+        "collectives": col,
+        "memory": mem,
+        "consts": const,
+    }

@@ -1,0 +1,295 @@
+"""Compiled-HLO parsing: collectives and buffer shapes, machine-checked.
+
+The framework's multi-chip story rests on one structural claim: the
+merge moves the ``(m, d, k)`` factor stack (an ``all_gather``) instead
+of a ``d x d`` mean projector (a ``psum``) — 2·d/(m·k)× less ICI traffic
+at the benchmark shapes (16× at d=1024, m=8, k=8) — and the
+feature-sharded solvers reduce only k-wide payloads. This module makes
+the claim machine-checked: parse the collectives (and, for the memory
+contracts, every buffer shape) out of the COMPILED (SPMD-partitioned)
+HLO, compare them against the documented model, and fail a gate if a
+future change silently reintroduces a dense allreduce.
+
+Works on the CPU virtual-device mesh (the partitioner emits the same
+collective ops it would for ICI), so the audit runs in plain pytest,
+inside ``dryrun_multichip``, and as CI stage 9 (``scripts/analyze.py``).
+
+History: lived at ``utils/collectives_audit.py`` through round 9; that
+module is now a back-compat shim over this one, and the per-program
+expectations moved from hand-rolled call sites into the contract
+registry (:mod:`.contracts`).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+# one optimized-HLO collective per line. Two result forms:
+#   %ag = f32[8,128,4]{...} all-gather(%p), replica_groups=...
+#   %rs = (f32[64]{0}, u32[]) all-reduce-start(%p), ...   (async / tuple)
+# The op-name alternation accepts the async "-start" suffix (TPU HLO
+# lowers collectives to start/done pairs) and "-done" is deliberately
+# NOT matched (it would double-count its start's payload).
+_OP_NAMES = (
+    "all-gather", "all-reduce", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+# The tuple branch matches LAZILY up to the closing ") <op-name>(": TPU
+# tiled layouts put parens INSIDE the tuple members (e.g.
+# "(f32[64]{0:T(256)}, u32[])"), so a greedy-to-first-')' matcher would
+# truncate mid-member and the parser-drift tripwire would raise on every
+# TPU-compiled module (ADVICE.md r5).
+_COLLECTIVE_RE = re.compile(
+    r" = (\(.*?\)|\w+\[[\d,]*\][^ ]*) "
+    r"(" + "|".join(_OP_NAMES) + r")(?:-start)?"
+    r"\("
+)
+# raw occurrence counter for the parser-drift tripwire (see
+# parse_collectives): "-done" ops and the start forms both contain the
+# base name, so count call sites `name(` and `name-start(` only
+_RAW_RE = re.compile(
+    r"(" + "|".join(_OP_NAMES) + r")(?:-start)?\("
+)
+
+# Result-shape token at an instruction definition ("%name = SHAPE op(")
+# — the per-device buffer set the memory contracts walk. Tuple results
+# contribute each member via _SHAPE_RE over the matched text.
+_RESULT_RE = re.compile(
+    r"%[\w.\-]+ = (\([^=]*?\)|\w+\[[\d,]*\][^ ]*) \w[\w\-]*\("
+)
+
+# Itemsizes for every dtype the HLO printer emits. Unknown dtypes used
+# to fall back to 4 bytes silently (and a KeyError in strict callers) —
+# now any dtype outside this table raises AuditParseError naming the
+# offending HLO line, so a new XLA dtype widens the table instead of
+# silently mis-weighing payload bounds (ISSUE 10 satellite).
+_ITEMSIZE = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+    "f8e4m3": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2": 1, "f8e5m2fnuz": 1, "f8e3m4": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "s4": 1, "u4": 1, "s2": 1, "u2": 1,
+    "pred": 1, "c64": 8, "c128": 16,
+    "token": 0,  # sequencing tokens carry no payload
+}
+
+
+class AuditParseError(RuntimeError):
+    """The HLO text contains something the audit cannot weigh — an
+    unknown dtype or a collective call site the structured regex cannot
+    parse. Loud by design: an audit that guesses is an audit that can
+    read "no dense collectives" off a module it never understood."""
+
+
+def itemsize_of(dtype: str, *, context: str = "") -> int:
+    """Bytes per element for an HLO dtype token, or a loud
+    :class:`AuditParseError` naming the dtype and the offending HLO
+    line for anything outside the table."""
+    try:
+        return _ITEMSIZE[dtype]
+    except KeyError:
+        raise AuditParseError(
+            f"unknown HLO dtype {dtype!r} — the audit cannot weigh its "
+            f"payload; add it to analysis.hlo._ITEMSIZE"
+            + (f" (offending HLO: {context.strip()!r})" if context else "")
+        ) from None
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    op: str  # all-gather / all-reduce / ...
+    dtype: str
+    shape: tuple[int, ...]
+    #: the HLO source line the op was parsed from — error context for
+    #: unknown dtypes and contract-violation messages
+    line: str = field(default="", compare=False)
+
+    @property
+    def elems(self) -> int:
+        return math.prod(self.shape) if self.shape else 1
+
+    @property
+    def payload_bytes(self) -> int:
+        return self.elems * itemsize_of(self.dtype, context=self.line)
+
+
+def _line_around(text: str, pos: int) -> str:
+    start = text.rfind("\n", 0, pos) + 1
+    end = text.find("\n", pos)
+    return text[start: end if end >= 0 else len(text)]
+
+
+def parse_collectives(hlo_text: str) -> list[CollectiveOp]:
+    """Every collective op in an (optimized, SPMD-partitioned) HLO dump.
+
+    Shapes are PER-DEVICE — an ``all-gather`` line's shape is its
+    gathered output on each device. Tuple-shaped results (async
+    ``-start`` forms, combined collectives) contribute the LARGEST
+    member as the op's shape — the quantity the dense tripwire checks —
+    and a tripwire guards the parser itself: if the text contains more
+    collective call sites than the structured regex matched, the parser
+    has drifted from the HLO syntax and raises instead of silently
+    under-reporting (an empty parse must never read as "no dense
+    collectives"). Ops inside a ``while`` body (the ``lax.scan`` steps)
+    appear once in the text; callers reason per step, which is exactly
+    the granularity the byte model wants.
+    """
+    out = []
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        shapes_txt, op = m.groups()
+        line = _line_around(hlo_text, m.start())
+        members = [
+            (dt, tuple(int(s) for s in dims.split(",") if s))
+            for dt, dims in _SHAPE_RE.findall(shapes_txt)
+        ]
+        if not members:
+            members = [("f32", ())]  # shapeless scalar result
+        dtype, dims = max(
+            members, key=lambda p: math.prod(p[1]) if p[1] else 1
+        )
+        out.append(CollectiveOp(op=op, dtype=dtype, shape=dims, line=line))
+    raw = len(_RAW_RE.findall(hlo_text))
+    if raw > len(out):
+        raise AuditParseError(
+            f"collective parser drift: {raw} collective call sites in "
+            f"the HLO but only {len(out)} parsed — the audit would "
+            "under-report; fix _COLLECTIVE_RE for the new syntax"
+        )
+    return out
+
+
+def parse_buffer_shapes(
+    hlo_text: str,
+) -> list[tuple[str, tuple[int, ...], str]]:
+    """Every instruction-result buffer in the HLO as ``(dtype, shape,
+    line)`` — PER-DEVICE shapes in a partitioned module. Tuple results
+    contribute each member. This is the buffer set the memory contracts
+    scan for dense ``d x d`` temporaries; over-collection is harmless
+    (a shape only appears because some buffer has it), silent
+    under-collection is not — instruction definitions the regex cannot
+    shape-parse simply carry no digits and match nothing, and the
+    collectives path has its own drift tripwire."""
+    out: list[tuple[str, tuple[int, ...], str]] = []
+    for m in _RESULT_RE.finditer(hlo_text):
+        line = _line_around(hlo_text, m.start())
+        for dt, dims in _SHAPE_RE.findall(m.group(1)):
+            out.append(
+                (dt, tuple(int(s) for s in dims.split(",") if s), line)
+            )
+    return out
+
+
+def audit_compiled(compiled) -> dict:
+    """Summary of a compiled program's collectives: per-(op, dtype,
+    shape) counts plus the largest single payload — the number the
+    dense-allreduce tripwire checks. Accepts a
+    ``jit(...).lower(...).compile()`` result or its HLO text."""
+    hlo_text = compiled if isinstance(compiled, str) else compiled.as_text()
+    ops = parse_collectives(hlo_text)
+    counts: dict[str, int] = {}
+    for o in ops:
+        key = f"{o.op} {o.dtype}[{','.join(map(str, o.shape))}]"
+        counts[key] = counts.get(key, 0) + 1
+    return {
+        "ops": counts,
+        "n_collectives": len(ops),
+        "max_payload_elems": max((o.elems for o in ops), default=0),
+        "max_payload_bytes": max(
+            (o.payload_bytes for o in ops), default=0
+        ),
+        "_parsed": ops,
+    }
+
+
+def assert_no_dense_collective(audit: dict, dim: int) -> None:
+    """The regression tripwire: no collective payload may reach ``d^2``
+    elements (or even half of it) — the structural invariant every
+    sharded trainer maintains is that ONLY factor stacks (m·d·k) and
+    k-wide reductions cross the mesh, never a dense d x d matrix. A
+    reintroduced dense-projector psum trips this immediately."""
+    limit = dim * dim // 2
+    worst = audit["max_payload_elems"]
+    if worst >= limit:
+        offenders = [
+            f"{o.op} {o.dtype}{list(o.shape)}"
+            for o in audit["_parsed"]
+            if o.elems >= limit
+        ]
+        raise AssertionError(
+            f"dense collective detected: payload {worst} elems >= "
+            f"d^2/2 = {limit} ({', '.join(offenders)}) — the merge must "
+            "move factors, not d x d matrices (ops/linalg.py "
+            "merged_top_k_lowrank; BASELINE.md item 4)"
+        )
+
+
+def ici_step_model(
+    m: int, d: int, k: int, *,
+    n_workers_mesh: int, n_feature_shards: int = 1, itemsize: int = 4,
+) -> dict:
+    """Documented per-step ICI byte model for the sharded trainers,
+    ring-collective accounting (what XLA lowers to on a torus):
+
+    - factor merge: ``all_gather`` of per-device ``(m/W, d_l, k)`` shards
+      into ``(m, d_l, k)`` on each of W worker-mesh devices — each
+      device moves ``(W-1)/W * m * d_l * k`` elements per step
+      (``d_l = d / n_feature_shards``);
+    - the dense alternative this design replaces: ``psum`` of a
+      ``d x d`` projector — ``2 * (W-1)/W * d^2`` elements per device;
+    - feature-axis reductions (sharded matvec / CholeskyQR Grams /
+      sketch folds): k-wide payloads, O(n·k + k^2) elements — reported
+      as a bound, not enumerated (each is <= the merge payload by
+      construction; the audit asserts the ceiling).
+
+    Returns modeled bytes/device/step for the factor route, the dense
+    route, and their ratio — the number BASELINE.md's "16x less ICI
+    traffic" claim quotes, now computed instead of asserted in prose.
+    """
+    w = max(n_workers_mesh, 1)
+    d_local = d // max(n_feature_shards, 1)
+    ring = (w - 1) / w if w > 1 else 0.0
+    factor = ring * m * d_local * k * itemsize
+    dense = 2.0 * ring * d * d * itemsize
+    return {
+        "factor_gather_bytes_per_step": int(factor),
+        "dense_psum_bytes_per_step": int(dense),
+        # None (not inf) when the worker axis is trivial — a 1-chip mesh
+        # moves nothing, and inf is not valid strict JSON
+        "dense_over_factor": (
+            round(dense / factor, 2) if factor else None
+        ),
+        "model": "ring collectives: all_gather (W-1)/W*payload, "
+                 "psum 2*(W-1)/W*payload, per device per step",
+    }
+
+
+def scaling_projection(
+    m: int, d: int, k: int, *, step_seconds: float,
+    n_workers_mesh: int, n_feature_shards: int = 1,
+    ici_gbps: float = 90.0,
+) -> dict:
+    """ICI-bytes-per-step vs step-time projection: at what mesh size
+    does the merge's collective stop hiding behind the step's compute?
+    ``ici_gbps`` defaults to a single v5e ICI link's ~90 GB/s (4800
+    Gbps bidirectional across 4 links per chip / conservative per-link
+    share); the point of the field is the RATIO trend, not the last
+    percent — both inputs are in the JSON so readers can re-anchor.
+    """
+    model = ici_step_model(
+        m, d, k,
+        n_workers_mesh=n_workers_mesh,
+        n_feature_shards=n_feature_shards,
+    )
+    wire_s = model["factor_gather_bytes_per_step"] / (ici_gbps * 1e9)
+    return {
+        **model,
+        "assumed_ici_gb_per_sec": ici_gbps,
+        "modeled_collective_seconds_per_step": round(wire_s, 9),
+        "measured_step_seconds": round(step_seconds, 9),
+        "collective_fraction_of_step": (
+            round(wire_s / step_seconds, 6) if step_seconds > 0 else None
+        ),
+    }

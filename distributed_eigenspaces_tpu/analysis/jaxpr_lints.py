@@ -1,0 +1,75 @@
+"""Jaxpr-level lints usable on ANY jitted callable (pass 3a outside
+the program matrix).
+
+The contract checkers (:func:`.contracts.check_consts`) run this rule
+over the audited matrix; this module is the standalone entry point for
+linting one function — e.g. a notebook probe, or the mutation
+self-test seeding a closure-captured basis.
+
+A closure-captured array constant in a jitted program is a double
+hazard: the program recompiles whenever the VALUE changes (the shape
+didn't, so nothing in the jit cache key saves you), and the persistent
+``CompileCache`` keys hash shapes/knobs — two runs baking different
+values would collide on one serialized program.
+"""
+
+from __future__ import annotations
+
+import math
+
+from distributed_eigenspaces_tpu.analysis.contracts import Violation
+
+#: default ceiling, in elements: a k-vector of knobs is fine, a (d, k)
+#: basis is not. Matrix programs get per-contract bounds instead.
+DEFAULT_MAX_CONST_ELEMS = 256
+
+
+def const_arrays(closed_jaxpr) -> list[tuple[tuple[int, ...], str, int]]:
+    """Every array constant baked into a closed jaxpr, as
+    ``(shape, dtype, elems)`` — scalars report as ``((), dtype, 1)``."""
+    out = []
+    for c in getattr(closed_jaxpr, "consts", ()) or ():
+        shape = tuple(getattr(c, "shape", ()) or ())
+        elems = math.prod(shape) if shape else 1
+        out.append((shape, str(getattr(c, "dtype", type(c).__name__)),
+                    elems))
+    return out
+
+
+def lint_baked_constants(
+    fn_or_jaxpr,
+    *args,
+    max_elems: int = DEFAULT_MAX_CONST_ELEMS,
+    program: str = "<fn>",
+) -> list[Violation]:
+    """Flag closure-captured array constants above ``max_elems``.
+
+    Accepts a closed jaxpr directly, or a callable + example/abstract
+    args (traced via ``jax.make_jaxpr`` — no compile, no execution).
+    """
+    if hasattr(fn_or_jaxpr, "consts"):
+        closed = fn_or_jaxpr
+    else:
+        import jax
+
+        fn = fn_or_jaxpr
+        if hasattr(fn, "trace"):  # a jitted callable
+            closed = fn.trace(*args).jaxpr
+        else:
+            closed = jax.make_jaxpr(fn)(*args)
+    out: list[Violation] = []
+    for shape, dtype, elems in const_arrays(closed):
+        if elems > max_elems:
+            out.append(Violation(
+                program=program,
+                rule="baked-constant",
+                message=(
+                    f"jaxpr bakes in a {list(shape)} {dtype} constant "
+                    f"({elems} elems > bound {max_elems}) — closure-"
+                    "captured arrays recompile on every value change "
+                    "and poison CompileCache keys; pass it as an "
+                    "operand instead"
+                ),
+                location=f"const dtype={dtype}",
+            ))
+    return out

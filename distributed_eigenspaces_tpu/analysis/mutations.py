@@ -1,0 +1,195 @@
+"""Mutation self-tests: the gate that checks the checker.
+
+Each mutation seeds ONE violation class — a reintroduced dense
+``psum``, a materialized ``d x d`` temp, a baked-in array constant, a
+blocking call under a lock, … — and requires the matching checker to
+flag it with the expected rule. A static-analysis stage that can only
+pass is worthless; CI stage "analyze" runs ``--mutation-check`` so a
+refactor that silently blinds a pass fails the build.
+
+Compiled mutants are built in memory (tiny shapes, ~1 s total); AST
+mutants are source-text fixtures fed to the ``lint_*_source`` entry
+points. Nothing here touches the tree.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from distributed_eigenspaces_tpu.analysis import ast_lints, contracts
+
+_D = 64
+
+
+def _mutant_dense_collective() -> list[contracts.Violation]:
+    """The design this framework replaced: a shard_map round that
+    psums the dense d x d mean projector across the mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_eigenspaces_tpu.parallel.mesh import (
+        make_mesh,
+        shard_map,
+    )
+
+    mesh = make_mesh(num_workers=8)
+
+    def dense_round(x):  # (m_local, n, d) -> psum of d x d projector
+        g = jnp.einsum("mnd,mne->de", x, x)
+        return jax.lax.psum(g, "workers")
+
+    f = jax.jit(shard_map(
+        dense_round, mesh=mesh, in_specs=P("workers"), out_specs=P(),
+        check_vma=False,
+    ))
+    hlo = f.lower(
+        jnp.zeros((8, 8, _D), jnp.float32)
+    ).compile().as_text()
+    contract = contracts.CONTRACTS["scan_fit"]
+    params = contracts.ProgramParams(d=_D, k=2, m=8, n=8)
+    viols, _ = contracts.check_collectives(
+        contract, params, hlo, program="mutant_dense_collective"
+    )
+    return viols
+
+
+def _mutant_dense_temp() -> list[contracts.Violation]:
+    """A factor-only program that materializes the d x d Gram."""
+    import jax
+    import jax.numpy as jnp
+
+    def gram(x):  # (rows, d) -> (d, d): exactly what serve must not do
+        return x.T @ x
+
+    jitted = jax.jit(gram)
+    arg = jax.ShapeDtypeStruct((16, _D), jnp.float32)
+    contract = contracts.CONTRACTS["serve_transform"]
+    params = contracts.ProgramParams(d=_D, k=2, rows=16)
+    viols, _ = contracts.check_memory(
+        contract, params,
+        program="mutant_dense_temp",
+        hlo_text=jitted.lower(arg).compile().as_text(),
+        closed_jaxpr=jitted.trace(arg).jaxpr,
+    )
+    return viols
+
+
+def _mutant_baked_constant() -> list[contracts.Violation]:
+    """A serving kernel that closes over the basis instead of taking
+    it as an operand."""
+    import jax
+    import jax.numpy as jnp
+
+    v_baked = jnp.ones((_D, 2), jnp.float32)
+
+    def project(x):
+        return x @ v_baked
+
+    jitted = jax.jit(project)
+    arg = jax.ShapeDtypeStruct((16, _D), jnp.float32)
+    contract = contracts.CONTRACTS["serve_transform"]
+    params = contracts.ProgramParams(d=_D, k=2, rows=16)
+    viols, _ = contracts.check_consts(
+        contract, params, jitted.trace(arg).jaxpr,
+        program="mutant_baked_constant",
+    )
+    return viols
+
+
+_FIXTURE_BLOCKING = '''
+import threading, time
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def drain(self):
+        with self._lock:
+            self._thread.join()
+            time.sleep(0.1)
+'''
+
+_FIXTURE_LOCK_ORDER = '''
+import threading
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+    def swap(self):
+        with self._lock:
+            with self._aux:
+                pass
+'''
+
+_FIXTURE_UNGUARDED = '''
+import threading
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+    def bump(self):
+        with self._lock:
+            self.count += 1
+    def reset(self):
+        self.count = 0
+'''
+
+_FIXTURE_HOST_SYNC = '''
+import jax
+import numpy as np
+@jax.jit
+def step(x):
+    if x:
+        return float(x)
+    return np.asarray(x).item()
+'''
+
+
+def _ast_mutant(fixture: str, linter) -> Callable[[], list]:
+    def run() -> list[contracts.Violation]:
+        return linter(fixture, "seeded_fixture.py")
+
+    return run
+
+
+#: mutation name -> (expected rule, runner). Every violation class the
+#: analyzer claims to catch has exactly one seeded witness here.
+MUTATIONS: dict[str, tuple[str, Callable[[], list]]] = {
+    "dense_collective": ("collective-op", _mutant_dense_collective),
+    "dense_temp": ("dense-buffer", _mutant_dense_temp),
+    "baked_constant": ("baked-constant", _mutant_baked_constant),
+    "blocking_under_lock": ("blocking-under-lock", _ast_mutant(
+        _FIXTURE_BLOCKING, ast_lints.lint_concurrency_source
+    )),
+    "lock_order": ("lock-order", _ast_mutant(
+        _FIXTURE_LOCK_ORDER, ast_lints.lint_concurrency_source
+    )),
+    "unguarded_shared_write": ("unguarded-shared-write", _ast_mutant(
+        _FIXTURE_UNGUARDED, ast_lints.lint_concurrency_source
+    )),
+    "host_sync": ("host-sync", _ast_mutant(
+        _FIXTURE_HOST_SYNC, ast_lints.lint_host_sync_source
+    )),
+    "traced_branch": ("traced-branch", _ast_mutant(
+        _FIXTURE_HOST_SYNC, ast_lints.lint_host_sync_source
+    )),
+}
+
+
+def run_mutation_checks() -> tuple[bool, list[dict]]:
+    """Run every seeded mutation; each must be CAUGHT with the
+    expected rule. Returns (all_caught, per-mutation records)."""
+    records = []
+    all_ok = True
+    for name, (rule, runner) in MUTATIONS.items():
+        viols = runner()
+        hits = [v for v in viols if v.rule == rule]
+        caught = bool(hits)
+        all_ok &= caught
+        records.append({
+            "mutation": name,
+            "expected_rule": rule,
+            "caught": caught,
+            "n_violations": len(viols),
+            "messages": [v.format() for v in hits[:2]],
+        })
+    return all_ok, records

@@ -1,0 +1,306 @@
+"""The audited program matrix: build (lower + compile) every program
+kind the system ships, at audit-sized shapes, on the 8-virtual-device
+CPU mesh — the same partitioner that drives ICI, so the SPMD HLO the
+contracts read here is the schedule a TPU pod would run.
+
+Audit shapes are deliberately small (compile time is CI stage-9 budget)
+and deliberately keep every non-feature dimension below the dense
+threshold — see the premise note in :mod:`.contracts`. The matrix
+covers the config surface the contracts guard: solo/fleet/serve x
+pipeline x merge_interval x sharded (ISSUE 10).
+
+Declaring a new program = one ``_register`` entry here naming its
+contract; ``scripts/analyze.py --list`` shows the live matrix.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from distributed_eigenspaces_tpu.analysis.contracts import ProgramParams
+
+# audit shapes: d=64 solo/fleet/serve, d=128 over 2 feature shards
+# (d_local=64); everything else well below 64
+_D, _K, _M, _N, _T = 64, 2, 4, 8, 3
+_FEAT_D = 128
+_FLEET_B = 8
+_SERVE_ROWS = 16
+
+
+def require_mesh_devices(n: int = 8) -> None:
+    """The audit needs the virtual-device mesh. Loud, named failure
+    when the interpreter booted without it (the XLA flag must be set
+    before the first jax import — scripts/analyze.py and
+    tests/conftest.py both do)."""
+    import jax
+
+    have = len(jax.devices())
+    if have < n:
+        raise RuntimeError(
+            f"program audit needs >= {n} devices, found {have}: set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 before "
+            "the first jax import (scripts/analyze.py does this; in "
+            "pytest, tests/conftest.py does)"
+        )
+
+
+@dataclass
+class BuiltProgram:
+    """One audited program: the jitted callable + its abstract args,
+    with the lowered/compiled artifacts cached lazily so a
+    collectives-only question never pays a compile twice."""
+
+    name: str
+    contract: str  # key into contracts.CONTRACTS
+    params: ProgramParams
+    jitted: Any
+    args: tuple
+    _cache: dict = field(default_factory=dict, repr=False)
+
+    def lowered(self):
+        if "lowered" not in self._cache:
+            self._cache["lowered"] = self.jitted.lower(*self.args)
+        return self._cache["lowered"]
+
+    def compiled(self):
+        if "compiled" not in self._cache:
+            self._cache["compiled"] = self.lowered().compile()
+        return self._cache["compiled"]
+
+    def hlo_text(self) -> str:
+        return self.compiled().as_text()
+
+    def jaxpr(self):
+        if "jaxpr" not in self._cache:
+            self._cache["jaxpr"] = self.jitted.trace(*self.args).jaxpr
+        return self._cache["jaxpr"]
+
+    def memory_stats(self):
+        if "memory" not in self._cache:
+            try:
+                self._cache["memory"] = self.compiled().memory_analysis()
+            except Exception:  # backend without the query — metrics only
+                self._cache["memory"] = None
+        return self._cache["memory"]
+
+
+def _cfg(**kw):
+    from distributed_eigenspaces_tpu.config import PCAConfig
+
+    base = dict(
+        dim=_D, k=_K, num_workers=_M, rows_per_worker=_N, num_steps=_T,
+        solver="subspace", subspace_iters=2, warm_start_iters=1,
+        compute_dtype="bfloat16",
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+def _ensure_jit(fn):
+    """Builders in the trainer family return jitted callables; the
+    masked/feature variants return plain wrappers — normalize so every
+    audited program exposes ``.lower``/``.trace``."""
+    import jax
+
+    return fn if hasattr(fn, "lower") else jax.jit(fn)
+
+
+def _scan_program(name: str, *, masked: bool = False, **cfg_kw):
+    def build() -> BuiltProgram:
+        import jax.numpy as jnp
+
+        from distributed_eigenspaces_tpu.algo.online import OnlineState
+        from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+        from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+        require_mesh_devices()
+        cfg = _cfg(**cfg_kw)
+        mesh = make_mesh(num_workers=_M)
+        fit = _ensure_jit(make_scan_fit(cfg, mesh, masked=masked))
+        x = jnp.zeros((_T, _M, _N, _D), jnp.bfloat16)
+        args = (OnlineState.initial(_D), x)
+        if masked:
+            args += (jnp.ones((_T, _M), jnp.float32),)
+        return BuiltProgram(
+            name=name, contract="scan_fit",
+            params=ProgramParams(
+                d=_D, k=_K, m=_M, n=_N, T=_T, n_workers_mesh=_M,
+            ),
+            jitted=fit, args=args,
+        )
+
+    return build
+
+
+def _feature_program(name: str, kind: str):
+    def build() -> BuiltProgram:
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+            auto_feature_mesh,
+            make_feature_sharded_scan_fit,
+            make_feature_sharded_sketch_fit,
+        )
+
+        require_mesh_devices()
+        cfg = _cfg(num_workers=_M, dim=_FEAT_D, backend="feature_sharded")
+        mesh = auto_feature_mesh(cfg)
+        mk = (
+            make_feature_sharded_scan_fit if kind == "scan"
+            else make_feature_sharded_sketch_fit
+        )
+        fit = mk(cfg, mesh, seed=0)
+        blocks = jax.device_put(
+            jnp.zeros((3, _M, _N, _FEAT_D), jnp.bfloat16),
+            fit.blocks_sharding,
+        )
+        idx = jnp.arange(2 * _T, dtype=jnp.int32) % 3
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        return BuiltProgram(
+            name=name, contract="feature_sharded",
+            params=ProgramParams(
+                d=_FEAT_D, k=_K, m=_M, n=_N, T=2 * _T,
+                n_feature_shards=axes.get("features", 1),
+                n_workers_mesh=axes.get("workers", 1),
+                sketch_width=int(getattr(fit, "sketch_width", 0) or 0),
+            ),
+            jitted=_ensure_jit(lambda s, b, i: fit(s, b, i)),
+            args=(fit.init_state(), blocks, idx),
+        )
+
+    return build
+
+
+def _fleet_program(name: str, *, masked: bool = False):
+    def build() -> BuiltProgram:
+        import jax.numpy as jnp
+
+        from distributed_eigenspaces_tpu.parallel.fleet import (
+            fleet_mesh,
+            init_fleet_states,
+            make_fleet_fit,
+        )
+
+        require_mesh_devices()
+        cfg = _cfg()
+        mesh = fleet_mesh(_FLEET_B)
+        fit = _ensure_jit(make_fleet_fit(cfg, mesh, masked=masked))
+        xs = jnp.zeros((_FLEET_B, _T, _M, _N, _D), jnp.bfloat16)
+        actives = jnp.ones((_FLEET_B, _T), jnp.float32)
+        args = (init_fleet_states(cfg, _FLEET_B), xs)
+        if masked:
+            args += (jnp.ones((_FLEET_B, _T, _M), jnp.float32),)
+        args += (actives,)
+        return BuiltProgram(
+            name=name, contract="fleet_fit",
+            params=ProgramParams(
+                d=_D, k=_K, m=_M, n=_N, T=_T, B=_FLEET_B,
+                n_workers_mesh=_FLEET_B,
+            ),
+            jitted=fit, args=args,
+        )
+
+    return build
+
+
+def _serve_program(name: str, kind: str, *, sharded: bool):
+    def build() -> BuiltProgram:
+        import jax
+
+        from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+        from distributed_eigenspaces_tpu.serving.transform import (
+            TransformEngine,
+        )
+
+        require_mesh_devices()
+        mesh = make_mesh(num_workers=8) if sharded else None
+        eng = TransformEngine(_D, _K, mesh=mesh)
+        rows = _SERVE_ROWS
+        fn, arg_like, second_shape = eng._fns[kind]
+        if kind == "residual":
+            second = eng._z_like(rows)
+        else:
+            second = jax.ShapeDtypeStruct(second_shape, jax.numpy.float32)
+        # reuse the engine's own lowering path (the audited program IS
+        # the served program), wrapped so lower/trace see the args
+        lowered = eng._lowered(kind, rows)
+        built = BuiltProgram(
+            name=name, contract="serve_transform",
+            params=ProgramParams(
+                d=_D, k=_K, rows=rows,
+                n_workers_mesh=8 if sharded else 1,
+            ),
+            jitted=_ensure_jit(fn),
+            args=(arg_like(rows), second),
+        )
+        built._cache["lowered"] = lowered
+        return built
+
+    return build
+
+
+#: name -> zero-arg builder. The ORDER is the report order.
+PROGRAMS: dict[str, Callable[[], BuiltProgram]] = {
+    # solo scan family x pipeline x merge_interval
+    "scan_solo": _scan_program("scan_solo"),
+    "scan_pipelined": _scan_program(
+        "scan_pipelined", pipeline_merge=True
+    ),
+    "scan_interval2": _scan_program("scan_interval2", merge_interval=2),
+    "scan_pipelined_interval2": _scan_program(
+        "scan_pipelined_interval2", pipeline_merge=True, merge_interval=2
+    ),
+    "scan_masked": _scan_program("scan_masked", masked=True),
+    "scan_masked_interval2": _scan_program(
+        "scan_masked_interval2", masked=True, merge_interval=2
+    ),
+    # feature-sharded cores
+    "feature_scan": _feature_program("feature_scan", "scan"),
+    "feature_sketch": _feature_program("feature_sketch", "sketch"),
+    # fleet (B > 1, sharded over the workers axis)
+    "fleet_b8": _fleet_program("fleet_b8"),
+    "fleet_b8_masked": _fleet_program("fleet_b8_masked", masked=True),
+    # serve transforms, solo and row-sharded
+    "serve_project": _serve_program(
+        "serve_project", "project", sharded=True
+    ),
+    "serve_reconstruct": _serve_program(
+        "serve_reconstruct", "reconstruct", sharded=True
+    ),
+    "serve_residual": _serve_program(
+        "serve_residual", "residual", sharded=True
+    ),
+    "serve_project_solo": _serve_program(
+        "serve_project_solo", "project", sharded=False
+    ),
+}
+
+_BUILT: dict[str, BuiltProgram] = {}
+
+
+def build_program(name: str) -> BuiltProgram:
+    """Build (and cache) one audited program by matrix name."""
+    if name not in PROGRAMS:
+        raise KeyError(
+            f"unknown program {name!r}; matrix: {sorted(PROGRAMS)}"
+        )
+    if name not in _BUILT:
+        _BUILT[name] = PROGRAMS[name]()
+    return _BUILT[name]
+
+
+def engine_params(engine) -> ProgramParams:
+    """Params for a live :class:`~..serving.transform.TransformEngine`
+    — the serve-tier report audits the engine's ALREADY-COMPILED bucket
+    programs (zero extra compiles)."""
+    mesh = engine.mesh
+    rows = 1
+    n_mesh = 1
+    if mesh is not None:
+        n_mesh = int(math.prod(mesh.devices.shape))
+    return ProgramParams(
+        d=engine.d, k=engine.k, rows=rows, n_workers_mesh=n_mesh,
+    )

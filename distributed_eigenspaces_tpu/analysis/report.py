@@ -1,0 +1,130 @@
+"""Aggregation: run the passes, emit one bench-style machine-readable
+report.
+
+Three entry points:
+
+- :func:`run_analysis` — the full static audit (program matrix +
+  lints), what ``scripts/analyze.py --all`` and CI stage "analyze"
+  emit;
+- :func:`run_mutation_report` — the self-test (:mod:`.mutations`);
+- :func:`engine_report` — audit a LIVE serving engine's
+  already-compiled bucket programs (zero extra compiles): this is the
+  report ``MetricsLogger.attach_analysis`` lands in
+  ``summary()["analysis"]`` so a bench record carries the contract
+  verdict alongside its latency numbers.
+
+Report schema (``schema: "analysis-v1"``) is additive-friendly: bench
+``--compare`` treats ``analysis`` as a passthrough section, never a
+metric, so pre-PR-10 records compare cleanly against new ones.
+"""
+
+from __future__ import annotations
+
+SCHEMA = "analysis-v1"
+
+
+def _violations_json(viols) -> list[dict]:
+    return [
+        {
+            "program": v.program,
+            "rule": v.rule,
+            "message": v.message,
+            "location": v.location,
+        }
+        for v in viols
+    ]
+
+
+def run_analysis(
+    program_names=None,
+    *,
+    lints: bool = True,
+    root: str | None = None,
+) -> dict:
+    """The full static audit. ``program_names=None`` runs the whole
+    matrix; pass a subset for a fast targeted run."""
+    from distributed_eigenspaces_tpu.analysis import (
+        ast_lints,
+        contracts,
+        programs,
+    )
+
+    names = list(program_names or programs.PROGRAMS)
+    report: dict = {
+        "schema": SCHEMA,
+        "programs": {},
+        "lints": {},
+        "ok": True,
+        "n_violations": 0,
+    }
+    for name in names:
+        built = programs.build_program(name)
+        viols, detail = contracts.check_program(built)
+        detail["violations"] = _violations_json(viols)
+        report["programs"][name] = detail
+        report["n_violations"] += len(viols)
+    if lints:
+        for key, runner in (
+            ("concurrency", ast_lints.lint_concurrency),
+            ("host_sync", ast_lints.lint_host_sync),
+        ):
+            viols = runner(root)
+            report["lints"][key] = {
+                "ok": not viols,
+                "violations": _violations_json(viols),
+            }
+            report["n_violations"] += len(viols)
+    report["ok"] = report["n_violations"] == 0
+    return report
+
+
+def run_mutation_report() -> dict:
+    """The gate's self-test: every seeded violation class must be
+    caught with its expected rule."""
+    from distributed_eigenspaces_tpu.analysis import mutations
+
+    ok, records = mutations.run_mutation_checks()
+    return {"schema": SCHEMA, "ok": ok, "mutations": records}
+
+
+def engine_report(engine) -> dict:
+    """Contract audit of a live ``TransformEngine``'s compiled bucket
+    programs. Reads the engine's compile cache directly — no compiles,
+    so attaching this to a bench summary costs parsing only.
+
+    The memory pass runs only on buckets whose row count sits below
+    ``d`` (the premise that makes the dense-shape rule exact — a
+    (rows, d) activation with rows >= d is legitimately 'dense' by
+    shape and proves nothing)."""
+    from distributed_eigenspaces_tpu.analysis import contracts
+
+    contract = contracts.CONTRACTS["serve_transform"]
+    out: dict = {
+        "schema": SCHEMA,
+        "contract": contract.name,
+        "programs": {},
+        "ok": True,
+        "n_violations": 0,
+    }
+    for (kind, rows), compiled in sorted(engine._cache.items()):
+        params = contracts.ProgramParams(
+            d=engine.d, k=engine.k, rows=rows
+        )
+        name = f"serve_{kind}_rows{rows}"
+        hlo = compiled.as_text()
+        viols, col = contracts.check_collectives(
+            contract, params, hlo, program=name
+        )
+        entry: dict = {"collectives": col}
+        if rows < contract.dense_dim(params):
+            mv, mem = contracts.check_memory(
+                contract, params, program=name, hlo_text=hlo
+            )
+            viols += mv
+            entry["memory"] = mem
+        entry["ok"] = not viols
+        entry["violations"] = _violations_json(viols)
+        out["programs"][name] = entry
+        out["n_violations"] += len(viols)
+    out["ok"] = out["n_violations"] == 0
+    return out

@@ -465,10 +465,7 @@ def run_eval(
     # gather copy at bf16; stage_dtype="int8" halves them again and the
     # solvers contract int8 natively (bench.py methodology; ONE staging
     # contract — data.stream.stage_blocks)
-    from distributed_eigenspaces_tpu.data.stream import (
-        quantize_block_i8_device,
-        stage_blocks,
-    )
+    from distributed_eigenspaces_tpu.data.stream import stage_blocks
 
     stage_dtype = cfg.resolved_stage_dtype()
 
@@ -971,7 +968,7 @@ def run_eval(
         # (nothing crosses it). The structural claim itself (no dense
         # payload in the compiled HLO) is asserted in
         # tests/test_collectives_audit.py and dryrun_multichip.
-        from distributed_eigenspaces_tpu.utils.collectives_audit import (
+        from distributed_eigenspaces_tpu.analysis.hlo import (
             scaling_projection,
         )
 

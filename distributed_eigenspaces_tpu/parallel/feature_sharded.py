@@ -30,7 +30,6 @@ is exactly the shape the MXU and ICI want.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax

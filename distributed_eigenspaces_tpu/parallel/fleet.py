@@ -27,8 +27,8 @@ TPUs earn their keep. This module is that serving layer:
 - The fleet axis shards across the mesh as PURE data parallelism
   (:func:`fleet_mesh` reuses the ``workers`` mesh axis for tenants):
   every op is per-tenant, so the partitioned program contains no
-  cross-tenant collectives at all — machine-checked by
-  ``utils.collectives_audit`` in tests/test_fleet.py.
+  cross-tenant collectives at all — machine-checked against the
+  ``fleet_fit`` contract (``analysis.contracts``) in tests/test_fleet.py.
 - :class:`FleetServer` — the admission front door: requests accumulate
   into exact-signature buckets (``runtime.scheduler.ShapeBucketQueue``)
   that dispatch when FULL (``cfg.fleet_bucket_size``) or on a deadline
@@ -134,7 +134,8 @@ def make_fleet_fit(cfg: PCAConfig, mesh=None, *, masked: bool = False):
     ``workers`` mesh axis as pure data parallelism: every op is
     per-tenant, so the partitioned program needs no collectives —
     composing with ``parallel/mesh`` without new communication
-    (audited in tests/test_fleet.py via ``utils.collectives_audit``).
+    (audited in tests/test_fleet.py against the ``fleet_fit``
+    contract, ``analysis.contracts``).
 
     The steady-state restructure knobs are rejected loudly:
     ``pipeline_merge`` (a pending-factor carry per tenant does not

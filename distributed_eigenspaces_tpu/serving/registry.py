@@ -281,10 +281,13 @@ class EigenbasisRegistry:
         for bv in entries[:-self.keep] if len(entries) > self.keep else []:
             self._delete_version_dir(bv.version)
         entries = entries[-self.keep:]
-        self._versions = {bv.version: bv for bv in entries}
-        self._latest = entries[-1] if entries else None
-        self._next_id = max_seen + 1
-        self.recovered_versions = [bv.version for bv in entries]
+        # install under the lock: recovery runs from __init__ today,
+        # but these are the same shared fields publish()/latest() guard
+        with self._lock:
+            self._versions = {bv.version: bv for bv in entries}
+            self._latest = entries[-1] if entries else None
+            self._next_id = max_seen + 1
+            self.recovered_versions = [bv.version for bv in entries]
         if entries:
             self._log(
                 "registry recovery: warm store loaded",

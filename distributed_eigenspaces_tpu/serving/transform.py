@@ -24,8 +24,8 @@ Two disciplines make a hot-swappable serving tier cheap:
 The optional mesh path shards the padded row axis over the existing
 ``workers`` mesh axis as pure data parallelism — the axis name is never
 used inside the kernel, so the partitioned program contains ZERO
-collectives by construction (audited like the fleet trainer, via
-``utils.collectives_audit``).
+collectives by construction (audited like the fleet trainer, against
+the ``serve_transform`` contract in ``analysis.contracts``).
 """
 
 from __future__ import annotations
@@ -34,7 +34,6 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_eigenspaces_tpu.parallel.mesh import (

@@ -1,221 +1,32 @@
-"""Machine-checked collective-traffic audit (round-5 verdict item 2).
+"""Back-compat shim: the collective-traffic audit moved to
+``distributed_eigenspaces_tpu.analysis.hlo`` (PR 10), where it is one
+pass of the program-contract analyzer (``analysis/contracts.py``,
+driven by ``scripts/analyze.py``).
 
-The framework's multi-chip story rests on one structural claim: the
-merge moves the ``(m, d, k)`` factor stack (an ``all_gather``) instead
-of a ``d x d`` mean projector (a ``psum``) — 2·d/(m·k)× less ICI traffic
-at the benchmark shapes (16× at d=1024, m=8, k=8) — and the
-feature-sharded solvers reduce only k-wide payloads. Until round 5 that
-claim was prose + construction (`ops/linalg.py` docstring); the
-reference's wire cost was at least *observable* on its broker
-(``distributed.py:51``). This module makes ours machine-checked: parse
-the collectives out of the COMPILED (SPMD-partitioned) HLO, compare
-them against the documented model, and fail a test if a future change
-silently reintroduces a dense allreduce.
-
-Works on the CPU virtual-device mesh (the partitioner emits the same
-collective ops it would for ICI), so the audit runs in plain pytest and
-inside ``dryrun_multichip``.
+This module re-exports the old public names and warns ONCE per
+process; new code should import from ``analysis.hlo`` (parser) or use
+the contract API (``analysis.contracts.check_program``) directly.
 """
 
 from __future__ import annotations
 
-import math
-import re
-from dataclasses import dataclass
+import warnings
 
-# one optimized-HLO collective per line. Two result forms:
-#   %ag = f32[8,128,4]{...} all-gather(%p), replica_groups=...
-#   %rs = (f32[64]{0}, u32[]) all-reduce-start(%p), ...   (async / tuple)
-# The op-name alternation accepts the async "-start" suffix (TPU HLO
-# lowers collectives to start/done pairs) and "-done" is deliberately
-# NOT matched (it would double-count its start's payload).
-_OP_NAMES = (
-    "all-gather", "all-reduce", "reduce-scatter", "collective-permute",
-    "all-to-all",
-)
-_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
-# The tuple branch matches LAZILY up to the closing ") <op-name>(": TPU
-# tiled layouts put parens INSIDE the tuple members (e.g.
-# "(f32[64]{0:T(256)}, u32[])"), so a greedy-to-first-')' matcher would
-# truncate mid-member and the parser-drift tripwire would raise on every
-# TPU-compiled module (ADVICE.md r5).
-_COLLECTIVE_RE = re.compile(
-    r" = (\(.*?\)|\w+\[[\d,]*\][^ ]*) "
-    r"(" + "|".join(_OP_NAMES) + r")(?:-start)?"
-    r"\("
-)
-# raw occurrence counter for the parser-drift tripwire (see
-# parse_collectives): "-done" ops and the start forms both contain the
-# base name, so count call sites `name(` and `name-start(` only
-_RAW_RE = re.compile(
-    r"(" + "|".join(_OP_NAMES) + r")(?:-start)?\("
+from distributed_eigenspaces_tpu.analysis.hlo import (  # noqa: F401
+    AuditParseError,
+    CollectiveOp,
+    assert_no_dense_collective,
+    audit_compiled,
+    ici_step_model,
+    parse_collectives,
+    scaling_projection,
 )
 
-_ITEMSIZE = {
-    "f64": 8, "f32": 4, "bf16": 2, "f16": 2,
-    "s32": 4, "u32": 4, "s8": 1, "u8": 1, "pred": 1,
-}
-
-
-@dataclass(frozen=True)
-class CollectiveOp:
-    op: str  # all-gather / all-reduce / ...
-    dtype: str
-    shape: tuple[int, ...]
-
-    @property
-    def elems(self) -> int:
-        return math.prod(self.shape) if self.shape else 1
-
-    @property
-    def payload_bytes(self) -> int:
-        return self.elems * _ITEMSIZE.get(self.dtype, 4)
-
-
-def parse_collectives(hlo_text: str) -> list[CollectiveOp]:
-    """Every collective op in an (optimized, SPMD-partitioned) HLO dump.
-
-    Shapes are PER-DEVICE — an ``all-gather`` line's shape is its
-    gathered output on each device. Tuple-shaped results (async
-    ``-start`` forms, combined collectives) contribute the LARGEST
-    member as the op's shape — the quantity the dense tripwire checks —
-    and a tripwire guards the parser itself: if the text contains more
-    collective call sites than the structured regex matched, the parser
-    has drifted from the HLO syntax and raises instead of silently
-    under-reporting (an empty parse must never read as "no dense
-    collectives"). Ops inside a ``while`` body (the ``lax.scan`` steps)
-    appear once in the text; callers reason per step, which is exactly
-    the granularity the byte model wants.
-    """
-    out = []
-    for m in _COLLECTIVE_RE.finditer(hlo_text):
-        shapes_txt, op = m.groups()
-        members = [
-            (dt, tuple(int(s) for s in dims.split(",") if s))
-            for dt, dims in _SHAPE_RE.findall(shapes_txt)
-        ]
-        if not members:
-            members = [("f32", ())]  # shapeless scalar result
-        dtype, dims = max(
-            members, key=lambda p: math.prod(p[1]) if p[1] else 1
-        )
-        out.append(CollectiveOp(op=op, dtype=dtype, shape=dims))
-    raw = len(_RAW_RE.findall(hlo_text))
-    if raw > len(out):
-        raise RuntimeError(
-            f"collective parser drift: {raw} collective call sites in "
-            f"the HLO but only {len(out)} parsed — the audit would "
-            "under-report; fix _COLLECTIVE_RE for the new syntax"
-        )
-    return out
-
-
-def audit_compiled(compiled) -> dict:
-    """Summary of a ``jit(...).lower(...).compile()`` result's collectives:
-    per-(op, dtype, shape) counts plus the largest single payload —
-    the number the dense-allreduce tripwire checks."""
-    ops = parse_collectives(compiled.as_text())
-    counts: dict[str, int] = {}
-    for o in ops:
-        key = f"{o.op} {o.dtype}[{','.join(map(str, o.shape))}]"
-        counts[key] = counts.get(key, 0) + 1
-    return {
-        "ops": counts,
-        "n_collectives": len(ops),
-        "max_payload_elems": max((o.elems for o in ops), default=0),
-        "max_payload_bytes": max(
-            (o.payload_bytes for o in ops), default=0
-        ),
-        "_parsed": ops,
-    }
-
-
-def assert_no_dense_collective(audit: dict, dim: int) -> None:
-    """The regression tripwire: no collective payload may reach ``d^2``
-    elements (or even half of it) — the structural invariant every
-    sharded trainer maintains is that ONLY factor stacks (m·d·k) and
-    k-wide reductions cross the mesh, never a dense d x d matrix. A
-    reintroduced dense-projector psum trips this immediately."""
-    limit = dim * dim // 2
-    worst = audit["max_payload_elems"]
-    if worst >= limit:
-        offenders = [
-            f"{o.op} {o.dtype}{list(o.shape)}"
-            for o in audit["_parsed"]
-            if o.elems >= limit
-        ]
-        raise AssertionError(
-            f"dense collective detected: payload {worst} elems >= "
-            f"d^2/2 = {limit} ({', '.join(offenders)}) — the merge must "
-            "move factors, not d x d matrices (ops/linalg.py "
-            "merged_top_k_lowrank; BASELINE.md item 4)"
-        )
-
-
-def ici_step_model(
-    m: int, d: int, k: int, *,
-    n_workers_mesh: int, n_feature_shards: int = 1, itemsize: int = 4,
-) -> dict:
-    """Documented per-step ICI byte model for the sharded trainers,
-    ring-collective accounting (what XLA lowers to on a torus):
-
-    - factor merge: ``all_gather`` of per-device ``(m/W, d_l, k)`` shards
-      into ``(m, d_l, k)`` on each of W worker-mesh devices — each
-      device moves ``(W-1)/W * m * d_l * k`` elements per step
-      (``d_l = d / n_feature_shards``);
-    - the dense alternative this design replaces: ``psum`` of a
-      ``d x d`` projector — ``2 * (W-1)/W * d^2`` elements per device;
-    - feature-axis reductions (sharded matvec / CholeskyQR Grams /
-      sketch folds): k-wide payloads, O(n·k + k^2) elements — reported
-      as a bound, not enumerated (each is <= the merge payload by
-      construction; the audit asserts the ceiling).
-
-    Returns modeled bytes/device/step for the factor route, the dense
-    route, and their ratio — the number BASELINE.md's "16x less ICI
-    traffic" claim quotes, now computed instead of asserted in prose.
-    """
-    w = max(n_workers_mesh, 1)
-    d_local = d // max(n_feature_shards, 1)
-    ring = (w - 1) / w if w > 1 else 0.0
-    factor = ring * m * d_local * k * itemsize
-    dense = 2.0 * ring * d * d * itemsize
-    return {
-        "factor_gather_bytes_per_step": int(factor),
-        "dense_psum_bytes_per_step": int(dense),
-        # None (not inf) when the worker axis is trivial — a 1-chip mesh
-        # moves nothing, and inf is not valid strict JSON
-        "dense_over_factor": (
-            round(dense / factor, 2) if factor else None
-        ),
-        "model": "ring collectives: all_gather (W-1)/W*payload, "
-                 "psum 2*(W-1)/W*payload, per device per step",
-    }
-
-
-def scaling_projection(
-    m: int, d: int, k: int, *, step_seconds: float,
-    n_workers_mesh: int, n_feature_shards: int = 1,
-    ici_gbps: float = 90.0,
-) -> dict:
-    """ICI-bytes-per-step vs step-time projection: at what mesh size
-    does the merge's collective stop hiding behind the step's compute?
-    ``ici_gbps`` defaults to a single v5e ICI link's ~90 GB/s (4800
-    Gbps bidirectional across 4 links per chip / conservative per-link
-    share); the point of the field is the RATIO trend, not the last
-    percent — both inputs are in the JSON so readers can re-anchor.
-    """
-    model = ici_step_model(
-        m, d, k,
-        n_workers_mesh=n_workers_mesh,
-        n_feature_shards=n_feature_shards,
-    )
-    wire_s = model["factor_gather_bytes_per_step"] / (ici_gbps * 1e9)
-    return {
-        **model,
-        "assumed_ici_gb_per_sec": ici_gbps,
-        "modeled_collective_seconds_per_step": round(wire_s, 9),
-        "measured_step_seconds": round(step_seconds, 9),
-        "collective_fraction_of_step": (
-            round(wire_s / step_seconds, 6) if step_seconds > 0 else None
-        ),
-    }
+warnings.warn(
+    "distributed_eigenspaces_tpu.utils.collectives_audit is a "
+    "back-compat shim: import from "
+    "distributed_eigenspaces_tpu.analysis.hlo (parser) or use the "
+    "contract API in distributed_eigenspaces_tpu.analysis.contracts",
+    DeprecationWarning,
+    stacklevel=2,
+)

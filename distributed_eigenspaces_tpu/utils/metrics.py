@@ -134,6 +134,11 @@ class MetricsLogger:
         #: :meth:`attach_serve_health` — merged into
         #: ``summary()["serving"]["health"]``
         self.serve_health_sources: list = []
+        #: static-analysis verdict (analysis/report.py) — a report
+        #: dict or a zero-arg callable producing one, attached via
+        #: :meth:`attach_analysis`; surfaced by :meth:`summary`
+        #: under "analysis"
+        self.analysis_report = None
         self._last_time = None
         self._fit_trace = None
         # evicted-entry aggregates: what the ring buffers folded away
@@ -238,6 +243,17 @@ class MetricsLogger:
         self.compile_cache = cache
         if self.tracer is not None and getattr(cache, "tracer", None) is None:
             cache.tracer = self.tracer
+        return self
+
+    def attach_analysis(self, report) -> "MetricsLogger":
+        """Attach a static-analysis verdict (``analysis.report``):
+        either a finished report dict or a zero-arg callable producing
+        one (e.g. ``lambda: engine_report(engine)``, evaluated at
+        summary time so late-compiled bucket programs are audited
+        too). Lands in ``summary()["analysis"]`` — the run report
+        carries the contract verdict alongside the numbers it
+        certifies."""
+        self.analysis_report = report
         return self
 
     def attach_serve_health(self, source) -> "MetricsLogger":
@@ -521,6 +537,9 @@ class MetricsLogger:
             out["slo"] = slo
         if self.compile_cache is not None:
             out["compile"] = self.compile_cache.stats()
+        if self.analysis_report is not None:
+            rep = self.analysis_report
+            out["analysis"] = rep() if callable(rep) else rep
         return out
 
     # -- dispatch-section helpers --------------------------------------------

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Static program-contract analyzer — CI stage "analyze" (ISSUE 10).
+
+Audits every program kind in the config matrix
+(``analysis/programs.py``: solo/fleet/serve x pipeline x
+merge_interval x sharded) against its declarative contract
+(``analysis/contracts.py``) WITHOUT executing anything: collective
+schedule + payload bounds from the SPMD-partitioned HLO, memory-
+footprint (no dense d x d buffer in factor-only programs), baked-in
+jaxpr constants — plus the AST lints (host-sync in jitted paths, lock
+discipline over the threaded runtime).
+
+``--mutation-check`` additionally runs the self-test: seeded
+violations (a dense psum, a d x d temp, a baked constant, a blocking
+call under a lock, ...) must each be CAUGHT, so the gate can fail in
+both directions.
+
+Usage:
+    python scripts/analyze.py --all [--mutation-check] [--json OUT]
+    python scripts/analyze.py --programs scan_solo,fleet_b8
+    python scripts/analyze.py --lints-only
+    python scripts/analyze.py --list
+
+Exit code 0 iff every audited program honors its contract, the lints
+are clean, and (with ``--mutation-check``) every seeded violation was
+caught. Runs on the CPU rig: the 8-virtual-device mesh drives the same
+SPMD partitioner a TPU pod would.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _print_program_rows(report: dict) -> None:
+    for name, entry in report["programs"].items():
+        col = entry["collectives"]
+        mem = entry.get("memory", {})
+        status = "ok" if entry["ok"] else "FAIL"
+        print(
+            f"  {name:26s} {status:4s} contract={entry['contract']:16s} "
+            f"collectives={col['n_collectives']:3d} "
+            f"max_payload={col['max_payload_elems']:6d} "
+            f"policy={mem.get('policy', '-')}"
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--all", action="store_true",
+                    help="audit the full program matrix + lints")
+    ap.add_argument("--programs", default=None,
+                    help="comma-separated subset of the matrix")
+    ap.add_argument("--lints-only", action="store_true",
+                    help="run only the AST lints (no compiles)")
+    ap.add_argument("--mutation-check", action="store_true",
+                    help="also require every seeded violation caught")
+    ap.add_argument("--list", action="store_true",
+                    help="list the audited program matrix and exit")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="write the machine-readable report here")
+    args = ap.parse_args(argv)
+
+    from distributed_eigenspaces_tpu.analysis import report as report_mod
+
+    if args.list:
+        from distributed_eigenspaces_tpu.analysis import (
+            contracts,
+            programs,
+        )
+
+        for name, _ in programs.PROGRAMS.items():
+            print(name)
+        print("\ncontracts:")
+        for key, c in contracts.CONTRACTS.items():
+            print(f"  {key}: {c.description}")
+        return 0
+
+    if not (args.all or args.programs or args.lints_only):
+        ap.error("pick one of --all / --programs / --lints-only / --list")
+
+    t0 = time.time()
+    out: dict = {"schema": report_mod.SCHEMA}
+    failures = 0
+
+    if args.lints_only:
+        rep = report_mod.run_analysis([], lints=True)
+    else:
+        subset = (
+            [s for s in args.programs.split(",") if s]
+            if args.programs else None
+        )
+        rep = report_mod.run_analysis(subset, lints=not args.programs)
+    out["analysis"] = rep
+    failures += rep["n_violations"]
+
+    print(f"programs audited: {len(rep['programs'])}")
+    _print_program_rows(rep)
+    for key, entry in rep["lints"].items():
+        n = len(entry["violations"])
+        print(f"  lint:{key:21s} {'ok' if entry['ok'] else 'FAIL'}"
+              f"   violations={n}")
+    for name, entry in rep["programs"].items():
+        for v in entry["violations"]:
+            print(f"    VIOLATION {v['program']}: {v['rule']}: "
+                  f"{v['message']} [{v['location']}]")
+    for key, entry in rep["lints"].items():
+        for v in entry["violations"]:
+            print(f"    VIOLATION {v['program']}: {v['rule']}: "
+                  f"{v['message']} [{v['location']}]")
+
+    if args.mutation_check:
+        mut = report_mod.run_mutation_report()
+        out["mutation_check"] = mut
+        n_caught = sum(1 for r in mut["mutations"] if r["caught"])
+        print(f"mutation check: {n_caught}/{len(mut['mutations'])} "
+              f"seeded violation classes caught")
+        for r in mut["mutations"]:
+            mark = "caught" if r["caught"] else "MISSED"
+            print(f"  {r['mutation']:24s} {mark}  "
+                  f"rule={r['expected_rule']}")
+            if not r["caught"]:
+                failures += 1
+
+    out["elapsed_s"] = round(time.time() - t0, 2)
+    out["ok"] = failures == 0
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+        print(f"report -> {args.json}")
+    print(f"analyze: {'PASS' if out['ok'] else 'FAIL'} "
+          f"({out['elapsed_s']}s)")
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

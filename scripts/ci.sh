@@ -58,12 +58,20 @@
 #      loss loud within 2x heartbeat timeout + checkpoint auto-resume,
 #      all gated by the bench itself; compared (churn_recovery_ms
 #      ratio + structural bound) vs the committed BENCH_CHURN_SMOKE_CPU;
-#   9. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   9. scripts/analyze.py --all --mutation-check: the static program-
+#      contract gate (ISSUE 10, docs/ANALYSIS.md) — every program kind
+#      audited against its declarative contract (collective schedule +
+#      payload bounds, memory policy, baked constants) from compiled
+#      HLO/jaxprs without executing, plus the concurrency/host-sync AST
+#      lints AND the mutation self-tests that prove each violation
+#      class is caught. When ruff is on PATH (not in the pinned CI
+#      image) the lint config in pyproject.toml runs first;
+#   10. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/9] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/10] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -71,7 +79,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/9] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/10] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -81,7 +89,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/9] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/10] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -96,7 +104,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/9] serve equality + amortization smoke (CPU) =="
+echo "== [4/10] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -111,7 +119,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/9] coldstart + prewarm smoke (CPU) =="
+echo "== [5/10] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -126,7 +134,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [6/9] telemetry smoke: trace export + span-chain validation =="
+echo "== [6/10] telemetry smoke: trace export + span-chain validation =="
 # A serve burst with --trace-out, then a structural validation of the
 # emitted timeline: the JSON must parse as Chrome trace-event format,
 # every served query's span chain (admit → queue_wait → dispatch →
@@ -171,7 +179,7 @@ print(json.dumps({
 }))
 PY
 
-echo "== [7/9] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
+echo "== [7/10] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
 # bench.py --chaos-serve asserts the read-path resilience gates itself
 # (ISSUE 7): a kill -9'd publisher's store recovers (torn snapshot
 # skipped, checksum corruption quarantined) and the restarted server
@@ -190,7 +198,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve
 fi
 
-echo "== [8/9] chaos-churn smoke: elastic membership under churn (CPU) =="
+echo "== [8/10] chaos-churn smoke: elastic membership under churn (CPU) =="
 # bench.py --chaos-churn asserts the fit-tier elastic-membership gates
 # itself (ISSUE 8): a run with 30% mid-run worker loss, flapping
 # rejoins, and a persistent straggler finishes all steps inside the
@@ -210,7 +218,20 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-churn
 fi
 
-echo "== [9/9] graft entry + 8-device sharded dryrun =="
+echo "== [9/10] static analysis: program contracts + lints + mutations =="
+# scripts/analyze.py compiles (never runs) the whole program matrix and
+# audits each program against its contract, runs the concurrency /
+# host-sync AST lints over the threaded runtime, and proves the gate
+# bites via seeded mutations (docs/ANALYSIS.md). Budget: < 2 min on
+# the CPU rig (~15 s measured). ruff is config-only in the pinned
+# image — run it when available so dev machines get the style gate
+# without adding a CI dependency.
+if command -v ruff >/dev/null 2>&1; then
+    ruff check .
+fi
+JAX_PLATFORMS=cpu python scripts/analyze.py --all --mutation-check
+
+echo "== [10/10] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

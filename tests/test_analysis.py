@@ -1,0 +1,154 @@
+"""Program-contract suite (ISSUE 10 tentpole): every program kind in
+the audited matrix — solo scan (plain / pipelined / merge-interval /
+membership-masked), feature-sharded scan+sketch, B>1 fleet (masked and
+not), serve transforms (sharded and solo) — must honor its declarative
+contract: collective schedule + payload bounds, memory policy, no
+baked-in constants. The same checks CI stage "analyze" runs via
+scripts/analyze.py; here they gate plain pytest.
+"""
+
+import pytest
+
+from distributed_eigenspaces_tpu.analysis import contracts, programs
+from distributed_eigenspaces_tpu.analysis.contracts import ProgramParams
+
+
+@pytest.mark.parametrize("name", sorted(programs.PROGRAMS))
+def test_program_honors_contract(devices, name):
+    built = programs.build_program(name)
+    viols, detail = contracts.check_program(built)
+    assert not viols, [v.format() for v in viols]
+    assert detail["ok"]
+    contract = contracts.CONTRACTS[built.contract]
+    col = detail["collectives"]
+    if contract.require_collectives:
+        assert col["n_collectives"] > 0
+        assert col["max_payload_elems"] <= contract.max_payload_elems(
+            built.params
+        )
+    else:
+        assert col["n_collectives"] == 0, col["ops"]
+
+
+def test_matrix_covers_every_contract_kind(devices):
+    """The config matrix exercises every contract in the registry —
+    a contract nobody compiles against is a claim nobody checks."""
+    kinds = {
+        programs.build_program(n).contract
+        for n in (
+            "scan_solo", "feature_scan", "fleet_b8", "serve_project",
+        )
+    }
+    assert kinds == set(contracts.CONTRACTS)
+
+
+def test_scan_contract_pins_factor_gather(devices):
+    """The scan program's only collective is the (m, d, k) factor
+    all-gather and its payload equals the factor stack exactly."""
+    built = programs.build_program("scan_solo")
+    _, detail = contracts.check_program(built)
+    ops = detail["collectives"]["ops"]
+    assert ops and all(k.startswith("all-gather") for k in ops)
+    p = built.params
+    assert detail["collectives"]["max_payload_elems"] == p.m * p.d * p.k
+
+
+def test_dense_premise_violation_raises_loudly():
+    """An audit config whose small dims reach the dense threshold must
+    refuse to run (the shape rule would be meaningless), naming the
+    offending dims."""
+    contract = contracts.CONTRACTS["serve_transform"]
+    params = ProgramParams(d=64, k=2, rows=64)
+    with pytest.raises(ValueError, match="rows"):
+        contracts.check_memory(
+            contract, params, program="bad_config", hlo_text=""
+        )
+
+
+def test_d_local_property():
+    p = ProgramParams(d=128, k=2, n_feature_shards=2)
+    assert p.d_local == 64
+    assert ProgramParams(d=128, k=2).d_local == 128
+
+
+def test_engine_report_audits_live_cache(devices):
+    """engine_report reads the serving engine's compile cache without
+    adding compiles, and its verdict lands in bench summaries."""
+    from distributed_eigenspaces_tpu.analysis.report import engine_report
+    from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+    from distributed_eigenspaces_tpu.serving.transform import (
+        TransformEngine,
+    )
+
+    eng = TransformEngine(64, 2, mesh=make_mesh(num_workers=8))
+    eng.compiled_for("project", 16)
+    misses_before = eng.compile_misses
+    rep = engine_report(eng)
+    assert eng.compile_misses == misses_before  # audit compiles nothing
+    assert rep["ok"] and rep["n_violations"] == 0
+    assert "serve_project_rows16" in rep["programs"]
+    entry = rep["programs"]["serve_project_rows16"]
+    assert entry["collectives"]["n_collectives"] == 0
+    assert entry["memory"]["policy"] == "factor_only"
+
+
+def test_engine_report_skips_memory_premise_breaking_buckets(devices):
+    """A bucket with rows >= d is legitimately (rows, d)-dense by
+    shape; the engine report must audit its collectives but skip the
+    memory pass instead of raising or false-flagging."""
+    from distributed_eigenspaces_tpu.analysis.report import engine_report
+    from distributed_eigenspaces_tpu.serving.transform import (
+        TransformEngine,
+    )
+
+    eng = TransformEngine(32, 2)
+    eng.compiled_for("project", 64)  # rows 64 >= d 32
+    rep = engine_report(eng)
+    assert rep["ok"], rep
+    entry = rep["programs"]["serve_project_rows64"]
+    assert "memory" not in entry
+    assert entry["collectives"]["n_collectives"] == 0
+
+
+def test_metrics_summary_carries_analysis_verdict():
+    """attach_analysis accepts a finished report OR a zero-arg
+    callable (evaluated at summary time, like serve health) — either
+    way the verdict lands in summary()["analysis"]."""
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    rep = {"schema": "analysis-v1", "ok": True, "n_violations": 0}
+    assert MetricsLogger().attach_analysis(rep).summary()[
+        "analysis"
+    ] == rep
+
+    calls = []
+
+    def late():
+        calls.append(1)
+        return rep
+
+    m = MetricsLogger().attach_analysis(late)
+    assert not calls  # deferred until the summary is built
+    assert m.summary()["analysis"] == rep and calls == [1]
+    assert "analysis" not in MetricsLogger().summary()
+
+
+def test_run_analysis_report_shape(devices):
+    """The machine-readable report: per-program verdicts + lints +
+    aggregate ok, additive schema bench --compare passes through."""
+    from distributed_eigenspaces_tpu.analysis.report import (
+        SCHEMA,
+        run_analysis,
+    )
+
+    rep = run_analysis(["scan_solo"], lints=True)
+    assert rep["schema"] == SCHEMA
+    assert rep["ok"] and rep["n_violations"] == 0
+    assert set(rep["programs"]) == {"scan_solo"}
+    entry = rep["programs"]["scan_solo"]
+    assert entry["violations"] == []
+    assert {"contract", "ok", "collectives", "memory", "consts"} <= set(
+        entry
+    )
+    assert set(rep["lints"]) == {"concurrency", "host_sync"}
+    assert all(e["ok"] for e in rep["lints"].values()), rep["lints"]

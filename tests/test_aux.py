@@ -262,8 +262,6 @@ def test_checkpoint_rewrite_crash_leaves_no_committed_corruption(tmp_path):
     path = str(tmp_path / "ck")
     save_checkpoint(path, OnlineState.initial(4))
     # simulate the crash window: marker removed, payload half-written
-    import distributed_eigenspaces_tpu.utils.checkpoint as ckpt_mod
-
     real_savez = np.savez
 
     def crashing_savez(file, **kw):

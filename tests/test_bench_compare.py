@@ -242,6 +242,48 @@ def test_serve_decomposition_passes_through_compare(tmp_path, capsys):
     assert verdict["p99_decomposition_new"] == dec["p99"]
 
 
+def test_analysis_verdict_passes_through_compare(tmp_path, capsys):
+    """ISSUE 10: the static-analysis verdict rides through the compare
+    in BOTH directions — a post-PR-10 record carrying "analysis" vs a
+    pre-PR-10 record without it is not a metric mismatch, and vice
+    versa; when present, the condensed verdict (ok / violation count /
+    audited programs) surfaces for that side only."""
+    ana = {
+        "schema": "analysis-v1",
+        "ok": True,
+        "n_violations": 0,
+        "programs": {
+            "serve_project_rows8": {"ok": True},
+            "serve_project_rows64": {"ok": True},
+        },
+    }
+    old = tmp_path / "old.json"
+    # pre-ISSUE-10 record: no analysis section
+    old.write_text(json.dumps(_serve_report(25000.0, 0.1, 4.5, 0.04)))
+    new = {**_serve_report(26000.0, 0.1, 4.2, 0.041), "analysis": ana}
+    assert bench.compare_reports(str(old), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] != "skipped"
+    assert verdict["analysis_new"] == {
+        "ok": True,
+        "n_violations": 0,
+        "programs": ["serve_project_rows64", "serve_project_rows8"],
+    }
+    assert "analysis_old" not in verdict
+    assert not verdict["regression"]
+
+    # the other direction: old record audited, new one is not (e.g.
+    # comparing a stripped-down rerun against a full record)
+    old2 = tmp_path / "old2.json"
+    old2.write_text(json.dumps(new))
+    bare = _serve_report(26500.0, 0.1, 4.3, 0.04)
+    assert bench.compare_reports(str(old2), bare) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] != "skipped"
+    assert verdict["analysis_old"]["ok"] is True
+    assert "analysis_new" not in verdict
+
+
 def test_serve_vs_fleet_metric_mismatch_skips(tmp_path, capsys):
     old = tmp_path / "old.json"
     old.write_text(json.dumps(_serve_report(25000.0, 0.1, 4.5, 0.04)))

@@ -1,7 +1,7 @@
 """Collective-traffic audit of the sharded trainers (round-5 verdict
-item 2): the multi-chip communication claims, asserted from the
-COMPILED (SPMD-partitioned) HLO on the 8-virtual-device mesh instead of
-argued in prose.
+item 2, contract API since PR 10): the multi-chip communication
+claims, asserted from the COMPILED (SPMD-partitioned) HLO on the
+8-virtual-device mesh instead of argued in prose.
 
 The structural invariants:
 - the DP scan trainer's ONLY collective is the per-step ``all_gather``
@@ -10,17 +10,31 @@ The structural invariants:
   CholeskyQR2/ns_orth Grams, merge/sketch folds) but NEVER a payload
   approaching ``d^2`` — the dense mean projector must not cross the
   mesh;
-- a deliberately-dense merge program DOES trip the tripwire (the assert
-  actually bites).
+- a deliberately-dense merge program DOES trip both the legacy
+  tripwire and the contract checker (the gate actually bites);
+- the parser itself: async/tuple/TPU-tiled forms, full dtype table,
+  loud ``AuditParseError`` on anything unknown, drift tripwire;
+- ``utils.collectives_audit`` stays importable as a warn-once shim.
 """
+
+import importlib
+import sys
+import warnings
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from distributed_eigenspaces_tpu.algo.online import OnlineState
 from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+from distributed_eigenspaces_tpu.analysis import contracts as ctr
+from distributed_eigenspaces_tpu.analysis.hlo import (
+    AuditParseError,
+    assert_no_dense_collective,
+    audit_compiled,
+    ici_step_model,
+    parse_collectives,
+)
 from distributed_eigenspaces_tpu.config import PCAConfig
 from distributed_eigenspaces_tpu.parallel.feature_sharded import (
     auto_feature_mesh,
@@ -28,12 +42,6 @@ from distributed_eigenspaces_tpu.parallel.feature_sharded import (
     make_feature_sharded_sketch_fit,
 )
 from distributed_eigenspaces_tpu.parallel.mesh import make_mesh, shard_map
-from distributed_eigenspaces_tpu.utils.collectives_audit import (
-    assert_no_dense_collective,
-    audit_compiled,
-    ici_step_model,
-    parse_collectives,
-)
 
 D, K, M, N = 128, 4, 8, 32
 
@@ -51,12 +59,14 @@ def _cfg(**kw):
 def test_scan_fit_gathers_factors_only(devices):
     """The headline sharded trainer: the entire reference wire protocol
     (C11) must compile to all-gathers of (m, d, k) factors — nothing
-    else crosses the mesh, in particular no all-reduce."""
+    else crosses the mesh, in particular no all-reduce. Checked BOTH
+    ways: raw parse assertions and the scan_fit contract."""
     cfg = _cfg()
     mesh = make_mesh(num_workers=8)
     fit = make_scan_fit(cfg, mesh)
     x = jnp.zeros((6, M, N, D), jnp.bfloat16)
-    audit = audit_compiled(fit.lower(OnlineState.initial(D), x).compile())
+    hlo = fit.lower(OnlineState.initial(D), x).compile().as_text()
+    audit = audit_compiled(hlo)
 
     assert audit["n_collectives"] > 0
     for key in audit["ops"]:
@@ -65,6 +75,14 @@ def test_scan_fit_gathers_factors_only(devices):
     # the gathered factor stack is the LARGEST payload anywhere
     assert audit["max_payload_elems"] == M * D * K
     assert_no_dense_collective(audit, D)
+
+    viols, metrics = ctr.check_collectives(
+        ctr.CONTRACTS["scan_fit"],
+        ctr.ProgramParams(d=D, k=K, m=M, n=N, T=6),
+        hlo, program="scan_fit_test",
+    )
+    assert not viols, [v.format() for v in viols]
+    assert metrics["max_payload_elems"] == M * D * K
 
 
 @pytest.mark.parametrize(
@@ -78,23 +96,38 @@ def test_feature_sharded_collectives_are_k_wide(devices, make):
         jnp.zeros((3, 4, N, 256), jnp.bfloat16), fit.blocks_sharding
     )
     idx = jnp.arange(6, dtype=jnp.int32) % 3
-    audit = audit_compiled(
+    hlo = (
         jax.jit(lambda s, b, i: fit(s, b, i))
         .lower(fit.init_state(), blocks, idx)
-        .compile()
+        .compile().as_text()
     )
+    audit = audit_compiled(hlo)
     assert audit["n_collectives"] > 0
     assert_no_dense_collective(audit, 256)
     # stronger than the tripwire: every payload is bounded by the factor
-    # stack (m * d_local * k) — k-wide, per the §5.7 design
-    n_feat = mesh.devices.shape[list(mesh.axis_names).index("features")]
-    bound = 4 * (256 // n_feat) * max(K, fit.sketch_width if hasattr(fit, "sketch_width") else K)
-    assert audit["max_payload_elems"] <= bound, audit["ops"]
+    # stack (m * d_local * max(k, sketch_width)) — k-wide, per the §5.7
+    # design. The feature_sharded contract encodes exactly this bound.
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    params = ctr.ProgramParams(
+        d=256, k=K, m=4, n=N, T=6,
+        n_feature_shards=axes.get("features", 1),
+        n_workers_mesh=axes.get("workers", 1),
+        sketch_width=int(getattr(fit, "sketch_width", 0) or 0),
+    )
+    viols, metrics = ctr.check_collectives(
+        ctr.CONTRACTS["feature_sharded"], params, hlo,
+        program="feature_test",
+    )
+    assert not viols, [v.format() for v in viols]
+    bound = 4 * params.d_local * max(K, params.sketch_width or K)
+    assert metrics["max_payload_elems"] <= bound, metrics["ops"]
 
 
 def test_tripwire_bites_on_dense_psum(devices):
-    """The assert must actually fire on the design this framework
-    replaced: a shard_map round that psums the d x d mean projector."""
+    """The gate must actually fire on the design this framework
+    replaced: a shard_map round that psums the d x d mean projector —
+    caught by the legacy tripwire AND as contract violations (wrong op
+    kind + payload over the factor-stack bound)."""
     from jax.sharding import PartitionSpec as P
 
     mesh = make_mesh(num_workers=8)
@@ -109,11 +142,22 @@ def test_tripwire_bites_on_dense_psum(devices):
             check_vma=False,
         )
     )
-    audit = audit_compiled(
-        f.lower(jnp.zeros((M, N, D), jnp.float32)).compile()
-    )
+    hlo = f.lower(jnp.zeros((M, N, D), jnp.float32)).compile().as_text()
+    audit = audit_compiled(hlo)
     with pytest.raises(AssertionError, match="dense collective"):
         assert_no_dense_collective(audit, D)
+
+    viols, _ = ctr.check_collectives(
+        ctr.CONTRACTS["scan_fit"],
+        ctr.ProgramParams(d=D, k=K, m=M, n=N),
+        hlo, program="dense_mutant",
+    )
+    rules = {v.rule for v in viols}
+    assert "collective-op" in rules, viols
+    assert "collective-payload" in rules, viols
+    # the message alone must name the program and the offending line
+    msg = next(v for v in viols if v.rule == "collective-op").format()
+    assert "dense_mutant" in msg and "all-reduce" in msg
 
 
 def test_parse_collectives_shapes():
@@ -164,6 +208,37 @@ def test_parser_drift_tripwire():
         )
 
 
+def test_itemsize_covers_wide_and_narrow_dtypes():
+    """s64/u64, f8 variants, and complex payloads size correctly —
+    these used to fall through to a silent 4-byte guess."""
+    hlo = """
+      %a = s64[16]{0} all-reduce(%p), to_apply=%sum
+      %b = f8e4m3fn[32,8]{1,0} all-gather(%q), dimensions={0}
+      %c = c64[4,4]{1,0} all-reduce(%r), to_apply=%sum
+      %d = u16[8]{0} collective-permute(%s)
+    """
+    ops = parse_collectives(hlo)
+    assert ops[0].payload_bytes == 16 * 8
+    assert ops[1].payload_bytes == 32 * 8 * 1
+    assert ops[2].payload_bytes == 4 * 4 * 8
+    assert ops[3].payload_bytes == 8 * 2
+
+
+def test_unknown_dtype_raises_named_error_with_line():
+    """An unknown dtype is a LOUD AuditParseError naming the dtype and
+    the offending HLO line — never a silent default mid-audit."""
+    hlo = "%w = q7[64,64]{1,0} all-reduce(%p), to_apply=%sum"
+    with pytest.raises(AuditParseError) as ei:
+        ops = parse_collectives(hlo)
+        _ = [o.payload_bytes for o in ops]
+    msg = str(ei.value)
+    assert "q7" in msg
+    assert "all-reduce" in msg  # the offending line rides along
+    # and the named class is an RuntimeError subclass (old handlers
+    # that caught RuntimeError keep working)
+    assert issubclass(AuditParseError, RuntimeError)
+
+
 def test_ici_model_matches_hlo_payload(devices):
     """The documented model's factor payload equals what the compiled
     HLO actually gathers (elems, per device) — model and machine agree."""
@@ -203,3 +278,27 @@ def test_parse_tiled_tpu_layouts():
         ("all-gather", "f32", (8, 128, 4)),
         ("all-gather", "bf16", (8, 512)),
     ]
+
+
+def test_shim_warns_once_and_reexports():
+    """utils.collectives_audit is a back-compat shim: first import
+    warns DeprecationWarning, cached re-import stays silent, and the
+    old public names resolve to the moved implementations."""
+    name = "distributed_eigenspaces_tpu.utils.collectives_audit"
+    sys.modules.pop(name, None)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        shim = importlib.import_module(name)
+    assert any(
+        issubclass(x.category, DeprecationWarning) for x in w
+    ), [str(x.message) for x in w]
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        shim2 = importlib.import_module(name)  # cached: no second warn
+    assert not w2
+    assert shim2 is shim
+    from distributed_eigenspaces_tpu.analysis import hlo as hlo_mod
+
+    assert shim.parse_collectives is hlo_mod.parse_collectives
+    assert shim.audit_compiled is hlo_mod.audit_compiled
+    assert shim.AuditParseError is hlo_mod.AuditParseError

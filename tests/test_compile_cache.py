@@ -13,10 +13,6 @@ latency (per signature, in ``summary()["serving"]`` / ``["fleet"]``).
 
 import glob
 import json
-import os
-import threading
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,7 +35,6 @@ from distributed_eigenspaces_tpu.serving import (
     TransformEngine,
 )
 from distributed_eigenspaces_tpu.utils.compile_cache import (
-    CacheKey,
     CompileCache,
     compile_cache_for,
     config_knobs,

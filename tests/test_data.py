@@ -2,7 +2,6 @@
 pickles in the reference's exact on-disk format), preprocessing (B7 toggle),
 batcher remainder policies (B5 fix), and the planted-spectrum generator."""
 
-import os
 import pickle
 
 import jax

@@ -8,8 +8,8 @@ The contract under test:
 - ragged schedules freeze a tenant's carry exactly (its result is its
   own T_b-step fit, not a T_max-step one);
 - the sharded fleet program contains ZERO collectives (pure data
-  parallelism over the fleet axis — machine-checked via
-  ``utils.collectives_audit``);
+  parallelism over the fleet axis — machine-checked via the
+  ``analysis.contracts`` fleet_fit contract);
 - supervisor quarantine isolates ONLY the faulted tenant's workers
   (NaN corruption -> that tenant's mask; ``KillSwitch`` -> that
   tenant's remaining steps), other tenants' results untouched;
@@ -41,8 +41,8 @@ from distributed_eigenspaces_tpu.parallel.fleet import (
     make_fleet_fit,
     stage_fleet,
 )
+from distributed_eigenspaces_tpu.analysis import contracts as ctr
 from distributed_eigenspaces_tpu.runtime.supervisor import Supervisor
-from distributed_eigenspaces_tpu.utils import collectives_audit as ca
 from distributed_eigenspaces_tpu.utils.faults import (
     ChaosPlan,
     ChaosStream,
@@ -195,17 +195,25 @@ def test_fleet_sharded_matches_local_no_collectives(spec, devices):
     states = jax.device_put(init_fleet_states(cfg, b), sh)
     xs = jax.device_put(jnp.zeros((b, T, M, N, D), jnp.float32), sh)
     act = jax.device_put(jnp.ones((b, T), jnp.float32), sh)
-    audit = ca.audit_compiled(
-        make_fleet_fit(cfg, mesh).lower(states, xs, act).compile()
+    contract = ctr.CONTRACTS["fleet_fit"]
+    params = ctr.ProgramParams(d=D, k=K, m=M, n=N, T=T, B=b)
+    hlo = make_fleet_fit(cfg, mesh).lower(
+        states, xs, act
+    ).compile().as_text()
+    viols, audit = ctr.check_collectives(
+        contract, params, hlo, program="fleet_unmasked"
     )
+    assert not viols, [v.format() for v in viols]
     assert audit["n_collectives"] == 0, audit["ops"]
     mk = jax.device_put(jnp.ones((b, T, M), jnp.float32), sh)
-    audit_m = ca.audit_compiled(
-        make_fleet_fit(cfg, mesh, masked=True)
-        .lower(states, xs, mk, act).compile()
+    hlo_m = make_fleet_fit(cfg, mesh, masked=True).lower(
+        states, xs, mk, act
+    ).compile().as_text()
+    viols_m, audit_m = ctr.check_collectives(
+        contract, params, hlo_m, program="fleet_masked"
     )
+    assert not viols_m, [v.format() for v in viols_m]
     assert audit_m["n_collectives"] == 0, audit_m["ops"]
-    ca.assert_no_dense_collective(audit, D)
 
 
 def test_fleet_size_not_divisible_raises(spec, devices):

@@ -1,7 +1,6 @@
 """Native runtime tests: C++ conversion kernels vs numpy, chunk reader
 round-trip (native and fallback), prefetch stream semantics."""
 
-import os
 
 import numpy as np
 import pytest
