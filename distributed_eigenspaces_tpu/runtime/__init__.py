@@ -17,6 +17,13 @@ from distributed_eigenspaces_tpu.runtime.membership import (
     QuorumLost,
 )
 from distributed_eigenspaces_tpu.runtime.prefetch import prefetch_stream
+from distributed_eigenspaces_tpu.runtime.scenario import (
+    ScenarioRunner,
+    ScenarioSpec,
+    build_schedule,
+    load_spec,
+    run_scenario,
+)
 from distributed_eigenspaces_tpu.runtime.scheduler import (
     WorkQueue,
     run_dynamic_round,
@@ -37,6 +44,11 @@ __all__ = [
     "ElasticStream",
     "MembershipTable",
     "QuorumLost",
+    "ScenarioRunner",
+    "ScenarioSpec",
+    "build_schedule",
+    "load_spec",
+    "run_scenario",
     "WorkQueue",
     "run_dynamic_round",
     "FaultLedger",

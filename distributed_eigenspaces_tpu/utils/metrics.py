@@ -535,6 +535,9 @@ class MetricsLogger:
         slo = self._slo_summary(out)
         if slo:
             out["slo"] = slo
+        episodes = self._episode_summaries()
+        if episodes:
+            out["episodes"] = episodes
         if self.compile_cache is not None:
             out["compile"] = self.compile_cache.stats()
         if self.analysis_report is not None:
@@ -856,6 +859,151 @@ class MetricsLogger:
             out["servers"] = len(self.serve_health_sources)
             if lanes_alive:
                 out["lanes_alive"] = all(lanes_alive)
+        return out
+
+    @staticmethod
+    def _recovery_from(
+        t0: float, completions: list, target_ms: float, probe: int = 5
+    ) -> float | None:
+        """Recovery time (ms) from a fault injected at monotonic ``t0``
+        back to SLO-attaining steady state: the earliest completion at
+        or after ``t0`` from which the next ``probe`` consecutive
+        requests (or all that remain, if fewer) ALL meet the target —
+        one lucky fast request during the incident doesn't count as
+        recovered. ``completions`` is the time-sorted
+        ``(t_mono, latency_ms)`` stream; returns None when steady
+        state was never regained."""
+        for i in range(len(completions)):
+            if completions[i][0] < t0:
+                continue
+            k = min(probe, len(completions) - i)
+            if all(
+                completions[j][1] <= target_ms for j in range(i, i + k)
+            ):
+                return round((completions[i][0] - t0) * 1e3, 3)
+        return None
+
+    def _episode_summaries(self) -> dict:
+        """The ``summary()["episodes"]`` section (ISSUE 11): per-tier
+        records sliced by the attached tracer's ``category="episode"``
+        spans (``Tracer.episode`` — the scenario harness's markers).
+        Each episode reports the SAME key set (None/0 when a field
+        does not apply) so two runs of one spec produce structurally
+        identical verdicts: window SLO attainment + burn, p99 and its
+        queue_wait/compile_stall/compute decomposition, shed / lane /
+        breaker / drift counts, fleet requests, membership events, and
+        — for fault episodes — recovery back to SLO-attaining steady
+        state. Slicing covers the RETAINED ring window (size scenario
+        runs under ``retention``; a sliced long run under-counts
+        loudly via ``events_evicted`` in the per-tier sections)."""
+        tracer = self.tracer
+        if tracer is None:
+            return {}
+        ep_spans = [
+            sp for sp in tracer.snapshot() if sp.category == "episode"
+        ]
+        if not ep_spans:
+            return {}
+        batches = [
+            r for r in self.serve_records if r.get("serve") == "batch"
+        ]
+        serve_events = list(self.serve_records)
+        fleet_buckets = [
+            r for r in self.fleet_records if r.get("fleet") == "bucket"
+        ]
+        membership = list(self.membership_records)
+        # per-request completion stream for recovery scans: a request
+        # completes at its batch's dispatch stamp
+        completions = sorted(
+            (r["t_mono"], lat * 1e3)
+            for r in batches
+            for lat in (r.get("query_latency_s") or ())
+            if lat is not None
+        )
+        out: dict = {}
+        for sp in ep_spans:
+            t0 = sp.t_start_mono
+            t1 = (
+                sp.t_end_mono if sp.t_end_mono is not None
+                else float("inf")
+            )
+
+            def _in(r, t0=t0, t1=t1):
+                return t0 <= r.get("t_mono", r.get("t", 0.0)) <= t1
+
+            win = [r for r in batches if _in(r)]
+            lats_ms = [
+                lat * 1e3
+                for r in win
+                for lat in (r.get("query_latency_s") or ())
+                if lat is not None
+            ]
+            rows = [row for r in win for row in self._decomp_rows(r)]
+            p99_ms = None
+            if lats_ms:
+                ws = sorted(lats_ms)
+                p99_ms = round(
+                    ws[min(len(ws) - 1, int(len(ws) * 0.99))], 3
+                )
+            slo = (
+                slo_summary(self.slo_p99_ms, lats_ms, p99_ms=p99_ms)
+                if self.slo_p99_ms is not None and lats_ms else None
+            )
+            decomp = (
+                self._decomposition(rows, self._serve_agg, False)
+                if rows else None
+            )
+            fault = bool(sp.attrs.get("fault"))
+            recovery_ms = None
+            recovered = None
+            if fault and self.slo_p99_ms is not None:
+                recovery_ms = self._recovery_from(
+                    t0, completions, self.slo_p99_ms
+                )
+                recovered = recovery_ms is not None
+            out[sp.name] = {
+                "kind": sp.attrs.get("kind"),
+                "fault": fault,
+                "t_start_s": round(t0 - tracer.t0_mono, 6),
+                "duration_s": round(sp.duration_s, 6),
+                "requests": len(lats_ms),
+                "rejected": sum(r.get("rejected", 0) for r in win),
+                "sheds": sum(
+                    r.get("dropped", 1) for r in serve_events
+                    if r.get("serve") == "shed" and _in(r)
+                ),
+                "lane_restarts": sum(
+                    1 for r in serve_events
+                    if r.get("serve") == "lane"
+                    and r.get("event") == "restart" and _in(r)
+                ),
+                "lane_deaths": sum(
+                    1 for r in serve_events
+                    if r.get("serve") == "lane"
+                    and r.get("event") == "dead" and _in(r)
+                ),
+                "breaker_trips": sum(
+                    1 for r in serve_events
+                    if r.get("serve") == "breaker"
+                    and r.get("event") == "open" and _in(r)
+                ),
+                "drift_refreshes": sum(
+                    1 for r in serve_events
+                    if r.get("serve") == "drift" and _in(r)
+                ),
+                "fleet_requests": sum(
+                    r.get("tenants", 0) for r in fleet_buckets
+                    if _in(r)
+                ),
+                "membership_events": sum(
+                    1 for r in membership if _in(r)
+                ),
+                "p99_ms": p99_ms,
+                "slo": slo,
+                "latency_decomposition": decomp,
+                "recovery_ms": recovery_ms,
+                "recovered": recovered,
+            }
         return out
 
     def _slo_summary(self, out: dict) -> dict:

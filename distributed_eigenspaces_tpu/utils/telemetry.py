@@ -163,6 +163,54 @@ class _SpanHandle:
         self._tracer._close(self.span)
 
 
+class _EpisodeHandle:
+    """An open scenario episode (:meth:`Tracer.episode`): closing it
+    records ONE ``category="episode"`` span covering the open interval.
+
+    Deliberately OFF the per-thread implicit stack — episodes overlap
+    each other and outlive the thread that opened them, so they must
+    never parent (or be parented by) request spans. The export routes
+    them to their own top-level track."""
+
+    __slots__ = ("_tracer", "name", "attrs", "t_start_mono",
+                 "t_start_unix", "span_id", "_closed")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.t_start_mono = time.perf_counter()
+        self.t_start_unix = time.time()
+        self.span_id: int | None = None
+        self._closed = False
+
+    def set(self, **attrs) -> "_EpisodeHandle":
+        self.attrs.update(attrs)
+        return self
+
+    def close(self) -> int | None:
+        """Record the episode span; idempotent. Returns the span id."""
+        if self._closed:
+            return self.span_id
+        self._closed = True
+        self.span_id = self._tracer.record_span(
+            self.name,
+            self.t_start_mono,
+            time.perf_counter(),
+            category="episode",
+            attrs=self.attrs,
+            t_start_unix=self.t_start_unix,
+            thread_id=0,
+        )
+        return self.span_id
+
+    def __enter__(self) -> "_EpisodeHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 class Tracer:
     """Thread-safe span collector with a bounded buffer.
 
@@ -306,6 +354,16 @@ class Tracer:
         self._append(sp)
         return sp.span_id
 
+    def episode(self, name: str, **attrs) -> _EpisodeHandle:
+        """Open a named scenario episode (ISSUE 11): a long span that
+        overlaps other episodes and request spans freely, rendered as
+        its own top-level track by :meth:`export_chrome_trace`.
+        ``MetricsLogger.summary()["episodes"]`` slices per-tier records
+        by these spans' windows — the markers ARE the verdict's
+        episode boundaries. Close via the returned handle (or use it
+        as a context manager)."""
+        return _EpisodeHandle(self, name, dict(attrs))
+
     def event(
         self,
         name: str,
@@ -371,7 +429,20 @@ class Tracer:
                 "args": {"name": "distributed_eigenspaces_tpu"},
             }
         ]
-        tids = sorted({sp.thread_id for sp in spans})
+        # scenario episodes get the top-level track (tid 0, named),
+        # above every per-thread track — Perfetto then shows the
+        # request spans of each phase directly under its episode bar
+        if any(sp.category == "episode" for sp in spans):
+            events.append({
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": "episodes"},
+            })
+        tids = sorted({
+            sp.thread_id for sp in spans if sp.category != "episode"
+        })
         # compress real thread idents to small track numbers
         tid_map = {t: i + 1 for i, t in enumerate(tids)}
         for t, small in tid_map.items():
@@ -389,7 +460,10 @@ class Tracer:
                 "ph": sp.phase,
                 "ts": round((sp.t_start_mono - self.t0_mono) * 1e6, 3),
                 "pid": pid,
-                "tid": tid_map.get(sp.thread_id, 0),
+                "tid": (
+                    0 if sp.category == "episode"
+                    else tid_map.get(sp.thread_id, 0)
+                ),
                 "args": {
                     "trace_id": sp.trace_id,
                     "span_id": sp.span_id,
@@ -449,6 +523,9 @@ class NullTracer:
         def set(self, **attrs):
             return self
 
+        def close(self):
+            return None
+
         def __enter__(self):
             return self
 
@@ -464,6 +541,9 @@ class NullTracer:
         return None
 
     def span(self, name, **kw) -> "_NullHandle":
+        return self._HANDLE
+
+    def episode(self, name, **kw) -> "_NullHandle":
         return self._HANDLE
 
     def record_span(self, name, t_start_mono, t_end_mono, **kw) -> None:
@@ -687,6 +767,16 @@ def slo_summary(
     the target divided by the budgeted fraction (``1 - objective``) —
     1.0 means burning budget exactly as fast as allowed, >1 means the
     SLO fails if sustained.
+
+    Burn is reported over TWO windows side by side (``out["burn"]``,
+    docs/OBSERVABILITY.md): ``fast`` over the rolling ring window
+    (a flash crowd spikes it immediately, then it decays as healthy
+    requests refill the ring) and ``slow`` over the whole run's
+    lifetime counts (a slow regression creeps it up and a burst barely
+    moves it) — the pairing that distinguishes transient incidents
+    from sustained SLO erosion. ``budget_burn`` stays the lifetime
+    (slow) number for backward compatibility; the rolling window's own
+    burn also appears as ``window["budget_burn"]``.
     """
     window = [float(v) for v in latencies_ms]
     w_viol = sum(1 for v in window if v > target_p99_ms)
@@ -707,13 +797,20 @@ def slo_summary(
         out["attained"] = bool(p99_ms <= target_p99_ms)
     if requests:
         attainment = 1.0 - violations / requests
+        slow_burn = round((violations / requests) / budget, 4)
         out["attainment"] = round(attainment, 6)
         out["error_budget"] = round(budget, 6)
-        out["budget_burn"] = round((violations / requests) / budget, 4)
+        out["budget_burn"] = slow_burn
+        fast_burn = (
+            round((w_viol / len(window)) / budget, 4) if window
+            else slow_burn
+        )
+        out["burn"] = {"fast": fast_burn, "slow": slow_burn}
     if window:
         out["window"] = {
             "requests": len(window),
             "violations": w_viol,
             "attainment": round(1.0 - w_viol / len(window), 6),
+            "budget_burn": round((w_viol / len(window)) / budget, 4),
         }
     return out

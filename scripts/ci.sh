@@ -58,7 +58,18 @@
 #      loss loud within 2x heartbeat timeout + checkpoint auto-resume,
 #      all gated by the bench itself; compared (churn_recovery_ms
 #      ratio + structural bound) vs the committed BENCH_CHURN_SMOKE_CPU;
-#   9. scripts/analyze.py --all --mutation-check: the static program-
+#   9. scripts/scenario.py: the production-shaped scenario replay
+#      (ISSUE 11) — a 3-episode composition (flash crowd + lane kill,
+#      correlated fit-tier churn, mid-burst registry publish) replayed
+#      from scenarios/ci_smoke.json against the full stack, judged
+#      ONLY from MetricsLogger.summary(): per-episode SLO attainment
+#      + burn, recovery back to steady state, shed/breaker/lane
+#      counts. The verdict's hard gates exit nonzero themselves; the
+#      compare checks attainment + per-episode recovery drift against
+#      the committed BENCH_SCENARIO_SMOKE_CPU.json (ratio floors + a
+#      10 s structural recovery bound + a 0.5 absolute attainment
+#      floor, so CPU-rig jitter can't flap CI);
+#   10. scripts/analyze.py --all --mutation-check: the static program-
 #      contract gate (ISSUE 10, docs/ANALYSIS.md) — every program kind
 #      audited against its declarative contract (collective schedule +
 #      payload bounds, memory policy, baked constants) from compiled
@@ -66,12 +77,12 @@
 #      lints AND the mutation self-tests that prove each violation
 #      class is caught. When ruff is on PATH (not in the pinned CI
 #      image) the lint config in pyproject.toml runs first;
-#   10. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   11. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/10] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/11] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -79,7 +90,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/10] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/11] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -89,7 +100,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/10] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/11] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -104,7 +115,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/10] serve equality + amortization smoke (CPU) =="
+echo "== [4/11] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -119,7 +130,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/10] coldstart + prewarm smoke (CPU) =="
+echo "== [5/11] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -134,7 +145,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [6/10] telemetry smoke: trace export + span-chain validation =="
+echo "== [6/11] telemetry smoke: trace export + span-chain validation =="
 # A serve burst with --trace-out, then a structural validation of the
 # emitted timeline: the JSON must parse as Chrome trace-event format,
 # every served query's span chain (admit → queue_wait → dispatch →
@@ -179,7 +190,7 @@ print(json.dumps({
 }))
 PY
 
-echo "== [7/10] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
+echo "== [7/11] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
 # bench.py --chaos-serve asserts the read-path resilience gates itself
 # (ISSUE 7): a kill -9'd publisher's store recovers (torn snapshot
 # skipped, checksum corruption quarantined) and the restarted server
@@ -198,7 +209,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve
 fi
 
-echo "== [8/10] chaos-churn smoke: elastic membership under churn (CPU) =="
+echo "== [8/11] chaos-churn smoke: elastic membership under churn (CPU) =="
 # bench.py --chaos-churn asserts the fit-tier elastic-membership gates
 # itself (ISSUE 8): a run with 30% mid-run worker loss, flapping
 # rejoins, and a persistent straggler finishes all steps inside the
@@ -218,7 +229,27 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-churn
 fi
 
-echo "== [9/10] static analysis: program contracts + lints + mutations =="
+echo "== [9/11] scenario replay: production-shaped composition (CPU) =="
+# scripts/scenario.py replays scenarios/ci_smoke.json — a flash crowd
+# with a mid-crowd lane kill, correlated fit-tier worker churn, and a
+# mid-burst registry publish on one timeline — and judges it purely
+# from MetricsLogger.summary(): the hard gates (every episode
+# measured, every accepted ticket resolved, fault episodes recovered,
+# churned fit completed, published version served) exit nonzero from
+# the replay itself. The compare gates attainment + per-episode
+# recovery drift against the committed record at the same
+# CPU-tolerant floors as the chaos stages (override the recovery
+# bound with DET_SCENARIO_RECOVERY_BOUND_MS, the attainment floor
+# with DET_SCENARIO_ATTAINMENT_FLOOR).
+if [[ -f BENCH_SCENARIO_SMOKE_CPU.json ]]; then
+    JAX_PLATFORMS=cpu python bench.py --scenario scenarios/ci_smoke.json \
+        --compare BENCH_SCENARIO_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    JAX_PLATFORMS=cpu python bench.py --scenario scenarios/ci_smoke.json
+fi
+
+echo "== [10/11] static analysis: program contracts + lints + mutations =="
 # scripts/analyze.py compiles (never runs) the whole program matrix and
 # audits each program against its contract, runs the concurrency /
 # host-sync AST lints over the threaded runtime, and proves the gate
@@ -231,7 +262,7 @@ if command -v ruff >/dev/null 2>&1; then
 fi
 JAX_PLATFORMS=cpu python scripts/analyze.py --all --mutation-check
 
-echo "== [10/10] graft entry + 8-device sharded dryrun =="
+echo "== [11/11] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

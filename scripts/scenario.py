@@ -1,0 +1,89 @@
+"""Scenario replay driver: production-shaped load + chaos, judged by SLO.
+
+Replays a declarative scenario spec (``scenarios/*.json``) against the
+full stack — fit, registry, QueryServer, FleetServer, DriftMonitor,
+elastic membership — via ``runtime/scenario.py`` (ISSUE 11), and prints
+the pure-telemetry verdict as ONE JSON line: per-episode SLO attainment
+and error-budget burn, p99 latency decomposition, shed / breaker /
+lane-restart counts, and recovery time from each injected fault back to
+SLO-attaining steady state, every judged number computed from
+``MetricsLogger.summary()`` alone.
+
+Exit code 0 iff every hard gate in the verdict holds (all episodes
+measured, every accepted ticket resolved, every fault episode
+recovered, churned fits completed, mid-burst publishes served).
+
+The verdict is a ``bench.py --compare``-compatible record: save it with
+``--out BENCH_SCENARIO_<name>_CPU.json`` and regression-gate later runs
+with ``bench.py --scenario <spec> --compare <record>`` (the CI smoke
+stage does exactly this against ``BENCH_SCENARIO_SMOKE_CPU.json``).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/scenario.py scenarios/ci_smoke.json
+    python scripts/scenario.py scenarios/production_day.json \
+        --out BENCH_SCENARIO_PROD_CPU.json --trace-out prod_trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable as `python scripts/scenario.py` from anywhere (the package
+# imports resolve from the repo root, like the other script drivers)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument(
+        "spec", nargs="?", default="scenarios/ci_smoke.json",
+        help="scenario spec JSON (schema: docs/OBSERVABILITY.md "
+             "'Scenario verdicts')",
+    )
+    p.add_argument(
+        "--out", default=None,
+        help="also write the verdict record to this path "
+             "(BENCH_SCENARIO_*.json for bench.py --compare)",
+    )
+    p.add_argument(
+        "--trace-out", default=None,
+        help="export the replay's Chrome trace (episodes as a "
+             "top-level Perfetto track) to this path",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from distributed_eigenspaces_tpu.runtime.scenario import run_scenario
+
+    verdict, ok = run_scenario(args.spec, trace_out=args.trace_out)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=2)
+            f.write("\n")
+    print(json.dumps(verdict))
+    if not ok:
+        print(
+            json.dumps({
+                "scenario_fail": verdict.get("scenario_fail"),
+                "spec": args.spec,
+            }),
+            file=sys.stderr,
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

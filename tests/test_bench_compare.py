@@ -484,3 +484,141 @@ def test_chaos_churn_missing_recovery_skips_loudly(tmp_path, capsys):
     verdict = json.loads(capsys.readouterr().err.strip())
     assert verdict["compare"] == "skipped"
     assert "churn_recovery_ms" in verdict["reason"]
+
+
+def _scenario_report(attainment, crowd_recovery_ms, *, recovered=True,
+                     scenario="ci_smoke"):
+    """A scripts/scenario.py verdict record (the ISSUE-11 shape)."""
+    return {
+        "metric": "pca_scenario_slo_verdict",
+        "scenario": scenario,
+        "seed": 7,
+        "value": attainment,
+        "unit": "slo_attainment",
+        "episodes": {
+            "crowd": {
+                "kind": "flash_crowd", "fault": True,
+                "slo": {"attainment": attainment},
+                "recovery_ms": crowd_recovery_ms,
+                "recovered": recovered,
+            },
+            "swap": {
+                "kind": "publish", "fault": False,
+                "slo": None, "recovery_ms": None, "recovered": None,
+            },
+        },
+        "gates": {"all_episodes_measured": True},
+    }
+
+
+def test_scenario_records_compare_per_episode_recovery(
+    tmp_path, capsys
+):
+    """ISSUE-11 satellite: scenario records compare per-episode
+    recovery (old/new ratio + structural bound, like the chaos
+    compares) and surface both sides' attainment in the verdict."""
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_scenario_report(0.85, 600.0)))
+    # slower recovery, far under the structural bound: rig jitter
+    assert bench.compare_reports(
+        str(old), _scenario_report(0.82, 1400.0), threshold=0.5
+    ) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["attainment_old"] == 0.85
+    assert verdict["attainment_new"] == 0.82
+    crowd = verdict["episodes"]["crowd"]
+    assert crowd["recovery_ms_old"] == 600.0
+    assert crowd["recovery_ms_new"] == 1400.0
+    assert crowd["regression"] is False
+    assert not verdict["regression"]
+
+    # recovery past BOTH the ratio floor and the structural bound:
+    # a stuck recovery, not jitter
+    assert bench.compare_reports(
+        str(old), _scenario_report(0.82, 30_000.0), threshold=0.5
+    ) == 1
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["episodes"]["crowd"]["regression"] is True
+    assert verdict["regression"] is True
+    assert verdict["structural_bound_ms"] == 10_000.0
+
+
+def test_scenario_recovered_to_never_recovered_is_regression(
+    tmp_path, capsys
+):
+    # the ratio can't express r_new=None — the explicit branch must
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_scenario_report(0.85, 600.0)))
+    new = _scenario_report(0.84, None, recovered=False)
+    assert bench.compare_reports(str(old), new, threshold=0.5) == 1
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["episodes"]["crowd"]["regression"] is True
+    assert verdict["regression"] is True
+
+
+def test_scenario_attainment_floor_gates_overall_value(
+    tmp_path, capsys
+):
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_scenario_report(0.9, 600.0)))
+    # halved attainment AND below the 0.5 absolute floor: regression
+    assert bench.compare_reports(
+        str(old), _scenario_report(0.3, 620.0), threshold=0.6
+    ) == 1
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["regression"] is True
+    # same ratio drop but still above the floor: chaos episodes are
+    # ALLOWED to burn budget — not a regression
+    assert bench.compare_reports(
+        str(old), _scenario_report(0.52, 620.0), threshold=0.6
+    ) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["regression"] is False
+    assert verdict["attainment_floor"] == 0.5
+
+
+def test_scenario_cross_spec_compare_skips_loudly(tmp_path, capsys):
+    # same metric, different replayed spec: every episode name and
+    # fault comes from the spec, so a ratio would be a unit error
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_scenario_report(0.85, 600.0)))
+    new = _scenario_report(0.85, 600.0, scenario="production_day")
+    assert bench.compare_reports(str(old), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "scenario mismatch" in verdict["reason"]
+
+
+def test_scenario_vs_headline_mismatch_skips_both_directions(
+    tmp_path, capsys
+):
+    # pre-PR-11 records (headline or chaos) never cross-compare with
+    # a scenario verdict, in either direction
+    headline = _report(60e6, 120.0)
+    scen = _scenario_report(0.85, 600.0)
+    old = tmp_path / "old.json"
+
+    old.write_text(json.dumps(scen))
+    assert bench.compare_reports(str(old), headline) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]
+
+    old.write_text(json.dumps(headline))
+    assert bench.compare_reports(str(old), scen) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]
+
+
+def test_committed_scenario_smoke_record_passes_self_compare():
+    # the record ci.sh gates against must at least accept ITSELF
+    rec = json.loads(
+        (Path(__file__).resolve().parent.parent
+         / "BENCH_SCENARIO_SMOKE_CPU.json").read_text()
+    )
+    assert bench.compare_reports(
+        str(Path(__file__).resolve().parent.parent
+            / "BENCH_SCENARIO_SMOKE_CPU.json"),
+        dict(rec), 0.5,
+    ) == 0

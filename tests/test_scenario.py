@@ -1,0 +1,364 @@
+"""Scenario harness (ISSUE 11): spec validation fails loudly naming
+the offending episode and field, the schedule is a pure function of
+(spec, seed), the per-episode summary slices telemetry by the tracer's
+episode markers with a stable key set, and two full replays of one
+spec produce structurally identical verdicts — same gates, same
+episode fields — from ``summary()`` alone.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributed_eigenspaces_tpu.runtime.scenario import (
+    EPISODE_KINDS,
+    FAULT_KINDS,
+    ScenarioSpec,
+    build_schedule,
+    load_spec,
+    run_scenario,
+)
+from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+from distributed_eigenspaces_tpu.utils.telemetry import Tracer
+
+
+def _spec_dict(**over):
+    d = {
+        "name": "unit",
+        "seed": 5,
+        "episodes": [
+            {"name": "calm", "kind": "steady", "start_s": 0.0,
+             "duration_s": 1.0, "qps": 4},
+        ],
+    }
+    d.update(over)
+    return d
+
+
+def _episodes(*eps):
+    return _spec_dict(episodes=list(eps))
+
+
+# -- spec validation ----------------------------------------------------------
+
+
+class TestSpecValidation:
+    def test_valid_spec_round_trips(self, tmp_path):
+        raw = _episodes(
+            {"name": "a", "kind": "diurnal", "start_s": 0.0,
+             "duration_s": 2.0, "qps_low": 2, "qps_high": 8,
+             "period_s": 1.0},
+            {"name": "b", "kind": "churn", "start_s": 0.5,
+             "duration_s": 1.0, "workers": 4, "kill_slots": [1],
+             "kill_step": 2, "rejoin_step": 4},
+            {"name": "c", "kind": "publish", "start_s": 1.0,
+             "duration_s": 0.0},
+        )
+        path = tmp_path / "s.json"
+        path.write_text(json.dumps(raw))
+        spec = load_spec(str(path))
+        assert spec == load_spec(raw)  # file and dict forms agree
+        assert [ep.kind for ep in spec.episodes] == [
+            "diurnal", "churn", "publish",
+        ]
+        assert spec.horizon_s == 2.0
+        assert not spec.episodes[0].fault
+
+    def test_fault_kinds_are_a_subset_of_the_taxonomy(self):
+        assert set(FAULT_KINDS) <= set(EPISODE_KINDS)
+
+    @pytest.mark.parametrize("ep,needle", [
+        # missing kind-required field: episode AND field named
+        ({"name": "a", "kind": "steady", "start_s": 0.0,
+          "duration_s": 1.0}, "episode 'a': missing required field 'qps'"),
+        # unknown field for the kind
+        ({"name": "a", "kind": "steady", "start_s": 0.0,
+          "duration_s": 1.0, "qps": 4, "qqps": 9},
+         "episode 'a': unknown field 'qqps'"),
+        # unknown kind lists the taxonomy
+        ({"name": "a", "kind": "meteor", "start_s": 0.0,
+          "duration_s": 1.0}, "episode 'a': field 'kind'"),
+        # negative timeline
+        ({"name": "a", "kind": "steady", "start_s": -1.0,
+          "duration_s": 1.0, "qps": 4}, "episode 'a': field 'start_s'"),
+        # zero-duration load episode can never emit an arrival
+        ({"name": "a", "kind": "steady", "start_s": 0.0,
+          "duration_s": 0.0, "qps": 4}, "episode 'a': field 'duration_s'"),
+        # inverted diurnal band
+        ({"name": "a", "kind": "diurnal", "start_s": 0.0,
+          "duration_s": 1.0, "qps_low": 9, "qps_high": 2,
+          "period_s": 1.0}, "episode 'a': field 'qps_high'"),
+        # kill_slots outside [0, workers)
+        ({"name": "a", "kind": "churn", "start_s": 0.0,
+          "duration_s": 1.0, "workers": 2, "kill_slots": [5],
+          "kill_step": 1}, "episode 'a': field 'kill_slots'"),
+    ])
+    def test_malformed_episode_names_episode_and_field(self, ep, needle):
+        with pytest.raises(ValueError) as ei:
+            load_spec(_episodes(ep))
+        msg = str(ei.value)
+        assert msg.startswith("scenario spec 'unit'")
+        assert needle in msg
+
+    def test_missing_common_field_names_it(self):
+        with pytest.raises(ValueError, match="missing required field "
+                                             "'duration_s'"):
+            load_spec(_episodes(
+                {"name": "a", "kind": "steady", "start_s": 0.0, "qps": 4}
+            ))
+
+    def test_duplicate_episode_names_rejected(self):
+        ep = {"name": "a", "kind": "steady", "start_s": 0.0,
+              "duration_s": 1.0, "qps": 4}
+        with pytest.raises(ValueError, match="episode 'a': duplicate"):
+            load_spec(_episodes(ep, dict(ep)))
+
+    def test_top_level_failures_are_loud(self):
+        with pytest.raises(ValueError, match="'name'"):
+            load_spec(_spec_dict(name=""))
+        with pytest.raises(ValueError, match="'episodes'"):
+            load_spec(_spec_dict(episodes=[]))
+        with pytest.raises(ValueError, match="'seed'"):
+            load_spec(_spec_dict(seed="7"))
+        with pytest.raises(ValueError, match="'slo_p99_ms'"):
+            load_spec(_spec_dict(slo_p99_ms=0))
+        with pytest.raises(ValueError, match="unknown top-level"):
+            load_spec(_spec_dict(qps=3))
+
+    def test_committed_specs_load(self):
+        # the specs CI replays must stay valid
+        for path in ("scenarios/ci_smoke.json",
+                     "scenarios/production_day.json"):
+            spec = load_spec(path)
+            assert isinstance(spec, ScenarioSpec)
+        # production_day exercises every non-trivial kind (steady is
+        # the degenerate diurnal)
+        prod = load_spec("scenarios/production_day.json")
+        assert {ep.kind for ep in prod.episodes} == \
+            set(EPISODE_KINDS) - {"steady"}
+
+
+# -- deterministic schedule ---------------------------------------------------
+
+
+class TestSchedule:
+    def test_same_spec_and_seed_identical_schedule(self):
+        raw = _episodes(
+            {"name": "cycle", "kind": "diurnal", "start_s": 0.0,
+             "duration_s": 2.0, "qps_low": 2, "qps_high": 10,
+             "period_s": 1.0},
+            {"name": "skew", "kind": "tenant_skew", "start_s": 0.5,
+             "duration_s": 1.0, "qps": 8, "tenants": 3, "zipf_s": 1.2},
+            {"name": "crowd", "kind": "flash_crowd", "start_s": 1.0,
+             "duration_s": 0.5, "qps": 30},
+        )
+        s1 = build_schedule(load_spec(raw))
+        s2 = build_schedule(load_spec(json.loads(json.dumps(raw))))
+        assert s1.actions == s2.actions
+        assert s1.describe() == s2.describe()
+
+    def test_seed_changes_arrivals(self):
+        raw = _episodes(
+            {"name": "crowd", "kind": "flash_crowd", "start_s": 0.0,
+             "duration_s": 1.0, "qps": 20},
+        )
+        a = build_schedule(load_spec(raw)).describe()
+        b = build_schedule(load_spec({**raw, "seed": 6})).describe()
+        assert a["episodes"]["crowd"]["arrivals"] != \
+            b["episodes"]["crowd"]["arrivals"]
+        # ...but the planned request count is qps*duration either way
+        assert a["episodes"]["crowd"]["planned_requests"] == 20
+        assert b["episodes"]["crowd"]["planned_requests"] == 20
+
+    def test_diurnal_arrivals_integrate_the_cycle(self):
+        # mean rate over a full period is (lo+hi)/2 — the integrator
+        # must land within one arrival of the analytic count, and the
+        # arrivals must cluster in the high-rate half of the cycle
+        raw = _episodes(
+            {"name": "cycle", "kind": "diurnal", "start_s": 0.0,
+             "duration_s": 2.0, "qps_low": 2, "qps_high": 10,
+             "period_s": 2.0},
+        )
+        sched = build_schedule(load_spec(raw))
+        offs = [
+            a.t_s for a in sched.actions if a.kind == "query"
+        ]
+        assert abs(len(offs) - 12) <= 1
+        mid = [t for t in offs if 0.5 <= t <= 1.5]  # the hi half
+        assert len(mid) > len(offs) / 2
+
+    def test_tenant_skew_ranks_valid_and_zipf_heavy_on_rank0(self):
+        raw = _episodes(
+            {"name": "skew", "kind": "tenant_skew", "start_s": 0.0,
+             "duration_s": 1.0, "qps": 200, "tenants": 4,
+             "zipf_s": 1.5},
+        )
+        tenants = build_schedule(
+            load_spec(raw)
+        ).describe()["episodes"]["skew"]["tenants"]
+        assert len(tenants) == 200
+        assert set(tenants) <= {0, 1, 2, 3}
+        counts = np.bincount(tenants, minlength=4)
+        assert counts[0] == max(counts)  # rank 0 is the hot tenant
+
+    def test_ordering_markers_bracket_same_instant_work(self):
+        raw = _episodes(
+            {"name": "a", "kind": "steady", "start_s": 0.0,
+             "duration_s": 1.0, "qps": 4},
+            {"name": "p", "kind": "publish", "start_s": 0.0,
+             "duration_s": 0.0},
+        )
+        acts = build_schedule(load_spec(raw)).actions
+        at_zero = [a.kind for a in acts if a.t_s == 0.0]
+        assert at_zero[0] == "episode_start"
+        assert at_zero.index("publish") < at_zero.index("episode_end")
+
+
+# -- telemetry slicing (synthetic records, no stack) --------------------------
+
+
+class TestEpisodeSummaries:
+    def _rig(self, slo_ms=50.0):
+        m = MetricsLogger(slo_p99_ms=slo_ms)
+        tr = Tracer()
+        m.attach_tracer(tr)
+        return m, tr
+
+    def _episode(self, tr, name, t0, t1, kind="steady", fault=False):
+        tr.record_span(
+            name, t0, t1, category="episode",
+            attrs={"kind": kind, "fault": fault}, thread_id=0,
+        )
+
+    def test_records_slice_by_episode_window(self):
+        m, tr = self._rig()
+        base = 1000.0
+        self._episode(tr, "inside", base, base + 1.0)
+        # two batches inside the window, one after it
+        m.serve({"kind": "batch", "t_mono": base + 0.2,
+                 "query_latency_s": [0.010, 0.020], "rejected": 1})
+        m.serve({"kind": "batch", "t_mono": base + 0.8,
+                 "query_latency_s": [0.030]})
+        m.serve({"kind": "batch", "t_mono": base + 5.0,
+                 "query_latency_s": [0.040] * 4})
+        m.serve({"kind": "shed", "t_mono": base + 0.5, "dropped": 3})
+        m.fleet({"kind": "bucket", "t_mono": base + 0.4, "tenants": 2})
+        m.membership({"kind": "join", "t_mono": base + 0.1, "slot": 1})
+        m.membership({"kind": "join", "t_mono": base + 9.0, "slot": 2})
+        eps = m.summary()["episodes"]
+        sec = eps["inside"]
+        assert sec["kind"] == "steady" and sec["fault"] is False
+        assert sec["requests"] == 3  # the late batch is outside
+        assert sec["rejected"] == 1
+        assert sec["sheds"] == 3
+        assert sec["fleet_requests"] == 2
+        assert sec["membership_events"] == 1
+        assert sec["p99_ms"] == pytest.approx(30.0)
+        assert sec["slo"]["attainment"] == 1.0
+        # non-fault episode: recovery fields present but None
+        assert sec["recovery_ms"] is None and sec["recovered"] is None
+
+    def test_fault_episode_measures_recovery(self):
+        m, tr = self._rig(slo_ms=50.0)
+        base = 2000.0
+        self._episode(tr, "crowd", base, base + 1.0,
+                      kind="flash_crowd", fault=True)
+        # incident: violating completions right after the fault, then
+        # a probe-length healthy run starting at +0.2s
+        m.serve({"kind": "batch", "t_mono": base + 0.05,
+                 "query_latency_s": [0.200, 0.300]})
+        m.serve({"kind": "batch", "t_mono": base + 0.2,
+                 "query_latency_s": [0.010] * 5})
+        sec = m.summary()["episodes"]["crowd"]
+        assert sec["fault"] is True
+        assert sec["recovered"] is True
+        assert sec["recovery_ms"] == pytest.approx(200.0, abs=1.0)
+
+    def test_fault_episode_never_recovering_reports_none(self):
+        m, tr = self._rig(slo_ms=50.0)
+        base = 3000.0
+        self._episode(tr, "crowd", base, base + 1.0,
+                      kind="flash_crowd", fault=True)
+        m.serve({"kind": "batch", "t_mono": base + 0.1,
+                 "query_latency_s": [0.200] * 3})
+        sec = m.summary()["episodes"]["crowd"]
+        assert sec["recovered"] is False and sec["recovery_ms"] is None
+
+    def test_one_lucky_request_is_not_recovery(self):
+        # a single fast request mid-incident must not count: the probe
+        # demands consecutive healthy completions
+        completions = [
+            (10.0, 200.0), (10.1, 10.0), (10.2, 200.0),
+            (10.3, 10.0), (10.4, 10.0), (10.5, 10.0),
+        ]
+        r = MetricsLogger._recovery_from(
+            10.0, completions, 50.0, probe=3
+        )
+        assert r == pytest.approx(300.0)
+
+    def test_stable_key_set_across_episodes(self):
+        m, tr = self._rig()
+        base = 4000.0
+        self._episode(tr, "a", base, base + 1.0)
+        self._episode(tr, "b", base + 1.0, base + 2.0,
+                      kind="flash_crowd", fault=True)
+        eps = m.summary()["episodes"]
+        assert set(eps["a"]) == set(eps["b"])  # structural contract
+
+    def test_no_tracer_or_no_episodes_is_empty(self):
+        assert MetricsLogger()._episode_summaries() == {}
+        m, tr = self._rig()
+        with tr.span("not_an_episode"):
+            pass
+        assert m.summary().get("episodes") is None
+
+
+# -- full replay: two runs, one verdict shape ---------------------------------
+
+
+TINY = {
+    "name": "unit_tiny",
+    "seed": 3,
+    "slo_p99_ms": 800.0,
+    "config": {"dim": 16, "k": 2, "num_workers": 2,
+               "rows_per_worker": 8, "num_steps": 2},
+    "episodes": [
+        {"name": "calm", "kind": "steady", "start_s": 0.0,
+         "duration_s": 0.5, "qps": 10},
+        {"name": "swap", "kind": "publish", "start_s": 0.25,
+         "duration_s": 0.0},
+    ],
+}
+
+
+class TestReplayDeterminism:
+    def test_same_spec_same_verdict_shape(self):
+        v1, ok1 = run_scenario(dict(TINY))
+        v2, ok2 = run_scenario(dict(TINY))
+        assert ok1 and ok2
+        for v in (v1, v2):
+            assert v["metric"] == "pca_scenario_slo_verdict"
+            assert v["scenario"] == "unit_tiny" and v["seed"] == 3
+            json.dumps(v)  # the record bench.py --compare consumes
+        # the determinism contract: gates agree in NAME and VALUE,
+        # episode sections agree in key set, the replay accounting
+        # (schedule-driven) matches exactly
+        assert v1["gates"] == v2["gates"]
+        assert set(v1["episodes"]) == set(v2["episodes"]) == \
+            {"calm", "swap"}
+        for name in v1["episodes"]:
+            assert set(v1["episodes"][name]) == set(v2["episodes"][name])
+        assert v1["replay"]["submitted"] == v2["replay"]["submitted"] == 5
+        assert v1["replay"]["publishes"] == v2["replay"]["publishes"] == 1
+
+    def test_verdict_numbers_come_from_summary(self):
+        v, ok = run_scenario(dict(TINY))
+        assert ok
+        # value IS the serve SLO attainment from summary()["slo"]
+        assert v["value"] == v["slo"]["serve"]["attainment"]
+        assert v["slo"]["serve"]["burn"].keys() == {"fast", "slow"}
+        calm = v["episodes"]["calm"]
+        assert calm["requests"] > 0
+        assert v["gates"]["calm_served"] is True
+        assert v["gates"]["swap_version_live"] is True

@@ -184,6 +184,80 @@ class TestChromeExport:
         assert ev["dur"] >= 2e3
 
 
+class TestEpisodeTrack:
+    """The ISSUE-11 export format: scenario episodes render as their
+    own top-level Perfetto track (tid 0, named "episodes"), above and
+    apart from every per-thread request track."""
+
+    def test_episode_spans_get_top_level_track(self, tmp_path):
+        tr = Tracer()
+        crowd = tr.episode("crowd", kind="flash_crowd", fault=True)
+        with tr.span("admit", category="serve"):
+            pass
+        cycle = tr.episode("cycle", kind="diurnal", fault=False)
+        cycle.close()
+        crowd.close()
+        path = tr.export_chrome_trace(str(tmp_path / "trace.json"))
+        evs = json.load(open(path))["traceEvents"]
+        # exactly one "episodes" meta row, pinned at tid 0
+        (meta,) = [
+            e for e in evs
+            if e["ph"] == "M" and e["name"] == "thread_name"
+            and e["args"]["name"] == "episodes"
+        ]
+        assert meta["tid"] == 0
+        # episode X events land on tid 0, carrying kind/fault attrs
+        eps = [e for e in evs if e["ph"] == "X" and e["tid"] == 0]
+        assert {e["name"] for e in eps} == {"crowd", "cycle"}
+        assert all(e["cat"] == "episode" for e in eps)
+        by_name = {e["name"]: e["args"] for e in eps}
+        assert by_name["crowd"]["kind"] == "flash_crowd"
+        assert by_name["crowd"]["fault"] is True
+        # request spans keep their per-thread tracks — never tid 0 —
+        # and no per-thread meta row claims the episode track
+        (admit,) = [e for e in evs if e["name"] == "admit"]
+        assert admit["tid"] != 0
+        thread_metas = [
+            e for e in evs
+            if e["ph"] == "M" and e["name"] == "thread_name"
+            and e["args"]["name"] != "episodes"
+        ]
+        assert thread_metas and all(e["tid"] != 0 for e in thread_metas)
+
+    def test_no_episode_track_without_episodes(self, tmp_path):
+        tr = Tracer()
+        with tr.span("a"):
+            pass
+        path = tr.export_chrome_trace(str(tmp_path / "t.json"))
+        evs = json.load(open(path))["traceEvents"]
+        assert not any(
+            e["ph"] == "M" and e["args"].get("name") == "episodes"
+            for e in evs
+        )
+
+    def test_episode_handle_records_once_and_is_idempotent(self):
+        tr = Tracer()
+        h = tr.episode("ep", kind="steady")
+        time.sleep(0.002)
+        sid = h.close()
+        assert h.close() == sid  # second close: same id, no new span
+        (sp,) = tr.snapshot()
+        assert sp.category == "episode" and sp.span_id == sid
+        assert sp.thread_id == 0  # off every real thread's track
+        assert sp.duration_s >= 0.002
+        # context-manager form closes on exit
+        with tr.episode("ep2") as h2:
+            pass
+        assert {s.name for s in tr.snapshot()} == {"ep", "ep2"}
+        assert h2.span_id is not None
+
+    def test_null_tracer_episode_parity(self):
+        with NULL_TRACER.episode("x", kind="steady") as h:
+            assert h.set(a=1) is h
+        assert NULL_TRACER.episode("y").close() is None
+        assert NULL_TRACER.snapshot() == []
+
+
 # -- histogram ---------------------------------------------------------------
 
 
@@ -493,12 +567,32 @@ class TestSLO:
         # rolling window reported separately, violations live-only
         assert s["window"] == {
             "requests": 50, "violations": 0, "attainment": 1.0,
+            "budget_burn": 0.0,
         }
 
     def test_empty_window(self):
         s = slo_summary(50.0, [])
         assert s["requests"] == 0
         assert "attainment" not in s and "p99_ms" not in s
+
+    def test_fast_and_slow_burn_windows(self):
+        # a flash crowd against a long healthy history: half the
+        # rolling window violates (fast burn 50.0) while the lifetime
+        # burn barely moves (slow 1.0) — the transient-incident shape
+        s = slo_summary(
+            50.0, [200.0] * 10 + [10.0] * 10,
+            evicted_requests=980, evicted_violations=0,
+        )
+        assert s["burn"]["fast"] == pytest.approx(50.0)
+        assert s["burn"]["slow"] == pytest.approx(1.0)
+        # back-compat: budget_burn stays the lifetime (slow) number
+        assert s["budget_burn"] == s["burn"]["slow"]
+        assert s["window"]["budget_burn"] == s["burn"]["fast"]
+
+    def test_burn_windows_agree_on_uniform_history(self):
+        # no eviction: the ring IS the lifetime, fast == slow
+        s = slo_summary(50.0, [10.0] * 97 + [200.0] * 3)
+        assert s["burn"] == {"fast": 3.0, "slow": 3.0}
 
     def test_logger_surfaces_serve_slo(self):
         m = MetricsLogger(slo_p99_ms=15.0)
