@@ -1516,6 +1516,170 @@ def measure_chaos_churn():
     return result, ok
 
 
+def _tree_cfg():
+    """Tree-merge A/B workload (ISSUE 12): 8 workers over a chip:4 x
+    host:2 topology, shapes small enough for the CPU rig. d divides
+    every fan-in and the fan-ins multiply to the fleet — the
+    resolve_topology invariants."""
+    from distributed_eigenspaces_tpu.config import PCAConfig
+
+    small = _os.environ.get("DET_BENCH_SMALL") == "1"
+    d, n, T = (64, 32, 8) if small else (256, 128, 10)
+    return PCAConfig(
+        dim=d, k=4, num_workers=8, rows_per_worker=n, num_steps=T,
+        backend="local", solver="subspace", subspace_iters=6,
+        prefetch_depth=0,
+        merge_topology=(("chip", 4), ("host", 2)),
+    )
+
+
+def measure_tree():
+    """``--tree``: the hierarchical-merge A/B (ISSUE 12) — the SAME
+    planted-spectrum fit run flat and through the chip:4 x host:2 tree,
+    with three evidence classes:
+
+    1. **Accuracy.** Both fits must land inside the 1-degree angle
+       budget vs planted truth, and the tree's final basis must agree
+       with the flat basis (the multi-tier truncation is the only
+       numeric difference — gated, not assumed).
+    2. **Merge-step time.** The isolated merge core (jitted, warmed,
+       value-fetch fenced) timed flat vs tree over the same factor
+       stack — the stacked tree pays f-group vmapped eigensolves of
+       (f*k)^2 Grams instead of one (m*k)^2 solve.
+    3. **Collective payload.** The contract audit's measured per-device
+       payloads on the tiered-mesh program vs the flat scan program
+       (needs the 8-virtual-device rig; skipped LOUDLY in the record
+       when absent). The headline value is the payload reduction: the
+       flat merge gathers the m-wide factor stack, the tree never moves
+       more than max(d*k, (f*k)^2) elements.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.algo.online import OnlineState
+    from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+    from distributed_eigenspaces_tpu.algo.step import merge_core
+    from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+    from distributed_eigenspaces_tpu.parallel.topology import (
+        resolve_topology,
+    )
+
+    cfg = _tree_cfg()
+    cfg_flat = cfg.replace(merge_topology=None)
+    topo = resolve_topology(cfg)
+    d, k, m, n, T = (
+        cfg.dim, cfg.k, cfg.num_workers, cfg.rows_per_worker,
+        cfg.num_steps,
+    )
+    spec = planted_spectrum(d, k_planted=k, gap=20.0, noise=0.01, seed=7)
+    truth = spec.top_k(k)
+    data = np.asarray(spec.sample(jax.random.PRNGKey(1), T * m * n))
+    x = jnp.asarray(
+        data.reshape(T, m, n, d), jnp.float32
+    )
+
+    fit_flat = make_scan_fit(cfg_flat)
+    fit_tree = make_scan_fit(cfg)
+    _, vb_flat = fit_flat(OnlineState.initial(d), x)
+    _, vb_tree = fit_tree(OnlineState.initial(d), x)
+    v_flat = np.asarray(vb_flat[-1])
+    v_tree = np.asarray(vb_tree[-1])
+    angle_flat = float(np.max(np.asarray(
+        principal_angles_degrees(jnp.asarray(v_flat), truth)
+    )))
+    angle_tree = float(np.max(np.asarray(
+        principal_angles_degrees(jnp.asarray(v_tree), truth)
+    )))
+    angle_tree_vs_flat = float(np.max(np.asarray(
+        principal_angles_degrees(
+            jnp.asarray(v_tree), jnp.asarray(v_flat)
+        )
+    )))
+
+    # -- isolated merge-step timing over one representative stack ----------
+    blocks0 = x[0]  # (m, n, d)
+    gram = jnp.einsum("mnd,mne->mde", blocks0, blocks0)
+    _, vecs = jnp.linalg.eigh(gram)
+    vs_stack = vecs[..., -k:][..., ::-1]  # (m, d, k) per-worker bases
+    merge_flat = jax.jit(lambda s: merge_core(s, k))
+    merge_tree = jax.jit(lambda s: merge_core(s, k, topology=topo))
+    reps = 5 if _os.environ.get("DET_BENCH_SMALL") == "1" else 30
+
+    def _time_merge(fn):
+        _sync(fn(vs_stack))  # compile + warm
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _sync(fn(vs_stack))
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times) * 1e3)
+
+    flat_ms = _time_merge(merge_flat)
+    tree_ms = _time_merge(merge_tree)
+
+    # -- collective payloads from the contract audit ------------------------
+    gates = {
+        "flat_angle_within_budget": angle_flat <= 1.0,
+        "tree_angle_within_budget": angle_tree <= 1.0,
+        "tree_matches_flat_basis": angle_tree_vs_flat <= 0.5,
+    }
+    audit: dict = {}
+    payload_reduction = None
+    try:
+        from distributed_eigenspaces_tpu.analysis.contracts import (
+            check_program,
+        )
+        from distributed_eigenspaces_tpu.analysis.programs import (
+            build_program,
+        )
+
+        tree_built = build_program("tree_fit")
+        flat_built = build_program("scan_solo")
+        _, tree_m = check_program(tree_built)
+        _, flat_m = check_program(flat_built)
+        t_pay = int(tree_m["collectives"]["max_payload_elems"])
+        f_pay = int(flat_m["collectives"]["max_payload_elems"])
+        payload_reduction = round(f_pay / max(t_pay, 1), 3)
+        audit = {
+            "tree_max_payload_elems": t_pay,
+            "flat_max_payload_elems": f_pay,
+            "tree_max_payload_bytes": 4 * t_pay,
+            "flat_max_payload_bytes": 4 * f_pay,
+            "tree_ops": tree_m["collectives"]["ops"],
+            "flat_ops": flat_m["collectives"]["ops"],
+        }
+        gates["tree_contract_ok"] = bool(tree_m["ok"])
+        gates["tree_payload_below_flat"] = t_pay < f_pay
+    except RuntimeError as e:
+        # no 8-virtual-device rig in this interpreter: the payload
+        # evidence is skipped LOUDLY, never silently zeroed
+        audit = {"skipped": str(e)}
+
+    ok = all(gates.values())
+    result = {
+        "metric": "pca_tree_merge",
+        "value": payload_reduction,
+        "unit": "x",
+        "topology": [[name, f] for name, f in topo.tiers],
+        "dim": d, "k": k, "workers": m,
+        "merge_flat_ms": round(flat_ms, 3),
+        "merge_tree_ms": round(tree_ms, 3),
+        "angle_flat_deg": round(angle_flat, 4),
+        "angle_tree_deg": round(angle_tree, 4),
+        "angle_tree_vs_flat_deg": round(angle_tree_vs_flat, 4),
+        "payload_audit": audit,
+        "gates": gates,
+    }
+    if not ok:
+        result["tree_fail"] = sorted(
+            g for g, passed in gates.items() if not passed
+        )
+    return result, ok
+
+
 def measure_scenario(spec_path: str, trace_out: str | None = None):
     """``--scenario [SPEC]``: production-shaped trace replay judged
     purely by telemetry (ISSUE 11). Replays the declarative episode
@@ -1748,6 +1912,16 @@ def measure_coldstart():
 
 
 def main():
+    # --tree's payload audit needs the 8-virtual-device rig; the flag
+    # only takes effect BEFORE the first jax import (the conftest /
+    # scripts-analyze discipline), so inject it here at entry
+    if "--tree" in sys.argv[1:]:
+        flags = _os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            _os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
     import jax
 
     # `bench.py --eval [name ...]` runs the BASELINE.md config evals
@@ -1839,6 +2013,20 @@ def main():
     # timeout + auto-resume; every gate asserted by the measurement
     if "--chaos-churn" in args:
         result, ok = measure_chaos_churn()
+        print(json.dumps(result))
+        if not ok:
+            return 1
+        if compare_path is not None:
+            return compare_reports(compare_path, result, compare_threshold)
+        return 0
+
+    # --tree: the hierarchical-merge A/B (ISSUE 12) — flat vs chip:4 x
+    # host:2 tree on the same planted fit: angle budget, isolated
+    # merge-step ms, and the contract audit's measured collective
+    # payloads (the tree's headline win); every gate asserted by the
+    # measurement itself
+    if "--tree" in args:
+        result, ok = measure_tree()
         print(json.dumps(result))
         if not ok:
             return 1
@@ -2127,6 +2315,55 @@ def compare_reports(old_path: str, result: dict,
             "regression": bool(
                 ratio < threshold and r_new > structural_ms
             ),
+        }
+        print(json.dumps(verdict), file=sys.stderr)
+        return 1 if verdict["regression"] else 0
+
+    if "pca_tree_merge" in (old_metric, new_metric):
+        # tree records are comparable only on the SAME topology: the
+        # payload reduction is a structural function of the tier
+        # fan-ins, so a cross-topology ratio would be a unit error
+        if old.get("topology") != result.get("topology"):
+            print(
+                json.dumps({
+                    "compare": "skipped",
+                    "reason": (
+                        f"topology mismatch: {old.get('topology')!r} "
+                        f"vs {result.get('topology')!r} (payload "
+                        "reduction is a function of the tier fan-ins)"
+                    ),
+                }),
+                file=sys.stderr,
+            )
+            return 0
+        r_old, r_new = old.get("value"), result.get("value")
+        if r_old is None or r_new is None:
+            print(
+                json.dumps({
+                    "compare": "skipped",
+                    "reason": (
+                        "missing payload reduction (a record produced "
+                        "without the 8-virtual-device rig skips the "
+                        "payload audit loudly)"
+                    ),
+                }),
+                file=sys.stderr,
+            )
+            return 0
+        ratio = r_new / max(r_old, 1e-9)
+        verdict = {
+            "compare": old_path,
+            "payload_reduction_old": r_old,
+            "payload_reduction_new": r_new,
+            "merge_tree_ms_old": old.get("merge_tree_ms"),
+            "merge_tree_ms_new": result.get("merge_tree_ms"),
+            "normalized_ratio": round(ratio, 3),
+            "threshold": threshold,
+            # the bench itself already failed on the hard gates (angle
+            # budget, contract ok, payload-below-flat); the compare
+            # catches a structural payload-reduction regression — a
+            # merge that silently started moving bigger buffers
+            "regression": bool(ratio < threshold),
         }
         print(json.dumps(verdict), file=sys.stderr)
         return 1 if verdict["regression"] else 0

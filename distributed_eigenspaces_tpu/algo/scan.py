@@ -52,7 +52,12 @@ def _merge_or_fold_factory(cfg: PCAConfig):
     — a worker drop takes effect in the same round's fold and at the
     next merge, never ``s`` steps late (§5.3 under ``merge_interval``).
     """
+    from distributed_eigenspaces_tpu.parallel.topology import (
+        resolve_topology,
+    )
+
     k, s = cfg.k, cfg.merge_interval
+    topology = resolve_topology(cfg)
 
     def update_p(st, p):
         return update_state_projector(
@@ -63,7 +68,12 @@ def _merge_or_fold_factory(cfg: PCAConfig):
         merge_now = (st.step % s) == 0
 
         def do_merge(vs_):
-            v = merge_core(vs_, k, mask=mask)
+            # merge rounds run the (possibly tiered) merge; fold-only
+            # rounds below stay the FLAT masked mean — the mean of
+            # projectors is associative over the tree, so the fold is
+            # exact regardless of topology (only the truncating
+            # eigensolve has a tree structure)
+            v = merge_core(vs_, k, mask=mask, topology=topology)
             return v, projector(v)
 
         def fold_only(vs_):
@@ -176,11 +186,16 @@ def _make_interval_fit(cfg: PCAConfig, axis_name, update, gather: bool):
     once one exists) and the shared merge-or-fold dispatch runs the
     merged eigensolve only on merge rounds. ``v_bars[t]`` is the merged
     basis AS OF step ``t+1`` (the carry on fold rounds)."""
+    from distributed_eigenspaces_tpu.parallel.topology import (
+        resolve_topology,
+    )
+
     solve_cold = make_solve_core(cfg)
     solve_warm = make_warm_solve_core(cfg)
     warm = solve_warm is not None
     fold_round = _merge_or_fold_factory(cfg)
     k = cfg.k
+    topology = resolve_topology(cfg)
 
     def body(carry, x):
         st, vp = carry
@@ -196,7 +211,8 @@ def _make_interval_fit(cfg: PCAConfig, axis_name, update, gather: bool):
         # seeds the warm carry; also the resume-safe path)
         def run(state, first_x, scan_body, xs_rest):
             v0_bar = merge_core(
-                solve_cold(first_x, axis_name=axis_name), k
+                solve_cold(first_x, axis_name=axis_name), k,
+                topology=topology,
             )
             state = update(state, v0_bar)
             (state, _), v_bars = jax.lax.scan(
@@ -376,6 +392,27 @@ def make_scan_fit(
 
     if masked and gather:
         raise ValueError("masked scan fits take a dense (T, ...) stack")
+
+    # tiered-mesh dispatch: a mesh whose axes ARE the topology's tiers
+    # runs the tier-local-collective programs (parallel/topology.py —
+    # no factor gather, sharded tier updates). Any other build with a
+    # topology set (single device, single worker axis) runs the stacked
+    # tree through round_core/merge_core below; no topology at all is
+    # the byte-identical pre-topology build.
+    from distributed_eigenspaces_tpu.parallel.topology import (
+        is_tiered_mesh,
+        make_tree_scan_fit,
+        resolve_topology,
+    )
+
+    if is_tiered_mesh(mesh, resolve_topology(cfg)):
+        if gather:
+            raise ValueError(
+                "gather staging is not supported on the tiered-mesh "
+                "path (stage dense (T, ...) stacks, or use a flat "
+                "worker-axis mesh)"
+            )
+        return make_tree_scan_fit(cfg, mesh, masked=masked)
 
     round_core = make_round_core(cfg)
     warm_core = make_warm_core(cfg)

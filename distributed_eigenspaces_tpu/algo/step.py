@@ -87,14 +87,25 @@ def make_warm_solve_core(cfg: PCAConfig):
     )
 
 
-def merge_core(vs, k, mask=None):
+def merge_core(vs, k, mask=None, topology=None):
     """The MERGE half of a round: exact masked low-rank top-k of the
     gathered factors (``merged_top_k_lowrank``), under the profiler
     region the traces name. ``mask`` (full ``(m,)`` {0,1}, replicated)
     excludes failed workers exactly; an all-masked round merges to
-    zeros."""
+    zeros. ``topology`` (a resolved
+    :class:`~..parallel.topology.MergeTopology`) runs the tiered tree
+    reduce over the stack instead (``tree_merge_stacked`` — per-group
+    exact merges, live-count weighted); ``None`` is the byte-identical
+    flat merge."""
     from distributed_eigenspaces_tpu.utils.tracing import named_scope
 
+    if topology is not None:
+        from distributed_eigenspaces_tpu.parallel.topology import (
+            tree_merge_stacked,
+        )
+
+        with named_scope("det_tree_merge"):
+            return tree_merge_stacked(vs, k, topology, mask=mask)
     with named_scope("det_merge"):
         return merged_top_k_lowrank(vs, k, mask=mask)
 
@@ -140,12 +151,23 @@ def make_round_core(
     merges to zeros (callers fold the zero projector and keep their
     warm carry — the per-step loop's tested semantics).
     """
+    # resolved ONCE at build time: cfg.merge_topology = None threads
+    # topology=None straight through merge_core — the traced program is
+    # byte-identical to the pre-topology build (the merge_interval
+    # discipline). Function-level import: parallel.topology imports
+    # ops.linalg only, but keep the build path lazy like the tracing
+    # imports above.
+    from distributed_eigenspaces_tpu.parallel.topology import (
+        resolve_topology,
+    )
+
+    topology = resolve_topology(cfg)
     solve_core = make_solve_core(cfg, iters=iters, orth=orth)
     k = cfg.k
 
     def round_core(x_blocks, axis_name=None, v0=None, mask=None):
         vs = solve_core(x_blocks, axis_name=axis_name, v0=v0)
-        return merge_core(vs, k, mask=mask)
+        return merge_core(vs, k, mask=mask, topology=topology)
 
     return round_core
 

@@ -55,6 +55,9 @@ class ProgramParams:
     n_feature_shards: int = 1
     n_workers_mesh: int = 1
     sketch_width: int = 0
+    #: merge-tree fan-ins leaf->root (tree_merge programs only): the
+    #: tier-local Gram psum is (f*k)^2 per tier
+    tier_fan_ins: tuple[int, ...] = ()
 
     @property
     def d_local(self) -> int:
@@ -113,6 +116,17 @@ def _factor_stack(p: ProgramParams) -> int:
     return p.m * p.d_local * max(p.k, p.sketch_width)
 
 
+def _tree_bound(p: ProgramParams) -> int:
+    """The tiered tree's payload ceiling: every tier moves at most the
+    single ``(d, k)`` basis (all-to-all of the row-split factors /
+    all-gather at the tier boundary) or the tier-local ``(f*k, f*k)``
+    factor Gram (one psum) — never the flat route's m-wide factor stack
+    and never a dense ``d x d``."""
+    kf = max(p.k, p.sketch_width)
+    gram = max(((f * kf) ** 2 for f in p.tier_fan_ins), default=0)
+    return max(p.d_local * kf, gram)
+
+
 # -- the registry ------------------------------------------------------------
 
 #: Contract per program KIND (programs.py maps each config-matrix entry
@@ -144,6 +158,23 @@ CONTRACTS: dict[str, ProgramContract] = {
         max_payload_elems=_factor_stack,
         require_collectives=True,
         memory_policy="factor_only",
+    ),
+    "tree_merge": ProgramContract(
+        name="tree_merge",
+        description=(
+            "tiered-mesh tree fit (ISSUE 12): per-tier sharded merge "
+            "updates only — all-to-all of the row-split (d, k) "
+            "factors, one all-reduce of the (f*k, f*k) tier Gram, and "
+            "the (d, k) basis all-gather at each tier boundary; the "
+            "flat route's m-wide factor-stack gather must NOT appear, "
+            "and no collective ever moves a dense d x d"
+        ),
+        allowed_collectives=frozenset(
+            {"all-to-all", "all-reduce", "all-gather"}
+        ),
+        max_payload_elems=_tree_bound,
+        require_collectives=True,
+        memory_policy="dense_state",
     ),
     "fleet_fit": ProgramContract(
         name="fleet_fit",

@@ -54,6 +54,46 @@ def _mutant_dense_collective() -> list[contracts.Violation]:
     return viols
 
 
+def _mutant_tree_dense_collective() -> list[contracts.Violation]:
+    """The tree-merge shortcut the tier contract forbids: a tiered-mesh
+    round that psums the dense d x d projector across a tier axis
+    instead of the sharded (f*k)^2 Gram. all-reduce itself is in the
+    tree contract's allowed set — the PAYLOAD bound is what must
+    catch this."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_eigenspaces_tpu.parallel.mesh import shard_map
+    from distributed_eigenspaces_tpu.parallel.topology import (
+        MergeTopology,
+        make_tiered_mesh,
+    )
+
+    topo = MergeTopology((("chip", 2), ("host", 2)))
+    mesh = make_tiered_mesh(topo)
+
+    def dense_tier_round(v):  # (d, k) -> psum of d x d across the tier
+        p = v @ v.T
+        return jax.lax.psum(p, "chip")
+
+    f = jax.jit(shard_map(
+        dense_tier_round, mesh=mesh, in_specs=P(), out_specs=P(),
+        check_vma=False,
+    ))
+    hlo = f.lower(
+        jnp.zeros((_D, 2), jnp.float32)
+    ).compile().as_text()
+    contract = contracts.CONTRACTS["tree_merge"]
+    params = contracts.ProgramParams(
+        d=_D, k=2, m=4, n=8, tier_fan_ins=topo.fan_ins
+    )
+    viols, _ = contracts.check_collectives(
+        contract, params, hlo, program="mutant_tree_dense_collective"
+    )
+    return viols
+
+
 def _mutant_dense_temp() -> list[contracts.Violation]:
     """A factor-only program that materializes the d x d Gram."""
     import jax
@@ -155,6 +195,9 @@ def _ast_mutant(fixture: str, linter) -> Callable[[], list]:
 #: analyzer claims to catch has exactly one seeded witness here.
 MUTATIONS: dict[str, tuple[str, Callable[[], list]]] = {
     "dense_collective": ("collective-op", _mutant_dense_collective),
+    "tree_dense_collective": (
+        "collective-payload", _mutant_tree_dense_collective
+    ),
     "dense_temp": ("dense-buffer", _mutant_dense_temp),
     "baked_constant": ("baked-constant", _mutant_baked_constant),
     "blocking_under_lock": ("blocking-under-lock", _ast_mutant(

@@ -134,6 +134,44 @@ def _scan_program(name: str, *, masked: bool = False, **cfg_kw):
     return build
 
 
+def _tree_program(name: str, *, masked: bool = False):
+    """Tiered-mesh tree fit (ISSUE 12): a 2x2 chip/host topology over
+    the 8-device rig (4 workers on a ("host", "chip") mesh) — the
+    tree_merge contract's subject. The tree's whole point shows in the
+    bound: max(d*k, (f*k)^2) = 128 elems here vs the flat factor
+    stack's m*d*k = 512."""
+
+    def build() -> BuiltProgram:
+        import jax.numpy as jnp
+
+        from distributed_eigenspaces_tpu.algo.online import OnlineState
+        from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+        from distributed_eigenspaces_tpu.parallel.topology import (
+            make_tiered_mesh,
+            resolve_topology,
+        )
+
+        require_mesh_devices()
+        cfg = _cfg(merge_topology=(("chip", 2), ("host", 2)))
+        topo = resolve_topology(cfg)
+        mesh = make_tiered_mesh(topo)
+        fit = _ensure_jit(make_scan_fit(cfg, mesh, masked=masked))
+        x = jnp.zeros((_T, _M, _N, _D), jnp.bfloat16)
+        args = (OnlineState.initial(_D), x)
+        if masked:
+            args += (jnp.ones((_T, _M), jnp.float32),)
+        return BuiltProgram(
+            name=name, contract="tree_merge",
+            params=ProgramParams(
+                d=_D, k=_K, m=_M, n=_N, T=_T, n_workers_mesh=_M,
+                tier_fan_ins=topo.fan_ins,
+            ),
+            jitted=fit, args=args,
+        )
+
+    return build
+
+
 def _feature_program(name: str, kind: str):
     def build() -> BuiltProgram:
         import jax
@@ -257,6 +295,9 @@ PROGRAMS: dict[str, Callable[[], BuiltProgram]] = {
     "scan_masked_interval2": _scan_program(
         "scan_masked_interval2", masked=True, merge_interval=2
     ),
+    # tiered-mesh tree merge (ISSUE 12)
+    "tree_fit": _tree_program("tree_fit"),
+    "tree_fit_masked": _tree_program("tree_fit_masked", masked=True),
     # feature-sharded cores
     "feature_scan": _feature_program("feature_scan", "scan"),
     "feature_sketch": _feature_program("feature_sketch", "sketch"),

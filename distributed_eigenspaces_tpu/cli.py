@@ -156,6 +156,16 @@ def build_parser() -> argparse.ArgumentParser:
                    "with warm starts; --trainer scan; incompatible with "
                    "--checkpoint-dir/--resume — the pipelined carry is "
                    "not checkpointable)")
+    p.add_argument("--merge-topology", default=None, metavar="SPEC",
+                   help="hierarchical merge tree, leaf->root, as "
+                   "'name:fan_in,name:fan_in' (e.g. 'chip:4,host:2'): "
+                   "compile the flat merge into a tiered tree reduce "
+                   "with per-tier sharded updates — each tier moves "
+                   "only the (d, k) basis and an (f*k)^2 Gram, never "
+                   "the m-wide factor stack. Fan-ins must multiply to "
+                   "--workers and each must divide --dim; unset = the "
+                   "exact flat merge (docs/ARCHITECTURE.md "
+                   "'Hierarchical merge')")
     p.add_argument("--dim", type=int, default=1024,
                    help="feature dim for --data synthetic")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
@@ -1266,6 +1276,39 @@ def main(argv=None) -> int:
             )
             return 2
 
+    merge_topology = None
+    if args.merge_topology:
+        try:
+            pairs = [
+                part.strip() for part in args.merge_topology.split(",")
+                if part.strip()
+            ]
+            parsed = []
+            for part in pairs:
+                tier_name, _, fan = part.partition(":")
+                if not tier_name.strip() or not fan:
+                    raise ValueError(part)
+                parsed.append((tier_name.strip(), int(fan)))
+            if not parsed:
+                raise ValueError(args.merge_topology)
+            merge_topology = tuple(parsed)
+        except ValueError:
+            print(
+                f"error: --merge-topology must be "
+                f"'name:fan_in,name:fan_in' leaf->root (e.g. "
+                f"'chip:4,host:2'), got {args.merge_topology!r}",
+                file=sys.stderr,
+            )
+            return 2
+        if args.pipeline_merge:
+            print(
+                "error: --merge-topology is incompatible with "
+                "--pipeline-merge (the pipelined body overlaps the "
+                "FLAT merge schedule; pick one)",
+                file=sys.stderr,
+            )
+            return 2
+
     import jax.numpy as jnp
 
     from distributed_eigenspaces_tpu.config import PCAConfig
@@ -1339,6 +1382,7 @@ def main(argv=None) -> int:
         ),
         merge_interval=args.merge_interval,
         pipeline_merge=args.pipeline_merge,
+        merge_topology=merge_topology,
         serve_slo_p99_ms=args.slo_p99_ms,
         fleet_slo_p99_ms=args.slo_p99_ms,
         compile_cache_dir=args.compile_cache,

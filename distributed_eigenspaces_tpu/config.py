@@ -262,6 +262,24 @@ class PCAConfig:
         is not checkpointable state, so kill/resume could not be
         bit-for-bit), and the per-step pool loop runs unpipelined
         (merge and next solve live in different dispatches there).
+      merge_topology: declarative hierarchical-merge tree
+        (``parallel/topology.py``): a sequence of ``(tier_name,
+        fan_in)`` pairs ordered leaf -> root, e.g. ``[("chip", 4),
+        ("host", 2)]`` for 8 workers merged 4-way on-chip then 2-way
+        across hosts. The flat merge becomes a tiered tree reduce:
+        each tier averages its children's projectors with tier-LOCAL
+        collectives, using the cross-replica-sharded update (the mean-
+        projector accumulation is sharded over the tier's replicas;
+        only the (d, k) basis is all-gathered at the tier boundary —
+        never a replicated d x d, never a replicated factor stack).
+        Tier fan-ins must multiply to ``num_workers`` and each fan-in
+        must divide ``dim`` (checked at topology resolution, where the
+        worker count is final). Each non-leaf tier gets its own
+        membership/deadline/quorum rule (``runtime/tiers.py``): a late
+        host folds one-step-stale into the NEXT tier-local merge and
+        ``QuorumLost`` is raised per tier, not globally. ``None``
+        (default) dispatches to the byte-identical pre-topology flat
+        merge programs.
       seed: PRNG seed for initialization (subspace solver, synthetic data).
     """
 
@@ -302,6 +320,7 @@ class PCAConfig:
     heartbeat_timeout_ms: float = 1000.0
     round_deadline_ms: float | None = 250.0
     min_quorum_frac: float = 0.5
+    merge_topology: tuple | None = None
     seed: int = 0
 
     def __post_init__(self):
@@ -477,6 +496,67 @@ class PCAConfig:
                 f"min_quorum_frac must be a fraction in (0, 1], got "
                 f"{self.min_quorum_frac!r}"
             )
+        if self.merge_topology is not None:
+            topo = self.merge_topology
+            if not isinstance(topo, (list, tuple)) or len(topo) == 0:
+                raise ValueError(
+                    f"merge_topology must be a non-empty sequence of "
+                    f"(tier_name, fan_in) pairs or None, got {topo!r}"
+                )
+            names = []
+            tiers = []
+            for entry in topo:
+                if (
+                    not isinstance(entry, (list, tuple))
+                    or len(entry) != 2
+                ):
+                    raise ValueError(
+                        f"merge_topology entries must be (tier_name, "
+                        f"fan_in) pairs, got {entry!r}"
+                    )
+                name, fan_in = entry
+                if not isinstance(name, str) or not name:
+                    raise ValueError(
+                        f"merge_topology tier names must be non-empty "
+                        f"strings, got {name!r}"
+                    )
+                if not isinstance(fan_in, int) or isinstance(
+                    fan_in, bool
+                ) or fan_in < 1:
+                    raise ValueError(
+                        f"merge_topology tier {name!r} fan_in must be an "
+                        f"int >= 1, got {fan_in!r}"
+                    )
+                names.append(name)
+                tiers.append((name, fan_in))
+            if len(set(names)) != len(names):
+                raise ValueError(
+                    f"merge_topology tier names must be unique, got "
+                    f"{names!r}"
+                )
+            # the tree merge replaces the flat merge core; the knobs
+            # that restructure the flat merge's SCHEDULE have no tiered
+            # counterpart yet — reject loudly rather than silently
+            # running a flat program under a topology flag
+            if self.pipeline_merge:
+                raise ValueError(
+                    "merge_topology does not compose with "
+                    "pipeline_merge=True: the pipelined body overlaps "
+                    "the FLAT merge; pick one"
+                )
+            if self.backend == "feature_sharded":
+                raise ValueError(
+                    "merge_topology is not supported on the "
+                    "feature_sharded backend (the tree factors the "
+                    "WORKER axis; feature sharding factors d)"
+                )
+            # normalize to a tuple of tuples so configs stay
+            # value-comparable regardless of how the topology was
+            # spelled (fan-in product vs num_workers and d
+            # divisibility are checked at topology resolution, where
+            # the worker count is final — scenario specs reuse config
+            # dicts at different fleet sizes)
+            object.__setattr__(self, "merge_topology", tuple(tiers))
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")
         if self.prefetch_depth < 0:

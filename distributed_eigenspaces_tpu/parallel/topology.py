@@ -1,0 +1,451 @@
+"""Hierarchical merge topology: the flat merge as a tiered tree reduce.
+
+The paper's merge — average the workers' projectors, re-eigensolve — is
+flat: one gather/psum over a single ``workers`` mesh axis, which ROADMAP
+names as the scaling ceiling for "millions of contributors". This module
+compiles a declarative ``cfg.merge_topology`` (ordered leaf -> root,
+e.g. ``[("chip", 4), ("host", 2)]``) into that tree:
+
+- **Tiered mesh factoring** (:func:`make_tiered_mesh`): the worker axis
+  becomes one mesh axis PER TIER, root-major (the leaf tier is the
+  fastest-varying axis, so a leaf group is ICI-adjacent and the root
+  tier maps to the slow DCN hop — the DrJAX placement shape, PAPERS.md
+  arxiv 2403.07128).
+
+- **Tier-local merges with the cross-replica-sharded update**
+  (:func:`tier_merge_sharded`): each tier of fan-in ``f`` merges its
+  children's projectors WITHOUT materializing a d x d and WITHOUT
+  replicating the (f, d, k) factor stack. The mean-projector Gram
+  accumulation is sharded over the tier's replicas (arxiv 2004.13336's
+  shard-the-update pattern): an ``all_to_all`` re-shards the scaled
+  factors so replica ``r`` holds every child's row-slice ``r`` (d*k
+  elements moved), the (f*k, f*k) factor Gram is accumulated from the
+  row-slices with one ``psum`` ((f*k)^2 elements), and only the merged
+  (d, k) basis is all-gathered at the tier boundary (d*k elements).
+  Per-tier collective payloads are therefore bounded by
+  ``max(d*k, (f*k)^2)`` — the ``tree_merge`` contract
+  (``analysis/contracts.py``) declares exactly that and CI enforces it.
+
+- **Stacked tree merge** (:func:`tree_merge_stacked`): the same tree
+  applied to a gathered ``(m, d, k)`` factor stack — the single-device
+  (vmap) and single-worker-axis mesh route, used by ``algo/step.py``'s
+  ``merge_core`` whenever a topology is configured. Each tier runs the
+  EXACT masked low-rank merge (``ops.linalg.merged_top_k_lowrank``) per
+  group, weighting groups by their live-child counts, so a single-tier
+  topology is bit-identical to the flat merge by construction.
+
+``cfg.merge_topology is None`` never reaches this module: the trainers
+dispatch to the byte-identical pre-topology programs (the
+``merge_interval == 1`` discipline).
+
+Numerics: each tier truncates its group's mean projector to rank k, so
+a multi-tier result is NOT bitwise the flat merge — it is the same
+subspace up to tier-truncation error, gated by the existing
+angle-budget tests (tests/test_topology.py). Weights carry the live
+LEAF count through the tree (a tier's merged basis represents
+``sum w`` leaves), so stragglers/masks are weighted exactly at every
+level, matching the flat masked mean.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from distributed_eigenspaces_tpu.ops.linalg import (
+    canonicalize_signs,
+    guarded_inv_sqrt,
+    merged_top_k_lowrank,
+)
+
+__all__ = [
+    "MergeTopology",
+    "make_tiered_mesh",
+    "make_tree_scan_fit",
+    "resolve_topology",
+    "tier_merge_sharded",
+    "tree_merge_sharded",
+    "tree_merge_stacked",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class MergeTopology:
+    """Resolved merge tree: ``tiers`` ordered leaf -> root, validated
+    against a concrete worker count and feature dimension. Built by
+    :func:`resolve_topology` — construct through that so the loud
+    validation cannot be skipped."""
+
+    tiers: tuple[tuple[str, int], ...]
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(name for name, _ in self.tiers)
+
+    @property
+    def fan_ins(self) -> tuple[int, ...]:
+        return tuple(f for _, f in self.tiers)
+
+    @property
+    def num_workers(self) -> int:
+        n = 1
+        for _, f in self.tiers:
+            n *= f
+        return n
+
+    def member_count(self, stage: int) -> int:
+        """Members ENTERING tier ``stage`` (0 = leaf): the worker count
+        divided by the fan-ins already merged below."""
+        n = self.num_workers
+        for _, f in self.tiers[:stage]:
+            n //= f
+        return n
+
+    def group_of(self, stage: int, worker: int) -> int:
+        """The tier-``stage`` member a leaf worker rolls up into
+        (C-order grouping: leaf groups are contiguous worker ranges)."""
+        g = worker
+        for _, f in self.tiers[: stage + 1]:
+            g //= f
+        return g
+
+
+def resolve_topology(cfg) -> MergeTopology | None:
+    """``cfg.merge_topology`` -> validated :class:`MergeTopology`, or
+    None for the flat merge. The worker-count/dim checks live HERE, not
+    in ``PCAConfig.__post_init__``: scenario specs and the fleet reuse
+    one config at several fleet sizes, so the product constraint is
+    only checkable where a trainer is actually built."""
+    topo = getattr(cfg, "merge_topology", None)
+    if topo is None:
+        return None
+    tiers = tuple((str(n), int(f)) for n, f in topo)
+    product = 1
+    for name, f in tiers:
+        if cfg.dim % f:
+            raise ValueError(
+                f"merge_topology tier {name!r} fan_in {f} must divide "
+                f"dim={cfg.dim}: the sharded tier update splits the "
+                f"basis rows across the tier's replicas"
+            )
+        product *= f
+    if product != cfg.num_workers:
+        raise ValueError(
+            f"merge_topology fan-ins {tuple(f for _, f in tiers)} "
+            f"multiply to {product}, but num_workers={cfg.num_workers} "
+            f"— the tree must cover the fleet exactly"
+        )
+    return MergeTopology(tiers)
+
+
+def make_tiered_mesh(topo: MergeTopology, *, devices=None) -> Mesh:
+    """Factor the worker axis into one mesh axis per tier, ROOT-major:
+    axis order is ``reversed(topo.names)`` so the leaf tier is the
+    fastest-varying axis — leaf groups are contiguous device ranges
+    (ICI-adjacent on hardware) and worker ``l``'s device is the C-order
+    flat index of its per-tier coordinates. Uses exactly
+    ``topo.num_workers`` devices; oversubscription is rejected loudly
+    (the ``make_mesh`` discipline)."""
+    if devices is None:
+        devices = jax.devices()
+    need = topo.num_workers
+    if need > len(devices):
+        raise ValueError(
+            f"tiered mesh {dict(topo.tiers)} needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    shape = tuple(reversed(topo.fan_ins))
+    names = tuple(reversed(topo.names))
+    grid = np.asarray(devices[:need]).reshape(shape)
+    return Mesh(grid, names)
+
+
+def is_tiered_mesh(mesh: Mesh | None, topo: MergeTopology | None) -> bool:
+    """True when ``mesh`` is a tier-factored mesh for ``topo`` (the
+    dispatch predicate ``make_scan_fit`` uses to pick the tier-local
+    collective path over the gather-then-stacked-tree path)."""
+    if mesh is None or topo is None:
+        return False
+    return tuple(mesh.axis_names) == tuple(reversed(topo.names))
+
+
+def flat_worker_index(topo: MergeTopology):
+    """Inside ``shard_map`` over a tiered mesh: this device's leaf
+    worker index, accumulated root-major (matches the C-order device
+    grid of :func:`make_tiered_mesh`)."""
+    idx = jnp.zeros((), jnp.int32)
+    for name, f in reversed(topo.tiers):
+        idx = idx * f + lax.axis_index(name)
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# stacked route: the tree over a gathered (m, d, k) factor stack
+# ---------------------------------------------------------------------------
+
+
+def tree_merge_stacked(vs, k: int, topo: MergeTopology, mask=None):
+    """Tiered tree reduce over a gathered factor stack ``vs (m, d, k)``:
+    each tier partitions the current members into contiguous groups of
+    its fan-in and runs the EXACT masked low-rank merge per group
+    (vmapped ``merged_top_k_lowrank``), weighting every member by the
+    live-leaf count it represents. Returns the root's ``(d, k)`` basis.
+
+    A single-tier topology calls ``merged_top_k_lowrank`` ONCE on the
+    full stack — bit-identical to the flat merge (tested). Groups whose
+    leaves are all masked out merge to zeros with weight zero and
+    contribute nothing upstream — the flat masked-mean semantics,
+    recursively.
+    """
+    m = vs.shape[0]
+    if m != topo.num_workers:
+        raise ValueError(
+            f"factor stack has {m} workers but merge_topology covers "
+            f"{topo.num_workers}"
+        )
+    if mask is None:
+        w = jnp.ones((m,), jnp.float32)
+    else:
+        w = mask.astype(jnp.float32)
+    for name, f in topo.tiers:
+        g = vs.shape[0] // f
+        groups = vs.reshape(g, f, *vs.shape[1:])
+        gw = w.reshape(g, f)
+        if g == 1:
+            # root (or single-tier) group: the plain flat merge call —
+            # bitwise the pre-topology numerics for one-tier topologies
+            vs = merged_top_k_lowrank(groups[0], k, mask=gw[0])[None]
+        else:
+            vs = jax.vmap(
+                lambda gv, gm: merged_top_k_lowrank(gv, k, mask=gm)
+            )(groups, gw)
+        w = gw.sum(axis=1)
+    return vs[0]
+
+
+# ---------------------------------------------------------------------------
+# sharded route: tier-local collectives on a tiered mesh
+# ---------------------------------------------------------------------------
+
+
+def tier_merge_sharded(v, w, k: int, axis: str, fan_in: int):
+    """One tier of the tree with the cross-replica-sharded update.
+
+    Every device in the tier group holds its child basis ``v (d, kf)``
+    and scalar live-leaf weight ``w``; returns the group's merged
+    ``(d, k)`` basis (replicated within the group) and its total weight.
+    Mirrors ``ops.linalg._merged_top_k_factor_gram`` exactly, with the
+    accumulation sharded over the tier's ``fan_in`` replicas instead of
+    replicated:
+
+    1. scale children by ``sqrt(w / cnt)`` (``cnt = psum(w)`` — the
+       masked-mean weighting);
+    2. ``all_to_all`` the row-split factors so replica ``r`` holds every
+       child's row-slice ``r`` (moves d*k elements — never the (f, d, k)
+       stack a gather would replicate);
+    3. accumulate the (f*k, f*k) factor Gram from the row-slices with
+       one ``psum`` ((f*k)^2 elements), eigensolve it (tiny, replicated);
+    4. map back on the LOCAL row-slice and ``all_gather`` only the
+       merged (d, k) basis at the tier boundary.
+
+    A fully-masked group (cnt == 0) propagates exact zeros with weight
+    zero — the flat route's guard semantics. Requires
+    ``d % fan_in == 0`` (validated by :func:`resolve_topology`).
+    """
+    d, kf = v.shape
+    cnt = lax.psum(w, axis)
+    c = v * jnp.sqrt(w / jnp.maximum(cnt, 1.0))
+    # replica r's send chunk j = its own row-slice j; after the
+    # exchange, entry j = child j's row-slice r
+    c = c.reshape(fan_in, d // fan_in, kf)
+    c = lax.all_to_all(c, axis, split_axis=0, concat_axis=0)
+    # local rows of the concatenated C (d, f*kf): child-major columns,
+    # matching the flat route's transpose-reshape ordering
+    s = jnp.transpose(c, (1, 0, 2)).reshape(d // fan_in, fan_in * kf)
+    b = lax.psum(
+        jnp.matmul(s.T, s, precision=lax.Precision.HIGHEST), axis
+    )
+    with jax.default_matmul_precision("highest"):
+        ew, u = jnp.linalg.eigh(0.5 * (b + b.T))
+    wk = ew[-k:][::-1]
+    uk = u[:, -k:][:, ::-1]
+    rows = jnp.matmul(s, uk, precision=lax.Precision.HIGHEST)
+    rows = rows * guarded_inv_sqrt(wk)[None, :]
+    v_new = lax.all_gather(rows, axis, axis=0, tiled=True)
+    return canonicalize_signs(v_new), cnt
+
+
+def tree_merge_sharded(v, w, k: int, topo: MergeTopology):
+    """All tiers of the sharded tree, leaf -> root: after the last tier
+    the merged ``(d, k)`` basis is replicated across the whole tiered
+    mesh (each tier's gather replicates within its groups; the root's
+    group IS the mesh). ``v (d, kf)`` / scalar ``w`` are this device's
+    leaf basis and mask weight."""
+    from distributed_eigenspaces_tpu.utils.tracing import named_scope
+
+    for name, f in topo.tiers:
+        with named_scope(f"det_tier_merge_{name}"):
+            v, w = tier_merge_sharded(v, w, k, name, f)
+    return v
+
+
+def make_tree_scan_fit(cfg, mesh: Mesh, *, masked: bool = False):
+    """Whole-fit scan trainer on a TIERED mesh: per-device local solves
+    (no factor gather at all — the flat path's ``all_gather`` of the
+    (m, d, k) stack is exactly what the tree removes) followed by the
+    tier-local sharded tree merge each step. Signature matches
+    ``make_scan_fit``'s dense entries: ``fit(state, x_steps)`` /
+    ``fit(state, x_steps, masks[, membership_masks])``.
+
+    Scope (rejected loudly, the segmented trainer's discipline):
+    ``merge_interval > 1`` and gather staging are flat-merge schedule
+    restructures with no tiered counterpart yet — use the stacked
+    topology route (single worker axis / single device) for those.
+    ``pipeline_merge`` is already rejected at config time.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_eigenspaces_tpu.algo.online import update_state
+    from distributed_eigenspaces_tpu.algo.step import (
+        make_solve_core,
+        make_warm_solve_core,
+    )
+    from distributed_eigenspaces_tpu.utils.guards import checked_jit
+
+    topo = resolve_topology(cfg)
+    if topo is None:
+        raise ValueError(
+            "make_tree_scan_fit needs cfg.merge_topology (flat fits "
+            "use make_scan_fit)"
+        )
+    if not is_tiered_mesh(mesh, topo):
+        raise ValueError(
+            f"mesh axes {mesh.axis_names} do not match merge_topology "
+            f"tiers {topo.names} (build the mesh with make_tiered_mesh)"
+        )
+    if cfg.merge_interval > 1:
+        raise ValueError(
+            "merge_interval > 1 is not supported on the tiered-mesh "
+            "path: the between-merge mean-projector fold is a flat-"
+            "merge schedule (use the stacked topology route — a "
+            "single-worker-axis mesh or single device)"
+        )
+
+    solve_cold = make_solve_core(cfg)
+    solve_warm = make_warm_solve_core(cfg)
+    warm = solve_warm is not None
+    k = cfg.k
+
+    def update(st, v_bar):
+        return update_state(
+            st, v_bar, discount=cfg.discount, num_steps=cfg.num_steps
+        )
+
+    axis_tuple = tuple(mesh.axis_names)
+
+    def make_fit():
+        def local_solve(x, vp, live):
+            # x (1, n, d): this device's worker block. No axis_name —
+            # the cores' flat factor gather must NOT run here.
+            if warm:
+                return lax.cond(
+                    live,
+                    lambda xx, vv: solve_warm(xx, v0=vv),
+                    lambda xx, vv: solve_cold(xx),
+                    x, vp,
+                )
+            return solve_cold(x)
+
+        if masked:
+
+            def body(carry, xm):
+                st, vp = carry
+                x, mk = xm
+                w = mk[flat_worker_index(topo)]
+                live = jnp.any(vp != 0)
+                vs = local_solve(x, vp, live)
+                v_bar = tree_merge_sharded(vs[0], w, k, topo)
+                # liveness from the MASK row (the masked-body rule:
+                # a live all-zero round must still advance the carry)
+                vp_next = jnp.where(jnp.any(mk != 0), v_bar, vp)
+                return (update(st, v_bar), vp_next), v_bar
+
+            def fit(state, x_steps, masks):
+                vp0 = jnp.zeros((cfg.dim, k), jnp.float32)
+                (state, _), v_bars = jax.lax.scan(
+                    body, (state, vp0),
+                    (x_steps, masks.astype(jnp.float32)),
+                )
+                return state, v_bars
+
+            return fit
+
+        def body(carry, x):
+            st, vp = carry
+            vs = local_solve(x, vp, jnp.any(vp != 0) if warm else None)
+            v_bar = tree_merge_sharded(vs[0], jnp.float32(1.0), k, topo)
+            return (update(st, v_bar), v_bar), v_bar
+
+        if warm:
+
+            def fit(state, x_steps):
+                # step 1: cold at the full iteration count (seeds the
+                # warm carry — the scan trainer's schedule exactly)
+                v0 = tree_merge_sharded(
+                    solve_cold(x_steps[0])[0], jnp.float32(1.0), k, topo
+                )
+                state = update(state, v0)
+                (state, _), v_bars = jax.lax.scan(
+                    body, (state, v0), x_steps[1:]
+                )
+                return state, jnp.concatenate([v0[None], v_bars], axis=0)
+
+            return fit
+
+        def fit_cold(state, x_steps):
+            def b(st, x):
+                vs = solve_cold(x)
+                v_bar = tree_merge_sharded(
+                    vs[0], jnp.float32(1.0), k, topo
+                )
+                return update(st, v_bar), v_bar
+
+            return jax.lax.scan(b, state, x_steps)
+
+        return fit_cold
+
+    from distributed_eigenspaces_tpu.parallel.mesh import shard_map
+
+    rep = NamedSharding(mesh, P())
+    # the worker dim of (T, m, n, d) is partitioned JOINTLY by every
+    # tier axis, root-major — worker l lands on its C-order device
+    x_sharding = NamedSharding(mesh, P(None, axis_tuple))
+    extra = (P(),) if masked else ()
+    inner = shard_map(
+        make_fit(),
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_tuple)) + extra,
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    fitted = checked_jit(
+        inner,
+        in_shardings=(rep, x_sharding) + ((rep,) if masked else ()),
+        out_shardings=(rep, rep),
+    )
+    if not masked:
+        return fitted
+
+    def fit_masked_elastic(state, x_steps, masks, membership_masks=None):
+        if membership_masks is not None:
+            masks = jnp.asarray(masks, jnp.float32) * jnp.asarray(
+                membership_masks, jnp.float32
+            )
+        return fitted(state, x_steps, masks)
+
+    return fit_masked_elastic

@@ -101,7 +101,7 @@ EPISODE_KINDS: dict[str, tuple[tuple[str, ...], tuple[str, ...]]] = {
     "tenant_skew": (("qps", "tenants", "zipf_s"), ()),
     "churn": (
         ("workers", "kill_slots", "kill_step"),
-        ("rejoin_step", "steps", "publish"),
+        ("rejoin_step", "steps", "publish", "tier"),
     ),
     "publish": ((), ()),
 }
@@ -221,6 +221,10 @@ def _validate_episode(spec_name: str, i: int, raw: Any) -> Episode:
                 f"{label}: field 'kill_slots' must be a non-empty "
                 f"list of slot ids in [0, {w}), got {ks!r}",
             )
+        tier = params.get("tier")
+        if tier is not None and (not isinstance(tier, str) or not tier):
+            _fail(spec_name, f"{label}: field 'tier' must be a non-"
+                             f"empty tier name, got {tier!r}")
     if kind in _SERVE_LOAD and raw["duration_s"] <= 0:
         _fail(spec_name, f"{label}: field 'duration_s' must be > 0 "
                          f"for load kind '{kind}'")
@@ -228,6 +232,72 @@ def _validate_episode(spec_name: str, i: int, raw: Any) -> Episode:
         name=name, kind=kind, start_s=float(raw["start_s"]),
         duration_s=float(raw["duration_s"]), params=params,
     )
+
+
+def _validate_churn_topology(
+    spec_name: str, episodes: tuple[Episode, ...], config: dict
+) -> None:
+    """Cross-check churn episodes against the spec config's
+    ``merge_topology`` (ISSUE 12): a 'tier' that names no topology tier,
+    a fleet the tree doesn't cover, or kill_slots beyond the tier's
+    member count must all fail AT SPEC-LOAD TIME — not as a trainer
+    build error half-way through a replay."""
+    topo_raw = config.get("merge_topology")
+    tiers: tuple[tuple[str, int], ...] | None = None
+    if topo_raw is not None:
+        try:
+            tiers = tuple((str(n), int(f)) for n, f in topo_raw)
+        except (TypeError, ValueError):
+            _fail(
+                spec_name,
+                f"field 'config.merge_topology' must be a list of "
+                f"[name, fan_in] pairs, got {topo_raw!r}",
+            )
+    names = tuple(n for n, _ in tiers) if tiers else ()
+    for ep in episodes:
+        if ep.kind != "churn":
+            continue
+        label = f"episode '{ep.name}'"
+        w = int(ep.params["workers"])
+        if tiers is not None:
+            product = 1
+            for _, f in tiers:
+                product *= f
+            if product != w:
+                _fail(
+                    spec_name,
+                    f"{label}: field 'workers' ({w}) must equal the "
+                    f"merge_topology fan-in product {product} "
+                    f"({dict(tiers)}) — the tree must cover the "
+                    f"churned fleet exactly",
+                )
+        tier = ep.params.get("tier")
+        if tier is None:
+            continue  # default: leaf worker churn
+        if tiers is None:
+            _fail(
+                spec_name,
+                f"{label}: field 'tier' is {tier!r} but the spec "
+                f"config has no 'merge_topology' — a flat fleet has "
+                f"only the leaf worker tier (omit 'tier')",
+            )
+        if tier not in names:
+            _fail(
+                spec_name,
+                f"{label}: field 'tier' {tier!r} is not a "
+                f"merge_topology tier (have {list(names)})",
+            )
+        members = w
+        for _, f in tiers[: names.index(tier)]:
+            members //= f
+        bad = sorted(s for s in ep.params["kill_slots"] if s >= members)
+        if bad:
+            _fail(
+                spec_name,
+                f"{label}: kill_slots {bad} out of range for tier "
+                f"{tier!r} — it has {members} members (slot ids are "
+                f"TIER-member indices, not worker indices)",
+            )
 
 
 def load_spec(source: Any) -> ScenarioSpec:
@@ -277,6 +347,7 @@ def load_spec(source: Any) -> ScenarioSpec:
     extra = set(raw) - {"name", "seed", "episodes", "config", "slo_p99_ms"}
     if extra:
         _fail(name, f"unknown top-level field(s): {sorted(extra)}")
+    _validate_churn_topology(name, episodes, config)
     return ScenarioSpec(
         name=name, seed=seed, episodes=episodes, config=dict(config),
         slo_p99_ms=float(slo) if slo is not None else None,
@@ -539,16 +610,27 @@ class ScenarioRunner:
     def _churn_thread(self, ep: Episode, spectrum, metrics):
         """One churn episode's background elastic fit: ChurnPlan +
         MembershipTable + ElasticStream — the PR 8 surfaces, reused
-        verbatim. Returns (thread, result holder)."""
+        verbatim. A 'tier' param (ISSUE 12, validated at spec load)
+        re-targets the churn: a non-leaf tier's kills/rejoins drive a
+        TierSet + TieredStream instead of the leaf plan, so the episode
+        exercises the per-tier deadline/quorum path. Returns
+        (thread, result holder)."""
         import jax
 
         from distributed_eigenspaces_tpu.data.stream import block_stream
+        from distributed_eigenspaces_tpu.parallel.topology import (
+            resolve_topology,
+        )
         from distributed_eigenspaces_tpu.runtime.membership import (
             ElasticStream,
             MembershipTable,
         )
         from distributed_eigenspaces_tpu.runtime.supervisor import (
             supervised_fit,
+        )
+        from distributed_eigenspaces_tpu.runtime.tiers import (
+            TierSet,
+            TieredStream,
         )
         from distributed_eigenspaces_tpu.utils.faults import ChurnPlan
 
@@ -576,6 +658,15 @@ class ScenarioRunner:
             min_quorum_frac=cfg.min_quorum_frac, metrics=metrics,
         )
         metrics.attach_membership(table)
+        topo = resolve_topology(cfg)
+        tier = ep.params.get("tier")
+        tier_nonleaf = (
+            topo is not None and tier is not None and tier != topo.names[0]
+        )
+        tiers = (
+            TierSet(topo, cfg, churn={tier: churn}, metrics=metrics)
+            if tier_nonleaf else None
+        )
         holder: dict = {}
 
         def factory(start_row):
@@ -583,10 +674,16 @@ class ScenarioRunner:
                 data, num_workers=m, rows_per_worker=n,
                 start_row=start_row, device=False,
             )
-            return ElasticStream(
-                raw, table, cfg, churn=churn,
+            es = ElasticStream(
+                raw, table, cfg,
+                # a non-leaf tier's churn drives the TierSet, not the
+                # leaf plan — slot ids there are TIER-member indices
+                churn=None if tier_nonleaf else churn,
                 first_step=start_row // (m * n) + 1, metrics=metrics,
             )
+            if tiers is not None:
+                return TieredStream(es, tiers)
+            return es
 
         def work():
             try:

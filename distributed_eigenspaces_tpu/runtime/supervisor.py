@@ -949,12 +949,20 @@ def supervised_fit(
                 # detection loop 4 (ISSUE 8): bounded-time loud quorum
                 # loss → wait for quorum to return (rejoiners admitted
                 # during the wait) → auto-resume under the SAME resume
-                # budget as any other escalation
-                if sup.membership is None:
+                # budget as any other escalation. A TIER quorum loss
+                # (runtime/tiers.py TierQuorumLost) rides the same
+                # loop: the wait runs against the TIER's table (ql
+                # carries it), and the ledger records which tier lost
+                # quorum — but the tier table never becomes the
+                # per-WORKER membership annotator (its slots are tier
+                # members, not workers).
+                tier = getattr(ql, "tier", None)
+                if sup.membership is None and tier is None:
                     sup.membership = ql.table
                 sup.record(
                     "quorum_lost", ql.step, live=ql.live,
                     frac=round(ql.frac, 4), required=ql.required,
+                    **({"tier": tier} if tier is not None else {}),
                 )
                 if ckpt is None:
                     raise SupervisorError(
@@ -980,6 +988,7 @@ def supervised_fit(
                     "quorum_restored", None,
                     live=ql.table.live_count(),
                     frac=round(ql.table.live_frac(), 4),
+                    **({"tier": tier} if tier is not None else {}),
                 )
                 resumes += 1
                 latest = ckpt.latest()

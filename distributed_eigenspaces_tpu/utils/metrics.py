@@ -125,6 +125,10 @@ class MetricsLogger:
         #: live membership table (attach_membership) — its snapshot
         #: (states, generations, quorum) rides the summary
         self.membership_table = None
+        #: hierarchical-merge events (runtime/tiers.py TieredStream /
+        #: TierSet): per-tier round closes, stale folds, tier quorum
+        #: transitions — surfaced by :meth:`summary` under "merge"
+        self.merge_records = RingLog(retention, self._evict_merge)
         #: compile-lifecycle counters (utils/compile_cache.py
         #: CompileCache), attached via :meth:`attach_compile` —
         #: surfaced by :meth:`summary` under "compile"
@@ -166,6 +170,11 @@ class MetricsLogger:
             "deadline_closed": 0, "stale_folds": 0,
             "arrival_hist": {},
         }
+        # hierarchical-merge eviction aggregates (ISSUE 12): event
+        # counts by kind plus PER-TIER round outcomes (fan-in,
+        # deadline closes, stale folds, arrival histogram) — so
+        # summary()["merge"] covers the whole run after eviction
+        self._merge_agg: dict = {"count": 0, "by_kind": {}, "tiers": {}}
 
     @staticmethod
     def _fresh_dispatch_agg() -> dict:
@@ -323,6 +332,17 @@ class MetricsLogger:
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
 
+    def merge(self, event: dict) -> None:
+        """Record one structured hierarchical-merge event (a tier-local
+        round close, stale fold, or tier quorum transition —
+        ``runtime/tiers.py``). Rides the same JSON stream as step
+        records, tagged ``"merge"``."""
+        rec = {"merge": event.get("kind", "unknown"), **event}
+        _stamp(rec)
+        self.merge_records.append(rec)
+        if self.stream is not None:
+            print(json.dumps(rec), file=self.stream, flush=True)
+
     def fault(self, event: dict) -> None:
         """Record one structured fault event (a supervisor detection /
         recovery action). Events ride the same JSON stream as step
@@ -371,6 +391,33 @@ class MetricsLogger:
             key = str(int(arrived))
             hist = agg["arrival_hist"]
             hist[key] = hist.get(key, 0) + 1
+
+    def _evict_merge(self, rec: dict) -> None:
+        agg = self._merge_agg
+        agg["count"] += 1
+        kind = rec.get("merge", "unknown")
+        agg["by_kind"][kind] = agg["by_kind"].get(kind, 0) + 1
+        if kind == "tier_round":
+            self._fold_merge_tier(agg["tiers"], rec)
+
+    @staticmethod
+    def _fold_merge_tier(tiers: dict, rec: dict) -> None:
+        """One tier-round record into the per-tier aggregate — the
+        membership round fold, keyed by tier name (the tree shape is
+        part of the ledger: fan-in rides every record)."""
+        tier = rec.get("tier", "unknown")
+        t = tiers.setdefault(tier, {
+            "fan_in": rec.get("fan_in"), "rounds": 0,
+            "deadline_closed": 0, "stale_folds": 0, "arrival_hist": {},
+        })
+        t["rounds"] += 1
+        if rec.get("deadline_closed"):
+            t["deadline_closed"] += 1
+        t["stale_folds"] += len(rec.get("stale") or ())
+        arrived = rec.get("arrived")
+        if arrived is not None:
+            key = str(int(arrived))
+            t["arrival_hist"][key] = t["arrival_hist"].get(key, 0) + 1
 
     def _evict_serve(self, rec: dict) -> None:
         if rec.get("serve") == "drift":
@@ -528,6 +575,8 @@ class MetricsLogger:
             or self.membership_table is not None
         ):
             out["membership"] = self._membership_summary()
+        if self.merge_records or self._merge_agg["count"]:
+            out["merge"] = self._merge_summary()
         if self.serve_records or self._serve_agg["events"]:
             out["serving"] = self._serving_summary()
         if self.fleet_records or self._fleet_agg["events"]:
@@ -707,6 +756,34 @@ class MetricsLogger:
             out["events_evicted"] = self.membership_records.evicted
         if self.membership_table is not None:
             out["table"] = self.membership_table.snapshot()
+        return out
+
+    def _merge_summary(self) -> dict:
+        """The ``summary()["merge"]`` section (ISSUE 12): hierarchical-
+        merge event counts by kind and the PER-TIER round ledger —
+        fan-in, rounds, tier-deadline closes, one-step-stale folds, and
+        the per-round arrival histogram — plus the retained event
+        window. Evictions are folded in (the membership-section rule),
+        so a long elastic run's tree stays fully accounted."""
+        agg = self._merge_agg
+        by_kind = dict(agg["by_kind"])
+        tiers = {
+            name: {**t, "arrival_hist": dict(t["arrival_hist"])}
+            for name, t in agg["tiers"].items()
+        }
+        for r in self.merge_records:
+            kind = r.get("merge", "unknown")
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+            if kind == "tier_round":
+                self._fold_merge_tier(tiers, r)
+        out: dict = {
+            "events": agg["count"] + len(self.merge_records),
+            "by_kind": by_kind,
+            "tiers": tiers,
+            "recent": list(self.merge_records),
+        }
+        if self.merge_records.evicted:
+            out["events_evicted"] = self.merge_records.evicted
         return out
 
     def _fleet_summary(self) -> dict:

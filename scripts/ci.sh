@@ -58,7 +58,16 @@
 #      loss loud within 2x heartbeat timeout + checkpoint auto-resume,
 #      all gated by the bench itself; compared (churn_recovery_ms
 #      ratio + structural bound) vs the committed BENCH_CHURN_SMOKE_CPU;
-#   9. scripts/scenario.py: the production-shaped scenario replay
+#   9. bench.py --tree: the hierarchical-merge smoke (ISSUE 12) —
+#      the same planted fit flat vs the chip:4 x host:2 tree: both
+#      inside the angle budget and agreeing with each other, the
+#      tiered program passing its tree_merge contract, and the
+#      contract audit's measured per-device collective payloads
+#      strictly below the flat factor-stack gather (the tree's
+#      headline win, reported as the payload-reduction ratio); the
+#      compare gates that structural ratio against the committed
+#      BENCH_TREE_SMOKE_CPU.json (same-topology records only);
+#   10. scripts/scenario.py: the production-shaped scenario replay
 #      (ISSUE 11) — a 3-episode composition (flash crowd + lane kill,
 #      correlated fit-tier churn, mid-burst registry publish) replayed
 #      from scenarios/ci_smoke.json against the full stack, judged
@@ -69,7 +78,7 @@
 #      the committed BENCH_SCENARIO_SMOKE_CPU.json (ratio floors + a
 #      10 s structural recovery bound + a 0.5 absolute attainment
 #      floor, so CPU-rig jitter can't flap CI);
-#   10. scripts/analyze.py --all --mutation-check: the static program-
+#   11. scripts/analyze.py --all --mutation-check: the static program-
 #      contract gate (ISSUE 10, docs/ANALYSIS.md) — every program kind
 #      audited against its declarative contract (collective schedule +
 #      payload bounds, memory policy, baked constants) from compiled
@@ -77,12 +86,12 @@
 #      lints AND the mutation self-tests that prove each violation
 #      class is caught. When ruff is on PATH (not in the pinned CI
 #      image) the lint config in pyproject.toml runs first;
-#   11. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   12. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/11] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/12] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -90,7 +99,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/11] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/12] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -100,7 +109,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/11] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/12] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -115,7 +124,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/11] serve equality + amortization smoke (CPU) =="
+echo "== [4/12] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -130,7 +139,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/11] coldstart + prewarm smoke (CPU) =="
+echo "== [5/12] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -145,7 +154,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [6/11] telemetry smoke: trace export + span-chain validation =="
+echo "== [6/12] telemetry smoke: trace export + span-chain validation =="
 # A serve burst with --trace-out, then a structural validation of the
 # emitted timeline: the JSON must parse as Chrome trace-event format,
 # every served query's span chain (admit → queue_wait → dispatch →
@@ -190,7 +199,7 @@ print(json.dumps({
 }))
 PY
 
-echo "== [7/11] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
+echo "== [7/12] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
 # bench.py --chaos-serve asserts the read-path resilience gates itself
 # (ISSUE 7): a kill -9'd publisher's store recovers (torn snapshot
 # skipped, checksum corruption quarantined) and the restarted server
@@ -209,7 +218,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve
 fi
 
-echo "== [8/11] chaos-churn smoke: elastic membership under churn (CPU) =="
+echo "== [8/12] chaos-churn smoke: elastic membership under churn (CPU) =="
 # bench.py --chaos-churn asserts the fit-tier elastic-membership gates
 # itself (ISSUE 8): a run with 30% mid-run worker loss, flapping
 # rejoins, and a persistent straggler finishes all steps inside the
@@ -229,7 +238,26 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-churn
 fi
 
-echo "== [9/11] scenario replay: production-shaped composition (CPU) =="
+echo "== [9/12] tree-merge smoke: flat vs tiered tree (CPU) =="
+# bench.py --tree asserts the hierarchical-merge gates itself (ISSUE
+# 12): the same planted fit run flat and through the chip:4 x host:2
+# tree must both land inside the angle budget AND agree with each
+# other (the per-tier rank-k truncation is the only numeric
+# difference); the tiered-mesh program must pass its tree_merge
+# contract; and the contract audit's measured per-device payloads
+# must be strictly below the flat factor-stack gather. The compare
+# gates the structural payload-reduction ratio against the committed
+# record (same-topology records only — a cross-topology ratio is a
+# unit error and skips loudly).
+if [[ -f BENCH_TREE_SMOKE_CPU.json ]]; then
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --tree \
+        --compare BENCH_TREE_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --tree
+fi
+
+echo "== [10/12] scenario replay: production-shaped composition (CPU) =="
 # scripts/scenario.py replays scenarios/ci_smoke.json — a flash crowd
 # with a mid-crowd lane kill, correlated fit-tier worker churn, and a
 # mid-burst registry publish on one timeline — and judges it purely
@@ -249,7 +277,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --scenario scenarios/ci_smoke.json
 fi
 
-echo "== [10/11] static analysis: program contracts + lints + mutations =="
+echo "== [11/12] static analysis: program contracts + lints + mutations =="
 # scripts/analyze.py compiles (never runs) the whole program matrix and
 # audits each program against its contract, runs the concurrency /
 # host-sync AST lints over the threaded runtime, and proves the gate
@@ -262,7 +290,7 @@ if command -v ruff >/dev/null 2>&1; then
 fi
 JAX_PLATFORMS=cpu python scripts/analyze.py --all --mutation-check
 
-echo "== [11/11] graft entry + 8-device sharded dryrun =="
+echo "== [12/12] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

@@ -37,6 +37,7 @@ def test_matrix_covers_every_contract_kind(devices):
         programs.build_program(n).contract
         for n in (
             "scan_solo", "feature_scan", "fleet_b8", "serve_project",
+            "tree_fit",
         )
     }
     assert kinds == set(contracts.CONTRACTS)

@@ -1,0 +1,521 @@
+"""Hierarchical merge topology (ISSUE 12): flat dispatch bit-identity,
+single-tier == flat bitwise, multi-tier within the angle budget,
+sharded tiered-mesh route vs the stacked reference, per-tier elastic
+membership (TierQuorumLost + one-step-stale straggler folds), the
+supervised auto-resume on a tier quorum loss, per-tier merge telemetry,
+and the scenario spec's tier-targeted churn validation."""
+
+import tempfile
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_eigenspaces_tpu.algo.online import OnlineState
+from distributed_eigenspaces_tpu.algo.scan import make_scan_fit
+from distributed_eigenspaces_tpu.algo.step import merge_core
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.stream import block_stream
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.ops.linalg import (
+    principal_angles_degrees,
+)
+from distributed_eigenspaces_tpu.parallel.mesh import make_mesh, shard_map
+from distributed_eigenspaces_tpu.parallel.topology import (
+    MergeTopology,
+    is_tiered_mesh,
+    make_tiered_mesh,
+    make_tree_scan_fit,
+    resolve_topology,
+    tree_merge_sharded,
+    tree_merge_stacked,
+)
+from distributed_eigenspaces_tpu.runtime.membership import (
+    ElasticStream,
+    MembershipTable,
+    QuorumLost,
+)
+from distributed_eigenspaces_tpu.runtime.supervisor import supervised_fit
+from distributed_eigenspaces_tpu.runtime.tiers import (
+    TierQuorumLost,
+    TierSet,
+    TierTable,
+    TieredStream,
+)
+from distributed_eigenspaces_tpu.utils.faults import ChurnPlan
+from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=16, k=2, num_workers=4, rows_per_worker=8, num_steps=6,
+        backend="local", prefetch_depth=0,
+        heartbeat_timeout_ms=100.0, round_deadline_ms=30.0,
+        min_quorum_frac=0.5,
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+def _data(cfg, seed=0):
+    spec = planted_spectrum(
+        cfg.dim, k_planted=cfg.k, gap=20.0, noise=0.01, seed=seed
+    )
+    rows = cfg.num_workers * cfg.rows_per_worker * cfg.num_steps
+    return np.asarray(spec.sample(jax.random.PRNGKey(seed + 1), rows)), spec
+
+
+def _x_steps(cfg, data):
+    T, m, n = cfg.num_steps, cfg.num_workers, cfg.rows_per_worker
+    return jnp.asarray(data.reshape(T, m, n, cfg.dim))
+
+
+def _max_angle(a, b):
+    return float(jnp.max(principal_angles_degrees(a, b)))
+
+
+# -- resolution + config validation ------------------------------------------
+
+
+class TestResolveTopology:
+    def test_flat_none_resolves_none(self):
+        assert resolve_topology(_cfg()) is None
+
+    def test_fan_in_product_must_cover_fleet(self):
+        cfg = _cfg(num_workers=4, merge_topology=(("chip", 2), ("host", 4)))
+        with pytest.raises(ValueError, match="multiply to"):
+            resolve_topology(cfg)
+
+    def test_fan_in_must_divide_dim(self):
+        cfg = _cfg(dim=15, num_workers=4,
+                   merge_topology=(("chip", 2), ("host", 2)))
+        with pytest.raises(ValueError, match="divide"):
+            resolve_topology(cfg)
+
+    def test_member_count_and_group_of(self):
+        topo = MergeTopology((("chip", 4), ("host", 2)))
+        assert topo.num_workers == 8
+        assert topo.member_count(0) == 8  # leaf: every worker
+        assert topo.member_count(1) == 2  # hosts entering the host tier
+        # leaf groups are contiguous C-order ranges
+        assert [topo.group_of(0, w) for w in range(8)] == \
+            [0, 0, 0, 0, 1, 1, 1, 1]
+        assert [topo.group_of(1, w) for w in range(8)] == [0] * 8
+
+    def test_config_rejects_pipeline_merge_combo(self):
+        with pytest.raises(ValueError, match="pipeline_merge"):
+            _cfg(merge_topology=(("chip", 2), ("host", 2)),
+                 pipeline_merge=True, solver="subspace")
+
+    def test_config_rejects_feature_sharded(self):
+        with pytest.raises(ValueError, match="feature_sharded"):
+            _cfg(merge_topology=(("chip", 2), ("host", 2)),
+                 backend="feature_sharded")
+
+    def test_config_normalizes_to_tuple(self):
+        cfg = _cfg(merge_topology=[["chip", 2], ["host", 2]])
+        assert cfg.merge_topology == (("chip", 2), ("host", 2))
+
+
+# -- stacked tree route ------------------------------------------------------
+
+
+class TestStackedTree:
+    def test_single_tier_bitwise_flat(self, rng):
+        vs = jnp.asarray(rng.standard_normal((4, 16, 2)).astype(np.float32))
+        topo = MergeTopology((("workers", 4),))
+        flat = merge_core(vs, 2)
+        tree = merge_core(vs, 2, topology=topo)
+        np.testing.assert_array_equal(np.asarray(flat), np.asarray(tree))
+        mask = jnp.asarray([1.0, 0.0, 1.0, 1.0])
+        np.testing.assert_array_equal(
+            np.asarray(merge_core(vs, 2, mask=mask)),
+            np.asarray(merge_core(vs, 2, mask=mask, topology=topo)),
+        )
+
+    def test_single_tier_scan_fit_bitwise_flat(self):
+        cfg_flat = _cfg(merge_topology=None)
+        cfg_tree = _cfg(merge_topology=(("workers", 4),))
+        data, _ = _data(cfg_flat)
+        x = _x_steps(cfg_flat, data)
+        st_f, v_f = make_scan_fit(cfg_flat)(
+            OnlineState.initial(cfg_flat.dim), x
+        )
+        st_t, v_t = make_scan_fit(cfg_tree)(
+            OnlineState.initial(cfg_tree.dim), x
+        )
+        np.testing.assert_array_equal(np.asarray(v_f), np.asarray(v_t))
+        np.testing.assert_array_equal(
+            np.asarray(st_f.sigma_tilde), np.asarray(st_t.sigma_tilde)
+        )
+
+    def test_two_tier_within_angle_budget_of_flat(self):
+        cfg_flat = _cfg(dim=32, num_steps=8)
+        cfg_tree = _cfg(dim=32, num_steps=8,
+                        merge_topology=(("chip", 2), ("host", 2)))
+        data, spec = _data(cfg_flat)
+        x = _x_steps(cfg_flat, data)
+        _, v_f = make_scan_fit(cfg_flat)(OnlineState.initial(32), x)
+        _, v_t = make_scan_fit(cfg_tree)(OnlineState.initial(32), x)
+        w_f, w_t = v_f[-1], v_t[-1]
+        planted = spec.top_k(cfg_flat.k)
+        # tier truncation is the only numeric difference: the tree
+        # tracks the flat basis far tighter than either tracks truth
+        assert _max_angle(w_f, w_t) <= 0.5
+        assert _max_angle(w_f, planted) <= 2.5
+        assert _max_angle(w_t, planted) <= 2.5
+
+    def test_masked_dead_group_contributes_nothing(self, rng):
+        # a fully-masked leaf group merges to weight zero: the root
+        # result is bitwise invariant to WHAT the dead group held
+        topo = MergeTopology((("chip", 2), ("host", 2)))
+        vs = rng.standard_normal((4, 16, 2)).astype(np.float32)
+        mask = jnp.asarray([1.0, 1.0, 0.0, 0.0])  # host 1's whole group
+        a = tree_merge_stacked(jnp.asarray(vs), 2, topo, mask=mask)
+        vs2 = vs.copy()
+        vs2[2:] = rng.standard_normal((2, 16, 2)).astype(np.float32)
+        b = tree_merge_stacked(jnp.asarray(vs2), 2, topo, mask=mask)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_stack_size_mismatch_raises(self, rng):
+        topo = MergeTopology((("chip", 2), ("host", 2)))
+        vs = jnp.asarray(rng.standard_normal((6, 16, 2)).astype(np.float32))
+        with pytest.raises(ValueError, match="covers"):
+            tree_merge_stacked(vs, 2, topo)
+
+
+# -- sharded tiered-mesh route -----------------------------------------------
+
+
+class TestShardedRoute:
+    def test_tiered_mesh_axes_root_major(self):
+        topo = MergeTopology((("chip", 2), ("host", 2)))
+        mesh = make_tiered_mesh(topo)
+        assert tuple(mesh.axis_names) == ("host", "chip")
+        assert is_tiered_mesh(mesh, topo)
+        assert not is_tiered_mesh(make_mesh(num_workers=4), topo)
+        assert not is_tiered_mesh(None, topo)
+        assert not is_tiered_mesh(mesh, None)
+
+    def test_sharded_matches_stacked_reference(self, rng):
+        topo = MergeTopology((("chip", 2), ("host", 2)))
+        mesh = make_tiered_mesh(topo)
+        vs = jnp.asarray(rng.standard_normal((4, 16, 2)).astype(np.float32))
+        ref = tree_merge_stacked(vs, 2, topo)
+
+        def shard_fn(v):  # (1, d, k): this device's leaf basis
+            return tree_merge_sharded(v[0], jnp.float32(1.0), 2, topo)
+
+        sharded = jax.jit(shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=P(("host", "chip")), out_specs=P(),
+            check_vma=False,
+        ))(vs)
+        assert _max_angle(ref, sharded) <= 0.1
+
+    def test_tree_scan_fit_matches_stacked_route(self):
+        cfg = _cfg(dim=16, num_steps=6,
+                   merge_topology=(("chip", 2), ("host", 2)))
+        data, spec = _data(cfg)
+        x = _x_steps(cfg, data)
+        st_s, v_s = make_scan_fit(cfg)(OnlineState.initial(cfg.dim), x)
+        topo = resolve_topology(cfg)
+        fit_mesh = make_scan_fit(cfg, mesh=make_tiered_mesh(topo))
+        st_m, v_m = fit_mesh(OnlineState.initial(cfg.dim), x)
+        assert int(st_m.step) == cfg.num_steps
+        assert _max_angle(v_s[-1], v_m[-1]) <= 0.2
+        assert _max_angle(v_m[-1], spec.top_k(cfg.k)) <= 1.5
+
+    def test_tree_scan_fit_rejections(self):
+        cfg = _cfg(merge_topology=(("chip", 2), ("host", 2)))
+        topo = resolve_topology(cfg)
+        mesh = make_tiered_mesh(topo)
+        with pytest.raises(ValueError, match="merge_topology"):
+            make_tree_scan_fit(_cfg(), mesh)
+        with pytest.raises(ValueError, match="make_tiered_mesh"):
+            make_tree_scan_fit(cfg, make_mesh(num_workers=4))
+        cfg_iv = _cfg(merge_topology=(("chip", 2), ("host", 2)),
+                      merge_interval=2)
+        with pytest.raises(ValueError, match="merge_interval"):
+            make_tree_scan_fit(cfg_iv, mesh)
+
+
+# -- per-tier elastic membership ---------------------------------------------
+
+
+def _tierset(cfg=None, churn=None, metrics=None):
+    cfg = cfg or _cfg()
+    topo = MergeTopology((("w", 2), ("host", 2)))
+    t = [0.0]
+    slept = []
+    ts = TierSet(
+        topo, cfg, churn=churn, metrics=metrics,
+        clock=lambda: t[0], sleep=slept.append,
+    )
+    return ts, t, slept
+
+
+class TestTierMembership:
+    def test_tier_table_events_carry_tier(self):
+        metrics = MetricsLogger()
+        tab = TierTable(2, tier="host", heartbeat_timeout_ms=100.0,
+                        min_quorum_frac=0.5, metrics=metrics)
+        tab.leave(0)
+        recs = [r for r in metrics.membership_records]
+        assert recs and all(r.get("tier") == "host" for r in recs)
+
+    def test_tier_quorum_lost_subclasses_and_names_tier(self):
+        t = [0.0]
+        tab = TierTable(2, tier="host", heartbeat_timeout_ms=100.0,
+                        min_quorum_frac=0.5, clock=lambda: t[0])
+        t[0] = 0.5  # both leases long expired: suspect, then dead
+        tab.sweep()
+        t[0] = 1.0
+        with pytest.raises(TierQuorumLost, match="tier 'host'") as ei:
+            tab.begin_round(3)
+        assert isinstance(ei.value, QuorumLost)
+        assert ei.value.tier == "host"
+        assert ei.value.table is tab
+
+    def test_churn_must_target_known_nonleaf_tier(self):
+        with pytest.raises(ValueError, match="non-leaf"):
+            _tierset(churn={"pod": ChurnPlan(kill_at={2: [0]})})
+        with pytest.raises(ValueError, match="non-leaf"):
+            # the leaf tier's churn rides the worker ElasticStream
+            _tierset(churn={"w": ChurnPlan(kill_at={2: [0]})})
+
+    def test_straggler_folds_one_step_stale(self):
+        metrics = MetricsLogger()
+        ts, _, _ = _tierset(
+            churn={"host": ChurnPlan(straggle={2: {1: 10.0}})},
+            metrics=metrics,
+        )
+        r1 = ts.begin_round(1)["host"]
+        assert r1["effective"].tolist() == [1.0, 1.0]
+        r2 = ts.begin_round(2)["host"]  # host 1 misses the deadline
+        assert r2["late"] == [1]
+        assert r2["effective"].tolist() == [1.0, 0.0]
+        assert r2["deadline_closed"]
+        r3 = ts.begin_round(3)["host"]  # held rows fold, one-step-stale
+        assert r3["stale"] == [1]
+        assert r3["effective"].tolist() == [1.0, 1.0]
+        merge = metrics.summary()["merge"]
+        host = merge["tiers"]["host"]
+        assert host["fan_in"] == 2
+        assert host["rounds"] == 3
+        assert host["deadline_closed"] == 1
+        assert host["stale_folds"] == 1
+        assert host["arrival_hist"] == {"2": 2, "1": 1}
+        assert merge["by_kind"]["tier_round"] == 3
+
+    def test_tier_quorum_lost_raised_per_tier(self):
+        ts, t, _ = _tierset(
+            churn={"host": ChurnPlan(kill_at={2: [0, 1]})},
+        )
+        ts.begin_round(1)
+        ts.begin_round(2)  # crash: heartbeats stop, leases still warm
+        t[0] = 0.5  # past lease + grace: both hosts dead at the sweep
+        with pytest.raises(TierQuorumLost) as ei:
+            ts.begin_round(3)
+        assert ei.value.tier == "host"
+        assert ei.value.table is ts.tables["host"]
+
+    def test_replay_respects_durable_table(self):
+        ts, _, _ = _tierset(
+            churn={"host": ChurnPlan(kill_at={2: [0]})},
+        )
+        # the table says slot 0 is live (e.g. it rejoined before the
+        # resume): the churn replay must not re-crash it
+        ts._held["host"].add(1)
+        ts.replay(first_step=4)
+        assert ts._sim_dead["host"] == set()
+        assert ts._held["host"] == set()  # holds die with the restart
+
+
+# -- tiered stream composition -----------------------------------------------
+
+
+class TestTieredStream:
+    def _stream(self, T=4, churn=None):
+        cfg = _cfg(num_workers=4, num_steps=T)
+        topo = MergeTopology((("w", 2), ("host", 2)))
+        # block[t][w] row-filled with 10*t + w: splices are visible
+        blocks = [
+            np.stack([
+                np.full((2, 3), 10.0 * t + w, np.float32)
+                for w in range(4)
+            ])
+            for t in range(1, T + 1)
+        ]
+        table = MembershipTable(
+            4, heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+            min_quorum_frac=cfg.min_quorum_frac,
+        )
+        es = ElasticStream(
+            iter(blocks), table, cfg, sleep=lambda s: None,
+        )
+        tiers = TierSet(
+            topo, cfg, churn=churn, sleep=lambda s: None,
+        )
+        return TieredStream(es, tiers), blocks
+
+    def test_no_churn_passthrough(self):
+        ts, blocks = self._stream(T=2)
+        feed = ts.membership_masks()
+        for t in range(2):
+            np.testing.assert_array_equal(np.asarray(next(ts)), blocks[t])
+            assert next(feed).tolist() == [1.0] * 4
+
+    def test_late_host_masked_then_spliced_stale(self):
+        ts, blocks = self._stream(
+            T=3, churn={"host": ChurnPlan(straggle={2: {1: 10.0}})}
+        )
+        feed = ts.membership_masks()
+        b1 = np.asarray(next(ts))
+        np.testing.assert_array_equal(b1, blocks[0])
+        assert next(feed).tolist() == [1.0] * 4
+        # round 2: host 1 (workers 2, 3) misses the tier deadline —
+        # its fresh rows are held and its workers weighted 0
+        b2 = np.asarray(next(ts))
+        np.testing.assert_array_equal(b2, blocks[1])
+        assert next(feed).tolist() == [1.0, 1.0, 0.0, 0.0]
+        # round 3: the held round-2 group rows fold one-step-stale
+        b3 = np.asarray(next(ts))
+        np.testing.assert_array_equal(b3[:2], blocks[2][:2])
+        np.testing.assert_array_equal(b3[2:], blocks[1][2:])
+        assert next(feed).tolist() == [1.0] * 4
+
+    def test_leaf_table_is_the_supervisor_table(self):
+        ts, _ = self._stream(T=2)
+        assert isinstance(ts.table, MembershipTable)
+        assert not isinstance(ts.table, TierTable)
+
+
+# -- supervised auto-resume on a tier quorum loss ----------------------------
+
+
+class TestSupervisedTierQuorum:
+    def test_host_tier_quorum_loss_auto_resumes(self):
+        cfg = _cfg(num_workers=4, num_steps=8,
+                   merge_topology=(("w", 2), ("host", 2)))
+        data, _ = _data(cfg)
+        metrics = MetricsLogger()
+        table = MembershipTable(
+            4, heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+            min_quorum_frac=cfg.min_quorum_frac, metrics=metrics,
+        )
+        topo = resolve_topology(cfg)
+        tiers = TierSet(
+            topo, cfg,
+            churn={"host": ChurnPlan(kill_at={3: [0, 1]})},
+            metrics=metrics,
+        )
+        host_tab = tiers.tables["host"]
+        rows_per_step = cfg.num_workers * cfg.rows_per_worker
+
+        def factory(start_row):
+            raw = block_stream(
+                data, num_workers=cfg.num_workers,
+                rows_per_worker=cfg.rows_per_worker,
+                start_row=start_row, device=False,
+            )
+            es = ElasticStream(
+                raw, table, cfg,
+                first_step=start_row // rows_per_step + 1,
+                metrics=metrics,
+            )
+            return TieredStream(es, tiers)
+
+        done = threading.Event()
+
+        def rejoiner():
+            deadline = time.monotonic() + 20.0
+            while not done.is_set() and time.monotonic() < deadline:
+                host_tab.sweep()
+                for s in range(host_tab.num_workers):
+                    if host_tab.state(s) == "dead":
+                        tiers._sim_dead["host"].discard(s)
+                        host_tab.join(s)
+                time.sleep(0.01)
+
+        threading.Thread(target=rejoiner, daemon=True).start()
+        try:
+            with tempfile.TemporaryDirectory() as ck:
+                w, st, sup = supervised_fit(
+                    factory, cfg, metrics=metrics, membership=table,
+                    checkpoint_dir=ck,
+                )
+        finally:
+            done.set()
+        assert int(st.step) == cfg.num_steps
+        kinds = sup.ledger.by_kind
+        assert kinds.get("quorum_lost", 0) >= 1
+        assert kinds.get("quorum_restored", 0) >= 1
+        assert kinds.get("resume", 0) >= 1
+        lost = [e for e in sup.ledger.events if e["kind"] == "quorum_lost"]
+        restored = [
+            e for e in sup.ledger.events if e["kind"] == "quorum_restored"
+        ]
+        assert all(e["tier"] == "host" for e in lost + restored)
+        # the LEAF fleet never lost quorum and stays the per-worker
+        # ledger annotator — the tier table never takes its place
+        assert sup.membership is table
+
+
+# -- scenario spec: tier-targeted churn validation ---------------------------
+
+
+def _scenario(config=None, **churn_over):
+    ep = {
+        "name": "c", "kind": "churn", "start_s": 0.0,
+        "duration_s": 1.0, "workers": 4, "kill_slots": [1],
+        "kill_step": 2,
+    }
+    ep.update(churn_over)
+    d = {"name": "unit", "seed": 3, "episodes": [ep]}
+    if config is not None:
+        d["config"] = config
+    return d
+
+
+class TestScenarioTierValidation:
+    def test_tier_without_topology_fails_at_load(self):
+        from distributed_eigenspaces_tpu.runtime.scenario import load_spec
+        with pytest.raises(ValueError, match="flat fleet"):
+            load_spec(_scenario(tier="host"))
+
+    def test_unknown_tier_fails_at_load(self):
+        from distributed_eigenspaces_tpu.runtime.scenario import load_spec
+        cfg = {"merge_topology": [["w", 2], ["host", 2]]}
+        with pytest.raises(ValueError, match="not a merge_topology tier"):
+            load_spec(_scenario(config=cfg, tier="pod"))
+
+    def test_workers_must_match_fan_in_product(self):
+        from distributed_eigenspaces_tpu.runtime.scenario import load_spec
+        cfg = {"merge_topology": [["w", 2], ["host", 2]]}
+        with pytest.raises(ValueError, match="fan-in product"):
+            load_spec(_scenario(config=cfg, workers=8, tier="host"))
+
+    def test_kill_slots_are_tier_member_indices(self):
+        from distributed_eigenspaces_tpu.runtime.scenario import load_spec
+        cfg = {"merge_topology": [["w", 2], ["host", 2]]}
+        with pytest.raises(ValueError, match="TIER-member"):
+            load_spec(_scenario(config=cfg, tier="host",
+                                kill_slots=[2]))
+
+    def test_malformed_topology_fails_loudly(self):
+        from distributed_eigenspaces_tpu.runtime.scenario import load_spec
+        cfg = {"merge_topology": "chip:4"}
+        with pytest.raises(ValueError, match=r"\[name, fan_in\] pairs"):
+            load_spec(_scenario(config=cfg))
+
+    def test_valid_tier_churn_loads(self):
+        from distributed_eigenspaces_tpu.runtime.scenario import load_spec
+        cfg = {"merge_topology": [["w", 2], ["host", 2]]}
+        spec = load_spec(_scenario(config=cfg, tier="host",
+                                   kill_slots=[1]))
+        assert spec.episodes[0].params["tier"] == "host"
