@@ -18,7 +18,9 @@ ARG JAX_EXTRA=""
 WORKDIR /opt/det
 COPY pyproject.toml README.md ./
 COPY distributed_eigenspaces_tpu ./distributed_eigenspaces_tpu
-RUN pip install --no-cache-dir . \
+# .[dev] pulls ruff so the image's scripts/ci.sh lint stage actually
+# runs instead of skipping on `command -v ruff` (ISSUE 13 satellite)
+RUN pip install --no-cache-dir ".[dev]" \
     && if [ -n "$JAX_EXTRA" ]; then \
          pip install --no-cache-dir "jax[$JAX_EXTRA]"; fi
 

@@ -2552,6 +2552,7 @@ def compare_reports(old_path: str, result: dict,
         # metric name stays the contract — and a record whose attached
         # contract audit failed is surfaced even when every throughput
         # ratio passes.
+        schemas = {}
         for side, rep in (("old", old), ("new", result)):
             ana = rep.get("analysis")
             if isinstance(ana, dict):
@@ -2560,6 +2561,21 @@ def compare_reports(old_path: str, result: dict,
                     "n_violations": ana.get("n_violations"),
                     "programs": sorted(ana.get("programs") or {}),
                 }
+                if ana.get("schema"):
+                    schemas[side] = ana["schema"]
+        if len(set(schemas.values())) > 1:
+            # analysis-v1 vs analysis-v2 (ISSUE 13): the condensed
+            # verdict above uses only the stable v1 keys, so the
+            # compare proceeds — but the mismatch is surfaced LOUDLY
+            # so nobody diffs a v2-only section (shardings/costs)
+            # against a record that never carried it.
+            verdict["analysis_schema_note"] = (
+                "analysis schema mismatch (old={old}, new={new}): "
+                "v2-only sections (shardings/costs) NOT compared; "
+                "verdict uses the stable v1 keys only".format(
+                    old=schemas.get("old"), new=schemas.get("new")
+                )
+            )
     print(json.dumps(verdict), file=sys.stderr)
     return 1 if verdict["regression"] else 0
 

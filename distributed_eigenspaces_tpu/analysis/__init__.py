@@ -1,9 +1,11 @@
-"""Static program-contract analysis (ISSUE 10).
+"""Static program-contract analysis (ISSUE 10; sharding contracts +
+cost model added in ISSUE 13).
 
 Audits every program kind the system compiles — solo step/scan,
-masked/pipelined scan, feature-sharded cores, fleet vmapped fit, serve
-transform — against declarative **program contracts**, without
-executing them, plus AST lints over the threaded runtime. Four passes:
+masked/pipelined scan, feature-sharded cores, tiered tree merges,
+fleet vmapped fit, serve transform — against declarative **program
+contracts**, without executing them, plus AST lints over the threaded
+runtime. Six passes:
 
 1. **collective-schedule contracts** (:mod:`.contracts` over
    :mod:`.hlo`): per-program expected collective op kinds and payload
@@ -23,17 +25,31 @@ executing them, plus AST lints over the threaded runtime. Four passes:
 4. **concurrency lints** (:mod:`.ast_lints`): the repo's lock
    discipline over the threaded runtime — single lock order, no
    blocking calls while holding a lock, shared mutable attributes
-   touched only under their documented lock.
+   touched only under their documented lock;
+5. **sharding contracts** (:mod:`.shardings`): declared PartitionSpecs
+   for each program's d-carrying buffers, checked against the
+   compiled executable's actual input/output shardings and the HLO
+   annotations — SILENT REPLICATION of a contract-sharded buffer (the
+   partitioner quietly all-gathering a ``(d, k)`` basis) fails with
+   program + buffer shape + HLO location named;
+6. **analytic cost model** (:mod:`.costmodel`): per-program FLOPs,
+   HBM bytes, and per-mesh-axis collective bytes x hop counts parsed
+   from compiled HLO, matched against closed-form models in
+   ``ProgramParams``, budget-enforced per op, and diff-gated against
+   the committed ``ANALYSIS_COSTS.json`` snapshot — the quantitative
+   gate the d-ceiling work (ROADMAP: d >= 32k) plans against.
 
-``scripts/analyze.py`` drives all four over the config matrix and the
+``scripts/analyze.py`` drives all six over the config matrix and the
 gate is self-testing: :mod:`.mutations` seeds one violation per class
-(a dense ``psum``, a ``d x d`` temp, a baked-in constant, a blocking
-call under lock, …) and requires the checker to catch each one.
+(a dense ``psum``, a ``d x d`` temp, a baked-in constant, a replicated
+``(d, k)`` basis, a tree tier over its byte budget, a blocking call
+under lock, …) and requires the checker to catch each one.
 
 The package ``__init__`` stays lazy: :mod:`.hlo` and the lint modules
 are import-cheap, but :mod:`.programs` pulls the trainer builders —
-resolved on first attribute access so the ``utils/collectives_audit``
-back-compat shim can import :mod:`.hlo` without dragging the world in.
+resolved on first attribute access. The old
+``utils/collectives_audit`` shim (PR 10's back-compat path) is
+RETIRED (ISSUE 13); its public names resolve here and in :mod:`.hlo`.
 """
 
 from __future__ import annotations
@@ -46,16 +62,36 @@ _LAZY = {
     "ast_lints": "distributed_eigenspaces_tpu.analysis.ast_lints",
     "report": "distributed_eigenspaces_tpu.analysis.report",
     "mutations": "distributed_eigenspaces_tpu.analysis.mutations",
+    "shardings": "distributed_eigenspaces_tpu.analysis.shardings",
+    "costmodel": "distributed_eigenspaces_tpu.analysis.costmodel",
 }
 
-__all__ = sorted(_LAZY)
+#: the audit's stable entry points, re-exported from :mod:`.hlo` so
+#: callers of the retired ``utils/collectives_audit`` shim migrate to
+#: ``from distributed_eigenspaces_tpu import analysis`` one-for-one
+_HLO_API = (
+    "AuditParseError",
+    "CollectiveOp",
+    "assert_no_dense_collective",
+    "audit_compiled",
+    "ici_step_model",
+    "parse_collectives",
+    "scaling_projection",
+)
+
+__all__ = sorted(_LAZY) + sorted(_HLO_API)
 
 
 def __getattr__(name: str):
-    if name in _LAZY:
-        import importlib
+    import importlib
 
+    if name in _LAZY:
         mod = importlib.import_module(_LAZY[name])
         globals()[name] = mod
         return mod
+    if name in _HLO_API:
+        mod = importlib.import_module(_LAZY["hlo"])
+        obj = getattr(mod, name)
+        globals()[name] = obj
+        return obj
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -39,6 +39,11 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from distributed_eigenspaces_tpu.analysis import hlo as _hlo
+from distributed_eigenspaces_tpu.analysis.shardings import (
+    WILD,
+    DeclaredBuffer,
+    ShardingContract,
+)
 
 
 @dataclass(frozen=True)
@@ -58,6 +63,10 @@ class ProgramParams:
     #: merge-tree fan-ins leaf->root (tree_merge programs only): the
     #: tier-local Gram psum is (f*k)^2 per tier
     tier_fan_ins: tuple[int, ...] = ()
+    #: merge-tree tier AXIS NAMES leaf->root — the mesh axes the
+    #: sharding contract requires the tree's inputs sharded over and
+    #: the cost model attributes per-tier wire bytes to
+    tier_axes: tuple[str, ...] = ()
 
     @property
     def d_local(self) -> int:
@@ -108,6 +117,11 @@ class ProgramContract:
     max_const_elems: Callable[[ProgramParams], int] = field(
         default=lambda p: p.d
     )
+    #: declared PartitionSpecs (ISSUE 13): which buffers must be
+    #: sharded over which mesh axes — the silent-replication gate.
+    #: None = no sharding contract (checked programs without one are
+    #: skipped with a named reason, never passed vacuously)
+    sharding: ShardingContract | None = None
 
 
 def _factor_stack(p: ProgramParams) -> int:
@@ -145,6 +159,18 @@ CONTRACTS: dict[str, ProgramContract] = {
         max_payload_elems=_factor_stack,
         require_collectives=True,
         memory_policy="dense_state",
+        sharding=ShardingContract(buffers=(
+            DeclaredBuffer(
+                "step blocks", "in",
+                dims=lambda p: (WILD, p.m, p.n, p.d),
+                spec=lambda p: (None, "workers", None, None),
+            ),
+            DeclaredBuffer(
+                "carried state", "in",
+                dims=lambda p: (p.d, p.d),
+                spec=lambda p: (None, None),
+            ),
+        )),
     ),
     "feature_sharded": ProgramContract(
         name="feature_sharded",
@@ -158,6 +184,28 @@ CONTRACTS: dict[str, ProgramContract] = {
         max_payload_elems=_factor_stack,
         require_collectives=True,
         memory_policy="factor_only",
+        sharding=ShardingContract(
+            buffers=(
+                DeclaredBuffer(
+                    "feature-sharded basis", "in",
+                    dims=lambda p: (p.d, WILD),
+                    spec=lambda p: ("features", None),
+                ),
+                DeclaredBuffer(
+                    "feature blocks", "in",
+                    dims=lambda p: (WILD, p.m, p.n, p.d),
+                    spec=lambda p: (None, "workers", None, "features"),
+                ),
+                DeclaredBuffer(
+                    "feature-sharded basis", "out",
+                    dims=lambda p: (p.d, WILD),
+                    spec=lambda p: ("features", None),
+                ),
+            ),
+            # THE d-ceiling rule: no device may hold a full-d buffer
+            # with >= 2 companion elements — an un-sharded (d, k)
+            replicated_axis_floor=lambda p: p.d,
+        ),
     ),
     "tree_merge": ProgramContract(
         name="tree_merge",
@@ -175,6 +223,20 @@ CONTRACTS: dict[str, ProgramContract] = {
         max_payload_elems=_tree_bound,
         require_collectives=True,
         memory_policy="dense_state",
+        sharding=ShardingContract(buffers=(
+            DeclaredBuffer(
+                "step blocks", "in",
+                dims=lambda p: (WILD, p.m, p.n, p.d),
+                # the worker dim factors over ALL tier axes (root-major
+                # mesh; compared as a set)
+                spec=lambda p: (None, p.tier_axes, None, None),
+            ),
+            DeclaredBuffer(
+                "carried state", "in",
+                dims=lambda p: (p.d, p.d),
+                spec=lambda p: (None, None),
+            ),
+        )),
     ),
     "fleet_fit": ProgramContract(
         name="fleet_fit",
@@ -185,6 +247,28 @@ CONTRACTS: dict[str, ProgramContract] = {
         ),
         allowed_collectives=frozenset(),
         memory_policy="dense_state",
+        sharding=ShardingContract(buffers=(
+            DeclaredBuffer(
+                "tenant blocks", "in",
+                dims=lambda p: (p.B, WILD, WILD, WILD, p.d),
+                spec=lambda p: ("workers", None, None, None, None),
+            ),
+            DeclaredBuffer(
+                "tenant state", "in",
+                dims=lambda p: (p.B, p.d, p.d),
+                spec=lambda p: ("workers", None, None),
+            ),
+            DeclaredBuffer(
+                "tenant state", "out",
+                dims=lambda p: (p.B, p.d, p.d),
+                spec=lambda p: ("workers", None, None),
+            ),
+            DeclaredBuffer(
+                "tenant basis history", "out",
+                dims=lambda p: (p.B, WILD, p.d, WILD),
+                spec=lambda p: ("workers", None, None, None),
+            ),
+        )),
     ),
     "serve_transform": ProgramContract(
         name="serve_transform",
@@ -196,6 +280,50 @@ CONTRACTS: dict[str, ProgramContract] = {
         allowed_collectives=frozenset(),
         memory_policy="factor_only",
         dense_dim=lambda p: p.d,
+        # serve kernels vary by transform (project takes (rows, d)+
+        # basis, reconstruct takes (rows, k)+basis, residual (rows, d)
+        # +(rows, k)) — every row-indexed buffer that appears must be
+        # workers-sharded, the basis replicated BY DESIGN today (the
+        # distributed-solve PR flips that declaration, and this gate
+        # is what will prove the flip landed end-to-end)
+        sharding=ShardingContract(buffers=(
+            DeclaredBuffer(
+                "row activations", "in",
+                dims=lambda p: (p.rows, p.d),
+                spec=lambda p: ("workers", None),
+                required=False,
+            ),
+            DeclaredBuffer(
+                "row codes", "in",
+                dims=lambda p: (p.rows, WILD),
+                spec=lambda p: ("workers", None),
+                required=False,
+            ),
+            DeclaredBuffer(
+                "replicated basis", "in",
+                dims=lambda p: (p.d, WILD),
+                spec=lambda p: (None, None),
+                required=False,
+            ),
+            DeclaredBuffer(
+                "row outputs", "out",
+                dims=lambda p: (p.rows, WILD),
+                spec=lambda p: ("workers", None),
+                required=False,
+            ),
+            DeclaredBuffer(
+                "reconstructed rows", "out",
+                dims=lambda p: (p.rows, p.d),
+                spec=lambda p: ("workers", None),
+                required=False,
+            ),
+            DeclaredBuffer(
+                "row scalars", "out",
+                dims=lambda p: (p.rows,),
+                spec=lambda p: ("workers",),
+                required=False,
+            ),
+        )),
     ),
 }
 
@@ -434,8 +562,12 @@ def check_consts(
 
 def check_program(built) -> tuple[list[Violation], dict]:
     """All static passes over one :class:`~.programs.BuiltProgram`:
-    collectives + memory + baked constants. Returns
-    ``(violations, metrics)`` — the driver aggregates."""
+    collectives + memory + baked constants + declared shardings +
+    cost-model byte budgets. Returns ``(violations, metrics)`` — the
+    driver aggregates."""
+    from distributed_eigenspaces_tpu.analysis import costmodel
+    from distributed_eigenspaces_tpu.analysis import shardings as _sh
+
     contract = CONTRACTS[built.contract]
     params = built.params
     hlo_text = built.hlo_text()
@@ -457,10 +589,16 @@ def check_program(built) -> tuple[list[Violation], dict]:
         contract, params, jaxpr, program=built.name
     )
     violations += v
+    v, shard = _sh.check_built(built, contract)
+    violations += v
+    v, costs = costmodel.check_built(built)
+    violations += v
     return violations, {
         "contract": contract.name,
         "ok": not violations,
         "collectives": col,
         "memory": mem,
         "consts": const,
+        "shardings": shard,
+        "costs": costs,
     }

@@ -14,10 +14,12 @@ Works on the CPU virtual-device mesh (the partitioner emits the same
 collective ops it would for ICI), so the audit runs in plain pytest,
 inside ``dryrun_multichip``, and as CI stage 9 (``scripts/analyze.py``).
 
-History: lived at ``utils/collectives_audit.py`` through round 9; that
-module is now a back-compat shim over this one, and the per-program
+History: lived at ``utils/collectives_audit.py`` through round 9,
+then behind a deprecation shim through round 12 (shim RETIRED in
+ISSUE 13 — the old path no longer imports); the per-program
 expectations moved from hand-rolled call sites into the contract
-registry (:mod:`.contracts`).
+registry (:mod:`.contracts`), and the public names re-export from the
+``analysis`` package facade.
 """
 
 from __future__ import annotations

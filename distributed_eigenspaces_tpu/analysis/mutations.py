@@ -137,6 +137,92 @@ def _mutant_baked_constant() -> list[contracts.Violation]:
     return viols
 
 
+def _mutant_replicated_dk() -> list[contracts.Violation]:
+    """The distributed-solve regression the sharding contracts exist
+    for (ISSUE 13): a feature-sharded step whose (d, q) basis comes
+    back REPLICATED (the partitioner quietly all-gathers it) despite
+    the contract declaring it sharded over 'features'. The
+    silent-replication rule must name program + buffer shape + the
+    offending HLO location."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_eigenspaces_tpu.analysis import (
+        shardings as sh_mod,
+    )
+    from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(num_workers=4, num_feature_shards=2)
+    d, q = 2 * _D, 2
+    fn = jax.jit(
+        lambda v: 2.0 * v,
+        in_shardings=NamedSharding(mesh, P("features", None)),
+        out_shardings=NamedSharding(mesh, P()),  # the regression
+    )
+    arg = jax.ShapeDtypeStruct((d, q), jnp.float32)
+    compiled = fn.lower(arg).compile()
+    contract = contracts.CONTRACTS["feature_sharded"]
+    params = contracts.ProgramParams(
+        d=d, k=q, m=4, n=8, n_feature_shards=2, n_workers_mesh=4,
+    )
+    viols, _ = sh_mod.check_shardings(
+        contract.sharding, params,
+        program="mutant_replicated_dk",
+        dense_dim=contract.dense_dim(params),
+        in_avals=[arg],
+        in_shardings=jax.tree_util.tree_leaves(
+            compiled.input_shardings
+        ),
+        out_avals=[arg],
+        out_shardings=jax.tree_util.tree_leaves(
+            compiled.output_shardings
+        ),
+        hlo_text=compiled.as_text(),
+    )
+    return viols
+
+
+def _mutant_tree_payload_drift() -> list[contracts.Violation]:
+    """A tree tier moving the flat m-wide factor STACK instead of the
+    merged (d, k) basis — the op kind (all-reduce) is in the tree
+    contract's allowed set, so only the cost model's per-op byte
+    budget can catch the drift."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_eigenspaces_tpu.analysis import costmodel
+    from distributed_eigenspaces_tpu.parallel.mesh import shard_map
+    from distributed_eigenspaces_tpu.parallel.topology import (
+        MergeTopology,
+        make_tiered_mesh,
+    )
+
+    topo = MergeTopology((("chip", 2), ("host", 2)))
+    mesh = make_tiered_mesh(topo)
+
+    def stack_round(vs):  # psum the whole (m, d, k) stack on a tier
+        return jax.lax.psum(vs, "chip")
+
+    f = jax.jit(shard_map(
+        stack_round, mesh=mesh, in_specs=P(), out_specs=P(),
+        check_vma=False,
+    ))
+    hlo = f.lower(
+        jnp.zeros((4, _D, 2), jnp.float32)
+    ).compile().as_text()
+    params = contracts.ProgramParams(
+        d=_D, k=2, m=4, n=8, tier_fan_ins=topo.fan_ins,
+        tier_axes=topo.names,
+    )
+    viols, _ = costmodel.check_cost_bound(
+        "tree_merge", params, hlo,
+        program="mutant_tree_payload_drift",
+    )
+    return viols
+
+
 _FIXTURE_BLOCKING = '''
 import threading, time
 class Worker:
@@ -200,6 +286,10 @@ MUTATIONS: dict[str, tuple[str, Callable[[], list]]] = {
     ),
     "dense_temp": ("dense-buffer", _mutant_dense_temp),
     "baked_constant": ("baked-constant", _mutant_baked_constant),
+    "replicated_dk": ("silent-replication", _mutant_replicated_dk),
+    "tree_payload_drift": (
+        "cost-bound", _mutant_tree_payload_drift
+    ),
     "blocking_under_lock": ("blocking-under-lock", _ast_mutant(
         _FIXTURE_BLOCKING, ast_lints.lint_concurrency_source
     )),

@@ -164,7 +164,7 @@ def _tree_program(name: str, *, masked: bool = False):
             name=name, contract="tree_merge",
             params=ProgramParams(
                 d=_D, k=_K, m=_M, n=_N, T=_T, n_workers_mesh=_M,
-                tier_fan_ins=topo.fan_ins,
+                tier_fan_ins=topo.fan_ins, tier_axes=topo.names,
             ),
             jitted=fit, args=args,
         )

@@ -13,14 +13,19 @@ Three entry points:
   ``summary()["analysis"]`` so a bench record carries the contract
   verdict alongside its latency numbers.
 
-Report schema (``schema: "analysis-v1"``) is additive-friendly: bench
-``--compare`` treats ``analysis`` as a passthrough section, never a
-metric, so pre-PR-10 records compare cleanly against new ones.
+Report schema ``analysis-v2`` (ISSUE 13): per-program entries carry
+``shardings`` (declared-PartitionSpec audit) and ``costs`` (measured
+FLOPs/HBM/per-axis collective bytes + the closed-form byte budget)
+sections alongside the v1 keys. bench ``--compare`` treats
+``analysis`` as a passthrough section, never a metric, so v1 records
+compare cleanly against v2 ones — a schema mismatch is surfaced as a
+loud note, not a crash (tests/test_bench_compare.py pins both
+directions).
 """
 
 from __future__ import annotations
 
-SCHEMA = "analysis-v1"
+SCHEMA = "analysis-v2"
 
 
 def _violations_json(viols) -> list[dict]:
@@ -97,6 +102,9 @@ def engine_report(engine) -> dict:
     (rows, d) activation with rows >= d is legitimately 'dense' by
     shape and proves nothing)."""
     from distributed_eigenspaces_tpu.analysis import contracts
+    from distributed_eigenspaces_tpu.analysis import (
+        shardings as shardings_mod,
+    )
 
     contract = contracts.CONTRACTS["serve_transform"]
     out: dict = {
@@ -116,6 +124,12 @@ def engine_report(engine) -> dict:
             contract, params, hlo, program=name
         )
         entry: dict = {"collectives": col}
+        # live engines expose compiled executables, not traced avals —
+        # the leaf-level sharding audit runs in the program matrix;
+        # here the HLO annotation census keeps the layout visible
+        entry["shardings"] = {
+            "annotations": shardings_mod.parse_hlo_shardings(hlo),
+        }
         if rows < contract.dense_dim(params):
             mv, mem = contracts.check_memory(
                 contract, params, program=name, hlo_text=hlo

@@ -1,0 +1,393 @@
+"""Sharding contracts: declared PartitionSpecs, checked statically.
+
+The ROADMAP's d-ceiling leg (distributed eigensolve, sharded (d, k)
+bases end-to-end) needs the "no d x d buffer" memory contract extended
+to "no un-sharded (d, k) buffer" — auto-partitioned sharding is exactly
+where silent replication hides (arxiv 2004.13336 argues for making the
+update step's sharding EXPLICIT rather than trusting propagation). This
+module is that rule as a first-class contract family:
+
+- each :class:`~.contracts.ProgramContract` declares the
+  PartitionSpecs its inputs/outputs must carry
+  (:class:`DeclaredBuffer` patterns over
+  :class:`~.contracts.ProgramParams` shapes);
+- the checker reads the ACTUAL shardings off the compiled artifact
+  (``compiled.input_shardings`` / ``output_shardings`` zipped against
+  the jaxpr avals) plus the HLO ``sharding={...}`` annotations, and
+  flags **silent replication** — a buffer the contract declares
+  sharded over ``workers``/``features``/a tier axis that the compiled
+  program holds replicated — naming the program, the buffer shape, and
+  the offending HLO location;
+- an intermediate-buffer floor (feature-sharded programs) additionally
+  scans the per-device HLO buffer set: no device may hold a full-d
+  buffer with >= 2 columns — the un-sharded (d, k) intermediate the
+  distributed-solve path must never materialize.
+
+Violations never raise; they aggregate through
+:func:`~.contracts.check_program` like every other pass, so a CI
+failure names program + rule + location from the message alone.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Callable
+
+from distributed_eigenspaces_tpu.analysis import hlo as _hlo
+
+#: dims-pattern wildcard — matches any axis strictly below the
+#: program's dense threshold (so a wildcard can never swallow a d-wide
+#: axis and mis-bind a declared pattern onto the wrong buffer)
+WILD = None
+
+
+@dataclass(frozen=True)
+class DeclaredBuffer:
+    """One declared buffer: a shape PATTERN (ints exact, ``WILD`` =
+    any axis below the dense threshold) plus the PartitionSpec the
+    compiled program must give every leaf the pattern matches.
+
+    ``spec(params)`` entries mirror PartitionSpec: ``None`` =
+    replicated dim, an axis name, or a tuple of axis names (compared
+    as SETS — mesh factorings reorder tier axes freely)."""
+
+    name: str
+    role: str  # "in" | "out"
+    dims: Callable[..., tuple]
+    spec: Callable[..., tuple]
+    #: required patterns that match no leaf are a violation (a stale
+    #: contract is a claim nobody checks); optional ones simply skip
+    required: bool = True
+
+
+@dataclass(frozen=True)
+class ShardingContract:
+    """The sharding half of a program contract."""
+
+    buffers: tuple[DeclaredBuffer, ...]
+    #: per-device HLO buffers with an axis >= this floor AND >= 2
+    #: remaining elements are un-sharded (d, k) intermediates — the
+    #: replication the d-ceiling invariant forbids. None = no
+    #: intermediate rule (dense_state programs legitimately carry d x d)
+    replicated_axis_floor: Callable[..., int] | None = None
+    #: at least one declared-SHARDED buffer must match a leaf, or the
+    #: audit passed vacuously (was the program actually partitioned?)
+    require_some: bool = True
+
+
+# -- actual-sharding extraction ----------------------------------------------
+
+
+def _spec_sets(entries, rank: int) -> tuple[frozenset, ...]:
+    """Normalize PartitionSpec-like entries to per-dim axis-name sets,
+    padded with replicated dims to ``rank``."""
+    out = []
+    for e in list(entries)[:rank]:
+        if e is None:
+            out.append(frozenset())
+        elif isinstance(e, (tuple, list)):
+            out.append(frozenset(str(a) for a in e))
+        else:
+            out.append(frozenset({str(e)}))
+    while len(out) < rank:
+        out.append(frozenset())
+    return tuple(out)
+
+
+def actual_spec_sets(sharding, shape) -> tuple[frozenset, ...] | None:
+    """Per-dim axis-name sets for a compiled leaf's sharding.
+
+    NamedShardings expose ``.spec`` directly. GSPMD shardings carry no
+    axis names — fall back to per-dim partition FACTORS via
+    ``shard_shape`` and mark partitioned dims with the ``"?"``
+    pseudo-axis (sharded-over-something still refutes silent
+    replication). None = the sharding is opaque; the caller skips the
+    leaf rather than guessing."""
+    rank = len(shape)
+    spec = getattr(sharding, "spec", None)
+    if spec is not None:
+        return _spec_sets(tuple(spec), rank)
+    if getattr(sharding, "is_fully_replicated", False):
+        return tuple(frozenset() for _ in range(rank))
+    try:
+        local = sharding.shard_shape(tuple(shape))
+    except Exception:
+        return None
+    return tuple(
+        frozenset({"?"}) if loc != glob else frozenset()
+        for glob, loc in zip(shape, local)
+    )
+
+
+def _fmt_sets(sets) -> str:
+    def one(s):
+        if not s:
+            return "None"
+        return "+".join(sorted(s))
+
+    return "(" + ", ".join(one(s) for s in sets) + ")"
+
+
+def _matches(pattern, shape, wildcard_max: int) -> bool:
+    if len(pattern) != len(shape):
+        return False
+    for want, have in zip(pattern, shape):
+        if want is WILD:
+            if have >= wildcard_max:
+                return False
+        elif have != want:
+            return False
+    return True
+
+
+# -- HLO annotation census ---------------------------------------------------
+
+_ANNOT_RE = re.compile(r"sharding=\{([^{}]*(?:\{[^{}]*\}[^{}]*)*)\}")
+
+
+def parse_hlo_shardings(hlo_text: str) -> dict:
+    """Census of ``sharding={...}`` annotations in a compiled module:
+    how many buffers the partitioner pinned replicated vs device-tiled.
+    Metrics, not a gate — the leaf-level checker is the gate; this
+    number is what makes "the program carries N sharded annotations"
+    visible in ``analyze.py --shardings`` output."""
+    n_rep = n_dev = n_other = 0
+    for m in _ANNOT_RE.finditer(hlo_text):
+        body = m.group(1)
+        if "devices=" in body:
+            n_dev += 1
+        elif "replicated" in body or "maximal" in body:
+            n_rep += 1
+        else:
+            n_other += 1
+    return {
+        "n_annotations": n_rep + n_dev + n_other,
+        "n_replicated": n_rep,
+        "n_device_tiled": n_dev,
+        "n_other": n_other,
+    }
+
+
+def _param_location(hlo_text: str, shape) -> str:
+    """The HLO parameter line holding a buffer of ``shape`` — the
+    offending location a silent-replication message names."""
+    token = "[" + ",".join(str(int(s)) for s in shape) + "]"
+    for line in hlo_text.splitlines():
+        if " parameter(" in line and token in line:
+            return line.strip()
+    return ""
+
+
+# -- the checker -------------------------------------------------------------
+
+
+def check_shardings(
+    scontract: ShardingContract,
+    params,
+    *,
+    program: str,
+    dense_dim: int,
+    in_avals,
+    in_shardings,
+    out_avals,
+    out_shardings,
+    hlo_text: str = "",
+) -> tuple[list, dict]:
+    """The sharding pass: declared PartitionSpecs vs the compiled
+    artifact's actual leaf shardings + per-device HLO buffers.
+
+    ``in_shardings``/``out_shardings`` are FLAT leaf lists aligned
+    with the jaxpr avals (``jax.tree_util.tree_leaves`` of
+    ``compiled.input_shardings``/``output_shardings`` — see
+    :func:`check_built`). Returns ``(violations, metrics)``."""
+    from distributed_eigenspaces_tpu.analysis.contracts import Violation
+
+    viols: list = []
+    detail: list[dict] = []
+    n_sharded_ok = 0
+
+    if len(in_avals) != len(in_shardings) or len(out_avals) != len(
+        out_shardings
+    ):
+        viols.append(Violation(
+            program=program,
+            rule="sharding-contract",
+            message=(
+                f"cannot align jaxpr avals with compiled sharding "
+                f"leaves (in {len(in_avals)} vs {len(in_shardings)}, "
+                f"out {len(out_avals)} vs {len(out_shardings)}) — the "
+                "audit would silently check the wrong buffers"
+            ),
+        ))
+        return viols, {"checked": False, "buffers": detail}
+
+    leaves = [
+        ("in", i, tuple(int(s) for s in getattr(a, "shape", ())), sh)
+        for i, (a, sh) in enumerate(zip(in_avals, in_shardings))
+    ] + [
+        ("out", i, tuple(int(s) for s in getattr(a, "shape", ())), sh)
+        for i, (a, sh) in enumerate(zip(out_avals, out_shardings))
+    ]
+
+    for buf in scontract.buffers:
+        pattern = buf.dims(params)
+        want = _spec_sets(buf.spec(params), len(pattern))
+        matched = 0
+        for role, idx, shape, sharding in leaves:
+            if role != buf.role or not _matches(
+                pattern, shape, dense_dim
+            ):
+                continue
+            matched += 1
+            actual = actual_spec_sets(sharding, shape)
+            row = {
+                "buffer": buf.name,
+                "role": role,
+                "leaf": idx,
+                "shape": list(shape),
+                "declared": _fmt_sets(want),
+                "actual": _fmt_sets(actual) if actual else "<opaque>",
+                "ok": True,
+            }
+            detail.append(row)
+            if actual is None:
+                continue  # opaque sharding: nothing checkable
+            loc = (
+                _param_location(hlo_text, shape) if role == "in"
+                else f"output leaf {idx}"
+            )
+            ok = True
+            for dim, (w, a) in enumerate(zip(want, actual)):
+                if w and not a:
+                    ok = False
+                    viols.append(Violation(
+                        program=program,
+                        rule="silent-replication",
+                        message=(
+                            f"{buf.name} ({role} leaf {idx}, shape "
+                            f"{list(shape)}) is declared sharded over "
+                            f"{sorted(w)} on dim {dim} but the "
+                            "compiled program holds it REPLICATED — "
+                            "an un-sharded (d, k) buffer is exactly "
+                            "the regression the d-ceiling contract "
+                            "forbids"
+                        ),
+                        location=loc,
+                    ))
+                elif not w and a:
+                    ok = False
+                    viols.append(Violation(
+                        program=program,
+                        rule="sharding-contract",
+                        message=(
+                            f"{buf.name} ({role} leaf {idx}, shape "
+                            f"{list(shape)}) is declared replicated "
+                            f"on dim {dim} but compiled sharded over "
+                            f"{sorted(a)} — update the declared "
+                            "PartitionSpec if this layout is "
+                            "intentional"
+                        ),
+                        location=loc,
+                    ))
+                elif w and a and a != {"?"} and w != a:
+                    ok = False
+                    viols.append(Violation(
+                        program=program,
+                        rule="sharding-contract",
+                        message=(
+                            f"{buf.name} ({role} leaf {idx}, shape "
+                            f"{list(shape)}) dim {dim} is sharded "
+                            f"over {sorted(a)} but declared "
+                            f"{sorted(w)}"
+                        ),
+                        location=loc,
+                    ))
+            row["ok"] = ok
+            if ok and any(want):
+                n_sharded_ok += 1
+        if buf.required and matched == 0:
+            viols.append(Violation(
+                program=program,
+                rule="sharding-contract",
+                message=(
+                    f"declared buffer {buf.name!r} (pattern "
+                    f"{list(pattern)}, {buf.role}) matched no "
+                    "compiled leaf — the sharding contract is stale; "
+                    "update the declaration in analysis/contracts.py"
+                ),
+            ))
+
+    if scontract.replicated_axis_floor is not None:
+        floor = scontract.replicated_axis_floor(params)
+        for _dtype, dims, line in _hlo.parse_buffer_shapes(hlo_text):
+            if not dims:
+                continue
+            widest = max(dims)
+            rest = math.prod(dims) // widest
+            if widest >= floor and rest >= 2:
+                viols.append(Violation(
+                    program=program,
+                    rule="silent-replication",
+                    message=(
+                        f"per-device HLO buffer {list(dims)} holds a "
+                        f"full-width axis (>= {floor}) with {rest} "
+                        "companion elements — an un-sharded (d, k) "
+                        "intermediate materialized on one device"
+                    ),
+                    location=line.strip(),
+                ))
+
+    if scontract.require_some and n_sharded_ok == 0 and not viols:
+        viols.append(Violation(
+            program=program,
+            rule="sharding-contract",
+            message=(
+                "no declared-sharded buffer matched any compiled "
+                "leaf — the sharding audit passed vacuously (was the "
+                "program actually partitioned?)"
+            ),
+        ))
+
+    metrics = {
+        "checked": True,
+        "n_declared": len(scontract.buffers),
+        "n_sharded_ok": n_sharded_ok,
+        "buffers": detail,
+        "annotations": parse_hlo_shardings(hlo_text),
+    }
+    return viols, metrics
+
+
+def check_built(built, contract) -> tuple[list, dict]:
+    """The sharding pass over one BuiltProgram: reads the compiled
+    artifact's input/output shardings (zero extra compiles — the
+    contract passes already compiled it). Unsharded programs
+    (``n_workers_mesh <= 1``, e.g. the solo serve transform) are
+    skipped with a named reason rather than checked against specs
+    that assume a mesh."""
+    import jax
+
+    params = built.params
+    scontract = getattr(contract, "sharding", None)
+    if scontract is None:
+        return [], {"checked": False, "reason": "no sharding contract"}
+    if params.n_workers_mesh <= 1:
+        return [], {"checked": False, "reason": "unsharded program"}
+    compiled = built.compiled()
+    jaxpr = built.jaxpr()
+    return check_shardings(
+        scontract, params,
+        program=built.name,
+        dense_dim=contract.dense_dim(params),
+        in_avals=list(jaxpr.in_avals),
+        in_shardings=jax.tree_util.tree_leaves(
+            compiled.input_shardings
+        ),
+        out_avals=list(jaxpr.out_avals),
+        out_shardings=jax.tree_util.tree_leaves(
+            compiled.output_shardings
+        ),
+        hlo_text=built.hlo_text(),
+    )

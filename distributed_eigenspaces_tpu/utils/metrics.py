@@ -261,7 +261,11 @@ class MetricsLogger:
         summary time so late-compiled bucket programs are audited
         too). Lands in ``summary()["analysis"]`` — the run report
         carries the contract verdict alongside the numbers it
-        certifies."""
+        certifies. The attached report self-identifies via its
+        ``schema`` key (``analysis-v2`` adds per-program ``shardings``
+        annotation censuses); bench ``--compare`` condenses only the
+        stable v1 keys, so records from either schema compare and a
+        mismatch is noted, never fatal."""
         self.analysis_report = report
         return self
 

@@ -7,24 +7,35 @@ merge_interval x sharded) against its declarative contract
 (``analysis/contracts.py``) WITHOUT executing anything: collective
 schedule + payload bounds from the SPMD-partitioned HLO, memory-
 footprint (no dense d x d buffer in factor-only programs), baked-in
-jaxpr constants — plus the AST lints (host-sync in jitted paths, lock
-discipline over the threaded runtime).
+jaxpr constants, declared-PartitionSpec sharding contracts (silent
+replication of a contract-sharded (d, k) buffer fails, ISSUE 13), and
+the analytic cost model (per-program FLOPs / HBM bytes / per-mesh-axis
+collective bytes x hops, budget-enforced and snapshot-gated) — plus
+the AST lints (host-sync in jitted paths, lock discipline over the
+threaded runtime).
 
 ``--mutation-check`` additionally runs the self-test: seeded
-violations (a dense psum, a d x d temp, a baked constant, a blocking
-call under a lock, ...) must each be CAUGHT, so the gate can fail in
-both directions.
+violations (a dense psum, a d x d temp, a baked constant, a
+replicated (d, k) basis, a tree tier over its byte budget, ...) must
+each be CAUGHT, so the gate can fail in both directions.
 
 Usage:
-    python scripts/analyze.py --all [--mutation-check] [--json OUT]
+    python scripts/analyze.py --all [--costs] [--shardings] \
+        [--mutation-check] [--json OUT]
+    python scripts/analyze.py --all --costs --write-costs   # commit
     python scripts/analyze.py --programs scan_solo,fleet_b8
     python scripts/analyze.py --lints-only
     python scripts/analyze.py --list
 
+``--costs`` regenerates the analytic snapshot and diff-gates it
+against the committed ``ANALYSIS_COSTS.json`` (regeneration on clean
+HEAD is a no-op; intentional changes re-commit via ``--write-costs``).
+
 Exit code 0 iff every audited program honors its contract, the lints
-are clean, and (with ``--mutation-check``) every seeded violation was
-caught. Runs on the CPU rig: the 8-virtual-device mesh drives the same
-SPMD partitioner a TPU pod would.
+are clean, the snapshot has no drift, and (with ``--mutation-check``)
+every seeded violation was caught. Runs on the CPU rig: the
+8-virtual-device mesh drives the same SPMD partitioner a TPU pod
+would.
 """
 
 import argparse
@@ -41,6 +52,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# Audit under the SAME jax config the runtime compiles under (cli.py
+# and tests/conftest.py both force partitionable threefry) — the RNG
+# lowering changes the HLO, so the measured cost snapshot would drift
+# between the analyzer and pytest otherwise.
+jax.config.update("jax_threefry_partitionable", True)
 
 
 def _print_program_rows(report: dict) -> None:
@@ -68,6 +87,17 @@ def main(argv=None) -> int:
                     help="also require every seeded violation caught")
     ap.add_argument("--list", action="store_true",
                     help="list the audited program matrix and exit")
+    ap.add_argument("--shardings", action="store_true",
+                    help="print the per-program sharding-contract "
+                         "detail and include a 'shardings' JSON "
+                         "section")
+    ap.add_argument("--costs", action="store_true",
+                    help="regenerate the analytic cost snapshot and "
+                         "diff-gate it against the committed "
+                         "ANALYSIS_COSTS.json")
+    ap.add_argument("--write-costs", action="store_true",
+                    help="write the regenerated snapshot to "
+                         "ANALYSIS_COSTS.json (with --costs)")
     ap.add_argument("--json", default=None, metavar="OUT",
                     help="write the machine-readable report here")
     args = ap.parse_args(argv)
@@ -119,6 +149,82 @@ def main(argv=None) -> int:
         for v in entry["violations"]:
             print(f"    VIOLATION {v['program']}: {v['rule']}: "
                   f"{v['message']} [{v['location']}]")
+
+    if args.shardings:
+        out["shardings"] = {
+            name: entry.get("shardings", {})
+            for name, entry in rep["programs"].items()
+        }
+        print("sharding contracts:")
+        for name, sh in out["shardings"].items():
+            if not sh.get("checked"):
+                print(f"  {name:26s} skipped "
+                      f"({sh.get('reason', '?')})")
+                continue
+            ann = sh.get("annotations", {})
+            print(f"  {name:26s} sharded_ok={sh['n_sharded_ok']} "
+                  f"declared={sh['n_declared']} "
+                  f"hlo_tiled={ann.get('n_device_tiled', 0)}")
+            for row in sh.get("buffers", []):
+                mark = "ok" if row["ok"] else "FAIL"
+                print(f"      {mark:4s} {row['buffer']:24s} "
+                      f"{row['role']:3s} {str(row['shape']):18s} "
+                      f"declared={row['declared']} "
+                      f"actual={row['actual']}")
+
+    if args.costs or args.write_costs:
+        from distributed_eigenspaces_tpu.analysis import costmodel
+        from distributed_eigenspaces_tpu.analysis.report import (
+            _violations_json,
+        )
+
+        snap = costmodel.cost_snapshot()
+        if args.write_costs:
+            path = costmodel.snapshot_path()
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(snap, f, indent=2, sort_keys=True)
+                f.write("\n")
+            print(f"cost snapshot -> {path}")
+        drift = costmodel.check_snapshot(
+            snap, costmodel.load_snapshot()
+        )
+        proj = snap["projections"]
+        claims_ok = (
+            proj["audit_shapes"]["flat_over_tree"] >= 4.0
+            and proj["large_d"]["flat_over_tree"] >= 4.0
+        )
+        out["costs"] = {
+            "schema": snap["schema"],
+            "snapshot": snap,
+            "drift": _violations_json(drift),
+            "claims_ok": claims_ok,
+            "ok": not drift and claims_ok,
+        }
+        print("cost model:")
+        for name, ent in snap["programs"].items():
+            axes = ", ".join(
+                f"{a}={e['bytes_on_wire']}B/{e['hops']}h"
+                for a, e in ent["collectives_per_axis"].items()
+            ) or "-"
+            print(f"  {name:26s} flops={ent['flops']:8d} "
+                  f"budget/op={ent['budget_bytes_per_op']:6d}B "
+                  f"wire[{axes}]")
+        print(f"  tree payload: flat/tree = "
+              f"{proj['audit_shapes']['flat_over_tree']}x at audit "
+              f"shapes, {proj['large_d']['flat_over_tree']}x at "
+              f"d={proj['large_d']['d']} "
+              f"(claim >= 4x: {'ok' if claims_ok else 'FAIL'})")
+        for name, b in proj["tier_deadline_budgets_large_d"].items():
+            print(f"  tier {name:6s} fan_in={b['fan_in']:3d} "
+                  f"{b['wire_bytes_per_round']:>12d} B/round -> "
+                  f"{b['modeled_ms_per_round']} ms at "
+                  f"{b['assumed_gb_per_sec']} GB/s")
+        if not claims_ok:
+            failures += 1
+        for v in drift:
+            print(f"    VIOLATION {v.program}: {v.rule}: "
+                  f"{v.message} [{v.location}]")
+            failures += 1
 
     if args.mutation_check:
         mut = report_mod.run_mutation_report()

@@ -78,14 +78,18 @@
 #      the committed BENCH_SCENARIO_SMOKE_CPU.json (ratio floors + a
 #      10 s structural recovery bound + a 0.5 absolute attainment
 #      floor, so CPU-rig jitter can't flap CI);
-#   11. scripts/analyze.py --all --mutation-check: the static program-
-#      contract gate (ISSUE 10, docs/ANALYSIS.md) — every program kind
-#      audited against its declarative contract (collective schedule +
-#      payload bounds, memory policy, baked constants) from compiled
-#      HLO/jaxprs without executing, plus the concurrency/host-sync AST
-#      lints AND the mutation self-tests that prove each violation
-#      class is caught. When ruff is on PATH (not in the pinned CI
-#      image) the lint config in pyproject.toml runs first;
+#   11. scripts/analyze.py --all --costs --shardings --mutation-check:
+#      the static program-contract gate (ISSUE 10 + 13,
+#      docs/ANALYSIS.md) — every program kind audited against its
+#      declarative contract (collective schedule + payload bounds,
+#      memory policy, baked constants, declared-PartitionSpec sharding
+#      contracts) from compiled HLO/jaxprs without executing, the
+#      analytic cost model diff-gated against the committed
+#      ANALYSIS_COSTS.json snapshot, plus the concurrency/host-sync
+#      AST lints AND the mutation self-tests that prove each violation
+#      class is caught. ruff (the dev extra / Dockerfile image) runs
+#      first when on PATH; a missing ruff now SKIPS LOUDLY instead of
+#      silently (DET_CI_REQUIRE_RUFF=1 turns the skip into a failure);
 #   12. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
@@ -277,18 +281,33 @@ else
     JAX_PLATFORMS=cpu python bench.py --scenario scenarios/ci_smoke.json
 fi
 
-echo "== [11/12] static analysis: program contracts + lints + mutations =="
+echo "== [11/12] static analysis: contracts + shardings + costs + lints + mutations =="
 # scripts/analyze.py compiles (never runs) the whole program matrix and
-# audits each program against its contract, runs the concurrency /
-# host-sync AST lints over the threaded runtime, and proves the gate
-# bites via seeded mutations (docs/ANALYSIS.md). Budget: < 2 min on
-# the CPU rig (~15 s measured). ruff is config-only in the pinned
-# image — run it when available so dev machines get the style gate
-# without adding a CI dependency.
+# audits each program against its contract — collective schedule,
+# memory policy, baked constants, and (ISSUE 13) the declared
+# PartitionSpec sharding contracts (silent replication of a
+# contract-sharded buffer fails here) — regenerates the analytic cost
+# snapshot and diff-gates it against the committed ANALYSIS_COSTS.json,
+# runs the concurrency / host-sync AST lints over the threaded
+# runtime, and proves the gate bites via seeded mutations
+# (docs/ANALYSIS.md). Budget: < 2 min on the CPU rig (~20 s measured).
+# ruff ships via the `dev` extra and the Dockerfile image; when it is
+# missing the lint stage skips LOUDLY (never silently) and
+# DET_CI_REQUIRE_RUFF=1 promotes the skip to a hard failure.
 if command -v ruff >/dev/null 2>&1; then
     ruff check .
+elif [[ "${DET_CI_REQUIRE_RUFF:-0}" == "1" ]]; then
+    echo "ci: ruff required (DET_CI_REQUIRE_RUFF=1) but not on PATH" >&2
+    echo "ci: install it with: pip install -e '.[dev]'" >&2
+    exit 1
+else
+    echo "ci: WARNING: ruff not on PATH — lint stage SKIPPED" >&2
+    echo "ci: install it with: pip install -e '.[dev]' (or use the" >&2
+    echo "ci: Dockerfile image); set DET_CI_REQUIRE_RUFF=1 to make" >&2
+    echo "ci: this skip a hard failure" >&2
 fi
-JAX_PLATFORMS=cpu python scripts/analyze.py --all --mutation-check
+JAX_PLATFORMS=cpu python scripts/analyze.py --all --costs --shardings \
+    --mutation-check
 
 echo "== [12/12] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py

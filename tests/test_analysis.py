@@ -136,20 +136,62 @@ def test_metrics_summary_carries_analysis_verdict():
 
 def test_run_analysis_report_shape(devices):
     """The machine-readable report: per-program verdicts + lints +
-    aggregate ok, additive schema bench --compare passes through."""
+    aggregate ok, additive schema bench --compare passes through.
+    analysis-v2 (ISSUE 13) pins the per-program shardings + costs
+    sections — this key set IS the schema contract v1 consumers were
+    regression-tested against, so removals bump the schema string."""
     from distributed_eigenspaces_tpu.analysis.report import (
         SCHEMA,
         run_analysis,
     )
 
+    assert SCHEMA == "analysis-v2"
     rep = run_analysis(["scan_solo"], lints=True)
     assert rep["schema"] == SCHEMA
     assert rep["ok"] and rep["n_violations"] == 0
     assert set(rep["programs"]) == {"scan_solo"}
     entry = rep["programs"]["scan_solo"]
     assert entry["violations"] == []
-    assert {"contract", "ok", "collectives", "memory", "consts"} <= set(
-        entry
-    )
+    # the full v2 per-program key set (v1 keys + shardings/costs)
+    assert {
+        "contract", "ok", "collectives", "memory", "consts",
+        "shardings", "costs",
+    } <= set(entry)
+    sh = entry["shardings"]
+    assert sh["checked"] and sh["n_sharded_ok"] >= 1
+    assert {"flops", "hbm_bytes_accessed", "collectives_per_axis",
+            "budget_bytes_per_op"} <= set(entry["costs"])
     assert set(rep["lints"]) == {"concurrency", "host_sync"}
     assert all(e["ok"] for e in rep["lints"].values()), rep["lints"]
+
+
+def test_analyze_cli_json_key_set(devices, tmp_path):
+    """The scripts/analyze.py --json artifact: top-level key set and
+    the --shardings/--costs sections pinned (the machine-readable
+    contract CI consumers and bench --compare read)."""
+    import importlib.util
+    import json
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "analyze_cli",
+        pathlib.Path(__file__).resolve().parent.parent
+        / "scripts" / "analyze.py",
+    )
+    analyze = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(analyze)
+
+    out_path = tmp_path / "report.json"
+    rc = analyze.main([
+        "--all", "--shardings", "--costs", "--json", str(out_path),
+    ])
+    assert rc == 0
+    out = json.loads(out_path.read_text())
+    assert {"schema", "analysis", "shardings", "costs",
+            "elapsed_s", "ok"} <= set(out)
+    assert out["schema"] == "analysis-v2" and out["ok"]
+    assert out["shardings"]["feature_scan"]["n_sharded_ok"] >= 1
+    costs = out["costs"]
+    assert costs["ok"] and costs["claims_ok"]
+    assert costs["drift"] == []
+    assert costs["snapshot"]["schema"] == "analysis-costs-v1"

@@ -284,6 +284,61 @@ def test_analysis_verdict_passes_through_compare(tmp_path, capsys):
     assert "analysis_new" not in verdict
 
 
+def test_analysis_schema_v1_v2_compare_both_directions(tmp_path, capsys):
+    """ISSUE 13: an analysis-v1 record (pre-sharding/cost sections)
+    compares against an analysis-v2 one IN BOTH DIRECTIONS — never a
+    crash, never a silent skip. The condensed verdict uses only the
+    stable v1 keys; the schema mismatch surfaces as a loud note naming
+    both schemas and what was not compared."""
+    v1 = {
+        "schema": "analysis-v1",
+        "ok": True,
+        "n_violations": 0,
+        "programs": {"serve_project_rows8": {"ok": True}},
+    }
+    v2 = {
+        "schema": "analysis-v2",
+        "ok": True,
+        "n_violations": 0,
+        "programs": {
+            "serve_project_rows8": {
+                "ok": True,
+                "shardings": {"annotations": {"n_annotations": 3}},
+            },
+        },
+    }
+    old = tmp_path / "v1.json"
+    old.write_text(json.dumps(
+        {**_serve_report(25000.0, 0.1, 4.5, 0.04), "analysis": v1}
+    ))
+    new = {**_serve_report(26000.0, 0.1, 4.2, 0.041), "analysis": v2}
+
+    # v1 committed baseline vs v2 fresh run
+    assert bench.compare_reports(str(old), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] != "skipped"
+    assert verdict["analysis_old"]["ok"] is True
+    assert verdict["analysis_new"]["ok"] is True
+    note = verdict["analysis_schema_note"]
+    assert "analysis-v1" in note and "analysis-v2" in note
+    assert "shardings" in note  # names what was NOT compared
+    assert not verdict["regression"]
+
+    # the reverse: v2 committed baseline vs a v1 (stripped) rerun
+    old2 = tmp_path / "v2.json"
+    old2.write_text(json.dumps(new))
+    rerun = {**_serve_report(26500.0, 0.1, 4.3, 0.04), "analysis": v1}
+    assert bench.compare_reports(str(old2), rerun) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] != "skipped"
+    assert "analysis-v1" in verdict["analysis_schema_note"]
+
+    # same schema on both sides: no note at all
+    assert bench.compare_reports(str(old2), new) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert "analysis_schema_note" not in verdict
+
+
 def test_serve_vs_fleet_metric_mismatch_skips(tmp_path, capsys):
     old = tmp_path / "old.json"
     old.write_text(json.dumps(_serve_report(25000.0, 0.1, 4.5, 0.04)))

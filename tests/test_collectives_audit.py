@@ -14,12 +14,11 @@ The structural invariants:
   tripwire and the contract checker (the gate actually bites);
 - the parser itself: async/tuple/TPU-tiled forms, full dtype table,
   loud ``AuditParseError`` on anything unknown, drift tripwire;
-- ``utils.collectives_audit`` stays importable as a warn-once shim.
+- the ``utils.collectives_audit`` shim is RETIRED (ISSUE 13): the old
+  path no longer imports; the public names live in ``analysis.hlo``.
 """
 
 import importlib
-import sys
-import warnings
 
 import jax
 import jax.numpy as jnp
@@ -280,25 +279,25 @@ def test_parse_tiled_tpu_layouts():
     ]
 
 
-def test_shim_warns_once_and_reexports():
-    """utils.collectives_audit is a back-compat shim: first import
-    warns DeprecationWarning, cached re-import stays silent, and the
-    old public names resolve to the moved implementations."""
+def test_shim_retired_and_api_lives_in_analysis():
+    """The PR-10 back-compat shim is gone (ISSUE 13): importing the
+    old path fails loudly instead of warning, and every name the shim
+    used to re-export is the real implementation in ``analysis.hlo``
+    (also surfaced through the lazy ``analysis`` package facade)."""
     name = "distributed_eigenspaces_tpu.utils.collectives_audit"
-    sys.modules.pop(name, None)
-    with warnings.catch_warnings(record=True) as w:
-        warnings.simplefilter("always")
-        shim = importlib.import_module(name)
-    assert any(
-        issubclass(x.category, DeprecationWarning) for x in w
-    ), [str(x.message) for x in w]
-    with warnings.catch_warnings(record=True) as w2:
-        warnings.simplefilter("always")
-        shim2 = importlib.import_module(name)  # cached: no second warn
-    assert not w2
-    assert shim2 is shim
+    with pytest.raises(ModuleNotFoundError):
+        importlib.import_module(name)
+
+    import distributed_eigenspaces_tpu.analysis as analysis_pkg
     from distributed_eigenspaces_tpu.analysis import hlo as hlo_mod
 
-    assert shim.parse_collectives is hlo_mod.parse_collectives
-    assert shim.audit_compiled is hlo_mod.audit_compiled
-    assert shim.AuditParseError is hlo_mod.AuditParseError
+    for attr in (
+        "AuditParseError", "CollectiveOp",
+        "assert_no_dense_collective", "audit_compiled",
+        "ici_step_model", "parse_collectives", "scaling_projection",
+    ):
+        assert hasattr(hlo_mod, attr), attr
+    # the package facade resolves the same objects (identity, not copies)
+    assert analysis_pkg.parse_collectives is hlo_mod.parse_collectives
+    assert analysis_pkg.audit_compiled is hlo_mod.audit_compiled
+    assert analysis_pkg.AuditParseError is hlo_mod.AuditParseError

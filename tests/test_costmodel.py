@@ -1,0 +1,229 @@
+"""Analytic cost model (ISSUE 13 tentpole): per-program FLOPs, HBM
+bytes, and per-mesh-axis collective bytes x hops derived statically
+from compiled HLO, plus the committed-snapshot diff gate and the
+closed-form projections that back the 4x tree-payload claim and the
+per-tier deadline budgets.
+"""
+
+import numpy as np
+
+from distributed_eigenspaces_tpu.analysis import costmodel as cm
+from distributed_eigenspaces_tpu.analysis.contracts import ProgramParams
+
+
+# -- replica-group parsing + axis attribution --------------------------------
+
+
+def test_parse_replica_groups_forms():
+    assert cm.parse_replica_groups(
+        "all-gather(%p), replica_groups={{0,1,2,3}}"
+    ) == [[0, 1, 2, 3]]
+    assert cm.parse_replica_groups(
+        "all-reduce(%p), replica_groups={{0,2},{1,3}}"
+    ) == [[0, 2], [1, 3]]
+    assert cm.parse_replica_groups("all-gather(%p)") is None
+
+
+def test_attribute_axis_resolves_single_and_joint_axes():
+    ids = np.arange(4).reshape(2, 2)  # axes ("a", "b")
+    axes = ("a", "b")
+    assert cm.attribute_axis([[0, 1], [2, 3]], axes, ids) == "b"
+    assert cm.attribute_axis([[0, 2], [1, 3]], axes, ids) == "a"
+    assert cm.attribute_axis([[0, 1, 2, 3]], axes, ids) == "a+b"
+    # a group set matching no axis subset refuses to guess
+    assert cm.attribute_axis([[0, 3]], axes, ids) == "unattributed"
+
+
+def test_ring_accounting():
+    assert cm._ring(1) == 0.0
+    assert cm._ring(4) == 0.75
+
+
+# -- modeled side ------------------------------------------------------------
+
+
+def test_model_costs_tree_merge_per_tier_terms():
+    p = ProgramParams(
+        d=64, k=2, m=4, n=8, tier_fan_ins=(2, 2),
+        tier_axes=("chip", "host"), n_workers_mesh=4,
+    )
+    model = cm.model_costs("tree_merge", p)
+    assert set(model) == {"chip", "host"}
+    for tier in model.values():
+        assert set(tier) == {
+            "alltoall_factor_bytes", "gram_psum_bytes",
+            "basis_gather_bytes",
+        }
+        # fan 2: ring = 1/2; Gram = 2 * 1/2 * (2*2)^2 * 4 = 64 B
+        assert tier["gram_psum_bytes"] == 64
+        assert tier["alltoall_factor_bytes"] == 64 * 2 * 4 // 2
+
+
+def test_model_costs_zero_collective_kinds_model_nothing():
+    p = ProgramParams(d=64, k=2, rows=16)
+    assert cm.model_costs("serve_transform", p) == {}
+    assert cm.model_costs("fleet_fit", p) == {}
+
+
+def test_check_cost_bound_zero_collective_contract_has_no_budget():
+    p = ProgramParams(d=64, k=2, rows=16)
+    viols, metrics = cm.check_cost_bound(
+        "serve_transform", p, "", program="unit"
+    )
+    assert not viols and metrics["budget_bytes_per_op"] == 0
+
+
+def test_seeded_tree_payload_mutant_caught_with_budget_named(devices):
+    """The mutation pin (ISSUE 13 satellite): a tree tier psumming the
+    flat factor stack exceeds its byte budget — caught by cost-bound
+    with the actual bytes, the budget, and the HLO line named."""
+    from distributed_eigenspaces_tpu.analysis import mutations
+
+    rule, runner = mutations.MUTATIONS["tree_payload_drift"]
+    assert rule == "cost-bound"
+    viols = runner()
+    hits = [v for v in viols if v.rule == rule]
+    assert hits, [v.format() for v in viols]
+    v = hits[0]
+    assert v.program == "mutant_tree_payload_drift"
+    assert "budget" in v.message and "payload bytes" in v.message
+    assert v.location  # the offending HLO line
+
+
+# -- measured side -----------------------------------------------------------
+
+
+def test_measured_costs_scan_attributes_workers_axis(devices):
+    from distributed_eigenspaces_tpu.analysis import programs
+
+    built = programs.build_program("scan_solo")
+    meas = cm.measured_costs(built)
+    assert meas["flops"] > 0
+    assert meas["hbm_bytes_accessed"] > 0
+    axes = meas["collectives_per_axis"]
+    assert set(axes) == {"workers"}  # the factor gather, nothing else
+    ent = axes["workers"]
+    assert ent["n_ops"] >= 1 and ent["bytes_on_wire"] > 0
+    assert ent["hops"] >= 1
+    # cached on the program: snapshot + report share one parse
+    assert cm.measured_costs(built) is meas
+
+
+def test_measured_costs_tree_attributes_both_tier_axes(devices):
+    from distributed_eigenspaces_tpu.analysis import programs
+
+    built = programs.build_program("tree_fit")
+    axes = cm.measured_costs(built)["collectives_per_axis"]
+    assert {"chip", "host"} <= set(axes)
+    assert "unattributed" not in axes  # every group maps to a real axis
+
+
+# -- projections: the 4x claim + deadline budgets ----------------------------
+
+
+def test_projections_validate_tree_payload_claim():
+    proj = cm.projections()
+    assert proj["audit_shapes"]["flat_over_tree"] >= 4.0
+    assert proj["large_d"]["flat_over_tree"] >= 4.0
+    assert proj["large_d"]["d"] >= 32768  # the d-ceiling target shape
+    budgets = proj["tier_deadline_budgets_large_d"]
+    assert set(budgets) == {"chip", "host"}
+    for b in budgets.values():
+        assert b["wire_bytes_per_round"] > 0
+        assert b["modeled_ms_per_round"] > 0
+        assert b["assumed_gb_per_sec"] > 0
+    # DCN tier is the slow one: same-order bytes, ~7x less bandwidth
+    assert (
+        budgets["host"]["modeled_ms_per_round"]
+        > budgets["chip"]["modeled_ms_per_round"]
+    )
+
+
+# -- snapshot ----------------------------------------------------------------
+
+
+def test_cost_snapshot_is_deterministic(devices):
+    a = cm.cost_snapshot(["scan_solo"])
+    b = cm.cost_snapshot(["scan_solo"])
+    assert a == b
+    assert a["schema"] == cm.SNAPSHOT_SCHEMA
+    entry = a["programs"]["scan_solo"]
+    assert entry["contract"] == "scan_fit"
+    assert entry["budget_bytes_per_op"] > 0
+    assert "projections" in a
+
+
+def test_check_snapshot_clean_and_drift(devices):
+    import copy
+    import json
+
+    snap = cm.cost_snapshot(["scan_solo"])
+    # identical (including a JSON round-trip: what CI actually diffs)
+    assert cm.check_snapshot(snap, json.loads(json.dumps(snap))) == []
+
+    # per-field drift names the program and the field
+    drifted = copy.deepcopy(snap)
+    drifted["programs"]["scan_solo"]["flops"] += 1
+    viols = cm.check_snapshot(snap, drifted)
+    assert viols and viols[0].rule == "cost-drift"
+    assert "scan_solo" in viols[0].message
+    assert "flops" in viols[0].message
+
+    # missing committed file: actionable message naming the fix
+    viols = cm.check_snapshot(snap, None)
+    assert len(viols) == 1 and "--write-costs" in viols[0].message
+
+    # schema drift
+    wrong = copy.deepcopy(snap)
+    wrong["schema"] = "analysis-costs-v0"
+    assert any(
+        "schema" in v.message for v in cm.check_snapshot(snap, wrong)
+    )
+
+    # program-set drift in both directions
+    extra = copy.deepcopy(snap)
+    extra["programs"]["ghost"] = dict(snap["programs"]["scan_solo"])
+    msgs = [v.message for v in cm.check_snapshot(snap, extra)]
+    assert any("no longer in the program matrix" in m for m in msgs)
+    msgs = [v.message for v in cm.check_snapshot(extra, snap)]
+    assert any("no committed cost entry" in m for m in msgs)
+
+    # projections drift
+    proj = copy.deepcopy(snap)
+    proj["projections"] = {}
+    assert any(
+        "projections" in v.message
+        for v in cm.check_snapshot(snap, proj)
+    )
+
+
+def test_committed_snapshot_exists_and_covers_the_matrix():
+    """The committed ANALYSIS_COSTS.json is the CI gate's baseline: it
+    must exist, carry the snapshot schema, and cover exactly the
+    program matrix (the full regeneration no-op is gated by
+    scripts/analyze.py --costs in CI stage 11, not re-run here)."""
+    from distributed_eigenspaces_tpu.analysis import programs
+
+    committed = cm.load_snapshot()
+    assert committed is not None, (
+        f"{cm.SNAPSHOT_NAME} missing — run scripts/analyze.py "
+        "--all --costs --write-costs and commit it"
+    )
+    assert committed["schema"] == cm.SNAPSHOT_SCHEMA
+    assert set(committed["programs"]) == set(programs.PROGRAMS)
+    proj = committed["projections"]
+    assert proj["audit_shapes"]["flat_over_tree"] >= 4.0
+    assert proj["large_d"]["flat_over_tree"] >= 4.0
+
+
+def test_committed_snapshot_matches_regeneration_spot_check(devices):
+    """One-program drift spot check in plain pytest (fast): the
+    committed scan_solo entry equals a fresh regeneration."""
+    committed = cm.load_snapshot()
+    assert committed is not None
+    fresh = cm.cost_snapshot(["scan_solo"])
+    assert (
+        fresh["programs"]["scan_solo"]
+        == committed["programs"]["scan_solo"]
+    )
+    assert fresh["projections"] == committed["projections"]

@@ -1,0 +1,334 @@
+"""Sharding contracts (ISSUE 13 tentpole): declared PartitionSpecs
+audited against the compiled executable's actual leaf shardings.
+
+The rule under test is the d-ceiling invariant: a buffer the contract
+declares sharded over ``workers``/``features``/a tier axis that the
+compiled program holds REPLICATED is a ``silent-replication``
+violation naming the program, the buffer shape, and the offending HLO
+location. The suite covers the checker's verdicts (clean, silently
+replicated, stale declaration, over-sharded, vacuous, misaligned), the
+spec normalization (tier-axis reorder, GSPMD "?" fallback), and the
+HLO annotation census.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from distributed_eigenspaces_tpu.analysis import shardings as sh
+from distributed_eigenspaces_tpu.analysis.contracts import ProgramParams
+from distributed_eigenspaces_tpu.analysis.shardings import (
+    WILD,
+    DeclaredBuffer,
+    ShardingContract,
+)
+from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+D, Q = 128, 2
+
+
+def _compiled_identity(devices, out_spec):
+    """A (D, Q) feature-sharded identity with a controllable output
+    layout — the minimal program that can silently replicate."""
+    mesh = make_mesh(num_workers=4, num_feature_shards=2)
+    fn = jax.jit(
+        lambda v: 2.0 * v,
+        in_shardings=NamedSharding(mesh, P("features", None)),
+        out_shardings=NamedSharding(mesh, out_spec),
+    )
+    arg = jax.ShapeDtypeStruct((D, Q), jnp.float32)
+    return arg, fn.lower(arg).compile()
+
+
+def _basis_contract(**kw):
+    return ShardingContract(
+        buffers=(
+            DeclaredBuffer(
+                "basis in", "in",
+                dims=lambda p: (p.d, WILD),
+                spec=lambda p: ("features", None),
+            ),
+            DeclaredBuffer(
+                "basis out", "out",
+                dims=lambda p: (p.d, WILD),
+                spec=lambda p: ("features", None),
+            ),
+        ),
+        **kw,
+    )
+
+
+def _check(scontract, arg, compiled, **kw):
+    params = ProgramParams(
+        d=D, k=Q, m=4, n=8, n_feature_shards=2, n_workers_mesh=4
+    )
+    return sh.check_shardings(
+        scontract, params,
+        program="unit_program",
+        dense_dim=D,
+        in_avals=[arg],
+        in_shardings=jax.tree_util.tree_leaves(
+            compiled.input_shardings
+        ),
+        out_avals=[arg],
+        out_shardings=jax.tree_util.tree_leaves(
+            compiled.output_shardings
+        ),
+        hlo_text=compiled.as_text(),
+        **kw,
+    )
+
+
+def test_clean_sharded_program_passes(devices):
+    arg, compiled = _compiled_identity(devices, P("features", None))
+    viols, metrics = _check(_basis_contract(), arg, compiled)
+    assert not viols, [v.format() for v in viols]
+    assert metrics["checked"]
+    assert metrics["n_sharded_ok"] == 2  # in + out both verified
+    assert all(row["ok"] for row in metrics["buffers"])
+
+
+def test_silent_replication_names_shape_and_location(devices):
+    """The headline rule: declared sharded, compiled replicated —
+    caught, with program + buffer shape + location in the message."""
+    arg, compiled = _compiled_identity(devices, P())  # the regression
+    viols, _ = _check(_basis_contract(), arg, compiled)
+    hits = [v for v in viols if v.rule == "silent-replication"]
+    assert hits, [v.format() for v in viols]
+    msg = hits[0].format()
+    assert "unit_program" in msg
+    assert f"[{D}, {Q}]" in msg  # the buffer shape
+    assert "REPLICATED" in msg
+    assert hits[0].location  # "output leaf 0" — never empty
+
+
+def test_declared_replicated_but_compiled_sharded(devices):
+    """The inverse staleness: the contract says replicated, the
+    partitioner sharded it — sharding-contract, not a pass."""
+    arg, compiled = _compiled_identity(devices, P("features", None))
+    stale = ShardingContract(
+        buffers=(
+            DeclaredBuffer(
+                "basis in", "in",
+                dims=lambda p: (p.d, WILD),
+                spec=lambda p: (None, None),  # stale declaration
+            ),
+        ),
+        require_some=False,
+    )
+    viols, _ = _check(stale, arg, compiled)
+    assert any(
+        v.rule == "sharding-contract"
+        and "declared replicated" in v.message
+        for v in viols
+    ), [v.format() for v in viols]
+
+
+def test_stale_pattern_matching_no_leaf_is_loud(devices):
+    arg, compiled = _compiled_identity(devices, P("features", None))
+    stale = ShardingContract(
+        buffers=(
+            DeclaredBuffer(
+                "ghost", "in",
+                dims=lambda p: (999, WILD),
+                spec=lambda p: ("features", None),
+            ),
+        ),
+        require_some=False,
+    )
+    viols, _ = _check(stale, arg, compiled)
+    assert any(
+        v.rule == "sharding-contract" and "matched no" in v.message
+        for v in viols
+    ), [v.format() for v in viols]
+
+
+def test_vacuous_contract_refused(devices):
+    """require_some: a contract whose declared-sharded buffers all
+    skip must fail, not pass silently."""
+    arg, compiled = _compiled_identity(devices, P("features", None))
+    vacuous = ShardingContract(
+        buffers=(
+            DeclaredBuffer(
+                "optional ghost", "in",
+                dims=lambda p: (999, WILD),
+                spec=lambda p: ("features", None),
+                required=False,
+            ),
+        ),
+    )
+    viols, _ = _check(vacuous, arg, compiled)
+    assert any("vacuously" in v.message for v in viols), [
+        v.format() for v in viols
+    ]
+
+
+def test_leaf_misalignment_is_a_violation_not_a_guess(devices):
+    arg, compiled = _compiled_identity(devices, P("features", None))
+    params = ProgramParams(
+        d=D, k=Q, m=4, n=8, n_feature_shards=2, n_workers_mesh=4
+    )
+    viols, metrics = sh.check_shardings(
+        _basis_contract(), params,
+        program="unit_program", dense_dim=D,
+        in_avals=[arg, arg],  # one more aval than sharding leaves
+        in_shardings=jax.tree_util.tree_leaves(
+            compiled.input_shardings
+        ),
+        out_avals=[arg],
+        out_shardings=jax.tree_util.tree_leaves(
+            compiled.output_shardings
+        ),
+    )
+    assert metrics["checked"] is False
+    assert any("cannot align" in v.message for v in viols)
+
+
+def test_wildcard_never_swallows_a_dense_axis():
+    """WILD matches only axes strictly below the dense threshold — a
+    (d, d) buffer can never bind to a (d, WILD) pattern."""
+    assert sh._matches((D, WILD), (D, Q), wildcard_max=D)
+    assert not sh._matches((D, WILD), (D, D), wildcard_max=D)
+    assert not sh._matches((D, WILD), (D,), wildcard_max=D)  # rank
+    assert not sh._matches((64, WILD), (D, Q), wildcard_max=D)
+
+
+def test_spec_sets_tolerate_tier_axis_reorder():
+    """Mesh factorings reorder tier axes freely — ("chip","host") and
+    ('host','chip') are the same layout, compared as sets."""
+    a = sh._spec_sets((("chip", "host"),), 1)
+    b = sh._spec_sets((("host", "chip"),), 1)
+    assert a == b
+    assert sh._spec_sets(("workers", None), 2) == (
+        frozenset({"workers"}), frozenset(),
+    )
+    # padding: missing trailing dims are replicated
+    assert sh._spec_sets(("workers",), 3)[1:] == (
+        frozenset(), frozenset(),
+    )
+
+
+def test_actual_spec_sets_named_replicated_and_gspmd_fallback(devices):
+    mesh = make_mesh(num_workers=4, num_feature_shards=2)
+    named = NamedSharding(mesh, P("features", None))
+    assert sh.actual_spec_sets(named, (D, Q)) == (
+        frozenset({"features"}), frozenset(),
+    )
+    rep = NamedSharding(mesh, P())
+    assert sh.actual_spec_sets(rep, (D, Q)) == (
+        frozenset(), frozenset(),
+    )
+
+    class FakeGspmd:  # axis names unrecoverable: "?" pseudo-axis
+        def shard_shape(self, shape):
+            return (shape[0] // 2, shape[1])
+
+    assert sh.actual_spec_sets(FakeGspmd(), (D, Q)) == (
+        frozenset({"?"}), frozenset(),
+    )
+
+    class Opaque:
+        def shard_shape(self, shape):
+            raise RuntimeError("no layout")
+
+    assert sh.actual_spec_sets(Opaque(), (D, Q)) is None
+
+
+def test_parse_hlo_shardings_census():
+    hlo = """
+      %p0 = f32[64,2]{1,0} parameter(0), sharding={devices=[2,1]0,1}
+      %p1 = f32[64,64]{1,0} parameter(1), sharding={replicated}
+      %p2 = f32[4]{0} parameter(2), sharding={maximal device=0}
+    """
+    census = sh.parse_hlo_shardings(hlo)
+    assert census == {
+        "n_annotations": 3,
+        "n_replicated": 2,
+        "n_device_tiled": 1,
+        "n_other": 0,
+    }
+    assert sh.parse_hlo_shardings("")["n_annotations"] == 0
+
+
+def test_replicated_axis_floor_flags_full_d_intermediate(devices):
+    """The intermediate-buffer floor: a per-device HLO buffer holding
+    a full-d axis with >= 2 companion elements is flagged even with no
+    matching declared buffer."""
+    arg, compiled = _compiled_identity(devices, P("features", None))
+    floor_contract = ShardingContract(
+        buffers=_basis_contract().buffers,
+        replicated_axis_floor=lambda p: p.d,
+    )
+    # hand the checker an HLO that materializes a replicated (D, Q)
+    hlo = f"  %t = f32[{D},{Q}]{{1,0}} add(%a, %b)\n"
+    params = ProgramParams(
+        d=D, k=Q, m=4, n=8, n_feature_shards=2, n_workers_mesh=4
+    )
+    viols, _ = sh.check_shardings(
+        floor_contract, params,
+        program="unit_program", dense_dim=D,
+        in_avals=[arg],
+        in_shardings=jax.tree_util.tree_leaves(
+            compiled.input_shardings
+        ),
+        out_avals=[arg],
+        out_shardings=jax.tree_util.tree_leaves(
+            compiled.output_shardings
+        ),
+        hlo_text=hlo,
+    )
+    hits = [v for v in viols if v.rule == "silent-replication"]
+    assert hits and "full-width axis" in hits[0].message
+    assert hits[0].location  # the HLO line itself
+
+
+def test_check_built_skips_unsharded_with_named_reason(devices):
+    from distributed_eigenspaces_tpu.analysis import (
+        contracts,
+        programs,
+    )
+
+    built = programs.build_program("serve_project_solo")
+    contract = contracts.CONTRACTS[built.contract]
+    viols, metrics = sh.check_built(built, contract)
+    assert not viols
+    assert metrics["checked"] is False
+    assert metrics["reason"] == "unsharded program"
+
+
+@pytest.mark.parametrize(
+    "name", ["feature_scan", "feature_sketch", "tree_fit"]
+)
+def test_enforced_programs_carry_verified_sharded_buffers(devices, name):
+    """The ISSUE 13 enforcement floor: the feature-sharded and
+    tree-merge programs must each verify >= 1 declared-SHARDED buffer
+    (not pass vacuously)."""
+    from distributed_eigenspaces_tpu.analysis import (
+        contracts,
+        programs,
+    )
+
+    built = programs.build_program(name)
+    contract = contracts.CONTRACTS[built.contract]
+    viols, metrics = sh.check_built(built, contract)
+    assert not viols, [v.format() for v in viols]
+    assert metrics["checked"] and metrics["n_sharded_ok"] >= 1
+
+
+def test_seeded_replicated_dk_mutant_caught_with_details(devices):
+    """The mutation pin (ISSUE 13 satellite): the replicated (d, k)
+    mutant is caught by silent-replication with program, buffer shape,
+    and location all named."""
+    from distributed_eigenspaces_tpu.analysis import mutations
+
+    rule, runner = mutations.MUTATIONS["replicated_dk"]
+    assert rule == "silent-replication"
+    viols = runner()
+    hits = [v for v in viols if v.rule == rule]
+    assert hits, [v.format() for v in viols]
+    v = hits[0]
+    assert v.program == "mutant_replicated_dk"
+    assert "[128, 2]" in v.message  # the (2*_D, 2) buffer shape
+    assert v.location
