@@ -44,6 +44,7 @@ CONCURRENCY_TARGETS = (
     "distributed_eigenspaces_tpu/runtime/membership.py",
     "distributed_eigenspaces_tpu/runtime/prewarm.py",
     "distributed_eigenspaces_tpu/serving/registry.py",
+    "distributed_eigenspaces_tpu/serving/replication.py",
 )
 
 #: jit-path files the host-sync lint gates

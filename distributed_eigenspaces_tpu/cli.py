@@ -68,6 +68,30 @@ def build_parser() -> argparse.ArgumentParser:
                    "snapshots are skipped loudly, checksum mismatches "
                    "quarantined (docs/ROBUSTNESS.md 'Read-path "
                    "resilience')")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="--mode serve with --registry-dir: serve "
+                   "through N read-only ReplicaRegistry tailers of the "
+                   "durable store instead of the publisher's in-memory "
+                   "view (PCAConfig.replicas; 1 = no replication) — "
+                   "each replica installs committed versions with the "
+                   "lock-free swap and reports its lag "
+                   "(docs/ROBUSTNESS.md 'Replicated registry')")
+    p.add_argument("--replica-staleness-ms", type=float, default=500.0,
+                   help="declared replica staleness bound "
+                   "(PCAConfig.replica_staleness_ms): a replica "
+                   "installing a version more than this many ms after "
+                   "its commit marker counts a stale install in "
+                   "summary()['replication']; GC retire grace is keyed "
+                   "off the same bound so a lagging replica's reader "
+                   "still gets VersionRetired, never a torn read")
+    p.add_argument("--publisher-lease-ms", type=float, default=1000.0,
+                   help="publisher lease TTL "
+                   "(PCAConfig.publisher_lease_ms): the exclusive "
+                   "write lease on the durable registry renews at "
+                   "TTL/3; a kill -9'd publisher fails over to a "
+                   "standby within ~one TTL, the takeover bumps the "
+                   "fencing epoch, and the zombie's commits are "
+                   "rejected by the store AND by every replica")
     p.add_argument("--serve-queue-depth", type=int, default=None,
                    help="bounded admission for --mode serve "
                    "(PCAConfig.serve_queue_depth): max un-resolved "
@@ -1036,8 +1060,22 @@ def _serve_cli(args, cfg, data, truth) -> int:
     from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
 
     tracer = _make_tracer(args)
+    # --replicas N with --registry-dir: publish under the exclusive
+    # lease and serve through N read-only replica tailers of the
+    # committed store (docs/ROBUSTNESS.md "Replicated registry")
+    replicated = cfg.registry_dir is not None and cfg.replicas > 1
+    lease = None
+    if replicated:
+        from distributed_eigenspaces_tpu.serving import PublisherLease
+
+        lease = PublisherLease(
+            cfg.registry_dir, owner="cli-serve",
+            lease_ms=cfg.publisher_lease_ms,
+        ).acquire(timeout_s=30.0)
+        lease.start_heartbeat()
     registry = EigenbasisRegistry(
-        keep=cfg.serve_keep_versions, registry_dir=cfg.registry_dir
+        keep=cfg.serve_keep_versions, registry_dir=cfg.registry_dir,
+        lease=lease,
     )
     live = registry.latest()
     warm_restart = (
@@ -1080,19 +1118,60 @@ def _serve_cli(args, cfg, data, truth) -> int:
     if cc is not None:
         metrics.attach_compile(cc)
     prewarm_stats = None
+    # expected dispatch sizes: one query, and a full micro-batch
+    prewarm = (r, r * cfg.serve_bucket_size) if args.prewarm else False
+    replica_regs = []
+    if replicated:
+        from distributed_eigenspaces_tpu.serving import ReplicaRegistry
+
+        replica_regs = [
+            ReplicaRegistry(
+                cfg.registry_dir, name=f"replica-{i}",
+                keep=cfg.serve_keep_versions,
+                staleness_ms=cfg.replica_staleness_ms,
+                poll_s=0.005, metrics=metrics,
+            )
+            for i in range(cfg.replicas)
+        ]
     t0 = time.time()
-    with QueryServer(
-        registry, cfg, metrics=metrics,
-        # expected dispatch sizes: one query, and a full micro-batch
-        prewarm=(r, r * cfg.serve_bucket_size) if args.prewarm else False,
-    ) as srv:
-        if args.prewarm:
-            # the zero-stall guarantee needs the fence: wait, THEN
-            # serve — the first request runs zero compiles
-            srv.wait_warm(timeout=600)
-            prewarm_stats = srv.prewarmer.stats()
-        tickets = [srv.submit(q) for q in queries]
-        results = [t.result(timeout=600) for t in tickets]
+    try:
+        if replica_regs:
+            # one QueryServer per replica, the burst round-robined
+            # across the fleet — every replica serves the committed
+            # latest it tailed off disk, bit-exact vs the publisher
+            servers = [
+                QueryServer(rr, cfg, metrics=metrics, prewarm=prewarm)
+                for rr in replica_regs
+            ]
+            try:
+                if args.prewarm:
+                    for srv in servers:
+                        srv.wait_warm(timeout=600)
+                    prewarm_stats = servers[0].prewarmer.stats()
+                tickets = [
+                    servers[i % len(servers)].submit(q)
+                    for i, q in enumerate(queries)
+                ]
+                results = [t.result(timeout=600) for t in tickets]
+            finally:
+                for srv in servers:
+                    srv.close()
+        else:
+            with QueryServer(
+                registry, cfg, metrics=metrics, prewarm=prewarm,
+            ) as srv:
+                if args.prewarm:
+                    # the zero-stall guarantee needs the fence: wait,
+                    # THEN serve — the first request runs zero compiles
+                    srv.wait_warm(timeout=600)
+                    prewarm_stats = srv.prewarmer.stats()
+                tickets = [srv.submit(q) for q in queries]
+                results = [t.result(timeout=600) for t in tickets]
+    finally:
+        for rr in replica_regs:
+            rr.close()
+        if lease is not None:
+            lease.stop_heartbeat()
     elapsed = time.time() - t0
 
     # served projections must match the direct transform exactly (the
@@ -1142,6 +1221,13 @@ def _serve_cli(args, cfg, data, truth) -> int:
         "serve_seconds": round(elapsed, 3),
         "max_abs_err_vs_direct": max_err,
         **summary.get("serving", {}),
+        **(
+            {
+                "replicas": cfg.replicas,
+                "replication": summary.get("replication", {}),
+            }
+            if replicated else {}
+        ),
         **(
             {"slo": summary["slo"]} if "slo" in summary else {}
         ),
@@ -1398,6 +1484,9 @@ def main(argv=None) -> int:
             serve_bucket_size=args.serve_bucket,
             serve_flush_s=args.serve_flush_s,
             registry_dir=args.registry_dir,
+            replicas=args.replicas,
+            replica_staleness_ms=args.replica_staleness_ms,
+            publisher_lease_ms=args.publisher_lease_ms,
             serve_queue_depth=args.serve_queue_depth,
             serve_breaker_threshold=args.breaker_threshold,
         )

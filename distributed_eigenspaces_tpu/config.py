@@ -280,6 +280,33 @@ class PCAConfig:
         ``QuorumLost`` is raised per tier, not globally. ``None``
         (default) dispatches to the byte-identical pre-topology flat
         merge programs.
+      replicas: serve-tier replica count (``serving/replication.py``;
+        CLI ``--replicas``): N in-process ``ReplicaRegistry`` readers
+        tail ONE committed ``registry_dir`` — the commit markers are
+        the propagation bus, no extra wire protocol — and each installs
+        recovered versions with the same one-assignment lock-free swap
+        the in-memory registry uses. ``1`` (default) is the single-
+        server read path unchanged. Requires ``registry_dir`` to mean
+        anything: replication is defined over the durable store.
+      replica_staleness_ms: declared propagation bound (CLI
+        ``--replica-staleness-ms``): a replica whose installed latest
+        lags the committed latest by more than this many milliseconds
+        is STALE — reported loudly per replica in
+        ``summary()["replication"]`` (lag histograms, propagation p99)
+        and gated by ``bench.py --replica``. Also keys the registry's
+        retire GRACE window: a GC'd version's payload outlives its
+        retirement by at least this bound, so a replica mid-swap never
+        serves a dangling path (``VersionRetired`` stays the only
+        terminal answer — docs/ROBUSTNESS.md "Replicated registry").
+      publisher_lease_ms: single-writer publisher lease duration (CLI
+        ``--publisher-lease-ms``): the publisher holds an atomically
+        created lease file under ``registry_dir`` and heartbeats it;
+        a lease unrenewed for this many milliseconds is EXPIRED and a
+        standby may take over with a bumped fencing epoch. The epoch
+        is stamped into every ``meta.json``, so a kill -9'd zombie
+        ex-publisher's commits are rejected by replicas AND by the
+        store itself — failover is bounded, version ids never tear or
+        duplicate.
       seed: PRNG seed for initialization (subspace solver, synthetic data).
     """
 
@@ -321,6 +348,9 @@ class PCAConfig:
     round_deadline_ms: float | None = 250.0
     min_quorum_frac: float = 0.5
     merge_topology: tuple | None = None
+    replicas: int = 1
+    replica_staleness_ms: float = 500.0
+    publisher_lease_ms: float = 1000.0
     seed: int = 0
 
     def __post_init__(self):
@@ -557,6 +587,21 @@ class PCAConfig:
             # the worker count is final — scenario specs reuse config
             # dicts at different fleet sizes)
             object.__setattr__(self, "merge_topology", tuple(tiers))
+        if not isinstance(self.replicas, int) or isinstance(
+            self.replicas, bool
+        ) or self.replicas < 1:
+            raise ValueError(
+                f"replicas must be an int >= 1, got {self.replicas!r}"
+            )
+        for ms_field in ("replica_staleness_ms", "publisher_lease_ms"):
+            ms = getattr(self, ms_field)
+            if not isinstance(ms, (int, float)) or isinstance(
+                ms, bool
+            ) or ms <= 0:
+                raise ValueError(
+                    f"{ms_field} must be a positive duration in ms, "
+                    f"got {ms!r}"
+                )
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")
         if self.prefetch_depth < 0:

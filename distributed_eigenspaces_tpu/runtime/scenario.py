@@ -58,8 +58,14 @@ Episode taxonomy (kind → required fields):
   ``rejoin_step`` brings them back, optional ``publish: true``
   publishes the churned fit's basis to the live registry when done
   (the cross-tier refit-during-traffic composition).
-- ``publish``     — no extra fields: one mid-burst
-  ``registry.publish`` at ``start_s`` (hot-swap under load).
+- ``publish``     — one mid-burst ``registry.publish`` at ``start_s``
+  (hot-swap under load). Optional ``replicas: N`` runs the replay
+  against the DURABLE registry with N ``ReplicaRegistry`` tailers
+  (ISSUE 14) and gates that the published version reaches every
+  replica inside ``replica_staleness_ms``; optional
+  ``kill_publisher: true`` kills the publisher lease mid-burst
+  (renewals stop, TTL lapses) so a standby must take over at epoch+1
+  through the lease-file protocol before the publish lands.
 
 Malformed specs fail LOUDLY at load time with the offending episode and
 field named in the ValueError — never at minute three of a replay.
@@ -103,7 +109,7 @@ EPISODE_KINDS: dict[str, tuple[tuple[str, ...], tuple[str, ...]]] = {
         ("workers", "kill_slots", "kill_step"),
         ("rejoin_step", "steps", "publish", "tier"),
     ),
-    "publish": ((), ()),
+    "publish": ((), ("replicas", "kill_publisher")),
 }
 
 _COMMON = ("name", "kind", "start_s", "duration_s")
@@ -225,6 +231,24 @@ def _validate_episode(spec_name: str, i: int, raw: Any) -> Episode:
         if tier is not None and (not isinstance(tier, str) or not tier):
             _fail(spec_name, f"{label}: field 'tier' must be a non-"
                              f"empty tier name, got {tier!r}")
+    if kind == "publish":
+        r = params.get("replicas")
+        if r is not None and (
+            not isinstance(r, int) or isinstance(r, bool) or r < 1
+        ):
+            _fail(spec_name, f"{label}: field 'replicas' must be an "
+                             f"int >= 1, got {r!r}")
+        kp = params.get("kill_publisher")
+        if kp is not None and not isinstance(kp, bool):
+            _fail(spec_name, f"{label}: field 'kill_publisher' must "
+                             f"be a bool, got {kp!r}")
+        if kp and not r:
+            _fail(
+                spec_name,
+                f"{label}: field 'kill_publisher' requires field "
+                f"'replicas' (lease failover only exists on the "
+                f"replicated durable registry)",
+            )
     if kind in _SERVE_LOAD and raw["duration_s"] <= 0:
         _fail(spec_name, f"{label}: field 'duration_s' must be > 0 "
                          f"for load kind '{kind}'")
@@ -526,6 +550,10 @@ class ScenarioRunner:
         self.fleet_resolved = 0
         self.fleet_failed = 0
         self.publishes = 0
+        self.publisher_failovers = 0
+        #: publish-episode name → did the version reach every replica
+        #: inside the staleness-derived window (ISSUE 14)
+        self.replica_converged: dict[str, bool] = {}
 
     # -- payload generators --------------------------------------------------
 
@@ -752,10 +780,54 @@ class ScenarioRunner:
         est = OnlineDistributedPCA(cfg).fit(
             np.asarray(spectrum.sample(jax.random.PRNGKey(spec.seed), fit_rows))
         )
-        registry = EigenbasisRegistry(keep=cfg.serve_keep_versions)
-        v1 = registry.publish_fit(est)
-
         metrics = MetricsLogger(slo_p99_ms=float(slo_ms))
+
+        # publish episodes with `replicas: N` (ISSUE 14) promote the
+        # replay registry to the DURABLE store + publisher lease + N
+        # read-only ReplicaRegistry tailers; everything else (server,
+        # drift, churn publishes) rides the same registry object
+        n_replicas = max(
+            (
+                int(ep.params["replicas"])
+                for ep in spec.episodes
+                if ep.kind == "publish" and ep.params.get("replicas")
+            ),
+            default=0,
+        )
+        registry_dir = None
+        lease = None
+        replica_regs: list = []
+        if n_replicas:
+            import tempfile
+
+            from distributed_eigenspaces_tpu.serving import (
+                PublisherLease,
+                ReplicaRegistry,
+            )
+
+            registry_dir = tempfile.mkdtemp(prefix="det_scenario_reg_")
+            lease = PublisherLease(
+                registry_dir, owner="scenario-primary",
+                lease_ms=cfg.publisher_lease_ms, metrics=metrics,
+            ).acquire(timeout_s=30.0)
+            lease.start_heartbeat()
+            registry = EigenbasisRegistry(
+                keep=cfg.serve_keep_versions, registry_dir=registry_dir,
+                lease=lease, metrics=metrics,
+            )
+        else:
+            registry = EigenbasisRegistry(keep=cfg.serve_keep_versions)
+        v1 = registry.publish_fit(est)
+        if n_replicas:
+            replica_regs = [
+                ReplicaRegistry(
+                    registry_dir, name=f"scenario-rep{i}",
+                    keep=cfg.serve_keep_versions,
+                    staleness_ms=cfg.replica_staleness_ms,
+                    poll_s=0.005, metrics=metrics,
+                )
+                for i in range(n_replicas)
+            ]
         tracer = Tracer()
         metrics.attach_tracer(tracer)
 
@@ -849,11 +921,53 @@ class ScenarioRunner:
                     except (ServerOverloaded, ServerClosed):
                         self.fleet_shed += 1
                 elif action.kind == "publish":
-                    registry.publish(
+                    if lease is not None and ep.params.get(
+                        "kill_publisher"
+                    ):
+                        # mid-burst publisher kill: renewals stop and
+                        # the TTL lapses (what a kill -9 leaves
+                        # behind); the standby must wait it out and
+                        # take over at epoch+1 BEFORE this publish —
+                        # which then lands fenced-and-accepted
+                        from distributed_eigenspaces_tpu.serving import (
+                            PublisherLease,
+                        )
+
+                        lease.stop_heartbeat()
+                        lease = PublisherLease(
+                            registry_dir, owner="scenario-standby",
+                            lease_ms=cfg.publisher_lease_ms,
+                            metrics=metrics,
+                        ).acquire(timeout_s=30.0)
+                        lease.start_heartbeat()
+                        registry.lease = lease
+                        self.publisher_failovers += 1
+                    published = registry.publish(
                         v1.v, sigma_tilde=v1.sigma_tilde, step=v1.step,
                         lineage={"producer": f"scenario:{ep.name}"},
                     )
                     self.publishes += 1
+                    if replica_regs:
+                        # bounded-staleness convergence gate: the
+                        # version must reach every replica inside a
+                        # window derived from the declared bound
+                        limit = max(
+                            1.0, 4.0 * cfg.replica_staleness_ms / 1e3
+                        )
+                        deadline = time.monotonic() + limit
+                        while time.monotonic() < deadline and not all(
+                            r.latest() is not None
+                            and r.latest().version >= published.version
+                            for r in replica_regs
+                        ):
+                            for r in replica_regs:
+                                r.poke()
+                            time.sleep(0.002)
+                        self.replica_converged[ep.name] = all(
+                            r.latest() is not None
+                            and r.latest().version >= published.version
+                            for r in replica_regs
+                        )
                 elif action.kind == "churn_start":
                     churn_threads[ep.name].start()
 
@@ -902,6 +1016,14 @@ class ScenarioRunner:
             if fleet is not None:
                 fleet.close()
             server.close()
+            for r in replica_regs:
+                r.close()
+            if lease is not None:
+                lease.stop_heartbeat()
+            if registry_dir is not None:
+                import shutil
+
+                shutil.rmtree(registry_dir, ignore_errors=True)
 
         summary = metrics.summary()
         verdict = self._verdict(summary, churn_holders)
@@ -924,6 +1046,7 @@ class ScenarioRunner:
         spec = self.spec
         episodes = summary.get("episodes") or {}
         serving = summary.get("serving") or {}
+        replication = summary.get("replication") or {}
         fleet = summary.get("fleet") or {}
         membership = summary.get("membership") or {}
         slo = summary.get("slo") or {}
@@ -953,6 +1076,10 @@ class ScenarioRunner:
                 gates[f"{ep.name}_version_live"] = (
                     len(serving.get("versions_served") or ()) >= 2
                 )
+                if ep.params.get("replicas"):
+                    gates[f"{ep.name}_replicas_converged"] = (
+                        self.replica_converged.get(ep.name, False)
+                    )
             if ep.fault:
                 gates[f"{ep.name}_recovered"] = bool(
                     sec.get("recovered")
@@ -976,6 +1103,15 @@ class ScenarioRunner:
                     "health", "drift_refreshes",
                 )
                 if k in serving
+            },
+            "replication": {
+                k: replication.get(k)
+                for k in (
+                    "installs", "stale", "fenced", "failovers",
+                    "propagation_p50_ms", "propagation_p99_ms",
+                    "failover_recovery_ms",
+                )
+                if k in replication
             },
             "fleet": {
                 k: fleet.get(k)
@@ -1004,6 +1140,7 @@ class ScenarioRunner:
                 "fleet_resolved": self.fleet_resolved,
                 "fleet_failed": self.fleet_failed,
                 "publishes": self.publishes,
+                "publisher_failovers": self.publisher_failovers,
             },
             "gates": gates,
         }

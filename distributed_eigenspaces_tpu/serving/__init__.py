@@ -17,6 +17,10 @@ closed end-to-end: ingest → fit → publish → serve → drift → refit.
 - :mod:`.drift` — :class:`~.drift.DriftMonitor`: served residual energy
   + principal-angle gap vs a background refit fold into a drift score;
   past threshold a refit is launched and published as a new version.
+- :mod:`.replication` — :class:`~.replication.ReplicaRegistry` replicas
+  tailing one committed store under a declared staleness bound, and the
+  :class:`~.replication.PublisherLease` single-writer election with
+  epoch fencing (ISSUE 14).
 """
 
 from distributed_eigenspaces_tpu.serving.registry import (
@@ -36,6 +40,11 @@ from distributed_eigenspaces_tpu.serving.server import (
     ServerOverloaded,
 )
 from distributed_eigenspaces_tpu.serving.drift import DriftMonitor
+from distributed_eigenspaces_tpu.serving.replication import (
+    LeaseLost,
+    PublisherLease,
+    ReplicaRegistry,
+)
 
 __all__ = [
     "BasisVersion",
@@ -43,7 +52,10 @@ __all__ = [
     "DeadlineExceeded",
     "DriftMonitor",
     "EigenbasisRegistry",
+    "LeaseLost",
+    "PublisherLease",
     "QueryServer",
+    "ReplicaRegistry",
     "ServerClosed",
     "ServerOverloaded",
     "TransformEngine",

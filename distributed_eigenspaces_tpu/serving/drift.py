@@ -70,6 +70,15 @@ class DriftMonitor:
       cooldown_batches: observed batches required between auto
         refreshes — a spike that refits but does NOT clear the publish
         threshold must not re-refit on every subsequent batch.
+      lease: optional ``serving/replication.py`` ``PublisherLease``.
+        In a replicated fleet every replica observes drift, but only
+        the LEASE HOLDER may publish — a non-leader's armed refit
+        completing would double-publish the same correction. With a
+        lease attached, :meth:`refresh_now` re-checks it right before
+        publishing and drops the publish (loudly: the drift event
+        records ``rejected="not_lease_holder"``) when this process is
+        not the current holder; the refit result is discarded and the
+        leader's own monitor performs the real refresh.
       metrics: optional ``MetricsLogger`` — drift events land in
         ``summary()["serving"]``.
     """
@@ -87,6 +96,7 @@ class DriftMonitor:
         refit: Callable | None = None,
         auto: bool = True,
         cooldown_batches: int = 8,
+        lease=None,
         metrics=None,
     ):
         if threshold <= 0:
@@ -102,6 +112,10 @@ class DriftMonitor:
         self.refit = refit
         self.auto = auto
         self.cooldown_batches = cooldown_batches
+        self.lease = lease
+        #: refreshes whose publish was dropped because this process did
+        #: not hold the publisher lease (replicated-fleet observability)
+        self.publishes_rejected = 0
         self._observes_since_refresh = 0
         self.metrics = metrics
         rows_per_step = cfg.num_workers * cfg.rows_per_worker
@@ -314,7 +328,26 @@ class DriftMonitor:
             with self._lock:
                 self._observes_since_refresh = 0
             published = None
-            if score >= self.threshold:
+            rejected = None
+            if score >= self.threshold and self.lease is not None \
+                    and not self.lease.check():
+                # replicated fleet: only the lease holder publishes.
+                # A non-leader's refit confirmed drift but the LEADER's
+                # monitor owns the republish — dropping here prevents
+                # the double-publish (and the store would fence the
+                # commit anyway; this keeps the failure loud and local)
+                rejected = "not_lease_holder"
+                self.publishes_rejected += 1
+                from distributed_eigenspaces_tpu.utils.metrics import (
+                    log_line,
+                )
+
+                log_line(
+                    "drift refresh publish rejected: not lease holder",
+                    score=round(score, 4),
+                    owner=getattr(self.lease, "owner", None),
+                )
+            elif score >= self.threshold:
                 published = self.registry.publish(
                     np.asarray(w),
                     sigma_tilde=(
@@ -341,7 +374,7 @@ class DriftMonitor:
                            "score": round(score, 4)},
                 )
             if self.metrics is not None:
-                self.metrics.serve({
+                event = {
                     "kind": "drift",
                     "trace_id": trace_id,
                     "score": round(score, 4),
@@ -351,5 +384,8 @@ class DriftMonitor:
                     "published": (
                         published.version if published else None
                     ),
-                })
+                }
+                if rejected is not None:
+                    event["rejected"] = rejected
+                self.metrics.serve(event)
             return published

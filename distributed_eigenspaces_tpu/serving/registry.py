@@ -33,6 +33,27 @@ marker — a publisher killed mid-publish) is skipped loudly and removed;
 a checksum-MISMATCHED version (tampering, disk rot) is quarantined
 loudly (renamed ``*.quarantined``, evidence preserved) and never served.
 GC applies to the disk tier too: the newest ``keep`` versions survive.
+
+**Replication hooks (ISSUE 14).** The committed store doubles as the
+propagation bus for ``serving/replication.py``: N ``ReplicaRegistry``
+readers tail the commit markers and install each recovered version with
+the same one-assignment swap. Three store-side mechanisms make that
+safe:
+
+- every ``meta.json`` carries a ``t_commit_unix`` stamp (propagation
+  lag is measurable) and, when the publisher holds a
+  ``PublisherLease``, the lease's fencing ``epoch`` — commits from a
+  lower epoch than an earlier committed version are a zombie
+  ex-publisher's and are FENCED at recovery (renamed ``*.fenced``,
+  evidence preserved, never served);
+- ``publish`` with a ``lease`` attached re-validates the lease before
+  assigning a version id, so a zombie that lost its lease raises
+  instead of committing — the store itself rejects it, replicas never
+  see the write;
+- ``retire_grace_s`` defers disk GC: a version leaves memory (and
+  ``get()`` answers ``VersionRetired``) immediately, but its payload
+  outlives retirement by the grace window, so a replica that read the
+  commit marker just before GC never dereferences a dangling path.
 """
 
 from __future__ import annotations
@@ -44,6 +65,7 @@ import os
 import re
 import shutil
 import threading
+import time
 from typing import Any, Mapping
 
 import numpy as np
@@ -121,22 +143,40 @@ class EigenbasisRegistry:
     """
 
     def __init__(self, *, keep: int = 4, registry_dir: str | None = None,
-                 metrics=None):
+                 metrics=None, lease=None, retire_grace_s: float = 0.0):
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
+        if retire_grace_s < 0:
+            raise ValueError(
+                f"retire_grace_s must be >= 0, got {retire_grace_s}"
+            )
         self.keep = keep
         self.registry_dir = registry_dir
         self.metrics = metrics
+        #: optional ``serving/replication.py`` PublisherLease: publish
+        #: re-validates it (``lease.ensure()``) before assigning an id,
+        #: and its fencing epoch is stamped into every commit marker
+        self.lease = lease
+        #: disk-GC grace window (seconds): a retired version's payload
+        #: outlives its retirement by at least this long, so a replica
+        #: between marker read and payload read never sees a dangling
+        #: path (key it off cfg.replica_staleness_ms when replicating)
+        self.retire_grace_s = retire_grace_s
         self._lock = threading.Lock()
         self._versions: dict[int, BasisVersion] = {}
         self._latest: BasisVersion | None = None
         self._next_id = 1
+        #: deferred disk retirements: (due_monotonic, version id),
+        #: appended under the lock at GC time, swept outside it
+        self._pending_retire: list[tuple[float, int]] = []
         #: recovery report (populated when ``registry_dir`` is set):
         #: version ids loaded from disk, torn snapshot dirs removed,
-        #: and quarantined (checksum-mismatch) dir names
+        #: quarantined (checksum-mismatch) dir names, and fenced
+        #: (stale-epoch zombie commit) dir names
         self.recovered_versions: list[int] = []
         self.torn_skipped: list[str] = []
         self.quarantined: list[str] = []
+        self.fenced: list[str] = []
         if registry_dir is not None:
             os.makedirs(registry_dir, exist_ok=True)
             self._recover()
@@ -184,6 +224,14 @@ class EigenbasisRegistry:
                 json.dumps(bv.lineage, default=str)
             ),
             "checksum": checksum,
+            # replication bus fields (ISSUE 14): the wall-clock commit
+            # stamp replicas measure propagation lag against, and the
+            # publisher lease's fencing epoch (0 = unleased publisher;
+            # pre-PR-14 markers carry neither and read as epoch 0)
+            "t_commit_unix": time.time(),
+            "epoch": (
+                int(self.lease.epoch) if self.lease is not None else 0
+            ),
         }
         tmp = os.path.join(vdir, "meta.json.tmp")
         with open(tmp, "w") as f:
@@ -197,6 +245,49 @@ class EigenbasisRegistry:
 
     def _delete_version_dir(self, version: int) -> None:
         shutil.rmtree(self._version_dir(version), ignore_errors=True)
+
+    def _retire_disk(self, gc_ids: list[int]) -> None:
+        """Disk GC for freshly retired ids. With a grace window the
+        deletion is DEFERRED (the replica-safety contract: a reader
+        that saw the commit marker gets ``retire_grace_s`` to finish
+        its payload read); without one it is immediate."""
+        if not gc_ids:
+            self.sweep_retired()
+            return
+        if self.retire_grace_s <= 0:
+            for vid in gc_ids:
+                self._delete_version_dir(vid)
+            return
+        due = time.monotonic() + self.retire_grace_s
+        with self._lock:
+            self._pending_retire_locked(due, gc_ids)
+        self.sweep_retired()
+
+    def _pending_retire_locked(self, due: float, gc_ids: list[int]) -> None:
+        for vid in gc_ids:
+            self._pending_retire.append((due, vid))
+
+    def sweep_retired(self, *, force: bool = False) -> list[int]:
+        """Delete deferred-retired version dirs whose grace window has
+        elapsed (``force=True`` drains regardless — close/teardown).
+        Called from the publish path and from replica watcher polls;
+        returns the version ids actually deleted."""
+        now = time.monotonic()
+        with self._lock:
+            if force:
+                ready = [vid for _, vid in self._pending_retire]
+                self._pending_retire = []
+            else:
+                ready = [
+                    vid for due, vid in self._pending_retire if due <= now
+                ]
+                self._pending_retire = [
+                    (due, vid) for due, vid in self._pending_retire
+                    if due > now
+                ]
+        for vid in ready:
+            self._delete_version_dir(vid)
+        return ready
 
     def _log(self, msg: str, **fields) -> None:
         from distributed_eigenspaces_tpu.utils.metrics import log_line
@@ -215,6 +306,13 @@ class EigenbasisRegistry:
         for name in sorted(os.listdir(self.registry_dir)):
             m = _VERSION_DIR_RE.match(name)
             if not m:
+                # ids renamed away by a PRIOR recovery (quarantined /
+                # fenced evidence dirs) still count toward _next_id:
+                # reusing one would collide with replicas that already
+                # marked it seen-and-rejected
+                mq = re.match(r"^v(\d{8})\.(?:quarantined|fenced)$", name)
+                if mq:
+                    max_seen = max(max_seen, int(mq.group(1)))
                 continue
             version = int(m.group(1))
             max_seen = max(max_seen, version)
@@ -263,6 +361,7 @@ class EigenbasisRegistry:
                     ),
                     lineage=dict(meta.get("lineage") or {}),
                 )
+                epoch = int(meta.get("epoch", 0))
             except Exception as e:
                 # corrupt-but-committed (tamper, rot, truncation):
                 # quarantine — never serve it, never silently delete
@@ -276,8 +375,30 @@ class EigenbasisRegistry:
                     version=version, path=qpath, error=repr(e),
                 )
                 continue
-            entries.append(bv)
-        entries.sort(key=lambda b: b.version)
+            entries.append((bv, epoch))
+        entries.sort(key=lambda be: be[0].version)
+        # epoch fencing (ISSUE 14): epochs must be non-decreasing in
+        # version order — a commit from a LOWER epoch than an earlier
+        # version is a zombie ex-publisher writing after failover.
+        # Fence it loudly (evidence preserved), never serve it.
+        kept: list[BasisVersion] = []
+        max_epoch = 0
+        for bv, epoch in entries:
+            if epoch < max_epoch:
+                path = self._version_dir(bv.version)
+                fpath = path + ".fenced"
+                shutil.rmtree(fpath, ignore_errors=True)
+                os.replace(path, fpath)
+                self.fenced.append(os.path.basename(fpath))
+                self._log(
+                    "registry recovery: stale-epoch commit fenced",
+                    version=bv.version, epoch=epoch,
+                    fencing_epoch=max_epoch, path=fpath,
+                )
+                continue
+            max_epoch = max(max_epoch, epoch)
+            kept.append(bv)
+        entries = kept
         for bv in entries[:-self.keep] if len(entries) > self.keep else []:
             self._delete_version_dir(bv.version)
         entries = entries[-self.keep:]
@@ -311,8 +432,15 @@ class EigenbasisRegistry:
         The basis is copied, frozen, and validated (2-D, finite) before
         the swap — a rejected publish leaves the registry untouched, and
         an accepted one is visible to ``latest()`` only as a complete
-        version.
+        version. With a ``lease`` attached, the lease is re-validated
+        first (``lease.ensure()`` raises ``LeaseLost``): a zombie
+        ex-publisher is rejected by the store BEFORE it assigns an id
+        or touches disk — no torn commit, no duplicated version id.
         """
+        if self.lease is not None:
+            # store-side fencing: re-reads the lease file, raises
+            # LeaseLost when a standby took over (higher epoch)
+            self.lease.ensure()
         arr = _frozen_array(v)
         if arr.ndim != 2:
             raise ValueError(
@@ -369,8 +497,9 @@ class EigenbasisRegistry:
                 del self._versions[oldest]
                 gc_ids.append(oldest)
         if self.registry_dir is not None:
-            for vid in gc_ids:  # disk GC mirrors memory GC (best effort)
-                self._delete_version_dir(vid)
+            # disk GC mirrors memory GC (best effort); with a grace
+            # window the payloads linger so replicas mid-read survive
+            self._retire_disk(gc_ids)
         return bv
 
     def publish_fit(self, estimator, *, lineage: Mapping[str, Any] | None = None,
@@ -456,6 +585,34 @@ class EigenbasisRegistry:
                     f"retained: {retained}) — raise serve_keep_versions "
                     "to widen the retention window"
                 ) from None
+
+    def load_payload(self, version: int) -> np.ndarray:
+        """Re-read a version's committed basis from the DISK tier (the
+        path a replica takes between commit-marker read and install).
+        A version GC'd out from under the read — even one whose dir
+        vanished between ``latest()`` and the ``np.load`` — raises
+        :class:`VersionRetired`, never a dangling-path
+        ``FileNotFoundError``: retirement is the only terminal answer
+        the read side ever gives."""
+        if self.registry_dir is None:
+            raise ValueError(
+                "load_payload needs a durable registry "
+                "(cfg.registry_dir is not set)"
+            )
+        payload = os.path.join(self._version_dir(version), "basis.npz")
+        try:
+            with np.load(payload) as z:
+                return _frozen_array(z["v"])
+        except FileNotFoundError:
+            with self._lock:
+                retained = sorted(self._versions)
+            raise VersionRetired(
+                f"version {version} is not on disk: retired past its "
+                f"grace window (retire_grace_s={self.retire_grace_s}; "
+                f"currently retained: {retained}) — raise "
+                "serve_keep_versions or replica_staleness_ms to widen "
+                "the window"
+            ) from None
 
     def versions(self) -> list[int]:
         """Retained version ids, oldest first."""

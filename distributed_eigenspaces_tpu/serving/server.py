@@ -414,6 +414,11 @@ class QueryServer:
             out["lane_dead"] = self._watchdog.dead
         if self.last_recovery_ms is not None:
             out["last_recovery_ms"] = round(self.last_recovery_ms, 3)
+        reg_health = getattr(self.registry, "health", None)
+        if callable(reg_health):
+            # a ReplicaRegistry backs this server: its watcher-lane
+            # liveness + staleness snapshot is part of read-path health
+            out["replica"] = reg_health()
         return out
 
     # -- client API ----------------------------------------------------------

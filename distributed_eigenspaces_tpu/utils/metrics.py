@@ -129,6 +129,13 @@ class MetricsLogger:
         #: TierSet): per-tier round closes, stale folds, tier quorum
         #: transitions — surfaced by :meth:`summary` under "merge"
         self.merge_records = RingLog(retention, self._evict_merge)
+        #: registry-replication events (serving/replication.py
+        #: ReplicaRegistry installs / staleness breaches / fenced
+        #: zombie commits, PublisherLease failovers) — surfaced by
+        #: :meth:`summary` under "replication"
+        self.replication_records = RingLog(
+            retention, self._evict_replication
+        )
         #: compile-lifecycle counters (utils/compile_cache.py
         #: CompileCache), attached via :meth:`attach_compile` —
         #: surfaced by :meth:`summary` under "compile"
@@ -175,6 +182,17 @@ class MetricsLogger:
         # deadline closes, stale folds, arrival histogram) — so
         # summary()["merge"] covers the whole run after eviction
         self._merge_agg: dict = {"count": 0, "by_kind": {}, "tiers": {}}
+        # registry-replication eviction aggregates (ISSUE 14): event
+        # counts by kind, install/staleness/fencing/failover counters,
+        # failover recovery times, and the mergeable propagation-lag
+        # histogram — so summary()["replication"] (propagation p99,
+        # failover count + recovery_ms) covers the whole run after
+        # ring-buffer eviction
+        self._replication_agg: dict = {
+            "count": 0, "by_kind": {}, "installs": 0, "stale": 0,
+            "fenced": 0, "failovers": 0, "recovery_ms": [],
+            "lag_hist": Histogram(),
+        }
 
     @staticmethod
     def _fresh_dispatch_agg() -> dict:
@@ -347,6 +365,18 @@ class MetricsLogger:
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
 
+    def replication(self, event: dict) -> None:
+        """Record one structured registry-replication event (a replica
+        install with its propagation ``lag_ms``, a staleness-bound
+        breach, a fenced zombie commit, or a publisher-lease failover —
+        ``serving/replication.py``). Rides the same JSON stream as step
+        records, tagged ``"replication"``."""
+        rec = {"replication": event.get("kind", "unknown"), **event}
+        _stamp(rec)
+        self.replication_records.append(rec)
+        if self.stream is not None:
+            print(json.dumps(rec), file=self.stream, flush=True)
+
     def fault(self, event: dict) -> None:
         """Record one structured fault event (a supervisor detection /
         recovery action). Events ride the same JSON stream as step
@@ -422,6 +452,36 @@ class MetricsLogger:
         if arrived is not None:
             key = str(int(arrived))
             t["arrival_hist"][key] = t["arrival_hist"].get(key, 0) + 1
+
+    def _evict_replication(self, rec: dict) -> None:
+        agg = self._replication_agg
+        agg["count"] += 1
+        self._fold_replication(agg, rec)
+        if rec.get("replication") == "install":
+            lag = rec.get("lag_ms")
+            if lag is not None:
+                # histograms carry seconds everywhere else; keep the
+                # unit and convert back at report time
+                agg["lag_hist"].record(max(float(lag), 1e-3) / 1e3)
+
+    @staticmethod
+    def _fold_replication(agg: dict, rec: dict) -> None:
+        """One replication event into the counter aggregate — shared by
+        eviction and the live-window pass in the summary builder."""
+        kind = rec.get("replication", "unknown")
+        agg["by_kind"][kind] = agg["by_kind"].get(kind, 0) + 1
+        if kind == "install":
+            agg["installs"] += 1
+        elif kind == "stale":
+            agg["stale"] += 1
+        elif kind == "fenced":
+            agg["fenced"] += 1
+        elif kind == "failover":
+            agg["failovers"] += 1
+            if rec.get("recovery_ms") is not None:
+                agg["recovery_ms"].append(
+                    round(float(rec["recovery_ms"]), 3)
+                )
 
     def _evict_serve(self, rec: dict) -> None:
         if rec.get("serve") == "drift":
@@ -581,6 +641,8 @@ class MetricsLogger:
             out["membership"] = self._membership_summary()
         if self.merge_records or self._merge_agg["count"]:
             out["merge"] = self._merge_summary()
+        if self.replication_records or self._replication_agg["count"]:
+            out["replication"] = self._replication_summary()
         if self.serve_records or self._serve_agg["events"]:
             out["serving"] = self._serving_summary()
         if self.fleet_records or self._fleet_agg["events"]:
@@ -788,6 +850,60 @@ class MetricsLogger:
         }
         if self.merge_records.evicted:
             out["events_evicted"] = self.merge_records.evicted
+        return out
+
+    def _replication_summary(self) -> dict:
+        """The ``summary()["replication"]`` section (ISSUE 14): event
+        counts by kind, replica installs / staleness breaches / fenced
+        zombie commits, propagation-lag percentiles (exact over the
+        live window; log-bucket histogram estimates once the ring has
+        evicted — the latency-section rule), failover count + per-
+        failover recovery_ms, and the retained event window."""
+        agg = self._replication_agg
+        fold = {
+            "by_kind": dict(agg["by_kind"]), "installs": agg["installs"],
+            "stale": agg["stale"], "fenced": agg["fenced"],
+            "failovers": agg["failovers"],
+            "recovery_ms": list(agg["recovery_ms"]),
+        }
+        live_lags: list[float] = []
+        for r in self.replication_records:
+            self._fold_replication(fold, r)
+            if (
+                r.get("replication") == "install"
+                and r.get("lag_ms") is not None
+            ):
+                live_lags.append(float(r["lag_ms"]))
+        out: dict = {
+            "events": agg["count"] + len(self.replication_records),
+            "by_kind": fold["by_kind"],
+            "installs": fold["installs"],
+            "stale": fold["stale"],
+            "fenced": fold["fenced"],
+            "failovers": fold["failovers"],
+        }
+        evicted = agg["lag_hist"].count > 0
+        if live_lags and not evicted:
+            lat = sorted(live_lags)
+            out["propagation_p50_ms"] = round(lat[len(lat) // 2], 3)
+            out["propagation_p99_ms"] = round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3
+            )
+        elif evicted:
+            h = agg["lag_hist"].copy()
+            h.record_many(max(v, 1e-3) / 1e3 for v in live_lags)
+            out["propagation_p50_ms"] = round(
+                (h.quantile(0.5) or 0.0) * 1e3, 3
+            )
+            out["propagation_p99_ms"] = round(
+                (h.quantile(0.99) or 0.0) * 1e3, 3
+            )
+            out["lag_hist"] = h.as_dict()
+        if fold["recovery_ms"]:
+            out["failover_recovery_ms"] = fold["recovery_ms"]
+        out["recent"] = list(self.replication_records)
+        if self.replication_records.evicted:
+            out["events_evicted"] = self.replication_records.evicted
         return out
 
     def _fleet_summary(self) -> dict:

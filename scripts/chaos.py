@@ -54,11 +54,39 @@ the serve-tier duals of the fit-side faults:
   breaker must trip and fast-fail while a neighbor signature serves
   bit-exact.
 
+``--mode replica`` (ISSUE 14) runs the REPLICATED-registry chaos suite
+(``serving/replication.py``) — the fleet-level duals of the serve
+faults, all against one committed ``registry_dir``:
+
+- **propagation**: every publish reaches every tailing replica within
+  the declared staleness bound (measured, not assumed);
+- **publisher failover**: the primary's lease lapses (the in-process
+  stand-in for kill -9; the real SIGKILL variant lives in ``bench.py
+  --replica``), a standby takes over with a bumped fencing epoch, its
+  next publish is accepted by every replica, and version ids stay
+  strictly unique;
+- **zombie publisher**: the deposed primary is rejected twice — the
+  store itself raises ``LeaseLost`` before assigning an id, and a
+  forged stale-epoch commit (written behind the lease's back) is
+  fenced by every replica AND by a fresh recovery scan;
+- **torn commit seen mid-tail**: a payload whose marker hasn't landed
+  is skipped loudly and retried, then installed once the marker
+  commits — never half-installed;
+- **slow / partitioned watcher**: a replica whose poll cadence is far
+  past the staleness bound reports itself stale LOUDLY (stale events,
+  lag > bound) and heals to lag 0 when the partition lifts;
+- **replica kill + warm restart**: a replica torn down mid-stream
+  comes back serving the recovered latest bit-exact, zero refit;
+- **retire grace**: a version GC'd past its grace window answers
+  ``VersionRetired`` on the disk-tier read path — never a dangling
+  ``FileNotFoundError``.
+
 Usage::
 
     JAX_PLATFORMS=cpu python scripts/chaos.py --trainer segmented
     python scripts/chaos.py --dim 256 --steps 20 --kill-step 13
     JAX_PLATFORMS=cpu python scripts/chaos.py --mode serve
+    JAX_PLATFORMS=cpu python scripts/chaos.py --mode replica
 """
 
 from __future__ import annotations
@@ -80,7 +108,7 @@ sys.path.insert(
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--mode", choices=["fit", "serve", "churn"],
+    p.add_argument("--mode", choices=["fit", "serve", "churn", "replica"],
                    default="fit",
                    help="fit: the write-path recovery contract "
                    "(supervisor kill/quarantine/resume); serve: the "
@@ -88,7 +116,11 @@ def build_parser() -> argparse.ArgumentParser:
                    "lane kill, overload shed, breaker isolation); "
                    "churn: the elastic-membership suite (lease "
                    "liveness, deadline rounds, straggler folds, "
-                   "quorum loss + auto-resume)")
+                   "quorum loss + auto-resume); replica: the "
+                   "replicated-registry suite (staleness-bounded "
+                   "propagation, publisher-lease failover + zombie "
+                   "fencing, torn/partitioned tails, replica warm "
+                   "restart)")
     p.add_argument("--dim", type=int, default=64)
     p.add_argument("--k", type=int, default=3)
     p.add_argument("--workers", type=int, default=4)
@@ -114,6 +146,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--keep-dir", default=None,
                    help="checkpoint dir to keep (default: a tempdir)")
+    p.add_argument("--replicas", type=int, default=3,
+                   help="--mode replica: tailing replicas")
+    p.add_argument("--staleness-ms", type=float, default=500.0,
+                   help="--mode replica: declared propagation bound "
+                   "(cfg.replica_staleness_ms)")
+    p.add_argument("--lease-ms", type=float, default=200.0,
+                   help="--mode replica: publisher lease duration "
+                   "(cfg.publisher_lease_ms)")
     return p
 
 
@@ -301,6 +341,272 @@ def serve_chaos(args) -> int:
         "breaker_health": health.get("breakers"),
         "torn_skipped": reg2.torn_skipped,
         "quarantined": reg2.quarantined,
+        "checks": checks,
+        "ok": all(checks.values()),
+        "registry_dir": reg_dir if keep_dir else None,
+    }
+    print(json.dumps(report, indent=2))
+    if not keep_dir:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+    return 0 if report["ok"] else 1
+
+
+def replica_chaos(args) -> int:
+    """``--mode replica``: the replicated-registry chaos suite (module
+    docstring). In-process faults — lease lapse stands in for the
+    publisher kill -9, whose real-SIGKILL variant (plus the saturating
+    multi-replica burst) lives in ``bench.py --replica``."""
+    import dataclasses as _dc
+    import time
+
+    from distributed_eigenspaces_tpu.serving import (
+        EigenbasisRegistry,
+        LeaseLost,
+        PublisherLease,
+        ReplicaRegistry,
+        VersionRetired,
+    )
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    d, k = args.dim, args.k
+    stale_ms = args.staleness_ms
+    grace_s = 2.0 * stale_ms / 1e3
+    rng = np.random.default_rng(args.seed)
+
+    def basis() -> np.ndarray:
+        return np.linalg.qr(rng.standard_normal((d, k)))[0].astype(
+            np.float32
+        )
+
+    def await_version(rep, version: int, timeout_s: float = 10.0):
+        """ms from now until the replica serves >= version (None on
+        timeout) — an upper bound on its propagation lag."""
+        t0 = time.perf_counter()
+        deadline = t0 + timeout_s
+        while time.perf_counter() < deadline:
+            lv = rep.latest()
+            if lv is not None and lv.version >= version:
+                return (time.perf_counter() - t0) * 1e3
+            rep.poke()
+            time.sleep(0.002)
+        return None
+
+    keep_dir = args.keep_dir
+    root = keep_dir or tempfile.mkdtemp(prefix="det_chaos_replica_")
+    reg_dir = os.path.join(root, "registry")
+    metrics = MetricsLogger()
+    checks: dict[str, bool] = {}
+    published: list[int] = []
+
+    # -- 1. propagation: every publish reaches every replica in bound ------
+    primary = PublisherLease(
+        reg_dir, owner="primary", lease_ms=args.lease_ms,
+        metrics=metrics,
+    )
+    assert primary.try_acquire()
+    reg = EigenbasisRegistry(
+        keep=4, registry_dir=reg_dir, lease=primary,
+        retire_grace_s=grace_s, metrics=metrics,
+    )
+    replicas = [
+        ReplicaRegistry(
+            reg_dir, name=f"r{i}", keep=4, staleness_ms=stale_ms,
+            poll_s=0.005, metrics=metrics,
+        )
+        for i in range(args.replicas)
+    ]
+    prop_ms: list[float] = []
+    try:
+        for _ in range(2):
+            bv = reg.publish(basis(), lineage={"producer": "chaos"})
+            published.append(bv.version)
+            for rep in replicas:
+                ms = await_version(rep, bv.version)
+                checks["propagation_within_bound"] = (
+                    checks.get("propagation_within_bound", True)
+                    and ms is not None and ms <= stale_ms
+                )
+                if ms is not None:
+                    prop_ms.append(ms)
+
+        # -- 2. publisher failover: lapse → standby takeover, new epoch ----
+        primary.stop_heartbeat()  # the "kill": renewals stop
+        standby = PublisherLease(
+            reg_dir, owner="standby", lease_ms=args.lease_ms,
+            metrics=metrics,
+        )
+        t0 = time.perf_counter()
+        standby.acquire(timeout_s=10.0)
+        reg_standby = EigenbasisRegistry(
+            keep=4, registry_dir=reg_dir, lease=standby,
+            retire_grace_s=grace_s, metrics=metrics,
+        )
+        bv = reg_standby.publish(basis(), lineage={"producer": "standby"})
+        failover_ms = None
+        for rep in replicas:
+            ms = await_version(rep, bv.version)
+            if ms is None:
+                failover_ms = None
+                break
+            failover_ms = (time.perf_counter() - t0) * 1e3
+        published.append(bv.version)
+        metrics.replication({
+            "kind": "failover", "owner": "standby",
+            "epoch": standby.epoch, "recovery_ms": failover_ms,
+        })
+        checks["failover_bounded"] = (
+            failover_ms is not None
+            and failover_ms <= 10.0 * args.lease_ms
+        )
+        checks["failover_epoch_bumped"] = standby.epoch == primary.epoch + 1
+        checks["no_duplicate_version_ids"] = (
+            len(set(published)) == len(published)
+            and published == sorted(published)
+        )
+
+        # -- 3. zombie publisher: rejected by store, fenced by replicas ----
+        try:
+            reg.publish(basis(), lineage={"producer": "zombie"})
+            checks["zombie_rejected_store_side"] = False
+        except LeaseLost:
+            checks["zombie_rejected_store_side"] = True
+
+        class _StaleLease:
+            # a zombie that skips the store's lease check entirely —
+            # the forged write path replicas must fence on their own
+            epoch = primary.epoch
+
+            @staticmethod
+            def ensure() -> None:
+                pass
+
+        reg_forge = EigenbasisRegistry(
+            keep=4, registry_dir=reg_dir, lease=_StaleLease(),
+        )
+        forged = reg_forge.publish(basis(), lineage={"producer": "zombie"})
+        for rep in replicas:
+            rep.poke()
+        time.sleep(0.1)
+        checks["zombie_commit_fenced_by_replicas"] = all(
+            forged.version in rep.fenced
+            and rep.latest().version == bv.version
+            for rep in replicas
+        )
+        reg_recovered = EigenbasisRegistry(
+            keep=4, registry_dir=reg_dir, lease=standby,
+            retire_grace_s=grace_s, metrics=metrics,
+        )
+        checks["zombie_commit_fenced_at_recovery"] = (
+            bool(reg_recovered.fenced)
+            and reg_recovered.latest().version == bv.version
+        )
+
+        # -- 4. torn commit seen mid-tail: skipped, then installed ---------
+        torn_id = forged.version + 1
+        torn_bv = _dc.replace(bv, version=torn_id)
+        vdir = reg_recovered._version_dir(torn_id)
+        checksum = reg_recovered._write_payload(vdir, torn_bv)
+        r0 = replicas[0]
+        r0.poke()
+        deadline = time.monotonic() + 5.0
+        while torn_id not in r0.torn_pending and time.monotonic() < deadline:
+            r0.poke()
+            time.sleep(0.002)
+        torn_seen = torn_id in r0.torn_pending
+        latest_held = r0.latest().version == bv.version
+        reg_recovered._write_meta(vdir, torn_bv, checksum)  # commit lands
+        ms = await_version(r0, torn_id)
+        checks["torn_commit_skipped_then_installed"] = (
+            torn_seen and latest_held and ms is not None
+        )
+        published.append(torn_id)
+
+        # the forged/torn ids landed BEHIND reg_recovered's recovery
+        # scan; a fresh recovery advances _next_id past them (the real
+        # restart path — ids are never reused, even forged ones)
+        reg_final = EigenbasisRegistry(
+            keep=4, registry_dir=reg_dir, lease=standby,
+            retire_grace_s=grace_s, metrics=metrics,
+        )
+
+        # -- 5. slow / partitioned watcher: stale loudly, then heals -------
+        slow = ReplicaRegistry(
+            reg_dir, name="r-slow", keep=4,
+            staleness_ms=max(1.0, stale_ms / 100.0),
+            poll_s=30.0, metrics=metrics, start=False,
+        )
+        bv2 = reg_final.publish(basis())
+        published.append(bv2.version)
+        time.sleep(0.05)  # the commit ages while the watcher is down
+        lag_before = slow.version_lag()
+        slow.start()  # partition heals: the first poll installs, stale
+        ms = await_version(slow, bv2.version)
+        checks["partitioned_watcher_goes_stale_loudly"] = (
+            lag_before is not None and lag_before >= 1
+            and ms is not None and slow.stale_installs >= 1
+        )
+        checks["partitioned_watcher_heals"] = slow.version_lag() == 0
+        slow.close()
+
+        # -- 6. replica kill + warm restart: bit-exact, zero refit ---------
+        r0.close()  # torn down mid-stream (in-process stand-in)
+        r_new = ReplicaRegistry(
+            reg_dir, name="r-restarted", keep=4,
+            staleness_ms=stale_ms, metrics=metrics, start=False,
+        )
+        checks["replica_warm_restart_bit_exact"] = (
+            r_new.latest() is not None
+            and r_new.latest().version
+            == reg_final.latest().version
+            and np.array_equal(
+                r_new.latest().v, reg_final.latest().v
+            )
+        )
+
+        # -- 7. retire grace: VersionRetired, never FileNotFoundError ------
+        for _ in range(4):  # push the earliest versions past keep=4
+            published.append(reg_final.publish(basis()).version)
+        retired_id = published[0]
+        try:
+            reg_final.get(retired_id)
+            in_memory_retired = False
+        except VersionRetired:
+            in_memory_retired = True
+        time.sleep(grace_s + 0.05)
+        reg_final.sweep_retired()
+        try:
+            reg_final.load_payload(retired_id)
+            disk_retired = False
+        except VersionRetired:
+            disk_retired = True
+        except FileNotFoundError:
+            disk_retired = False
+        checks["retired_read_is_version_retired"] = (
+            in_memory_retired and disk_retired
+        )
+    finally:
+        for rep in replicas:
+            rep.close()
+
+    summary = metrics.summary().get("replication", {})
+    report = {
+        "mode": "replica",
+        "replicas": args.replicas,
+        "staleness_ms": stale_ms,
+        "lease_ms": args.lease_ms,
+        "propagation_max_ms": (
+            round(max(prop_ms), 3) if prop_ms else None
+        ),
+        "failover_recovery_ms": (
+            round(failover_ms, 3) if failover_ms is not None else None
+        ),
+        "fencing_epoch": standby.epoch,
+        "published_ids": published,
+        "telemetry": {
+            k: v for k, v in summary.items() if k != "recent"
+        },
         "checks": checks,
         "ok": all(checks.values()),
         "registry_dir": reg_dir if keep_dir else None,
@@ -538,6 +844,8 @@ def main(argv=None) -> int:
         return serve_chaos(args)
     if args.mode == "churn":
         return churn_chaos(args)
+    if args.mode == "replica":
+        return replica_chaos(args)
     import jax
 
     from distributed_eigenspaces_tpu.config import PCAConfig

@@ -58,7 +58,18 @@
 #      loss loud within 2x heartbeat timeout + checkpoint auto-resume,
 #      all gated by the bench itself; compared (churn_recovery_ms
 #      ratio + structural bound) vs the committed BENCH_CHURN_SMOKE_CPU;
-#   9. bench.py --tree: the hierarchical-merge smoke (ISSUE 12) —
+#   9. bench.py --replica: the replicated-registry fleet smoke (ISSUE
+#      14) — a kill -9'd publisher (lease live) fails over to a standby
+#      at epoch+1 within the bounded window with zero duplicate version
+#      ids; the zombie's identity is fenced store-side (LeaseLost) AND
+#      a forged stale-epoch commit is fenced by every replica and the
+#      recovery scan; a mid-burst hot swap reaches all N replicas
+#      inside replica_staleness_ms with bit-exact post-swap serves; a
+#      kill -9'd replica warm-restarts bit-exact. The compare gates
+#      propagation-p99 drift against the committed
+#      BENCH_REPLICA_SMOKE_CPU.json (old/new ratio + the record's own
+#      staleness bound as the structural floor);
+#   10. bench.py --tree: the hierarchical-merge smoke (ISSUE 12) —
 #      the same planted fit flat vs the chip:4 x host:2 tree: both
 #      inside the angle budget and agreeing with each other, the
 #      tiered program passing its tree_merge contract, and the
@@ -67,7 +78,7 @@
 #      headline win, reported as the payload-reduction ratio); the
 #      compare gates that structural ratio against the committed
 #      BENCH_TREE_SMOKE_CPU.json (same-topology records only);
-#   10. scripts/scenario.py: the production-shaped scenario replay
+#   11. scripts/scenario.py: the production-shaped scenario replay
 #      (ISSUE 11) — a 3-episode composition (flash crowd + lane kill,
 #      correlated fit-tier churn, mid-burst registry publish) replayed
 #      from scenarios/ci_smoke.json against the full stack, judged
@@ -78,7 +89,7 @@
 #      the committed BENCH_SCENARIO_SMOKE_CPU.json (ratio floors + a
 #      10 s structural recovery bound + a 0.5 absolute attainment
 #      floor, so CPU-rig jitter can't flap CI);
-#   11. scripts/analyze.py --all --costs --shardings --mutation-check:
+#   12. scripts/analyze.py --all --costs --shardings --mutation-check:
 #      the static program-contract gate (ISSUE 10 + 13,
 #      docs/ANALYSIS.md) — every program kind audited against its
 #      declarative contract (collective schedule + payload bounds,
@@ -90,12 +101,12 @@
 #      class is caught. ruff (the dev extra / Dockerfile image) runs
 #      first when on PATH; a missing ruff now SKIPS LOUDLY instead of
 #      silently (DET_CI_REQUIRE_RUFF=1 turns the skip into a failure);
-#   12. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   13. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/12] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/13] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -103,7 +114,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/12] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/13] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -113,7 +124,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/12] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/13] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -128,7 +139,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/12] serve equality + amortization smoke (CPU) =="
+echo "== [4/13] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -143,7 +154,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/12] coldstart + prewarm smoke (CPU) =="
+echo "== [5/13] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -158,7 +169,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [6/12] telemetry smoke: trace export + span-chain validation =="
+echo "== [6/13] telemetry smoke: trace export + span-chain validation =="
 # A serve burst with --trace-out, then a structural validation of the
 # emitted timeline: the JSON must parse as Chrome trace-event format,
 # every served query's span chain (admit → queue_wait → dispatch →
@@ -203,7 +214,7 @@ print(json.dumps({
 }))
 PY
 
-echo "== [7/12] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
+echo "== [7/13] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
 # bench.py --chaos-serve asserts the read-path resilience gates itself
 # (ISSUE 7): a kill -9'd publisher's store recovers (torn snapshot
 # skipped, checksum corruption quarantined) and the restarted server
@@ -222,7 +233,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve
 fi
 
-echo "== [8/12] chaos-churn smoke: elastic membership under churn (CPU) =="
+echo "== [8/13] chaos-churn smoke: elastic membership under churn (CPU) =="
 # bench.py --chaos-churn asserts the fit-tier elastic-membership gates
 # itself (ISSUE 8): a run with 30% mid-run worker loss, flapping
 # rejoins, and a persistent straggler finishes all steps inside the
@@ -242,7 +253,29 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-churn
 fi
 
-echo "== [9/12] tree-merge smoke: flat vs tiered tree (CPU) =="
+echo "== [9/13] replica fleet smoke: lease failover + bounded staleness (CPU) =="
+# bench.py --replica asserts the replicated-registry gates itself
+# (ISSUE 14): N replicas warm-recover a kill -9'd publisher's store
+# bit-exact; a standby waits out the live lease and takes over at
+# epoch+1 within the bounded window with ZERO duplicate version ids;
+# the zombie's identity is rejected store-side (LeaseLost before an id
+# is assigned) and a forged stale-epoch commit is fenced by every
+# replica AND the recovery scan; a mid-burst hot swap reaches all N
+# replicas inside replica_staleness_ms with bit-exact post-swap
+# serves; a kill -9'd replica warm-restarts and re-serves bit-exact.
+# The compare checks propagation-p99 drift against the committed
+# record (old/new ratio + the record's own staleness bound as the
+# structural floor, override with DET_REPLICA_PROPAGATION_BOUND_MS —
+# a p99 inside the declared SLO never flaps CI).
+if [[ -f BENCH_REPLICA_SMOKE_CPU.json ]]; then
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --replica \
+        --compare BENCH_REPLICA_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --replica
+fi
+
+echo "== [10/13] tree-merge smoke: flat vs tiered tree (CPU) =="
 # bench.py --tree asserts the hierarchical-merge gates itself (ISSUE
 # 12): the same planted fit run flat and through the chip:4 x host:2
 # tree must both land inside the angle budget AND agree with each
@@ -261,7 +294,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --tree
 fi
 
-echo "== [10/12] scenario replay: production-shaped composition (CPU) =="
+echo "== [11/13] scenario replay: production-shaped composition (CPU) =="
 # scripts/scenario.py replays scenarios/ci_smoke.json — a flash crowd
 # with a mid-crowd lane kill, correlated fit-tier worker churn, and a
 # mid-burst registry publish on one timeline — and judges it purely
@@ -281,7 +314,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --scenario scenarios/ci_smoke.json
 fi
 
-echo "== [11/12] static analysis: contracts + shardings + costs + lints + mutations =="
+echo "== [12/13] static analysis: contracts + shardings + costs + lints + mutations =="
 # scripts/analyze.py compiles (never runs) the whole program matrix and
 # audits each program against its contract — collective schedule,
 # memory policy, baked constants, and (ISSUE 13) the declared
@@ -309,7 +342,7 @@ fi
 JAX_PLATFORMS=cpu python scripts/analyze.py --all --costs --shardings \
     --mutation-check
 
-echo "== [12/12] graft entry + 8-device sharded dryrun =="
+echo "== [13/13] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

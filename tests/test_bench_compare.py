@@ -541,6 +541,111 @@ def test_chaos_churn_missing_recovery_skips_loudly(tmp_path, capsys):
     assert "churn_recovery_ms" in verdict["reason"]
 
 
+def _replica_report(p99_ms, *, recovery_ms=330.0, staleness_ms=500.0):
+    """A bench.py --replica record (the ISSUE-14 shape)."""
+    return {
+        "metric": "pca_replica_propagation",
+        "value": p99_ms,
+        "unit": "ms",
+        "replicas": 3,
+        "staleness_ms": staleness_ms,
+        "lease_ms": 400.0,
+        "propagation_p99_ms": p99_ms,
+        "recovery_ms": recovery_ms,
+        "fencing_epoch": 2,
+        "gates": {"midburst_propagation_within_staleness": True},
+    }
+
+
+def test_replica_records_compare_propagation_and_failover(
+    tmp_path, capsys
+):
+    """ISSUE-14 satellite: replica records compare propagation p99
+    (old/new ratio with the record's OWN staleness bound as the
+    structural floor — poll-cadence jitter must not flap CI) and
+    surface the failover recovery time on both sides of the verdict."""
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_replica_report(10.0)))
+    # slower propagation, still inside the declared staleness SLO:
+    # no flap, whatever the ratio says
+    assert bench.compare_reports(
+        str(old), _replica_report(80.0, recovery_ms=410.0), threshold=0.5
+    ) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["propagation_p99_ms_old"] == 10.0
+    assert verdict["propagation_p99_ms_new"] == 80.0
+    assert verdict["recovery_ms_old"] == 330.0
+    assert verdict["recovery_ms_new"] == 410.0
+    assert verdict["structural_bound_ms"] == 500.0
+    assert not verdict["regression"]
+
+    # propagation past BOTH the ratio floor and the staleness bound:
+    # a wedged watcher, not jitter
+    assert bench.compare_reports(
+        str(old), _replica_report(1500.0), threshold=0.5
+    ) == 1
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["regression"] is True
+    assert verdict["structural_bound_ms"] == 500.0
+
+
+def test_replica_vs_headline_mismatch_skips_both_directions(
+    tmp_path, capsys
+):
+    # pre-ISSUE-14 rounds have no replica record: the compare must
+    # skip LOUDLY in both directions, never ratio across metrics
+    headline = _report(60e6, 120.0)
+    replica = _replica_report(10.0)
+    old = tmp_path / "old.json"
+
+    old.write_text(json.dumps(replica))
+    assert bench.compare_reports(str(old), headline) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]
+
+    old.write_text(json.dumps(headline))
+    assert bench.compare_reports(str(old), replica) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]
+
+
+def test_replica_vs_chaos_serve_mismatch_skips(tmp_path, capsys):
+    # both records carry a recovery_ms but mean different protocols
+    # (serve restart vs publisher lease failover) — never cross-compared
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_chaos_report(320.0)))
+    assert bench.compare_reports(str(old), _replica_report(10.0)) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "metric mismatch" in verdict["reason"]
+
+
+def test_replica_missing_p99_skips_loudly(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    rep = _replica_report(10.0)
+    rep["value"] = None
+    old.write_text(json.dumps(rep))
+    assert bench.compare_reports(str(old), _replica_report(12.0)) == 0
+    verdict = json.loads(capsys.readouterr().err.strip())
+    assert verdict["compare"] == "skipped"
+    assert "propagation p99" in verdict["reason"]
+
+
+def test_committed_replica_smoke_record_passes_self_compare():
+    """The committed BENCH_REPLICA_SMOKE_CPU.json must be comparable
+    against itself (ratio 1.0, no regression) — the CI stage's shape
+    contract."""
+    path = Path(__file__).resolve().parent.parent / (
+        "BENCH_REPLICA_SMOKE_CPU.json"
+    )
+    record = json.loads(path.read_text())
+    record = record.get("parsed", record)
+    assert record["metric"] == "pca_replica_propagation"
+    assert bench.compare_reports(str(path), dict(record)) == 0
+
+
 def _scenario_report(attainment, crowd_recovery_ms, *, recovered=True,
                      scenario="ci_smoke"):
     """A scripts/scenario.py verdict record (the ISSUE-11 shape)."""

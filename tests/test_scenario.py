@@ -93,6 +93,22 @@ class TestSpecValidation:
         ({"name": "a", "kind": "churn", "start_s": 0.0,
           "duration_s": 1.0, "workers": 2, "kill_slots": [5],
           "kill_step": 1}, "episode 'a': field 'kill_slots'"),
+        # ISSUE 14: replicas must be a positive int
+        ({"name": "a", "kind": "publish", "start_s": 0.0,
+          "duration_s": 0.0, "replicas": 0},
+         "episode 'a': field 'replicas'"),
+        ({"name": "a", "kind": "publish", "start_s": 0.0,
+          "duration_s": 0.0, "replicas": "two"},
+         "episode 'a': field 'replicas'"),
+        # kill_publisher must be a bool...
+        ({"name": "a", "kind": "publish", "start_s": 0.0,
+          "duration_s": 0.0, "replicas": 2, "kill_publisher": 1},
+         "episode 'a': field 'kill_publisher'"),
+        # ...and only exists on the replicated registry
+        ({"name": "a", "kind": "publish", "start_s": 0.0,
+          "duration_s": 0.0, "kill_publisher": True},
+         "episode 'a': field 'kill_publisher' requires field "
+         "'replicas'"),
     ])
     def test_malformed_episode_names_episode_and_field(self, ep, needle):
         with pytest.raises(ValueError) as ei:
