@@ -174,7 +174,7 @@ def online_distributed_pca(
             cfg.num_workers,
             backend="local" if cfg.backend == "auto" and len(jax.devices()) == 1
             else ("shard_map" if cfg.backend == "auto" else cfg.backend),
-            solver=cfg.solver,
+            solver=cfg.resolved_local_solver(),
             subspace_iters=cfg.subspace_iters,
             orth_method=cfg.orth_method,
             compute_dtype=cfg.compute_dtype,

@@ -46,7 +46,9 @@ def make_solve_core(
     halves back into the classic fused round, so the numerics have ONE
     definition either way.
     """
-    k, solver = cfg.k, cfg.solver
+    # "distributed" runs the subspace machinery for LOCAL solves; its
+    # crossover dispatch lives in the MERGE (merge_core / extract)
+    k, solver = cfg.k, cfg.resolved_local_solver()
     if iters is None:
         iters = cfg.subspace_iters
     # ``orth`` override: warm cores pass cfg.resolved_warm_orth() (the
@@ -87,7 +89,7 @@ def make_warm_solve_core(cfg: PCAConfig):
     )
 
 
-def merge_core(vs, k, mask=None, topology=None):
+def merge_core(vs, k, mask=None, topology=None, dist_iters=None):
     """The MERGE half of a round: exact masked low-rank top-k of the
     gathered factors (``merged_top_k_lowrank``), under the profiler
     region the traces name. ``mask`` (full ``(m,)`` {0,1}, replicated)
@@ -96,7 +98,14 @@ def merge_core(vs, k, mask=None, topology=None):
     :class:`~..parallel.topology.MergeTopology`) runs the tiered tree
     reduce over the stack instead (``tree_merge_stacked`` — per-group
     exact merges, live-count weighted); ``None`` is the byte-identical
-    flat merge."""
+    flat merge. ``dist_iters`` (set when
+    ``cfg.uses_distributed_solve()`` — solver="distributed" above the
+    ``eigh_crossover_d`` crossover) swaps the merge eigensolve for the
+    distributed subspace path (``solvers/``): the flat merge solves
+    the factor operator iteratively instead of the ``(m*k)^2`` Gram /
+    dense-route eigh, and a tiered tree applies it at the ROOT tier
+    only (lower tiers' per-group problems are small by
+    construction)."""
     from distributed_eigenspaces_tpu.utils.tracing import named_scope
 
     if topology is not None:
@@ -105,7 +114,18 @@ def merge_core(vs, k, mask=None, topology=None):
         )
 
         with named_scope("det_tree_merge"):
-            return tree_merge_stacked(vs, k, topology, mask=mask)
+            return tree_merge_stacked(
+                vs, k, topology, mask=mask, root_dist_iters=dist_iters
+            )
+    if dist_iters is not None:
+        from distributed_eigenspaces_tpu.solvers import (
+            merged_top_k_distributed,
+        )
+
+        with named_scope("det_dist_merge"):
+            return merged_top_k_distributed(
+                vs, k, mask=mask, iters=dist_iters
+            )
     with named_scope("det_merge"):
         return merged_top_k_lowrank(vs, k, mask=mask)
 
@@ -164,10 +184,13 @@ def make_round_core(
     topology = resolve_topology(cfg)
     solve_core = make_solve_core(cfg, iters=iters, orth=orth)
     k = cfg.k
+    dist_iters = cfg.subspace_iters if cfg.uses_distributed_solve() else None
 
     def round_core(x_blocks, axis_name=None, v0=None, mask=None):
         vs = solve_core(x_blocks, axis_name=axis_name, v0=v0)
-        return merge_core(vs, k, mask=mask, topology=topology)
+        return merge_core(
+            vs, k, mask=mask, topology=topology, dist_iters=dist_iters
+        )
 
     return round_core
 

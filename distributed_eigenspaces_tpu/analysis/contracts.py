@@ -283,9 +283,12 @@ CONTRACTS: dict[str, ProgramContract] = {
         # serve kernels vary by transform (project takes (rows, d)+
         # basis, reconstruct takes (rows, k)+basis, residual (rows, d)
         # +(rows, k)) — every row-indexed buffer that appears must be
-        # workers-sharded, the basis replicated BY DESIGN today (the
-        # distributed-solve PR flips that declaration, and this gate
-        # is what will prove the flip landed end-to-end)
+        # workers-sharded, the basis replicated BY DESIGN on this
+        # BELOW-crossover engine (d fits one device). Above
+        # ``cfg.eigh_crossover_d`` serving runs the sharded-basis
+        # engine instead, whose ``dist_serve`` contract declares the
+        # basis sharded over 'features' — that gate is what proves the
+        # flip landed end-to-end
         sharding=ShardingContract(buffers=(
             DeclaredBuffer(
                 "row activations", "in",
@@ -324,6 +327,119 @@ CONTRACTS: dict[str, ProgramContract] = {
                 required=False,
             ),
         )),
+    ),
+    "dist_solve": ProgramContract(
+        name="dist_solve",
+        description=(
+            "distributed eigensolve (ISSUE 15): merge / extract above "
+            "the crossover as subspace iteration on row-sharded "
+            "factors — the worker factor-stack gather plus k-wide "
+            "psums over 'features' (CholeskyQR2 Grams, factor "
+            "matvecs, the Rayleigh-Ritz reduce) only; nothing "
+            "quadratic in m*k, nothing d-wide, never a dense d x d, "
+            "and the result stays a (d_local, k) row shard"
+        ),
+        allowed_collectives=frozenset({"all-gather", "all-reduce"}),
+        max_payload_elems=_factor_stack,
+        require_collectives=True,
+        memory_policy="factor_only",
+        sharding=ShardingContract(
+            buffers=(
+                DeclaredBuffer(
+                    "worker factor stack", "in",
+                    dims=lambda p: (p.m, p.d, WILD),
+                    spec=lambda p: ("workers", "features", None),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    "worker mask", "in",
+                    dims=lambda p: (p.m,),
+                    spec=lambda p: ("workers",),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    "row-sharded state factors", "in",
+                    dims=lambda p: (p.d, WILD),
+                    spec=lambda p: ("features", None),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    "replicated spectrum", "in",
+                    dims=lambda p: (p.sketch_width,),
+                    spec=lambda p: (None,),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    "sharded eigenbasis", "out",
+                    dims=lambda p: (p.d, WILD),
+                    spec=lambda p: ("features", None),
+                ),
+            ),
+            # the d-ceiling rule, same as the sharded trainers: no
+            # device may hold an un-sharded full-d buffer
+            replicated_axis_floor=lambda p: p.d,
+        ),
+    ),
+    "dist_serve": ProgramContract(
+        name="dist_serve",
+        description=(
+            "sharded-basis serving kernels (above the crossover): the "
+            "SAME row-local matmuls on (d_local, k) basis shards, "
+            "plus the one rows x k projection psum the sharding makes "
+            "necessary — no collective ever moves the basis, and the "
+            "dense (d, k) never assembles on one device"
+        ),
+        allowed_collectives=frozenset({"all-reduce"}),
+        # the projection / input-energy psums carry per-row k-wide (or
+        # scalar) payloads — never anything d-wide
+        max_payload_elems=lambda p: p.rows * max(p.k, 1),
+        # reconstruct is row-local on the shards — zero collectives —
+        # so presence is enforced per-kind by the sharding pass, not
+        # globally here
+        require_collectives=False,
+        memory_policy="factor_only",
+        dense_dim=lambda p: p.d,
+        sharding=ShardingContract(
+            buffers=(
+                DeclaredBuffer(
+                    "row activations", "in",
+                    dims=lambda p: (p.rows, p.d),
+                    spec=lambda p: ("workers", "features"),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    "row codes", "in",
+                    dims=lambda p: (p.rows, WILD),
+                    spec=lambda p: ("workers", None),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    "feature-sharded basis", "in",
+                    dims=lambda p: (p.d, WILD),
+                    spec=lambda p: ("features", None),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    "row outputs", "out",
+                    dims=lambda p: (p.rows, WILD),
+                    spec=lambda p: ("workers", None),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    "reconstructed rows", "out",
+                    dims=lambda p: (p.rows, p.d),
+                    spec=lambda p: ("workers", "features"),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    "row scalars", "out",
+                    dims=lambda p: (p.rows,),
+                    spec=lambda p: ("workers",),
+                    required=False,
+                ),
+            ),
+            replicated_axis_floor=lambda p: p.d,
+        ),
     ),
 }
 

@@ -183,6 +183,47 @@ def _mutant_replicated_dk() -> list[contracts.Violation]:
     return viols
 
 
+def _mutant_dist_dense_gram() -> list[contracts.Violation]:
+    """The distributed-solve regression ISSUE 15's gate exists for: a
+    'distributed' eigensolve that assembles the full row set and psums
+    the DENSE d x d Gram over the features axis instead of iterating
+    on the row-sharded factors. Both op kinds (all-gather, all-reduce)
+    are in the dist_solve contract's allowed set — the PAYLOAD bound
+    is what must catch it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_eigenspaces_tpu.parallel.mesh import (
+        make_mesh,
+        shard_map,
+    )
+
+    mesh = make_mesh(num_workers=4, num_feature_shards=2)
+    d = 2 * _D
+
+    def dense_solve(c):  # (d_local, f) factor shard -> dense d x d
+        full = jax.lax.all_gather(c, "features", axis=0, tiled=True)
+        g = jnp.matmul(full, full.T)
+        return jax.lax.psum(g, "features")
+
+    f = jax.jit(shard_map(
+        dense_solve, mesh=mesh, in_specs=P("features", None),
+        out_specs=P(), check_vma=False,
+    ))
+    hlo = f.lower(
+        jnp.zeros((d, 8), jnp.float32)
+    ).compile().as_text()
+    contract = contracts.CONTRACTS["dist_solve"]
+    params = contracts.ProgramParams(
+        d=d, k=2, m=4, n_feature_shards=2, n_workers_mesh=4,
+    )
+    viols, _ = contracts.check_collectives(
+        contract, params, hlo, program="mutant_dist_dense_gram"
+    )
+    return viols
+
+
 def _mutant_tree_payload_drift() -> list[contracts.Violation]:
     """A tree tier moving the flat m-wide factor STACK instead of the
     merged (d, k) basis — the op kind (all-reduce) is in the tree
@@ -287,6 +328,9 @@ MUTATIONS: dict[str, tuple[str, Callable[[], list]]] = {
     "dense_temp": ("dense-buffer", _mutant_dense_temp),
     "baked_constant": ("baked-constant", _mutant_baked_constant),
     "replicated_dk": ("silent-replication", _mutant_replicated_dk),
+    "dist_dense_gram": (
+        "collective-payload", _mutant_dist_dense_gram
+    ),
     "tree_payload_drift": (
         "cost-bound", _mutant_tree_payload_drift
     ),

@@ -244,6 +244,151 @@ def _fleet_program(name: str, *, masked: bool = False):
     return build
 
 
+def _dist_merge_program(name: str):
+    """The distributed MERGE solve (ISSUE 15): dist_merged_top_k on
+    the (workers, features) mesh at audit shapes — the crossover twin
+    of the feature-sharded exact merge. The dist_solve contract's
+    subject: the worker factor-stack gather plus k-wide feature psums
+    only, output a (d_local, k) row shard."""
+
+    def build() -> BuiltProgram:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_eigenspaces_tpu.parallel.mesh import (
+            FEATURE_AXIS,
+            WORKER_AXIS,
+            make_mesh,
+            shard_map,
+        )
+        from distributed_eigenspaces_tpu.solvers import dist_merged_top_k
+
+        require_mesh_devices()
+        mesh = make_mesh(num_workers=_M, num_feature_shards=2)
+
+        def merge(vws, mask):
+            return dist_merged_top_k(vws, _K, mask=mask, iters=2)
+
+        in_specs = (P(WORKER_AXIS, FEATURE_AXIS, None), P(WORKER_AXIS))
+        fit = jax.jit(
+            shard_map(
+                merge, mesh=mesh, in_specs=in_specs,
+                out_specs=P(FEATURE_AXIS, None), check_vma=False,
+            ),
+            in_shardings=tuple(
+                NamedSharding(mesh, s) for s in in_specs
+            ),
+        )
+        args = (
+            jax.ShapeDtypeStruct((_M, _FEAT_D, _K), jnp.float32),
+            jax.ShapeDtypeStruct((_M,), jnp.float32),
+        )
+        return BuiltProgram(
+            name=name, contract="dist_solve",
+            params=ProgramParams(
+                d=_FEAT_D, k=_K, m=_M, n_feature_shards=2,
+                n_workers_mesh=_M,
+            ),
+            jitted=fit, args=args,
+        )
+
+    return build
+
+
+def _dist_extract_program(name: str):
+    """The distributed SERVING extract (ISSUE 15): dist_extract_top_k
+    of the running low-rank state U diag(s) U^T from its row-sharded
+    factors — the publish-time solve above the crossover whose output
+    basis is born sharded."""
+
+    _R = 8  # audit state rank (the operator's factor width)
+
+    def build() -> BuiltProgram:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_eigenspaces_tpu.parallel.mesh import (
+            FEATURE_AXIS,
+            make_mesh,
+            shard_map,
+        )
+        from distributed_eigenspaces_tpu.solvers import dist_extract_top_k
+
+        require_mesh_devices()
+        mesh = make_mesh(num_workers=_M, num_feature_shards=2)
+
+        def extract(u, s):
+            return dist_extract_top_k(u, s, _K, iters=2)
+
+        in_specs = (P(FEATURE_AXIS, None), P())
+        fit = jax.jit(
+            shard_map(
+                extract, mesh=mesh, in_specs=in_specs,
+                out_specs=P(FEATURE_AXIS, None), check_vma=False,
+            ),
+            in_shardings=tuple(
+                NamedSharding(mesh, s) for s in in_specs
+            ),
+        )
+        args = (
+            jax.ShapeDtypeStruct((_FEAT_D, _R), jnp.float32),
+            jax.ShapeDtypeStruct((_R,), jnp.float32),
+        )
+        return BuiltProgram(
+            name=name, contract="dist_solve",
+            params=ProgramParams(
+                d=_FEAT_D, k=_K, m=1, n_feature_shards=2,
+                n_workers_mesh=_M, sketch_width=_R,
+            ),
+            jitted=fit, args=args,
+        )
+
+    return build
+
+
+def _dist_serve_program(name: str, kind: str):
+    """Sharded-basis serving (ISSUE 15): the engine's own lowering at
+    ``basis_spec=("features", None)`` — queries shard over (workers,
+    features), the basis stays a row-sharded operand, and the
+    projection psum is the program's only collective."""
+
+    def build() -> BuiltProgram:
+        import jax
+
+        from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+        from distributed_eigenspaces_tpu.serving.transform import (
+            TransformEngine,
+        )
+
+        require_mesh_devices()
+        mesh = make_mesh(num_workers=4, num_feature_shards=2)
+        eng = TransformEngine(
+            _FEAT_D, _K, mesh=mesh, basis_spec=("features", None),
+        )
+        rows = _SERVE_ROWS
+        fn, arg_like, second_shape = eng._fns[kind]
+        if kind == "residual":
+            second = eng._z_like(rows)
+        else:
+            second = jax.ShapeDtypeStruct(second_shape, jax.numpy.float32)
+        lowered = eng._lowered(kind, rows)
+        built = BuiltProgram(
+            name=name, contract="dist_serve",
+            params=ProgramParams(
+                d=_FEAT_D, k=_K, rows=rows, n_feature_shards=2,
+                n_workers_mesh=4,
+            ),
+            jitted=_ensure_jit(fn),
+            args=(arg_like(rows), second),
+        )
+        built._cache["lowered"] = lowered
+        return built
+
+    return build
+
+
 def _serve_program(name: str, kind: str, *, sharded: bool):
     def build() -> BuiltProgram:
         import jax
@@ -316,6 +461,18 @@ PROGRAMS: dict[str, Callable[[], BuiltProgram]] = {
     ),
     "serve_project_solo": _serve_program(
         "serve_project_solo", "project", sharded=False
+    ),
+    # distributed eigensolve + sharded-basis serving (ISSUE 15)
+    "dist_merge": _dist_merge_program("dist_merge"),
+    "dist_extract": _dist_extract_program("dist_extract"),
+    "dist_serve_project": _dist_serve_program(
+        "dist_serve_project", "project"
+    ),
+    "dist_serve_reconstruct": _dist_serve_program(
+        "dist_serve_reconstruct", "reconstruct"
+    ),
+    "dist_serve_residual": _dist_serve_program(
+        "dist_serve_residual", "residual"
     ),
 }
 

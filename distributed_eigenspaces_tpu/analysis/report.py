@@ -106,7 +106,15 @@ def engine_report(engine) -> dict:
         shardings as shardings_mod,
     )
 
-    contract = contracts.CONTRACTS["serve_transform"]
+    # a sharded-basis engine's project/residual kernels legitimately
+    # psum over 'features' — audit those against the dist_serve
+    # contract, not the zero-collective replicated-basis one
+    kind_key = (
+        "dist_serve"
+        if getattr(engine, "basis_spec", None) is not None
+        else "serve_transform"
+    )
+    contract = contracts.CONTRACTS[kind_key]
     out: dict = {
         "schema": SCHEMA,
         "contract": contract.name,

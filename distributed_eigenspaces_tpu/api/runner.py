@@ -60,12 +60,16 @@ def extract_dense(cfg: PCAConfig, sigma_tilde) -> jax.Array:
     honoring the configured solver (a full d x d eigh at large d is the
     TPU anti-pattern the subspace solver exists for) AND the configured
     orthonormalization — one definition for estimator, evals and CLI
-    (they had drifted on the ``orth_method`` argument)."""
+    (they had drifted on the ``orth_method`` argument).
+    ``solver="distributed"`` resolves to the subspace machinery here:
+    the operand is already a dense replicated d x d, so the distributed
+    path has nothing to save — its crossover lives where the state is a
+    factorization (``solvers.dist_extract_top_k``)."""
     from distributed_eigenspaces_tpu.ops.linalg import merged_top_k
 
     return merged_top_k(
-        sigma_tilde, cfg.k, cfg.solver, max(cfg.subspace_iters, 16),
-        cfg.orth_method,
+        sigma_tilde, cfg.k, cfg.resolved_local_solver(),
+        max(cfg.subspace_iters, 16), cfg.orth_method,
     )
 
 

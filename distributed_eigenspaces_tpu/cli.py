@@ -130,9 +130,21 @@ def build_parser() -> argparse.ArgumentParser:
                    default="auto",
                    help="feature_sharded = large-d path: d sharded over a "
                    "second mesh axis, no d x d matrix anywhere")
-    p.add_argument("--solver", choices=["eigh", "subspace"], default="eigh")
+    p.add_argument("--solver", choices=["eigh", "subspace", "distributed"],
+                   default="eigh",
+                   help="distributed = subspace machinery for worker "
+                   "solves, plus the sharded factor-operator eigensolve "
+                   "(solvers/) for the merge and serving extract whenever "
+                   "--dim exceeds --eigh-crossover-d — the path that "
+                   "breaks the d ceiling")
     p.add_argument("--subspace-iters", type=int, default=16,
-                   help="power-iteration count for --solver subspace")
+                   help="power-iteration count for --solver "
+                   "subspace/distributed")
+    p.add_argument("--eigh-crossover-d", type=int, default=4096,
+                   help="with --solver distributed: dims ABOVE this run "
+                   "the distributed merge/extract eigensolve, dims at or "
+                   "below keep the exact eigh-family path (measure the "
+                   "crossover with bench.py --dsolve)")
     p.add_argument("--warm-orth-method", choices=["cholqr2", "qr", "ns"],
                    default=None,
                    help="orthonormalization for WARM solver rounds only "
@@ -943,6 +955,7 @@ def _fit_fleet_cli(args, data, truth) -> int:
         num_steps=args.steps,
         discount=args.discount,
         solver=args.solver,
+        eigh_crossover_d=args.eigh_crossover_d,
         subspace_iters=args.subspace_iters,
         orth_method=args.orth_method,
         warm_orth_method=args.warm_orth_method,
@@ -1306,7 +1319,7 @@ def main(argv=None) -> int:
         )
     if (
         args.warm_start_iters
-        and args.solver != "subspace"
+        and args.solver not in ("subspace", "distributed")
         and getattr(args, "trainer", None) != "sketch"
     ):
         # an explicit 0 ("disable") is solver-independent; a positive
@@ -1343,7 +1356,8 @@ def main(argv=None) -> int:
     if args.pipeline_merge:
         # clean CLI errors for the combinations PCAConfig / the trainers
         # would reject three layers down
-        if args.solver != "subspace" or args.warm_start_iters == 0:
+        if (args.solver not in ("subspace", "distributed")
+                or args.warm_start_iters == 0):
             print(
                 "error: --pipeline-merge requires --solver subspace with "
                 "warm starts enabled (the pipeline overlaps the merge "
@@ -1455,6 +1469,7 @@ def main(argv=None) -> int:
         discount=args.discount,
         backend=args.backend,
         solver=args.solver,
+        eigh_crossover_d=args.eigh_crossover_d,
         subspace_iters=args.subspace_iters,
         orth_method=args.orth_method,
         warm_orth_method=args.warm_orth_method,

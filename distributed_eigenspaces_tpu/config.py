@@ -35,9 +35,29 @@ class PCAConfig:
       backend: worker-pool backend: ``"auto"`` | ``"local"`` (vmap, single
         device) | ``"shard_map"`` (mesh DP over ICI) | ``"feature_sharded"``
         (2-D mesh, d sharded too — the large-d path).
-      solver: local top-k eigensolver: ``"eigh"`` (exact, d<=~4096) or
-        ``"subspace"`` (block power iteration; never materializes d x d in the
-        streaming path).
+      solver: local top-k eigensolver: ``"eigh"`` (exact, d<=~4096),
+        ``"subspace"`` (block power iteration; never materializes d x d in
+        the streaming path), or ``"distributed"`` (the ISSUE-15 d-ceiling
+        path, ``solvers/``): worker solves run the subspace machinery
+        unchanged (``resolved_local_solver()``), and the MERGE solve and
+        SERVING extract switch to the distributed eigensolve — blocked
+        randomized subspace iteration over the ``features`` axis with
+        CholeskyQR2 + a replicated Rayleigh–Ritz — whenever ``dim``
+        exceeds ``eigh_crossover_d`` (``uses_distributed_solve()``), and
+        stay on the exact eigh-family routes below it. Interactions:
+        the sketch trainer ignores the crossover BY DESIGN (its steady
+        state has no merge eigensolve to replace); ``pipeline_merge``
+        composes (``"distributed"`` is subspace-family, so warm starts
+        resolve); a tiered ``merge_topology`` uses the distributed
+        solve at the ROOT tier only (lower tiers' per-group problems
+        are small by construction).
+      eigh_crossover_d: the eigh-vs-distributed crossover dimension:
+        with ``solver="distributed"``, merge/extract eigensolves run
+        the exact eigh-family routes while ``dim <= eigh_crossover_d``
+        and the distributed subspace path above it (measured sweep:
+        ``bench.py --dsolve``). Only consulted by
+        ``solver="distributed"``; validated here so a bad value fails
+        at config resolution, not mid-fit.
       subspace_iters: power-iteration steps when ``solver="subspace"``.
       warm_start_iters: online warm start: with ``solver="subspace"``,
         step 1 runs the full ``subspace_iters`` cold, and every later
@@ -318,6 +338,7 @@ class PCAConfig:
     discount: str = "1/T"
     backend: str = "auto"
     solver: str = "eigh"
+    eigh_crossover_d: int = 4096
     subspace_iters: int = 16
     warm_start_iters: int | None | str = "auto"
     orth_method: str = "cholqr2"
@@ -362,8 +383,16 @@ class PCAConfig:
             # "tpu" = the north star's name for the mesh backend
             # (BASELINE.json); alias of "shard_map"
             raise ValueError(f"unknown backend: {self.backend!r}")
-        if self.solver not in ("eigh", "subspace"):
+        if self.solver not in ("eigh", "subspace", "distributed"):
             raise ValueError(f"unknown solver: {self.solver!r}")
+        if not isinstance(self.eigh_crossover_d, int) or isinstance(
+            self.eigh_crossover_d, bool
+        ) or self.eigh_crossover_d < 1:
+            raise ValueError(
+                f"eigh_crossover_d must be an int >= 1, got "
+                f"{self.eigh_crossover_d!r} (the eigh-vs-distributed "
+                "merge/extract crossover — see bench.py --dsolve)"
+            )
         if isinstance(self.warm_start_iters, str):
             if self.warm_start_iters != "auto":
                 raise ValueError(
@@ -423,7 +452,10 @@ class PCAConfig:
             # the warm-start lever there is no stale carry to solve from
             # (and eigh has nothing to warm-start) — reject rather than
             # silently running an unpipelined fit under a pipeline flag
-            if self.solver != "subspace" or self.resolved_warm_start() is None:
+            if (
+                self.solver not in ("subspace", "distributed")
+                or self.resolved_warm_start() is None
+            ):
                 raise ValueError(
                     "pipeline_merge=True requires solver='subspace' with "
                     "warm starts enabled (warm_start_iters not None): the "
@@ -621,11 +653,32 @@ class PCAConfig:
         to ``None`` there. The sketch trainer resolves separately (warm
         by construction, solver-independent — see
         ``make_feature_sharded_sketch_fit``)."""
-        if self.solver != "subspace":
+        if self.solver not in ("subspace", "distributed"):
             return None
         if self.warm_start_iters == "auto":
             return 2
         return self.warm_start_iters
+
+    def resolved_local_solver(self) -> str:
+        """The solver the LOCAL (per-worker / dense) eigensolves run:
+        ``"distributed"`` is the subspace machinery plus the crossover
+        merge/extract dispatch, so local solves resolve to
+        ``"subspace"`` — ONE definition for every cfg->component
+        boundary (worker pools, solve cores, dense extraction) so the
+        dispatch cannot drift."""
+        return "subspace" if self.solver == "distributed" else self.solver
+
+    def uses_distributed_solve(self) -> bool:
+        """True when the MERGE solve and SERVING extract must run the
+        distributed eigensolve (``solvers/``): ``solver="distributed"``
+        AND ``dim`` above the configured crossover. Below the crossover
+        the exact eigh-family routes run unchanged — the crossover
+        policy in ONE place (trainers, serving, topology root tier all
+        ask here)."""
+        return (
+            self.solver == "distributed"
+            and self.dim > self.eigh_crossover_d
+        )
 
     def resolved_warm_orth(self) -> str:
         """Orthonormalization for WARM solver rounds — ONE definition for

@@ -176,8 +176,13 @@ def merged_top_k(p: jax.Array, k: int, solver: str = "eigh",
                  iters: int = 16, orth: str = "cholqr2") -> jax.Array:
     """Top-k of a (replicated) symmetric matrix by the configured solver —
     the shared dispatch used by both the WorkerPool round and the fused
-    train step (keeps their numerics identical by construction)."""
-    if solver == "subspace":
+    train step (keeps their numerics identical by construction).
+    ``"distributed"`` resolves to the subspace machinery here: the
+    operand is already a replicated dense matrix, so the distributed
+    path has nothing to shard (callers normally pre-resolve via
+    ``cfg.resolved_local_solver()``; accepting the alias keeps a raw
+    ``cfg.solver`` passthrough from crashing a fit)."""
+    if solver in ("subspace", "distributed"):
         return subspace_iteration(
             lambda v: jnp.matmul(p, v, precision=lax.Precision.HIGHEST),
             p.shape[0],

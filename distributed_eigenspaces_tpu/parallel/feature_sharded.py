@@ -397,6 +397,7 @@ def _make_step_core(cfg: PCAConfig, *, collectives: str, key):
     weights = _discount_weights(cfg)
     s_int = cfg.merge_interval
     _, gather_c = _collective_ops(collectives)
+    dist_iters = cfg.subspace_iters if cfg.uses_distributed_solve() else None
 
     def step_core(st, x, step_iters, mask=None):
         # warm-start worker solves from the running estimate's top-k (zero
@@ -412,11 +413,27 @@ def _make_step_core(cfg: PCAConfig, *, collectives: str, key):
         w, keep = weights(st.step)
 
         def merge_round(st_, vws_):
-            with jax.named_scope("det_merge"):
-                v_bar = merged_lowrank_sharded(
-                    vws_, k, mask=mask, dim_total=cfg.dim,
-                    collectives=collectives,
+            if dist_iters is not None:
+                # crossover route (cfg.uses_distributed_solve()): the
+                # factor-operator subspace solve — no (m*k)^2 Gram, no
+                # dense dispatch; warm-started from the running
+                # estimate like the worker solves
+                from distributed_eigenspaces_tpu.solvers import (
+                    dist_merged_top_k,
                 )
+
+                with jax.named_scope("det_dist_merge"):
+                    v_bar = dist_merged_top_k(
+                        vws_, k, mask=mask, iters=dist_iters,
+                        key=key, collectives=collectives,
+                        v0=st_.u[:, :k],
+                    )
+            else:
+                with jax.named_scope("det_merge"):
+                    v_bar = merged_lowrank_sharded(
+                        vws_, k, mask=mask, dim_total=cfg.dim,
+                        collectives=collectives,
+                    )
             with jax.named_scope("det_state_update"):
                 new_st = _lowrank_update(
                     st_, v_bar, w, keep, axis_name=FEATURE_AXIS

@@ -189,7 +189,9 @@ def flat_worker_index(topo: MergeTopology):
 # ---------------------------------------------------------------------------
 
 
-def tree_merge_stacked(vs, k: int, topo: MergeTopology, mask=None):
+def tree_merge_stacked(
+    vs, k: int, topo: MergeTopology, mask=None, root_dist_iters=None
+):
     """Tiered tree reduce over a gathered factor stack ``vs (m, d, k)``:
     each tier partitions the current members into contiguous groups of
     its fan-in and runs the EXACT masked low-rank merge per group
@@ -201,6 +203,12 @@ def tree_merge_stacked(vs, k: int, topo: MergeTopology, mask=None):
     leaves are all masked out merge to zeros with weight zero and
     contribute nothing upstream — the flat masked-mean semantics,
     recursively.
+
+    ``root_dist_iters`` (set when ``cfg.uses_distributed_solve()``)
+    swaps the ROOT tier's eigensolve — the only tier whose problem
+    scales with the full fan-out — for the distributed subspace path
+    (``solvers.merged_top_k_distributed``); lower tiers keep the exact
+    per-group merges, whose fan-ins are small by construction.
     """
     m = vs.shape[0]
     if m != topo.num_workers:
@@ -217,9 +225,19 @@ def tree_merge_stacked(vs, k: int, topo: MergeTopology, mask=None):
         groups = vs.reshape(g, f, *vs.shape[1:])
         gw = w.reshape(g, f)
         if g == 1:
-            # root (or single-tier) group: the plain flat merge call —
-            # bitwise the pre-topology numerics for one-tier topologies
-            vs = merged_top_k_lowrank(groups[0], k, mask=gw[0])[None]
+            if root_dist_iters is not None:
+                from distributed_eigenspaces_tpu.solvers import (
+                    merged_top_k_distributed,
+                )
+
+                vs = merged_top_k_distributed(
+                    groups[0], k, mask=gw[0], iters=root_dist_iters
+                )[None]
+            else:
+                # root (or single-tier) group: the plain flat merge
+                # call — bitwise the pre-topology numerics for
+                # one-tier topologies
+                vs = merged_top_k_lowrank(groups[0], k, mask=gw[0])[None]
         else:
             vs = jax.vmap(
                 lambda gv, gm: merged_top_k_lowrank(gv, k, mask=gm)

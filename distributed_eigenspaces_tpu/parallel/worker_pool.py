@@ -148,7 +148,9 @@ def _local_eigenspaces(
     # At d >= 4096 streaming is unconditional — memory correctness (no d^2
     # allocation) outranks the FLOP trade-off even when k*iters is large.
     # Below that, stream only when it is the cheaper schedule.
-    streaming = solver == "subspace" and (
+    # "distributed" is the subspace machinery for worker-local solves
+    # (cfg.resolved_local_solver()); accept the raw alias defensively
+    streaming = solver in ("subspace", "distributed") and (
         d >= 4096 or (2 * k * iters < d and iters <= 6)
     )
     if streaming:
@@ -168,7 +170,7 @@ def _local_eigenspaces(
         if compute_dtype is not None and not int8_wire:
             xb = xb.astype(compute_dtype)
         g = gram_auto(xb) if use_pallas else gram(xb)
-        if solver == "subspace":
+        if solver in ("subspace", "distributed"):
             return subspace_iteration(
                 lambda v: jnp.matmul(
                     g, v, precision=jax.lax.Precision.HIGHEST

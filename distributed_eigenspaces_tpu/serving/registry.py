@@ -75,6 +75,85 @@ __all__ = ["BasisVersion", "EigenbasisRegistry", "VersionRetired"]
 _VERSION_DIR_RE = re.compile(r"^v(\d{8})$")
 
 
+def _file_checksum(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _load_committed_payload(
+    path: str, meta: dict, *, require_checksum: bool = True
+):
+    """Read a committed version dir's payload against its marker: the
+    single ``basis.npz`` (replicated publish) or every
+    ``basis.shardNN.npz`` (sharded publish), each shard verified
+    against ITS committed checksum before a byte of it is trusted — a
+    torn, truncated, or rotted shard fails alone and loudly, and the
+    caller quarantines (registry recovery) or skips (replica tail) the
+    version. Returns ``(v, sigma_tilde, spec, shard_sizes)`` with ``v``
+    the ordered row concatenation (host-side; serving re-places per
+    shard). Shared by :class:`EigenbasisRegistry` recovery/loads and
+    the ``serving/replication.py`` tail so the two read sides cannot
+    drift on what "committed" means."""
+    shards = meta.get("shards")
+    if not shards:
+        payload = os.path.join(path, "basis.npz")
+        committed = meta.get("checksum")
+        if committed is None and not require_checksum:
+            # replica-tail leniency: markers predating the checksum
+            # field install unverified (the publisher's registry
+            # recovery is the strict side); per-shard manifests below
+            # ALWAYS carry checksums, so sharded reads always verify
+            committed = None
+        else:
+            checksum = _file_checksum(payload)
+            if checksum != committed:
+                raise ValueError(
+                    f"checksum mismatch: payload {checksum[:12]}... "
+                    f"!= committed {str(committed)[:12]}..."
+                )
+        with np.load(payload) as z:
+            v = _frozen_array(z["v"])
+            st = (
+                _frozen_array(z["sigma_tilde"])
+                if "sigma_tilde" in z.files else None
+            )
+        return v, st, None, None
+    parts, st = [], None
+    for i, entry in enumerate(shards):
+        spath = os.path.join(path, entry["file"])
+        if not os.path.exists(spath):
+            # FileNotFoundError so a mid-GC read maps to retirement;
+            # registry recovery's generic except still quarantines
+            # (committed-but-missing = corrupt)
+            raise FileNotFoundError(
+                f"committed shard {i} missing: {entry['file']}"
+            )
+        checksum = _file_checksum(spath)
+        if checksum != entry.get("checksum"):
+            raise ValueError(
+                f"shard {i} checksum mismatch: payload "
+                f"{checksum[:12]}... != committed "
+                f"{str(entry.get('checksum'))[:12]}..."
+            )
+        with np.load(spath) as z:
+            part = _frozen_array(z["v"])
+            if i == 0 and "sigma_tilde" in z.files:
+                st = _frozen_array(z["sigma_tilde"])
+        if part.shape[0] != int(entry["rows"]):
+            raise ValueError(
+                f"shard {i} has {part.shape[0]} rows, marker "
+                f"committed {entry['rows']}"
+            )
+        parts.append(part)
+    v = _frozen_array(np.concatenate(parts, axis=0))
+    spec = tuple(meta["spec"]) if meta.get("spec") else None
+    shard_sizes = tuple(int(e["rows"]) for e in shards)
+    return v, st, spec, shard_sizes
+
+
 class VersionRetired(KeyError):
     """A version id outside the registry's retention window (GC'd, or
     never published). A KeyError subclass so pre-existing callers keep
@@ -107,6 +186,17 @@ class BasisVersion:
       lineage: provenance of the producing fit — trainer name,
         checkpoint path, fleet ticket, refit trigger — whatever the
         publisher knows. Stored as an immutable snapshot.
+      spec: the basis's PartitionSpec as a tuple of mesh-axis names
+        (e.g. ``("features", None)`` — rows sharded over the features
+        axis), or ``None`` for a replicated publish. A sharded version
+        serializes PER SHARD (``basis.shardNN.npz``, each checksummed
+        in the commit marker) and its in-memory ``v`` is the ordered
+        row concatenation — serving re-places it shard-by-shard
+        (``shard(i)``), never shipping the dense ``(d, k)`` to one
+        device.
+      shard_sizes: row count of each shard (sums to ``d``), or ``None``
+        when replicated. Recorded in the marker so recovery and
+        replicas rebuild the EXACT row partition, bit for bit.
     """
 
     version: int
@@ -116,6 +206,8 @@ class BasisVersion:
     step: int
     explained_variance: dict[str, float]
     lineage: dict[str, Any]
+    spec: tuple | None = None
+    shard_sizes: tuple[int, ...] | None = None
 
     @property
     def d(self) -> int:
@@ -124,6 +216,27 @@ class BasisVersion:
     @property
     def k(self) -> int:
         return self.signature[1]
+
+    @property
+    def num_shards(self) -> int:
+        return 1 if self.shard_sizes is None else len(self.shard_sizes)
+
+    def shard(self, i: int) -> np.ndarray:
+        """Row block ``i`` of the basis (a read-only view — no copy):
+        the unit a sharded consumer places per device. ``shard(0)`` of
+        a replicated version is the whole basis."""
+        if self.shard_sizes is None:
+            if i != 0:
+                raise IndexError(
+                    f"replicated version has 1 shard, asked for {i}"
+                )
+            return self.v
+        if not (0 <= i < len(self.shard_sizes)):
+            raise IndexError(
+                f"shard {i} out of range for {len(self.shard_sizes)} shards"
+            )
+        off = int(sum(self.shard_sizes[:i]))
+        return self.v[off:off + int(self.shard_sizes[i])]
 
 
 class EigenbasisRegistry:
@@ -188,11 +301,10 @@ class EigenbasisRegistry:
 
     @staticmethod
     def _payload_checksum(payload_path: str) -> str:
-        h = hashlib.sha256()
-        with open(payload_path, "rb") as f:
-            for chunk in iter(lambda: f.read(1 << 20), b""):
-                h.update(chunk)
-        return h.hexdigest()
+        return _file_checksum(payload_path)
+
+    def _load_payload_dir(self, path: str, meta: dict):
+        return _load_committed_payload(path, meta)
 
     def _write_payload(self, vdir: str, bv: BasisVersion) -> str:
         """The version's arrays via tmp + atomic rename; returns the
@@ -207,11 +319,42 @@ class EigenbasisRegistry:
         os.replace(tmp, final)
         return self._payload_checksum(final)
 
+    def _write_payload_sharded(
+        self, vdir: str, bv: BasisVersion
+    ) -> list[dict]:
+        """A sharded version's payload: one ``basis.shardNN.npz`` PER
+        row shard (each tmp + atomic rename, each independently
+        checksummed — a torn or rotted shard is detected by itself, not
+        by re-reading ``d * k`` floats). ``sigma_tilde`` (if any) rides
+        in shard 0. Returns the per-shard manifest the commit marker
+        commits to."""
+        os.makedirs(vdir, exist_ok=True)
+        manifest = []
+        for i in range(bv.num_shards):
+            arrays = {"v": bv.shard(i)}
+            if i == 0 and bv.sigma_tilde is not None:
+                arrays["sigma_tilde"] = bv.sigma_tilde
+            name = f"basis.shard{i:02d}.npz"
+            tmp = os.path.join(vdir, f"basis.shard{i:02d}.tmp.npz")
+            np.savez(tmp, **arrays)
+            final = os.path.join(vdir, name)
+            os.replace(tmp, final)
+            manifest.append({
+                "file": name,
+                "rows": int(bv.shard_sizes[i]),
+                "checksum": self._payload_checksum(final),
+            })
+        return manifest
+
     def _write_meta(self, vdir: str, bv: BasisVersion,
-                    checksum: str) -> None:
+                    checksum: str | None,
+                    shards: list[dict] | None = None) -> None:
         """The commit marker (tmp + atomic rename): a version without
         it is torn and recovery treats the publish as never having
-        happened — exactly the ``utils/checkpoint.py`` contract."""
+        happened — exactly the ``utils/checkpoint.py`` contract. A
+        sharded version's marker carries the per-shard manifest (file,
+        rows, checksum) and the PartitionSpec instead of the single
+        ``checksum``."""
         meta = {
             "format_version": 1,
             "version": bv.version,
@@ -224,6 +367,8 @@ class EigenbasisRegistry:
                 json.dumps(bv.lineage, default=str)
             ),
             "checksum": checksum,
+            "spec": list(bv.spec) if bv.spec is not None else None,
+            "shards": shards,
             # replication bus fields (ISSUE 14): the wall-clock commit
             # stamp replicas measure propagation lag against, and the
             # publisher lease's fencing epoch (0 = unleased publisher;
@@ -240,8 +385,12 @@ class EigenbasisRegistry:
 
     def _persist(self, bv: BasisVersion) -> None:
         vdir = self._version_dir(bv.version)
-        checksum = self._write_payload(vdir, bv)
-        self._write_meta(vdir, bv, checksum)
+        if bv.shard_sizes is not None:
+            shards = self._write_payload_sharded(vdir, bv)
+            self._write_meta(vdir, bv, None, shards=shards)
+        else:
+            checksum = self._write_payload(vdir, bv)
+            self._write_meta(vdir, bv, checksum)
 
     def _delete_version_dir(self, version: int) -> None:
         shutil.rmtree(self._version_dir(version), ignore_errors=True)
@@ -331,19 +480,9 @@ class EigenbasisRegistry:
             try:
                 with open(meta_path) as f:
                     meta = json.load(f)
-                payload = os.path.join(path, "basis.npz")
-                checksum = self._payload_checksum(payload)
-                if checksum != meta.get("checksum"):
-                    raise ValueError(
-                        f"checksum mismatch: payload {checksum[:12]}... "
-                        f"!= committed {str(meta.get('checksum'))[:12]}..."
-                    )
-                with np.load(payload) as z:
-                    v = _frozen_array(z["v"])
-                    st = (
-                        _frozen_array(z["sigma_tilde"])
-                        if "sigma_tilde" in z.files else None
-                    )
+                v, st, spec, shard_sizes = self._load_payload_dir(
+                    path, meta
+                )
                 sig = tuple(meta["signature"])
                 if v.shape != sig:
                     raise ValueError(
@@ -360,6 +499,8 @@ class EigenbasisRegistry:
                         meta.get("explained_variance") or {}
                     ),
                     lineage=dict(meta.get("lineage") or {}),
+                    spec=spec,
+                    shard_sizes=shard_sizes,
                 )
                 epoch = int(meta.get("epoch", 0))
             except Exception as e:
@@ -426,6 +567,8 @@ class EigenbasisRegistry:
         step: int = 0,
         explained_variance: Mapping[str, float] | None = None,
         lineage: Mapping[str, Any] | None = None,
+        spec=None,
+        num_shards: int | None = None,
     ) -> BasisVersion:
         """Publish one basis as the new latest version; returns it.
 
@@ -436,16 +579,58 @@ class EigenbasisRegistry:
         first (``lease.ensure()`` raises ``LeaseLost``): a zombie
         ex-publisher is rejected by the store BEFORE it assigns an id
         or touches disk — no torn commit, no duplicated version id.
+
+        ``v`` is either the full ``(d, k)`` array or — a SHARDED
+        publish — the ordered sequence of its row shards (what a
+        per-device fetch hands over; rows concatenate host-side, the
+        dense basis never transits one accelerator). ``spec`` records
+        the PartitionSpec as a tuple of mesh-axis names (e.g.
+        ``("features", None)``); ``num_shards`` alone requests a
+        balanced row split of a dense ``v``. Sharded versions persist
+        per shard with per-shard checksums (module docstring).
         """
         if self.lease is not None:
             # store-side fencing: re-reads the lease file, raises
             # LeaseLost when a standby took over (higher epoch)
             self.lease.ensure()
-        arr = _frozen_array(v)
+        shard_sizes = None
+        if isinstance(v, (list, tuple)):
+            parts = [np.asarray(p) for p in v]
+            if not parts or any(p.ndim != 2 for p in parts):
+                raise ValueError(
+                    "a sharded publish takes a non-empty sequence of "
+                    f"(rows_i, k) row shards, got {len(parts)} parts "
+                    f"with shapes {[p.shape for p in parts]}"
+                )
+            shard_sizes = tuple(int(p.shape[0]) for p in parts)
+            arr = _frozen_array(np.concatenate(parts, axis=0))
+        else:
+            arr = _frozen_array(v)
         if arr.ndim != 2:
             raise ValueError(
                 f"basis must be (d, k), got shape {arr.shape}"
             )
+        if num_shards is not None and shard_sizes is None:
+            if not (1 <= int(num_shards) <= arr.shape[0]):
+                raise ValueError(
+                    f"num_shards must be in [1, d={arr.shape[0]}], "
+                    f"got {num_shards}"
+                )
+            base, rem = divmod(arr.shape[0], int(num_shards))
+            shard_sizes = tuple(
+                base + (1 if i < rem else 0)
+                for i in range(int(num_shards))
+            )
+        if spec is not None:
+            spec = tuple(spec)
+            if shard_sizes is None:
+                # a spec with one payload is still a sharded version —
+                # with a single shard — so the marker stays honest
+                shard_sizes = (int(arr.shape[0]),)
+        elif shard_sizes is not None:
+            # default declaration: rows over the features mesh axis —
+            # the only sharded layout the serving tier produces today
+            spec = ("features", None)
         if not np.isfinite(arr).all():
             raise ValueError(
                 "refusing to publish a non-finite basis (serving it "
@@ -475,6 +660,8 @@ class EigenbasisRegistry:
             step=int(step),
             explained_variance=ev,
             lineage=dict(lineage or {}),
+            spec=spec,
+            shard_sizes=shard_sizes,
         )
         with self._lock:
             bv = BasisVersion(version=self._next_id, **bv_partial)
@@ -599,9 +786,15 @@ class EigenbasisRegistry:
                 "load_payload needs a durable registry "
                 "(cfg.registry_dir is not set)"
             )
-        payload = os.path.join(self._version_dir(version), "basis.npz")
+        vdir = self._version_dir(version)
         try:
-            with np.load(payload) as z:
+            meta_path = os.path.join(vdir, "meta.json")
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if meta.get("shards"):
+                v, _, _, _ = self._load_payload_dir(vdir, meta)
+                return v
+            with np.load(os.path.join(vdir, "basis.npz")) as z:
                 return _frozen_array(z["v"])
         except FileNotFoundError:
             with self._lock:

@@ -56,6 +56,7 @@ from distributed_eigenspaces_tpu.serving.registry import (
     BasisVersion,
     VersionRetired,
     _frozen_array,
+    _load_committed_payload,
     _VERSION_DIR_RE,
 )
 
@@ -484,14 +485,15 @@ class ReplicaRegistry:
                 epoch=epoch, fencing_epoch=self._max_epoch,
             )
             return
-        payload = os.path.join(vdir, "basis.npz")
         try:
-            with np.load(payload) as z:
-                v = _frozen_array(z["v"])
-                st = (
-                    _frozen_array(z["sigma_tilde"])
-                    if "sigma_tilde" in z.files else None
-                )
+            # shared committed-read: verifies the single checksum or —
+            # a sharded publish — EVERY per-shard checksum, so a torn
+            # or rotted shard is skipped here exactly as recovery
+            # quarantines it; sharded versions install with their
+            # PartitionSpec and row partition intact
+            v, st, spec, shard_sizes = _load_committed_payload(
+                vdir, meta, require_checksum=False
+            )
         except FileNotFoundError:
             # GC'd between marker read and payload read (we are past
             # the grace window — a badly lagged replica): the version
@@ -527,6 +529,8 @@ class ReplicaRegistry:
             step=int(meta.get("step", 0)),
             explained_variance=dict(meta.get("explained_variance") or {}),
             lineage=dict(meta.get("lineage") or {}),
+            spec=spec,
+            shard_sizes=shard_sizes,
         )
         t_commit = meta.get("t_commit_unix")
         lag_ms = (

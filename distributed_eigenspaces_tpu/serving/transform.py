@@ -26,6 +26,16 @@ The optional mesh path shards the padded row axis over the existing
 used inside the kernel, so the partitioned program contains ZERO
 collectives by construction (audited like the fleet trainer, against
 the ``serve_transform`` contract in ``analysis.contracts``).
+
+3. **Above the crossover the basis STAYS sharded** (ISSUE 15).
+   ``basis_spec=("features", None)`` keeps the basis operand row-sharded
+   over the ``features`` mesh axis end to end: queries shard their
+   feature axis the same way, projection reduces with ONE k-wide
+   ``psum`` over features, and reconstruction is row-local back onto the
+   shards. The dense ``(d, k)`` basis never lands on one device — the
+   partitioned program is audited against the ``dist_serve`` side of the
+   ``serve_transform`` contract, whose ``replicated_axis_floor`` now
+   EXCLUDES the basis buffer in this mode.
 """
 
 from __future__ import annotations
@@ -34,9 +44,11 @@ import time
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_eigenspaces_tpu.parallel.mesh import (
+    FEATURE_AXIS,
     WORKER_AXIS,
     shard_map,
 )
@@ -91,7 +103,7 @@ class TransformEngine:
     """
 
     def __init__(self, d: int, k: int, *, dtype=jnp.float32, mesh=None,
-                 min_bucket: int = 8, cache=None):
+                 min_bucket: int = 8, cache=None, basis_spec=None):
         if not (0 < k <= d):
             raise ValueError(f"need 0 < k <= d, got k={k}, d={d}")
         self.d = int(d)
@@ -99,6 +111,27 @@ class TransformEngine:
         self.dtype = jnp.dtype(dtype)
         self.mesh = mesh
         self.min_bucket = min_bucket
+        self.basis_spec = (
+            None if basis_spec is None else tuple(basis_spec)
+        )
+        if self.basis_spec is not None:
+            if mesh is None or FEATURE_AXIS not in mesh.shape:
+                raise ValueError(
+                    "basis_spec needs a (workers, features) mesh — the "
+                    "basis rows shard over the features axis "
+                    f"(got mesh={mesh})"
+                )
+            if self.basis_spec != (FEATURE_AXIS, None):
+                raise ValueError(
+                    "the serving tier shards bases by rows over the "
+                    f"features axis: basis_spec must be "
+                    f"({FEATURE_AXIS!r}, None), got {self.basis_spec}"
+                )
+            nf = int(mesh.shape[FEATURE_AXIS])
+            if self.d % nf:
+                raise ValueError(
+                    f"d={d} does not divide over {nf} feature shards"
+                )
         self._row_multiple = (
             1 if mesh is None else int(mesh.shape[WORKER_AXIS])
         )
@@ -136,6 +169,30 @@ class TransformEngine:
             "residual": (residual, self._x_like, None),
         }
 
+        # sharded-basis twins (basis_spec mode): the SAME row-local
+        # matmuls on feature shards, plus the one k-wide reduction the
+        # sharding makes necessary — projection (and the residual's
+        # input energy) sums partial products over the features axis;
+        # reconstruction is row-local back onto the shards, zero
+        # collectives
+        def project_sharded(x, v):
+            z = jnp.matmul(x, v.astype(x.dtype), precision=prec)
+            return lax.psum(z, FEATURE_AXIS)
+
+        def residual_sharded(x, z):
+            e_in = lax.psum(
+                jnp.sum(x.astype(jnp.float32) ** 2, axis=-1),
+                FEATURE_AXIS,
+            )
+            e_out = jnp.sum(z.astype(jnp.float32) ** 2, axis=-1)
+            return jnp.maximum(e_in - e_out, 0.0), e_in
+
+        self._sharded_fns = {
+            "project": project_sharded,
+            "reconstruct": reconstruct,  # row-local on the shard
+            "residual": residual_sharded,
+        }
+
     # -- operand shapes ------------------------------------------------------
 
     def _x_like(self, rows):
@@ -155,6 +212,34 @@ class TransformEngine:
             second = self._z_like(rows)
         else:
             second = jax.ShapeDtypeStruct(second_shape, jnp.float32)
+        if self.basis_spec is not None:
+            # sharded-basis mode: queries shard (rows over workers,
+            # features over features), the basis stays a row-sharded
+            # operand — the (d, k) never assembles on one device; the
+            # projection's psum is the program's ONLY collective
+            rows_x = P(WORKER_AXIS, FEATURE_AXIS)
+            rows_rep = P(WORKER_AXIS, None)
+            basis = P(*self.basis_spec)
+            if kind == "project":
+                in_specs, out_specs = (rows_x, basis), rows_rep
+            elif kind == "reconstruct":
+                in_specs, out_specs = (rows_rep, basis), rows_x
+            else:
+                in_specs = (rows_x, rows_rep)
+                out_specs = (P(WORKER_AXIS), P(WORKER_AXIS))
+            inner = shard_map(
+                self._sharded_fns[kind],
+                mesh=self.mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_vma=False,
+            )
+            return jax.jit(
+                inner,
+                in_shardings=tuple(
+                    NamedSharding(self.mesh, s) for s in in_specs
+                ),
+            ).lower(arg_like(rows), second)
         if self.mesh is None:
             return jax.jit(fn).lower(arg_like(rows), second)
         else:
@@ -208,6 +293,7 @@ class TransformEngine:
                     self.d, self.k, rows,
                     None if self.mesh is None
                     else tuple(self.mesh.shape.items()),
+                    self.basis_spec,
                 ),
                 str(self.dtype),
             )
@@ -262,18 +348,45 @@ class TransformEngine:
             x = jnp.zeros((padded, width), self.dtype).at[:rows].set(x)
         return x, rows
 
+    def _place_rows(self, a, spec):
+        """Commit a padded operand to the sharded-mode layout the AOT
+        executables were compiled against (a no-op re-placement when it
+        already matches; plain-mode dispatch skips this — jit places
+        host arrays itself)."""
+        if self.basis_spec is None:
+            return a
+        return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+    def place_basis(self, v) -> jax.Array:
+        """Device-place a basis for this engine. In sharded mode the
+        host array transfers SHARD BY SHARD onto the features axis —
+        the dense ``(d, k)`` never lands on one device; otherwise a
+        plain (replicated on the mesh path) placement. Accepts a
+        ``serving.registry.BasisVersion`` (its host-resident ``v``) or
+        any ``(d, k)`` array. Hot-swap cost is exactly this call: the
+        kernels take the result as an operand, so no recompile."""
+        if hasattr(v, "shard_sizes") and hasattr(v, "v"):
+            v = v.v
+        if self.mesh is None:
+            return jnp.asarray(v, jnp.float32)
+        spec = P() if self.basis_spec is None else P(*self.basis_spec)
+        return jax.device_put(v, NamedSharding(self.mesh, spec))
+
     def _check_basis(self, v):
         """Loud signature check at the kernel boundary (ISSUE 7): a
         mis-shaped basis would otherwise surface as an XLA shape error
         deep inside a dispatch lane — breaker food with a post-mortem
         that starts three layers too low."""
-        v = jnp.asarray(v, jnp.float32)
         if tuple(v.shape) != (self.d, self.k):
             raise ValueError(
                 f"basis shape {tuple(v.shape)} does not match this "
                 f"engine's signature ({self.d}, {self.k})"
             )
-        return v
+        if self.basis_spec is not None:
+            # shard-place (no-op when already placed): a host array
+            # transfers per shard, never assembling (d, k) on a device
+            return self.place_basis(v)
+        return jnp.asarray(v, jnp.float32)
 
     def project(self, x, v) -> jax.Array:
         """``(n, d) -> (n, k)`` against basis ``v`` — pad, dispatch the
@@ -281,6 +394,7 @@ class TransformEngine:
         precision), bit-for-bit regardless of padding."""
         v = self._check_basis(v)
         x_pad, rows = self._pad(x, self.d)
+        x_pad = self._place_rows(x_pad, P(WORKER_AXIS, FEATURE_AXIS))
         z = self._compiled("project", int(x_pad.shape[0]))(
             x_pad, v
         )
@@ -290,6 +404,7 @@ class TransformEngine:
         """``(n, k) -> (n, d)`` back-projection against basis ``v``."""
         v = self._check_basis(v)
         z_pad, rows = self._pad(z, self.k)
+        z_pad = self._place_rows(z_pad, P(WORKER_AXIS, None))
         x = self._compiled("reconstruct", int(z_pad.shape[0]))(
             z_pad, v
         )
@@ -301,6 +416,8 @@ class TransformEngine:
         Zero-padded rows contribute zero to both (harmless)."""
         x_pad, rows = self._pad(x, self.d)
         z_pad, _ = self._pad(z, self.k)
+        x_pad = self._place_rows(x_pad, P(WORKER_AXIS, FEATURE_AXIS))
+        z_pad = self._place_rows(z_pad, P(WORKER_AXIS, None))
         r, e = self._compiled("residual", int(x_pad.shape[0]))(
             x_pad, z_pad
         )

@@ -1,0 +1,39 @@
+"""Distributed eigensolve subsystem — the d-ceiling breaker (ISSUE 15).
+
+Every function here computes a top-k eigenbasis from *matvec access
+only*, with the feature dimension optionally row-sharded over the
+``features`` mesh axis: blocked randomized subspace iteration,
+orthonormalized by the in-tree CholeskyQR2 row-sharded pass
+(``parallel/feature_sharded.chol_qr2``), finished by a small replicated
+Rayleigh–Ritz solve. No d x d buffer and no above-floor replicated
+d x k ever exists on one device — enforced statically by the
+``dist_solve`` contract (``analysis/contracts.py``).
+
+Dispatch policy lives in ``PCAConfig``: ``solver="distributed"`` routes
+the merge solve and the serving extract through this package whenever
+``dim > cfg.eigh_crossover_d``, and keeps the exact ``eigh``-family
+paths below it (equivalence angle-gated in tests and
+``bench.py --dsolve``).
+"""
+
+from distributed_eigenspaces_tpu.solvers.distributed import (
+    dist_canonicalize_signs,
+    dist_extract_top_k,
+    dist_merged_top_k,
+    dist_rayleigh_ritz,
+    dist_subspace_eig,
+    factor_matvec,
+    lowrank_matvec,
+    merged_top_k_distributed,
+)
+
+__all__ = [
+    "dist_canonicalize_signs",
+    "dist_extract_top_k",
+    "dist_merged_top_k",
+    "dist_rayleigh_ritz",
+    "dist_subspace_eig",
+    "factor_matvec",
+    "lowrank_matvec",
+    "merged_top_k_distributed",
+]

@@ -22,6 +22,16 @@ actually restores, instead of failing the resume on damaged bytes. The
 payload is gathered to host on save, so restore works on any topology —
 state saved from an 8-device mesh restores onto 1 device or 64. States
 are a few d*r floats; orbax's async machinery buys nothing at this size.
+
+Sharded leaves (ISSUE 15): a state whose leaves carry a
+``NamedSharding`` (the feature-sharded trainers' carries — ``U`` rows
+over the ``features`` mesh axis) records each leaf's PartitionSpec in
+the commit marker, and :func:`restore_checkpoint` with a ``mesh`` puts
+every leaf straight back onto its recorded spec — the host array
+transfers per shard, so a ``(d, r)`` carry resumes on the mesh without
+a dense single-device stop. Restore without a mesh keeps the old
+behavior (host -> default placement), so dense-topology resumes are
+untouched.
 """
 
 from __future__ import annotations
@@ -57,6 +67,19 @@ _STATE_TYPES = {
     "scan_segment": SegmentState,
     "sketch": SketchState,
 }
+
+
+def _leaf_spec(x):
+    """A leaf's PartitionSpec as JSON (list of axis names; nested
+    lists for multi-axis dims), or None for unsharded / non-NamedSharding
+    leaves. Captured BEFORE the host gather, which erases it."""
+    spec = getattr(getattr(x, "sharding", None), "spec", None)
+    if spec is None:
+        return None
+    out = []
+    for ax in tuple(spec):
+        out.append(list(ax) if isinstance(ax, tuple) else ax)
+    return out
 
 
 def _to_host(tree):
@@ -103,10 +126,13 @@ def save_checkpoint(
             f"unsupported checkpoint state type {type(state).__name__}; "
             f"known: {sorted(_STATE_TYPES)}"
         )
+    # leaf PartitionSpecs, recorded before the gather erases them —
+    # restore_checkpoint(mesh=...) re-places each leaf onto its spec
+    leaf_specs = {f: _leaf_spec(getattr(state, f)) for f in state._fields}
     host = _to_host(state)  # collective — before any process-0 gate
     multi = jax.process_count() > 1
     if not multi or jax.process_index() == 0:
-        _write_checkpoint(path, host, kind, cursor, extra)
+        _write_checkpoint(path, host, kind, cursor, extra, leaf_specs)
     if multi:
         # barrier AFTER the commit marker: without it a non-zero process
         # returning early could restore (or assert existence) before
@@ -116,7 +142,7 @@ def save_checkpoint(
         multihost_utils.sync_global_devices("det_ckpt_commit")
 
 
-def _write_checkpoint(path, host, kind, cursor, extra):
+def _write_checkpoint(path, host, kind, cursor, extra, leaf_specs=None):
     os.makedirs(path, exist_ok=True)
     # Invalidate any previous commit marker BEFORE touching state.npz, and
     # write the payload via tmp+rename: a crash at any point leaves either
@@ -141,6 +167,12 @@ def _write_checkpoint(path, host, kind, cursor, extra):
         # checkpoints — those restore unverified, back-compat)
         "checksum": checksum,
     }
+    if leaf_specs and any(s is not None for s in leaf_specs.values()):
+        # per-leaf PartitionSpecs (None = unsharded leaf): the sharded
+        # round-trip half of the marker — absent on dense checkpoints
+        # and on anything written before ISSUE 15 (those restore to the
+        # default placement, as ever)
+        meta["leaf_specs"] = leaf_specs
     if extra:
         meta["extra"] = extra
     tmp = os.path.join(path, "meta.json.tmp")
@@ -149,12 +181,19 @@ def _write_checkpoint(path, host, kind, cursor, extra):
     os.replace(tmp, meta_final)  # atomic commit marker
 
 
-def restore_checkpoint(path: str):
+def restore_checkpoint(path: str, *, mesh=None):
     """Load ``(state, cursor)`` from a checkpoint directory.
 
     Raises FileNotFoundError on a missing/uncommitted checkpoint (a crash
     between state.npz and meta.json leaves no meta.json — the write is
     treated as never having happened).
+
+    ``mesh``: re-place every leaf whose PartitionSpec the marker
+    recorded (sharded trainers' carries) with
+    ``NamedSharding(mesh, spec)`` — host bytes transfer per shard, the
+    carry resumes on-mesh without a dense single-device stop. Leaves
+    without a recorded spec (and all leaves when ``mesh`` is None) take
+    the default placement.
     """
     meta_path = os.path.join(path, "meta.json")
     if not os.path.exists(meta_path):
@@ -179,11 +218,24 @@ def restore_checkpoint(path: str):
                 f"checksum (sha256 {got[:12]}… != recorded "
                 f"{want[:12]}…): torn or rotted bytes"
             )
+    leaf_specs = meta.get("leaf_specs") or {}
+
+    def _place(name, arr):
+        import jax.numpy as jnp
+
+        spec = leaf_specs.get(name)
+        if mesh is None or spec is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axes = tuple(
+            tuple(ax) if isinstance(ax, list) else ax for ax in spec
+        )
+        return jax.device_put(arr, NamedSharding(mesh, P(*axes)))
+
     try:
         with np.load(payload) as z:
-            import jax.numpy as jnp
-
-            state = cls(**{f: jnp.asarray(z[f]) for f in cls._fields})
+            state = cls(**{f: _place(f, z[f]) for f in cls._fields})
     except FileNotFoundError:
         raise
     except Exception as e:  # torn zip, missing field, bad dtype...
@@ -209,6 +261,9 @@ class Checkpointer:
     every: int = 1
     keep: int = 2
     rows_per_step: int = 0  # rows consumed per step -> saved stream cursor
+    #: optional mesh for sharded-carry resumes: latest() re-places each
+    #: leaf onto its recorded PartitionSpec (restore_checkpoint docs)
+    mesh: Any = None
 
     def on_step(self, t: int, state, v_bar=None) -> None:
         if t % self.every:
@@ -228,7 +283,7 @@ class Checkpointer:
         for step in reversed(self._steps()):
             path = os.path.join(self.directory, f"step_{step:08d}")
             try:
-                return restore_checkpoint(path)
+                return restore_checkpoint(path, mesh=self.mesh)
             except CheckpointCorrupt as e:
                 quarantined = path + ".quarantined"
                 try:

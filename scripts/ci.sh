@@ -78,7 +78,17 @@
 #      headline win, reported as the payload-reduction ratio); the
 #      compare gates that structural ratio against the committed
 #      BENCH_TREE_SMOKE_CPU.json (same-topology records only);
-#   11. scripts/scenario.py: the production-shaped scenario replay
+#   11. bench.py --dsolve: the distributed-eigensolve crossover smoke
+#      (ISSUE 15) — a planted-basis sweep over d where the blocked
+#      subspace iteration (factor matvecs only) must match the dense
+#      eigh merge/extract inside the angle budget at every d AND beat
+#      it outright at the largest swept d (the O(d^3) crossover the
+#      cfg.eigh_crossover_d flag encodes), with the dist_solve
+#      contract audit bounding every collective payload to factor
+#      sizes; the compare gates the dimensionless extract-speedup
+#      ratio against the committed BENCH_DSOLVE_SMOKE_CPU.json
+#      (same-dims records only — a cross-sweep ratio skips loudly);
+#   12. scripts/scenario.py: the production-shaped scenario replay
 #      (ISSUE 11) — a 3-episode composition (flash crowd + lane kill,
 #      correlated fit-tier churn, mid-burst registry publish) replayed
 #      from scenarios/ci_smoke.json against the full stack, judged
@@ -89,7 +99,7 @@
 #      the committed BENCH_SCENARIO_SMOKE_CPU.json (ratio floors + a
 #      10 s structural recovery bound + a 0.5 absolute attainment
 #      floor, so CPU-rig jitter can't flap CI);
-#   12. scripts/analyze.py --all --costs --shardings --mutation-check:
+#   13. scripts/analyze.py --all --costs --shardings --mutation-check:
 #      the static program-contract gate (ISSUE 10 + 13,
 #      docs/ANALYSIS.md) — every program kind audited against its
 #      declarative contract (collective schedule + payload bounds,
@@ -101,12 +111,12 @@
 #      class is caught. ruff (the dev extra / Dockerfile image) runs
 #      first when on PATH; a missing ruff now SKIPS LOUDLY instead of
 #      silently (DET_CI_REQUIRE_RUFF=1 turns the skip into a failure);
-#   13. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   14. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/13] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/14] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -114,7 +124,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/13] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/14] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -124,7 +134,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/13] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/14] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -139,7 +149,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/13] serve equality + amortization smoke (CPU) =="
+echo "== [4/14] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -154,7 +164,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/13] coldstart + prewarm smoke (CPU) =="
+echo "== [5/14] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -169,7 +179,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [6/13] telemetry smoke: trace export + span-chain validation =="
+echo "== [6/14] telemetry smoke: trace export + span-chain validation =="
 # A serve burst with --trace-out, then a structural validation of the
 # emitted timeline: the JSON must parse as Chrome trace-event format,
 # every served query's span chain (admit → queue_wait → dispatch →
@@ -214,7 +224,7 @@ print(json.dumps({
 }))
 PY
 
-echo "== [7/13] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
+echo "== [7/14] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
 # bench.py --chaos-serve asserts the read-path resilience gates itself
 # (ISSUE 7): a kill -9'd publisher's store recovers (torn snapshot
 # skipped, checksum corruption quarantined) and the restarted server
@@ -233,7 +243,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve
 fi
 
-echo "== [8/13] chaos-churn smoke: elastic membership under churn (CPU) =="
+echo "== [8/14] chaos-churn smoke: elastic membership under churn (CPU) =="
 # bench.py --chaos-churn asserts the fit-tier elastic-membership gates
 # itself (ISSUE 8): a run with 30% mid-run worker loss, flapping
 # rejoins, and a persistent straggler finishes all steps inside the
@@ -253,7 +263,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-churn
 fi
 
-echo "== [9/13] replica fleet smoke: lease failover + bounded staleness (CPU) =="
+echo "== [9/14] replica fleet smoke: lease failover + bounded staleness (CPU) =="
 # bench.py --replica asserts the replicated-registry gates itself
 # (ISSUE 14): N replicas warm-recover a kill -9'd publisher's store
 # bit-exact; a standby waits out the live lease and takes over at
@@ -275,7 +285,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --replica
 fi
 
-echo "== [10/13] tree-merge smoke: flat vs tiered tree (CPU) =="
+echo "== [10/14] tree-merge smoke: flat vs tiered tree (CPU) =="
 # bench.py --tree asserts the hierarchical-merge gates itself (ISSUE
 # 12): the same planted fit run flat and through the chip:4 x host:2
 # tree must both land inside the angle budget AND agree with each
@@ -294,7 +304,29 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --tree
 fi
 
-echo "== [11/13] scenario replay: production-shaped composition (CPU) =="
+echo "== [11/14] dsolve crossover smoke: eigh vs distributed solve (CPU) =="
+# bench.py --dsolve asserts the distributed-eigensolve gates itself
+# (ISSUE 15): at every swept d the blocked subspace iteration (factor
+# matvecs + CholeskyQR2 + replicated Rayleigh-Ritz, never a d x d
+# Gram) must agree with the dense-eigh merge/extract inside the angle
+# budget AND land the exact merge inside the planted-truth budget; at
+# the largest swept d the distributed extract must beat dense eigh
+# outright — the measured O(d^3) crossover cfg.eigh_crossover_d
+# encodes — and both program legs must pass the dist_solve contract
+# (every collective payload bounded by factor sizes; the audit skips
+# LOUDLY when the rig cannot build the mesh). The compare gates the
+# dimensionless extract-speedup ratio against the committed record
+# (same-dims records only — a cross-sweep ratio is a unit error and
+# skips loudly).
+if [[ -f BENCH_DSOLVE_SMOKE_CPU.json ]]; then
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --dsolve \
+        --compare BENCH_DSOLVE_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --dsolve
+fi
+
+echo "== [12/14] scenario replay: production-shaped composition (CPU) =="
 # scripts/scenario.py replays scenarios/ci_smoke.json — a flash crowd
 # with a mid-crowd lane kill, correlated fit-tier worker churn, and a
 # mid-burst registry publish on one timeline — and judges it purely
@@ -314,7 +346,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --scenario scenarios/ci_smoke.json
 fi
 
-echo "== [12/13] static analysis: contracts + shardings + costs + lints + mutations =="
+echo "== [13/14] static analysis: contracts + shardings + costs + lints + mutations =="
 # scripts/analyze.py compiles (never runs) the whole program matrix and
 # audits each program against its contract — collective schedule,
 # memory policy, baked constants, and (ISSUE 13) the declared
@@ -342,7 +374,7 @@ fi
 JAX_PLATFORMS=cpu python scripts/analyze.py --all --costs --shardings \
     --mutation-check
 
-echo "== [13/13] graft entry + 8-device sharded dryrun =="
+echo "== [14/14] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

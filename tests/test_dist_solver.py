@@ -1,0 +1,288 @@
+"""Distributed eigensolve (ISSUE 15 tentpole): the solvers/ subspace
+path vs the exact eigh-family routes.
+
+The runtime half of the acceptance gate: every distributed solve
+(merge, root-tier merge, serving extract) must agree with its exact
+twin inside the angle budget at small d, honor the masked / all-masked
+merge semantics exactly, and flow through the real feature-sharded
+trainer when ``cfg.uses_distributed_solve()``. The static half — the
+d >= 32k audit-shape proxy — lowers the SAME programs at d=32768 and
+runs the full dist_solve contract (collective schedule + payload
+bounds, factor-only memory, sharding with the replicated-axis floor)
+over the partitioned HLO: no device ever holds a dense d x d or an
+above-floor replicated d-wide buffer, proven without executing a flop.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.ops.linalg import (
+    canonicalize_signs,
+    merged_top_k_lowrank,
+    principal_angles_degrees,
+    top_k_eigvecs,
+)
+from distributed_eigenspaces_tpu.parallel.mesh import (
+    FEATURE_AXIS,
+    WORKER_AXIS,
+    make_mesh,
+    shard_map,
+)
+from distributed_eigenspaces_tpu.solvers import (
+    dist_canonicalize_signs,
+    dist_extract_top_k,
+    dist_merged_top_k,
+    merged_top_k_distributed,
+)
+
+D, K, M = 64, 3, 4
+ITERS = 24
+BUDGET_DEG = 0.5  # dist-vs-exact agreement (the bench --dsolve gate)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(num_workers=4, num_feature_shards=2)
+
+
+def _worker_stack(rng, m=M, d=D, k=K, noise=0.05):
+    """Per-worker orthonormal factors perturbed around one planted
+    truth — the merge inputs every equivalence test shares."""
+    truth = np.linalg.qr(rng.standard_normal((d, k)))[0]
+    vs = [
+        np.linalg.qr(truth + noise * rng.standard_normal((d, k)))[0]
+        for _ in range(m)
+    ]
+    return jnp.asarray(np.stack(vs).astype(np.float32))
+
+
+def _angle(a, b):
+    return float(np.max(np.asarray(principal_angles_degrees(a, b))))
+
+
+def test_merged_top_k_distributed_matches_exact(rng):
+    vs = _worker_stack(rng)
+    got = merged_top_k_distributed(vs, K, iters=ITERS)
+    want = merged_top_k_lowrank(vs, K)
+    assert _angle(got, want) < BUDGET_DEG
+
+
+def test_merged_top_k_distributed_masked_matches_exact(rng):
+    """A masked worker is excluded EXACTLY — the solve agrees with the
+    exact masked route, and masking a corrupted worker changes the
+    answer (the mask is load-bearing, not decorative)."""
+    vs = np.array(_worker_stack(rng))
+    # worker 0 solved garbage: an unrelated random subspace
+    vs[0] = np.linalg.qr(rng.standard_normal((D, K)))[0]
+    vs = jnp.asarray(vs)
+    mask = jnp.asarray([0.0, 1.0, 1.0, 1.0])
+    got = merged_top_k_distributed(vs, K, mask=mask, iters=ITERS)
+    want = merged_top_k_lowrank(vs, K, mask=mask)
+    assert _angle(got, want) < BUDGET_DEG
+    unmasked = merged_top_k_distributed(vs, K, iters=ITERS)
+    assert _angle(got, unmasked) > 1.0
+
+
+def test_merged_top_k_distributed_all_masked_zeros(rng):
+    """An all-masked round returns exact zeros (the exact route's
+    guard semantics) — not NaNs from a zero Gram's Cholesky."""
+    vs = _worker_stack(rng)
+    got = merged_top_k_distributed(
+        vs, K, mask=jnp.zeros((M,)), iters=ITERS
+    )
+    np.testing.assert_array_equal(np.asarray(got), 0.0)
+
+
+def test_dist_merged_top_k_on_mesh_matches_exact(mesh, devices, rng):
+    """The sharded merge inside shard_map over (workers, features)
+    agrees with the dense exact merge of the same stack."""
+    vs = _worker_stack(rng)
+
+    def merge(vws, mask):
+        return dist_merged_top_k(vws, K, mask=mask, iters=ITERS)
+
+    in_specs = (P(WORKER_AXIS, FEATURE_AXIS, None), P(WORKER_AXIS))
+    fit = jax.jit(
+        shard_map(
+            merge, mesh=mesh, in_specs=in_specs,
+            out_specs=P(FEATURE_AXIS, None), check_vma=False,
+        ),
+        in_shardings=tuple(NamedSharding(mesh, s) for s in in_specs),
+    )
+    got = np.asarray(fit(vs, jnp.ones((M,))))
+    want = merged_top_k_lowrank(vs, K)
+    assert _angle(jnp.asarray(got), want) < BUDGET_DEG
+
+
+def test_dist_extract_top_k_matches_eigh(rng):
+    """The serving extract from the low-rank factors == the dense
+    eigh of U diag(s) U^T, descending and sign-canonical."""
+    r = 8
+    u = jnp.asarray(
+        np.linalg.qr(rng.standard_normal((D, r)))[0].astype(np.float32)
+    )
+    s = jnp.asarray(np.linspace(9.0, 1.0, r).astype(np.float32))
+    dense = (u * s[None, :]) @ u.T
+    want = top_k_eigvecs(dense, K)
+    got = dist_extract_top_k(u, s, K, iters=ITERS, axis_name=None)
+    assert _angle(got, want) < BUDGET_DEG
+    # descending Rayleigh quotients: the published column order
+    quot = np.diag(np.asarray(got.T @ dense @ got))
+    assert np.all(np.diff(quot) <= 1e-4), quot
+
+
+def test_dist_extract_top_k_on_mesh_matches_eigh(mesh, devices, rng):
+    r = 8
+    u = jnp.asarray(
+        np.linalg.qr(rng.standard_normal((D, r)))[0].astype(np.float32)
+    )
+    s = jnp.asarray(np.linspace(9.0, 1.0, r).astype(np.float32))
+
+    def extract(uu, ss):
+        return dist_extract_top_k(uu, ss, K, iters=ITERS)
+
+    in_specs = (P(FEATURE_AXIS, None), P())
+    fit = jax.jit(
+        shard_map(
+            extract, mesh=mesh, in_specs=in_specs,
+            out_specs=P(FEATURE_AXIS, None), check_vma=False,
+        ),
+        in_shardings=tuple(NamedSharding(mesh, sp) for sp in in_specs),
+    )
+    got = jnp.asarray(np.asarray(fit(u, s)))
+    want = top_k_eigvecs((u * s[None, :]) @ u.T, K)
+    assert _angle(got, want) < BUDGET_DEG
+
+
+def test_dist_canonicalize_signs_matches_dense(mesh, devices, rng):
+    """The sharded sign rule == the dense rule, bit-for-bit: the pivot
+    search gathers a (2, k) candidate per shard, never the basis."""
+    v = jnp.asarray(rng.standard_normal((D, K)).astype(np.float32))
+    fn = jax.jit(
+        shard_map(
+            lambda x: dist_canonicalize_signs(x, FEATURE_AXIS),
+            mesh=mesh, in_specs=(P(FEATURE_AXIS, None),),
+            out_specs=P(FEATURE_AXIS, None), check_vma=False,
+        ),
+        in_shardings=(NamedSharding(mesh, P(FEATURE_AXIS, None)),),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fn(v)), np.asarray(canonicalize_signs(v))
+    )
+
+
+def test_crossover_policy_is_config_resolved():
+    """cfg.uses_distributed_solve() — the ONE crossover definition: it
+    flips strictly above eigh_crossover_d, only for
+    solver='distributed', and local solves resolve to the subspace
+    machinery."""
+    base = dict(dim=128, k=2, num_workers=2, rows_per_worker=8,
+                num_steps=1)
+    hi = PCAConfig(solver="distributed", eigh_crossover_d=64, **base)
+    assert hi.uses_distributed_solve()
+    assert hi.resolved_local_solver() == "subspace"
+    at = PCAConfig(solver="distributed", eigh_crossover_d=128, **base)
+    assert not at.uses_distributed_solve()  # strict: dim must EXCEED
+    eigh = PCAConfig(solver="eigh", eigh_crossover_d=64, **base)
+    assert not eigh.uses_distributed_solve()
+    for bad in (0, -1, True, "big"):
+        with pytest.raises(ValueError, match="eigh_crossover_d"):
+            PCAConfig(eigh_crossover_d=bad, **base)
+
+
+def test_fs_trainer_dist_solve_recovers_planted(mesh, devices):
+    """End to end through the REAL feature-sharded trainer with the
+    crossover active (eigh_crossover_d=1 < dim): the distributed merge
+    replaces the exact one and the planted subspace is still
+    recovered inside the trainer's own budget."""
+    from distributed_eigenspaces_tpu.data.synthetic import (
+        planted_spectrum,
+    )
+    from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+        make_feature_sharded_step,
+    )
+
+    n = 128
+    spec = planted_spectrum(D, k_planted=K, gap=25.0, noise=0.01,
+                            seed=11)
+    cfg = PCAConfig(
+        dim=D, k=K, num_workers=M, rows_per_worker=n, num_steps=5,
+        subspace_iters=30, solver="distributed", eigh_crossover_d=1,
+    )
+    assert cfg.uses_distributed_solve()
+    step = make_feature_sharded_step(cfg, mesh, seed=4)
+    state = step.init_state()
+    key = jax.random.PRNGKey(9)
+    for _ in range(cfg.num_steps):
+        key, sub = jax.random.split(key)
+        x = spec.sample(sub, M * n).reshape(M, n, D)
+        state, _ = step(state, x)
+    w = jnp.asarray(np.asarray(jax.device_get(state.u))[:, :K])
+    assert _angle(w, spec.top_k(K)) < 2.0
+
+
+@pytest.mark.parametrize("leg", ["merge", "extract"])
+def test_d32k_audit_proxy_never_dense(devices, leg):
+    """THE acceptance headline, statically: the merge and extract
+    programs lowered at d=32768 (the ANALYSIS_COSTS.json projection
+    shape) pass the full dist_solve contract — collective payloads
+    bounded by the factor stack, factor-only memory (no buffer with
+    two >= d_local axes anywhere in the jaxpr or the partitioned HLO),
+    and the sharding pass's replicated-axis floor (no un-sharded
+    d-wide operand). A d x d Gram — 4 GiB at this shape — cannot hide
+    in a program that passes this."""
+    from distributed_eigenspaces_tpu.analysis import contracts
+    from distributed_eigenspaces_tpu.analysis.programs import (
+        BuiltProgram,
+    )
+
+    d, k, m, r = 32768, 2, 4, 8
+    mesh = make_mesh(num_workers=4, num_feature_shards=2)
+    if leg == "merge":
+        def merge(vws, mask):
+            return dist_merged_top_k(vws, k, mask=mask, iters=2)
+
+        in_specs = (P(WORKER_AXIS, FEATURE_AXIS, None), P(WORKER_AXIS))
+        args = (
+            jax.ShapeDtypeStruct((m, d, k), jnp.float32),
+            jax.ShapeDtypeStruct((m,), jnp.float32),
+        )
+        fn, params = merge, contracts.ProgramParams(
+            d=d, k=k, m=m, n_feature_shards=2, n_workers_mesh=4,
+        )
+    else:
+        def extract(u, s):
+            return dist_extract_top_k(u, s, k, iters=2)
+
+        in_specs = (P(FEATURE_AXIS, None), P())
+        args = (
+            jax.ShapeDtypeStruct((d, r), jnp.float32),
+            jax.ShapeDtypeStruct((r,), jnp.float32),
+        )
+        fn, params = extract, contracts.ProgramParams(
+            d=d, k=k, m=1, n_feature_shards=2, n_workers_mesh=4,
+            sketch_width=r,
+        )
+    fit = jax.jit(
+        shard_map(
+            fn, mesh=mesh, in_specs=in_specs,
+            out_specs=P(FEATURE_AXIS, None), check_vma=False,
+        ),
+        in_shardings=tuple(NamedSharding(mesh, s) for s in in_specs),
+    )
+    built = BuiltProgram(
+        name=f"dist_{leg}_d32k", contract="dist_solve",
+        params=params, jitted=fit, args=args,
+    )
+    viols, detail = contracts.check_program(built)
+    assert not viols, [v.format() for v in viols]
+    col = detail["collectives"]
+    assert col["n_collectives"] > 0
+    bound = contracts._factor_stack(params)
+    assert col["max_payload_elems"] <= bound
+    assert detail["memory"]["policy"] == "factor_only"
+    assert detail["shardings"]["checked"]

@@ -1,0 +1,327 @@
+"""Sharded-basis durability (ISSUE 15): the pytree basis lifecycle —
+publish sharded, recover bit-exact per shard, quarantine a torn shard
+loudly, tail it from a replica inside the staleness bound, round-trip
+sharded checkpoint leaves, and serve it without ever assembling the
+dense (d, k) on one device.
+
+These are the write/read sides of the "bases are sharding-aware
+pytrees" refactor: a BasisVersion carries its PartitionSpec and row
+partition through disk, replication, and the serving engine — or the
+failure is loud, never a silently-replicated dense basis.
+"""
+
+import glob
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+    LowRankState,
+)
+from distributed_eigenspaces_tpu.parallel.mesh import (
+    FEATURE_AXIS,
+    make_mesh,
+)
+from distributed_eigenspaces_tpu.serving.registry import (
+    EigenbasisRegistry,
+)
+from distributed_eigenspaces_tpu.serving.replication import (
+    ReplicaRegistry,
+)
+from distributed_eigenspaces_tpu.serving.transform import (
+    TransformEngine,
+)
+from distributed_eigenspaces_tpu.utils.checkpoint import (
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+D, K = 32, 3
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(num_workers=4, num_feature_shards=2)
+
+
+def _shards(seed=0, d=D, k=K, parts=2):
+    """An orthonormal basis as its ordered row shards — what a
+    per-device fetch hands the publish."""
+    rng = np.random.default_rng(seed)
+    v = np.linalg.qr(rng.standard_normal((d, k)))[0].astype(np.float32)
+    rows = d // parts
+    return [v[i * rows:(i + 1) * rows] for i in range(parts)], v
+
+
+class TestShardedPublishRecover:
+    def test_roundtrip_bit_exact_per_shard(self, tmp_path):
+        td = str(tmp_path / "reg")
+        parts, full = _shards()
+        reg = EigenbasisRegistry(registry_dir=td)
+        bv = reg.publish(parts, spec=("features", None), step=3)
+        assert bv.shard_sizes == (16, 16)
+        assert bv.spec == ("features", None)
+        assert bv.num_shards == 2
+        for i, p in enumerate(parts):
+            np.testing.assert_array_equal(np.asarray(bv.shard(i)), p)
+        # cold recovery: a fresh registry restores the version with
+        # its partition AND its bytes intact, shard by shard
+        reg2 = EigenbasisRegistry(registry_dir=td)
+        lv = reg2.latest()
+        assert lv.version == bv.version and lv.step == 3
+        assert lv.spec == ("features", None)
+        assert lv.shard_sizes == (16, 16)
+        for i, p in enumerate(parts):
+            np.testing.assert_array_equal(np.asarray(lv.shard(i)), p)
+        np.testing.assert_array_equal(np.asarray(lv.v), full)
+
+    def test_num_shards_balanced_split(self, tmp_path):
+        rng = np.random.default_rng(7)
+        v = rng.standard_normal((33, 2)).astype(np.float32)
+        reg = EigenbasisRegistry(
+            registry_dir=str(tmp_path / "reg")
+        )
+        bv = reg.publish(v, num_shards=4)
+        assert bv.shard_sizes == (9, 8, 8, 8)
+        assert bv.spec == ("features", None)  # the default declaration
+        np.testing.assert_array_equal(
+            np.concatenate(
+                [np.asarray(bv.shard(i)) for i in range(4)]
+            ),
+            v,
+        )
+
+    def test_replicated_version_has_one_shard(self, tmp_path):
+        _, full = _shards()
+        reg = EigenbasisRegistry(registry_dir=str(tmp_path / "reg"))
+        bv = reg.publish(full)
+        assert bv.shard_sizes is None and bv.spec is None
+        np.testing.assert_array_equal(np.asarray(bv.shard(0)), full)
+        with pytest.raises(IndexError, match="1 shard"):
+            bv.shard(1)
+
+    def test_torn_shard_quarantined_loudly(self, tmp_path):
+        """One rotted shard fails ALONE and loudly: recovery
+        quarantines the whole version (evidence preserved, id never
+        reused) instead of serving a half-corrupt basis."""
+        td = str(tmp_path / "reg")
+        parts, _ = _shards()
+        EigenbasisRegistry(registry_dir=td).publish(
+            parts, spec=("features", None)
+        )
+        (shard_file,) = glob.glob(
+            os.path.join(td, "v*", "basis.shard01.npz")
+        )
+        with open(shard_file, "r+b") as f:
+            f.truncate(32)  # torn mid-write / rotted bytes
+        reg2 = EigenbasisRegistry(registry_dir=td)
+        assert reg2.latest() is None
+        assert len(reg2.quarantined) == 1
+        assert glob.glob(os.path.join(td, "v*.quarantined"))
+        # the quarantined id is burned: the next publish advances past
+        nxt = reg2.publish(parts, spec=("features", None))
+        assert nxt.version > 1
+
+    def test_missing_shard_quarantined(self, tmp_path):
+        td = str(tmp_path / "reg")
+        parts, _ = _shards()
+        EigenbasisRegistry(registry_dir=td).publish(
+            parts, spec=("features", None)
+        )
+        (shard_file,) = glob.glob(
+            os.path.join(td, "v*", "basis.shard00.npz")
+        )
+        os.remove(shard_file)  # committed-but-missing = corrupt
+        reg2 = EigenbasisRegistry(registry_dir=td)
+        assert reg2.latest() is None
+        assert len(reg2.quarantined) == 1
+
+
+class TestReplicaTailsShardedPublish:
+    def test_sharded_publish_propagates_within_staleness(
+        self, tmp_path
+    ):
+        td = str(tmp_path / "reg")
+        parts, full = _shards()
+        reg = EigenbasisRegistry(registry_dir=td)
+        with ReplicaRegistry(
+            td, staleness_ms=5000.0, poll_s=0.01
+        ) as rep:
+            bv = reg.publish(parts, spec=("features", None), step=9)
+            rep.poke()
+            deadline = time.monotonic() + 5.0
+            while rep.latest() is None or (
+                rep.latest().version != bv.version
+            ):
+                assert time.monotonic() < deadline, (
+                    "replica never installed the sharded publish"
+                )
+                time.sleep(0.005)
+            got = rep.latest()
+            # the partition survives the tail: spec, row sizes, and
+            # every shard's bytes — a replica serves the same pytree
+            assert got.spec == ("features", None)
+            assert got.shard_sizes == bv.shard_sizes
+            for i, p in enumerate(parts):
+                np.testing.assert_array_equal(
+                    np.asarray(got.shard(i)), p
+                )
+            np.testing.assert_array_equal(np.asarray(got.v), full)
+            assert rep.stale_installs == 0
+            assert rep.last_lag_ms is not None
+            assert rep.last_lag_ms <= rep.staleness_ms
+
+    def test_replica_skips_rotted_shard(self, tmp_path):
+        """A torn per-shard payload on the tail side: counted and
+        skipped (read-only — the store belongs to the lease holder),
+        never installed."""
+        td = str(tmp_path / "reg")
+        parts, _ = _shards()
+        EigenbasisRegistry(registry_dir=td).publish(
+            parts, spec=("features", None)
+        )
+        (shard_file,) = glob.glob(
+            os.path.join(td, "v*", "basis.shard01.npz")
+        )
+        with open(shard_file, "r+b") as f:
+            f.truncate(32)
+        with ReplicaRegistry(td, start=False) as rep:
+            assert rep.latest() is None
+            assert rep.corrupt_skipped == 1
+            assert glob.glob(
+                os.path.join(td, "v*", "basis.shard01.npz")
+            )  # evidence untouched
+
+
+class TestShardedCheckpointLeaves:
+    def test_lowrank_carry_roundtrips_with_specs(
+        self, tmp_path, mesh, devices
+    ):
+        """A feature-sharded trainer's carry checkpoints with its
+        per-leaf PartitionSpecs and restores ON THE MESH: the row
+        shards transfer per device, values bit-exact, placement
+        re-established from the marker."""
+        rng = np.random.default_rng(3)
+        r = 6
+        u_host = np.linalg.qr(
+            rng.standard_normal((D, r))
+        )[0].astype(np.float32)
+        s_host = np.linspace(5.0, 1.0, r).astype(np.float32)
+        row = NamedSharding(mesh, P(FEATURE_AXIS, None))
+        state = LowRankState(
+            u=jax.device_put(u_host, row),
+            s=jax.device_put(s_host, NamedSharding(mesh, P())),
+            step=jnp.asarray(4, jnp.int32),
+        )
+        path = str(tmp_path / "ckpt")
+        save_checkpoint(path, state, cursor=7)
+        restored, cursor = restore_checkpoint(path, mesh=mesh)
+        assert cursor == 7
+        np.testing.assert_array_equal(np.asarray(restored.u), u_host)
+        np.testing.assert_array_equal(np.asarray(restored.s), s_host)
+        assert int(restored.step) == 4
+        assert restored.u.sharding == row
+        # without a mesh the same checkpoint restores to the default
+        # placement (dense-trainer back-compat), values unchanged
+        plain, _ = restore_checkpoint(path)
+        np.testing.assert_array_equal(np.asarray(plain.u), u_host)
+
+
+class TestShardedServing:
+    def _engine(self, mesh):
+        return TransformEngine(
+            D, K, mesh=mesh, basis_spec=(FEATURE_AXIS, None)
+        )
+
+    def test_sharded_engine_matches_dense(self, mesh, devices):
+        rng = np.random.default_rng(5)
+        _, v = _shards(seed=5)
+        x = rng.standard_normal((10, D)).astype(np.float32)
+        eng = self._engine(mesh)
+        dense = TransformEngine(D, K)
+        z = np.asarray(eng.project(x, v))
+        np.testing.assert_allclose(
+            z, np.asarray(dense.project(x, v)), atol=1e-5
+        )
+        np.testing.assert_allclose(z, x @ v, atol=1e-4)
+        xr = np.asarray(eng.reconstruct(z, v))
+        np.testing.assert_allclose(
+            xr, np.asarray(dense.reconstruct(z, v)), atol=1e-5
+        )
+        res, e_in = eng.residual_energy(x, z)
+        np.testing.assert_allclose(
+            np.asarray(e_in), np.sum(x ** 2, axis=-1), rtol=1e-5
+        )
+        assert np.all(np.asarray(res) >= 0.0)
+
+    def test_basis_operand_is_sharded_not_replicated(
+        self, mesh, devices, tmp_path
+    ):
+        """place_basis of a sharded BasisVersion lands row shards on
+        the features axis — every device holds d/2 rows, and the
+        projection still equals the dense product."""
+        parts, v = _shards()
+        reg = EigenbasisRegistry(registry_dir=str(tmp_path / "r"))
+        bv = reg.publish(parts, spec=("features", None))
+        eng = self._engine(mesh)
+        placed = eng.place_basis(bv)
+        assert placed.sharding.spec == P(FEATURE_AXIS, None)
+        shard_rows = {
+            s.data.shape[0] for s in placed.addressable_shards
+        }
+        assert shard_rows == {D // 2}
+        x = np.random.default_rng(6).standard_normal(
+            (8, D)
+        ).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(eng.project(x, placed)), x @ v, atol=1e-4
+        )
+
+    def test_hot_swap_recompiles_nothing(self, mesh, devices):
+        """The sharded path keeps the serving tier's core economics:
+        the basis is an operand, so a version swap is a device_put,
+        not a compile."""
+        rng = np.random.default_rng(8)
+        _, v1 = _shards(seed=1)
+        _, v2 = _shards(seed=2)
+        x = rng.standard_normal((8, D)).astype(np.float32)
+        eng = self._engine(mesh)
+        eng.project(x, v1)
+        misses = eng.compile_misses
+        assert misses > 0
+        out = np.asarray(eng.project(x, eng.place_basis(v2)))
+        assert eng.compile_misses == misses
+        np.testing.assert_allclose(out, x @ v2, atol=1e-4)
+
+    def test_project_is_the_only_collective(self, mesh, devices):
+        """The dist_serve schedule in the compiled artifacts: project
+        carries the one k-wide psum, reconstruct stays row-local with
+        zero collectives."""
+        from distributed_eigenspaces_tpu.analysis.hlo import (
+            parse_collectives,
+        )
+
+        eng = self._engine(mesh)
+        rows = 8
+        proj_ops = parse_collectives(
+            eng.compiled_for("project", rows).as_text()
+        )
+        assert proj_ops and all(
+            o.op == "all-reduce" for o in proj_ops
+        )
+        assert max(o.elems for o in proj_ops) <= rows * K
+        assert not parse_collectives(
+            eng.compiled_for("reconstruct", rows).as_text()
+        )
+
+    def test_indivisible_d_rejected_loudly(self, devices):
+        mesh = make_mesh(num_workers=4, num_feature_shards=2)
+        with pytest.raises(ValueError, match="feature shards"):
+            TransformEngine(
+                33, 2, mesh=mesh, basis_spec=(FEATURE_AXIS, None)
+            )
