@@ -441,6 +441,46 @@ CONTRACTS: dict[str, ProgramContract] = {
             replicated_axis_floor=lambda p: p.d,
         ),
     ),
+    "population_merge": ProgramContract(
+        name="population_merge",
+        description=(
+            "population-scale cohort reduce (ISSUE 16): the hardened "
+            "Byzantine-tolerant merge of one sampled cohort's (d, k) "
+            "client summaries — the ONLY collective is the all-gather "
+            "of the cohort-sharded factor stack, so per-round payloads "
+            "are bounded by COHORT size (m := cohort), never by the "
+            "population; the clip / trim / screen pipeline runs "
+            "replicated post-gather and nothing population-sized or "
+            "dense d x d ever crosses the mesh"
+        ),
+        allowed_collectives=frozenset({"all-gather"}),
+        max_payload_elems=_factor_stack,
+        require_collectives=True,
+        memory_policy="factor_only",
+        sharding=ShardingContract(buffers=(
+            DeclaredBuffer(
+                "cohort stack", "in",
+                dims=lambda p: (p.m, p.d, WILD),
+                spec=lambda p: ("workers", None, None),
+            ),
+            DeclaredBuffer(
+                "arrival mask", "in",
+                dims=lambda p: (p.m,),
+                spec=lambda p: ("workers",),
+            ),
+            DeclaredBuffer(
+                "merged basis", "out",
+                dims=lambda p: (p.d, WILD),
+                spec=lambda p: (None, None),
+            ),
+            DeclaredBuffer(
+                "survivor mask", "out",
+                dims=lambda p: (p.m,),
+                spec=lambda p: (None,),
+                required=False,
+            ),
+        )),
+    ),
 }
 
 

@@ -264,6 +264,49 @@ def _mutant_tree_payload_drift() -> list[contracts.Violation]:
     return viols
 
 
+def _mutant_population_payload() -> list[contracts.Violation]:
+    """The population-scale regression ISSUE 16's gate exists for: a
+    cohort reduce that all-gathers the POPULATION-sized stack instead
+    of the sampled cohort's — the op kind (all-gather) is in the
+    population_merge contract's allowed set, so the PAYLOAD bound
+    (m := cohort, never population) is what must catch it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_eigenspaces_tpu.parallel.mesh import (
+        WORKER_AXIS,
+        make_mesh,
+        shard_map,
+    )
+
+    mesh = make_mesh(num_workers=8)
+    population = 1024  # vs the declared cohort of 16
+
+    def population_reduce(stack_shard):
+        full = jax.lax.all_gather(
+            stack_shard, WORKER_AXIS, axis=0, tiled=True
+        )
+        return full.mean(axis=0)
+
+    f = jax.jit(shard_map(
+        population_reduce, mesh=mesh,
+        in_specs=P(WORKER_AXIS, None, None), out_specs=P(),
+        check_vma=False,
+    ))
+    hlo = f.lower(
+        jnp.zeros((population, _D, 2), jnp.float32)
+    ).compile().as_text()
+    contract = contracts.CONTRACTS["population_merge"]
+    params = contracts.ProgramParams(
+        d=_D, k=2, m=16, n_workers_mesh=8,
+    )
+    viols, _ = contracts.check_collectives(
+        contract, params, hlo, program="mutant_population_payload"
+    )
+    return viols
+
+
 _FIXTURE_BLOCKING = '''
 import threading, time
 class Worker:
@@ -333,6 +376,9 @@ MUTATIONS: dict[str, tuple[str, Callable[[], list]]] = {
     ),
     "tree_payload_drift": (
         "cost-bound", _mutant_tree_payload_drift
+    ),
+    "population_payload": (
+        "collective-payload", _mutant_population_payload
     ),
     "blocking_under_lock": ("blocking-under-lock", _ast_mutant(
         _FIXTURE_BLOCKING, ast_lints.lint_concurrency_source

@@ -389,6 +389,44 @@ def _dist_serve_program(name: str, kind: str):
     return build
 
 
+def _population_program(name: str):
+    """The population cohort reduce (ISSUE 16): the hardened
+    Byzantine-tolerant merge of one sampled cohort's (d, k) summaries,
+    cohort-sharded over the workers axis. The population_merge
+    contract's subject: ONE all-gather of the (cohort, d, k) stack —
+    payload a function of the COHORT, never the population — then the
+    clip / trim / screen pipeline replicated post-gather."""
+
+    _COHORT = 16  # audit cohort: < dense_dim, and 8 | 16
+
+    def build() -> BuiltProgram:
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_eigenspaces_tpu.parallel.clients import (
+            make_sharded_cohort_reduce,
+        )
+        from distributed_eigenspaces_tpu.parallel.mesh import make_mesh
+
+        require_mesh_devices()
+        mesh = make_mesh(num_workers=8)
+        cfg = _cfg(
+            population=1024, cohort_size=_COHORT, max_poison_frac=0.1,
+        )
+        fit = make_sharded_cohort_reduce(cfg, mesh)
+        args = (
+            jax.ShapeDtypeStruct((_COHORT, _D, _K), jnp.float32),
+            jax.ShapeDtypeStruct((_COHORT,), jnp.float32),
+        )
+        return BuiltProgram(
+            name=name, contract="population_merge",
+            params=ProgramParams(d=_D, k=_K, m=_COHORT, n_workers_mesh=8),
+            jitted=fit, args=args,
+        )
+
+    return build
+
+
 def _serve_program(name: str, kind: str, *, sharded: bool):
     def build() -> BuiltProgram:
         import jax
@@ -462,6 +500,8 @@ PROGRAMS: dict[str, Callable[[], BuiltProgram]] = {
     "serve_project_solo": _serve_program(
         "serve_project_solo", "project", sharded=False
     ),
+    # population cohort reduce (ISSUE 16)
+    "population_reduce": _population_program("population_reduce"),
     # distributed eigensolve + sharded-basis serving (ISSUE 15)
     "dist_merge": _dist_merge_program("dist_merge"),
     "dist_extract": _dist_extract_program("dist_extract"),

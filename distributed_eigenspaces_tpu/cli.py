@@ -294,6 +294,35 @@ def build_parser() -> argparse.ArgumentParser:
                      "the heartbeat timeout); supervised runs wait "
                      "bounded for quorum and auto-resume from the "
                      "latest checkpoint")
+    pop = p.add_argument_group(
+        "population ingest (runtime/population.py)"
+    )
+    pop.add_argument("--population", type=int, default=None,
+                     help="simulated transient-client population size: "
+                     "enables the sampled-cohort ingest tier (each "
+                     "round draws a cohort, clients submit (d, k) "
+                     "factor summaries through the validation "
+                     "gauntlet + Byzantine-tolerant merge); default "
+                     "off (the stable-slot fit tier)")
+    pop.add_argument("--cohort-size", type=int, default=256,
+                     help="clients sampled per round; per-round merge "
+                     "cost and collective payloads scale with THIS, "
+                     "never with --population (the population_merge "
+                     "contract enforces it)")
+    pop.add_argument("--min-participation-frac", type=float,
+                     default=0.5,
+                     help="participation deadline floor: a round "
+                     "whose arrivals fall below this fraction of the "
+                     "cohort raises ParticipationLost (the population "
+                     "generalization of --min-quorum-frac); the run "
+                     "waits bounded and auto-resumes under "
+                     "--max-resumes")
+    pop.add_argument("--max-poison-frac", type=float, default=0.05,
+                     help="declared Byzantine tolerance: the trimmed "
+                     "merge drops this alpha-fraction from both tails "
+                     "of every coordinate, so up to this fraction of "
+                     "colluding poisoned clients cannot steer the "
+                     "basis (must be in [0, 0.5))")
     return p
 
 
@@ -814,6 +843,57 @@ def _fit_feature_whole(args, cfg, data, truth) -> int:
     print(json.dumps(out))
     if args.save:
         np.save(args.save, w_host)
+    return 0
+
+
+def _fit_population(args, cfg) -> int:
+    """``--population N``: the sampled-cohort ingest tier
+    (``runtime/population.py``) — each of ``--steps`` rounds draws a
+    ``--cohort-size`` cohort from the simulated population, every
+    contribution crosses the validation gauntlet, and the survivors
+    reduce through the Byzantine-tolerant hardened merge. Prints the
+    run summary (``summary()["population"]`` telemetry + planted-basis
+    recovery angle)."""
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+    from distributed_eigenspaces_tpu.runtime.population import (
+        population_fit,
+    )
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    metrics = MetricsLogger(
+        stream=sys.stderr if args.metrics else None,
+        retention=cfg.metrics_retention,
+    ).start()
+    w, info, _sup = population_fit(
+        cfg, rounds=args.steps, metrics=metrics,
+        max_resumes=args.max_resumes,
+    )
+    angle = float(
+        principal_angles_degrees(
+            jnp.asarray(w), jnp.asarray(info["planted"])
+        ).max()
+    )
+    out = {
+        "mode": "population",
+        # summary()["population"] is the telemetry section; the sizes
+        # ride under their own keys so the section is never clobbered
+        **metrics.summary(),
+        "dim": cfg.dim,
+        "k": cfg.k,
+        "population_size": cfg.population,
+        "cohort_size": cfg.cohort_size,
+        "rounds": info["rounds"],
+        "resumes": info["resumes"],
+        "rejects": info["rejects"],
+        "planted_recovery_angle_deg": round(angle, 3),
+    }
+    print(json.dumps(out))
+    if args.save:
+        np.save(args.save, np.asarray(w))
     return 0
 
 
@@ -1417,6 +1497,32 @@ def main(argv=None) -> int:
     from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
     from distributed_eigenspaces_tpu.utils.checkpoint import Checkpointer
 
+    if args.population is not None:
+        if args.mode != "fit":
+            print(
+                "error: --population runs the sampled-cohort ingest "
+                "tier (mode fit only); serve/fleet tiers consume the "
+                "published basis, they do not ingest",
+                file=sys.stderr,
+            )
+            return 2
+        # the population tier SIMULATES its clients — no data file
+        cfg = PCAConfig(
+            dim=args.dim,
+            k=args.rank,
+            num_workers=args.workers,
+            rows_per_worker=args.rows_per_worker or 16,
+            num_steps=args.steps,
+            heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+            min_quorum_frac=args.min_quorum_frac,
+            merge_topology=merge_topology,
+            population=args.population,
+            cohort_size=args.cohort_size,
+            min_participation_frac=args.min_participation_frac,
+            max_poison_frac=args.max_poison_frac,
+        )
+        return _fit_population(args, cfg)
+
     data, truth = _load(args)
     n_total, dim = data.shape
 
@@ -1492,6 +1598,10 @@ def main(argv=None) -> int:
             None if args.round_deadline_ms == 0 else args.round_deadline_ms
         ),
         min_quorum_frac=args.min_quorum_frac,
+        population=args.population,
+        cohort_size=args.cohort_size,
+        min_participation_frac=args.min_participation_frac,
+        max_poison_frac=args.max_poison_frac,
     )
 
     if args.mode == "serve":

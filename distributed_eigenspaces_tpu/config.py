@@ -327,6 +327,39 @@ class PCAConfig:
         ex-publisher's commits are rejected by replicas AND by the
         store itself — failover is bounded, version ids never tear or
         duplicate.
+      population: size of the simulated TRANSIENT client population for
+        population-scale ingest (``runtime/population.py``; CLI
+        ``--population``). Unlike ``num_workers`` — m stable mesh slots
+        with heartbeat leases (PR 8's trust model) — population clients
+        are anonymous and transient: each round SAMPLES a cohort of
+        ``cohort_size`` clients, every contribution crosses the
+        validation gauntlet (``parallel/clients.py``) before it can
+        touch the merge, and per-round collective payloads are bounded
+        by the COHORT, never the population (the ``population_merge``
+        contract in ``analysis/``). ``None`` (default) disables the
+        population ingest tier entirely.
+      cohort_size: clients sampled per population round (the DrJAX-style
+        ``clients``-axis width; CLI ``--cohort-size``). Must not exceed
+        ``population``. Merge cost, collective payloads, and the
+        trimmed-mean order statistics all scale with this knob — the
+        population size only scales the SAMPLER.
+      min_participation_frac: the participation-fraction deadline — the
+        population generalization of ``min_quorum_frac`` from "m slots
+        live" to "arrived contributions >= this fraction of the sampled
+        cohort". A round whose post-deadline arrivals (dropouts
+        contribute nothing; late arrivals fold one-step-stale into the
+        NEXT round, the PR 2/PR 12 rule) fall below the floor raises a
+        loud ``ParticipationLost`` (a ``QuorumLost`` subclass), which
+        ``population_fit`` handles exactly like the PR 8 arc: bounded
+        wait → resume under the existing ``max_resumes`` budget.
+      max_poison_frac: declared Byzantine tolerance: the largest
+        fraction of a cohort that may be adversarial (colluding
+        included) while the hardened merge still provably cannot be
+        steered outside the trimmed-mean envelope. Sets the α-tail the
+        coordinate-wise trimmed mean drops each round (α >= this
+        fraction on each side) and the bench's poison arm. Must lie in
+        [0, 0.5) — trimming both tails past half the cohort leaves
+        nothing to average.
       seed: PRNG seed for initialization (subspace solver, synthetic data).
     """
 
@@ -372,6 +405,10 @@ class PCAConfig:
     replicas: int = 1
     replica_staleness_ms: float = 500.0
     publisher_lease_ms: float = 1000.0
+    population: int | None = None
+    cohort_size: int = 256
+    min_participation_frac: float = 0.5
+    max_poison_frac: float = 0.05
     seed: int = 0
 
     def __post_init__(self):
@@ -634,6 +671,45 @@ class PCAConfig:
                     f"{ms_field} must be a positive duration in ms, "
                     f"got {ms!r}"
                 )
+        if self.population is not None and (
+            not isinstance(self.population, int)
+            or isinstance(self.population, bool)
+            or self.population < 1
+        ):
+            raise ValueError(
+                f"population must be an int >= 1 or None, got "
+                f"{self.population!r}"
+            )
+        if not isinstance(self.cohort_size, int) or isinstance(
+            self.cohort_size, bool
+        ) or self.cohort_size < 1:
+            raise ValueError(
+                f"cohort_size must be an int >= 1, got "
+                f"{self.cohort_size!r}"
+            )
+        if self.population is not None and self.cohort_size > self.population:
+            raise ValueError(
+                f"cohort_size must not exceed population, got "
+                f"cohort_size={self.cohort_size} > "
+                f"population={self.population}"
+            )
+        if not isinstance(self.min_participation_frac, (int, float)) or (
+            isinstance(self.min_participation_frac, bool)
+            or not 0.0 < self.min_participation_frac <= 1.0
+        ):
+            raise ValueError(
+                f"min_participation_frac must be a fraction in (0, 1], "
+                f"got {self.min_participation_frac!r}"
+            )
+        if not isinstance(self.max_poison_frac, (int, float)) or (
+            isinstance(self.max_poison_frac, bool)
+            or not 0.0 <= self.max_poison_frac < 0.5
+        ):
+            raise ValueError(
+                f"max_poison_frac must be a fraction in [0, 0.5), got "
+                f"{self.max_poison_frac!r} (trimming both α-tails past "
+                "half the cohort leaves nothing to average)"
+            )
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")
         if self.prefetch_depth < 0:

@@ -1,0 +1,333 @@
+"""Population-scale client aggregation: the ``clients``-axis merge math.
+
+The paper's merge — average per-worker projector summaries V̂V̂ᵀ — is
+exactly the shape a TRANSIENT client can contribute: a ``(d, k)`` factor
+summary of its local data. This module is the math layer of the
+population ingest tier (ISSUE 16): everything a sampled cohort's
+contributions pass through between "bytes arrived" and "basis updated",
+hardened by construction:
+
+1. **Validation gauntlet** (:func:`validate_contribution`): host-side
+   boundary screen per contribution — shape, dtype, non-finite scan,
+   and a near-orthonormality check (``‖WᵀW − I‖_F``). A scaled or
+   garbage summary never reaches device memory; the caller quarantines
+   it into the PR 1 fault ledger attributed by client id + reason.
+
+2. **Norm clip** (:func:`clip_factor_norms`): each surviving factor is
+   Frobenius-clipped to ``clip_mult·√k`` (the norm of an exactly
+   orthonormal summary), so no single client carries more than O(1)
+   weight into any downstream statistic.
+
+3. **Coordinate-wise trimmed mean** (:func:`trimmed_mean_factors`):
+   drop the α-tails per coordinate per round (α ≥
+   ``cfg.max_poison_frac``). With ``p ≤ α`` colluding Byzantine clients,
+   every poisoned value at a coordinate lands inside a dropped tail or
+   between honest order statistics, so the trimmed mean stays inside
+   the honest envelope — the steering bound ``scripts/chaos.py --mode
+   population`` checks empirically and docs/ROBUSTNESS.md states.
+
+4. **Affinity screen + exact merge** (:func:`hardened_merge_body`): the
+   trimmed mean (orthonormalized) is a robust ANCHOR, not the final
+   answer: contributions whose subspace affinity to the anchor falls
+   below ``screen_tau`` are excluded (attributable — the returned keep
+   mask names them), and the survivors reduce through the EXISTING
+   exact masked merge — ``merged_top_k_lowrank``, or the PR 12 tiered
+   tree (``tree_merge_stacked``) when a topology is configured — so
+   the accepted-path numerics stay the tested merge numerics.
+
+Per-round cost and collective payloads are functions of the COHORT
+size, never the population: :func:`make_sharded_cohort_reduce` is the
+audited program (``population_merge`` contract, ``analysis/``) whose
+single all-gather moves the ``(cohort, d, k)`` stack and nothing more.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from distributed_eigenspaces_tpu.ops.linalg import (
+    merged_top_k_lowrank,
+)
+
+__all__ = [
+    "REJECT_REASONS",
+    "clip_factor_norms",
+    "hardened_merge_body",
+    "make_population_merge",
+    "make_sharded_cohort_reduce",
+    "naive_mean_basis",
+    "population_topology",
+    "trimmed_mean_factors",
+    "validate_contribution",
+]
+
+#: the gauntlet's closed vocabulary of rejection reasons — ledger events
+#: and ``summary()["population"]["rejects"]`` key on exactly these
+REJECT_REASONS = (
+    "bad_shape",
+    "bad_dtype",
+    "nonfinite",
+    "not_orthonormal",
+)
+
+
+def validate_contribution(
+    w, d: int, k: int, *, orth_tol: float = 0.25
+) -> str | None:
+    """Host-side validation gauntlet for ONE client contribution.
+
+    Returns ``None`` for a valid ``(d, k)`` factor summary, else the
+    rejection reason (one of :data:`REJECT_REASONS`). Runs on numpy
+    BEFORE the contribution can touch any jitted program — corrupt
+    bytes never reach device memory, and the caller attributes the
+    quarantine by client id + reason in the fault ledger.
+
+    ``orth_tol`` bounds ``‖WᵀW − I‖_F``: honest summaries are QR
+    outputs (≈ 1e-6), while a scaled or rank-collapsed poison summary
+    fails by construction (a uniform scale ``s`` alone costs
+    ``√k·|s²−1|``).
+    """
+    arr = np.asarray(w)
+    if arr.shape != (d, k):
+        return "bad_shape"
+    if not np.issubdtype(arr.dtype, np.floating):
+        return "bad_dtype"
+    arr = np.asarray(arr, np.float64)
+    if not np.isfinite(arr).all():
+        return "nonfinite"
+    gram = arr.T @ arr
+    if np.linalg.norm(gram - np.eye(k)) > orth_tol:
+        return "not_orthonormal"
+    return None
+
+
+def clip_factor_norms(stack, *, clip_mult: float = 1.0):
+    """Frobenius-clip each contribution in ``stack (c, d, k)`` to
+    ``clip_mult·√k`` — the norm of an exactly orthonormal summary — so
+    a large-norm contribution that slipped every screen still carries
+    at most O(1) weight into the trimmed mean."""
+    k = stack.shape[-1]
+    cap = clip_mult * jnp.sqrt(jnp.asarray(k, stack.dtype))
+    norms = jnp.sqrt((stack * stack).sum(axis=(1, 2)) + 1e-30)
+    scale = jnp.minimum(1.0, cap / norms)
+    return stack * scale[:, None, None]
+
+
+def _align_signs(stack, mask):
+    """Per-column sign canonicalization ACROSS the cohort: pick the
+    consensus anchor row (argmax of the masked mean |entry| per column
+    — a location statistic ≤ half the cohort cannot move) and flip
+    each contribution's column so its anchor entry is non-negative.
+    Honest summaries near a common subspace come out sign-consistent;
+    without this, QR's arbitrary column signs would make the
+    coordinate-wise statistics meaningless."""
+    mf = mask.astype(stack.dtype)
+    cnt = jnp.maximum(mf.sum(), 1.0)
+    absmean = (jnp.abs(stack) * mf[:, None, None]).sum(axis=0) / cnt
+    j0 = jnp.argmax(absmean, axis=0)  # (k,) anchor row per column
+    anchor = jnp.take_along_axis(
+        stack, j0[None, None, :].repeat(stack.shape[0], 0), axis=1
+    )[:, 0, :]  # (c, k)
+    s = jnp.where(anchor < 0, -1.0, 1.0).astype(stack.dtype)
+    return stack * s[:, None, :]
+
+
+def trimmed_mean_factors(stack, mask, alpha: float):
+    """Masked coordinate-wise α-trimmed mean over the cohort axis.
+
+    For each of the ``d·k`` coordinates independently: sort the
+    ``cnt = Σ mask`` valid values, drop the lowest and highest
+    ``t = ⌊α·cnt⌋``, average the rest. Masked-out entries sort to the
+    tail (+inf) and never enter any average; an all-masked round
+    returns exact zeros (the flat merge's guard semantics).
+
+    The Byzantine bound this buys: with ``p·cnt ≤ t`` poisoned values
+    per coordinate, every surviving order statistic lies between two
+    HONEST values, so the trimmed mean is confined to the honest
+    envelope no matter what the colluders submit — unbounded steering
+    requires breaking the trim fraction, not crafting better values.
+    """
+    c = stack.shape[0]
+    dt = stack.dtype
+    mf = mask.astype(dt)
+    cnt = mf.sum()
+    guarded = jnp.where(
+        mf[:, None, None] > 0, stack, jnp.asarray(jnp.inf, dt)
+    )
+    srt = jnp.sort(guarded, axis=0)
+    pos = jnp.arange(c, dtype=dt)[:, None, None]
+    t = jnp.floor(alpha * cnt)
+    keep = (pos >= t) & (pos <= cnt - 1.0 - t)
+    vals = jnp.where(keep & jnp.isfinite(srt), srt, 0.0)
+    kept = jnp.maximum(cnt - 2.0 * t, 1.0)
+    return vals.sum(axis=0) / kept
+
+
+def naive_mean_basis(stack, mask, k: int):
+    """The UNHARDENED arm: plain masked mean of the raw factor
+    summaries, orthonormalized — no gauntlet, no clip, no trim, no
+    screen. This is the A/B baseline ``bench.py --population`` proves
+    a 5% colluding poison cohort steers past the angle budget."""
+    mf = mask.astype(stack.dtype)
+    mean = (stack * mf[:, None, None]).sum(axis=0) / jnp.maximum(
+        mf.sum(), 1.0
+    )
+    q, _ = jnp.linalg.qr(mean)
+    return q[:, :k]
+
+
+def hardened_merge_body(
+    stack,
+    mask,
+    *,
+    k: int,
+    alpha: float,
+    clip_mult: float = 1.0,
+    screen_tau: float = 0.5,
+    topology=None,
+):
+    """The full hardened cohort merge (pure, jittable): clip → sign
+    align → trimmed-mean anchor → affinity screen → exact masked merge
+    of the survivors. Returns ``(v, keep, stats)``:
+
+    - ``v (d, k)``: the merged basis (exact masked merge over the
+      screened survivors — ``tree_merge_stacked`` when ``topology`` is
+      a resolved :class:`~.topology.MergeTopology` covering the cohort,
+      else the flat ``merged_top_k_lowrank``);
+    - ``keep (c,)``: which arrivals survived the screen (the caller
+      attributes ``mask − keep`` as ``screened`` rejects);
+    - ``stats``: scalar diagnostics (arrived / kept counts, trim
+      fraction, anchor affinity floor of the survivors).
+
+    If the screen would exclude EVERYONE (a degenerate anchor), it
+    falls back to the arrival mask — degraded accuracy beats a zero
+    basis, and the fallback is visible in ``stats["screen_fallback"]``.
+    """
+    mf = mask.astype(stack.dtype)
+    w = clip_factor_norms(stack, clip_mult=clip_mult)
+    w = _align_signs(w, mf)
+    anchor = trimmed_mean_factors(w, mf, alpha)
+    q, _ = jnp.linalg.qr(anchor)
+    q = q[:, :k]
+    proj = jnp.einsum("dk,cdq->ckq", q, w)
+    aff = (proj * proj).sum(axis=(1, 2)) / k
+    keep = mf * (aff >= screen_tau).astype(stack.dtype)
+    fallback = keep.sum() == 0
+    keep = jnp.where(fallback, mf, keep)
+    if topology is not None:
+        from distributed_eigenspaces_tpu.parallel.topology import (
+            tree_merge_stacked,
+        )
+
+        v = tree_merge_stacked(w, k, topology, mask=keep)
+    else:
+        v = merged_top_k_lowrank(w, k, mask=keep)
+    arrived = mf.sum()
+    stats = {
+        "arrived": arrived,
+        "kept": keep.sum(),
+        "trim_frac": 1.0 - keep.sum() / jnp.maximum(arrived, 1.0),
+        "min_kept_aff": jnp.where(
+            keep > 0, aff, jnp.asarray(jnp.inf, stack.dtype)
+        ).min(),
+        "screen_fallback": fallback.astype(stack.dtype),
+    }
+    return v, keep, stats
+
+
+def population_topology(cfg):
+    """Resolve ``cfg.merge_topology`` against the COHORT (not
+    ``num_workers``): the population round's reduce covers
+    ``cohort_size`` contributions, so the tree's fan-ins must multiply
+    to the cohort and divide ``dim`` — same rules as
+    :func:`~.topology.resolve_topology`, re-anchored. ``None`` when no
+    topology is configured (flat merge)."""
+    topo = getattr(cfg, "merge_topology", None)
+    if topo is None:
+        return None
+    from distributed_eigenspaces_tpu.parallel.topology import (
+        MergeTopology,
+    )
+
+    tiers = tuple((str(n), int(f)) for n, f in topo)
+    product = 1
+    for name, f in tiers:
+        if cfg.dim % f:
+            raise ValueError(
+                f"population merge_topology tier {name!r} fan_in {f} "
+                f"must divide dim={cfg.dim}"
+            )
+        product *= f
+    if product != cfg.cohort_size:
+        raise ValueError(
+            f"population merge_topology fan-ins "
+            f"{tuple(f for _, f in tiers)} multiply to {product}, but "
+            f"cohort_size={cfg.cohort_size} — the tree must cover the "
+            "cohort exactly"
+        )
+    return MergeTopology(tiers)
+
+
+def make_population_merge(cfg, *, screen_tau: float = 0.5):
+    """Build the jitted hardened cohort merge for ``cfg``:
+    ``merge(stack (C, d, k), mask (C,)) -> (v, keep, stats)`` with
+    ``C = cfg.cohort_size`` static. α resolves to
+    ``cfg.max_poison_frac`` — the declared Byzantine tolerance IS the
+    trim fraction. A configured ``merge_topology`` routes the
+    survivors' reduce through the PR 12 tiered tree."""
+    topo = population_topology(cfg)
+    k, alpha = cfg.k, float(cfg.max_poison_frac)
+
+    def merge(stack, mask):
+        return hardened_merge_body(
+            stack, mask, k=k, alpha=alpha, screen_tau=screen_tau,
+            topology=topo,
+        )
+
+    return jax.jit(merge)
+
+
+def make_sharded_cohort_reduce(cfg, mesh, *, screen_tau: float = 0.5):
+    """The AUDITED population-merge program (``population_merge``
+    contract): the cohort stack arrives sharded over the ``workers``
+    mesh axis, ONE all-gather assembles the ``(cohort, d, k)`` stack —
+    the program's only cross-device movement, ``cohort·d·k`` elements,
+    a function of the COHORT and never the population — and the
+    hardened merge body runs replicated on the gathered stack.
+
+    Returns the jitted program; args are the sharded stack and mask.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_eigenspaces_tpu.parallel.mesh import (
+        WORKER_AXIS,
+        shard_map,
+    )
+
+    topo = population_topology(cfg)
+    k, alpha = cfg.k, float(cfg.max_poison_frac)
+
+    def reduce_shard(stack_shard, mask_shard):
+        stack = jax.lax.all_gather(
+            stack_shard, WORKER_AXIS, axis=0, tiled=True
+        )
+        mask = jax.lax.all_gather(
+            mask_shard, WORKER_AXIS, axis=0, tiled=True
+        )
+        v, _, _ = hardened_merge_body(
+            stack, mask, k=k, alpha=alpha, screen_tau=screen_tau,
+            topology=topo,
+        )
+        return v
+
+    in_specs = (P(WORKER_AXIS, None, None), P(WORKER_AXIS))
+    return jax.jit(
+        shard_map(
+            reduce_shard, mesh=mesh, in_specs=in_specs,
+            out_specs=P(), check_vma=False,
+        ),
+        in_shardings=tuple(NamedSharding(mesh, s) for s in in_specs),
+    )

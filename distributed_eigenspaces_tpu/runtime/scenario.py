@@ -110,6 +110,11 @@ EPISODE_KINDS: dict[str, tuple[tuple[str, ...], tuple[str, ...]]] = {
         ("rejoin_step", "steps", "publish", "tier"),
     ),
     "publish": ((), ("replicas", "kill_publisher")),
+    "population": (
+        ("population", "cohort_size"),
+        ("dropout_frac", "poison_frac", "rounds",
+         "min_participation_frac", "max_poison_frac"),
+    ),
 }
 
 _COMMON = ("name", "kind", "start_s", "duration_s")
@@ -249,6 +254,34 @@ def _validate_episode(spec_name: str, i: int, raw: Any) -> Episode:
                 f"'replicas' (lease failover only exists on the "
                 f"replicated durable registry)",
             )
+    if kind == "population":
+        p = params["population"]
+        if not isinstance(p, int) or isinstance(p, bool) or p < 2:
+            _fail(spec_name, f"{label}: field 'population' must be an "
+                             f"int >= 2, got {p!r}")
+        c = params["cohort_size"]
+        if not isinstance(c, int) or isinstance(c, bool) or c < 1 \
+                or c > p:
+            _fail(
+                spec_name,
+                f"{label}: field 'cohort_size' must be an int in "
+                f"[1, population={p}], got {c!r}",
+            )
+        for field in ("dropout_frac", "poison_frac",
+                      "min_participation_frac", "max_poison_frac"):
+            v = params.get(field)
+            if v is not None and (
+                not isinstance(v, (int, float)) or isinstance(v, bool)
+                or not 0.0 <= v < 1.0
+            ):
+                _fail(spec_name, f"{label}: field '{field}' must be a "
+                                 f"number in [0, 1), got {v!r}")
+        r = params.get("rounds")
+        if r is not None and (
+            not isinstance(r, int) or isinstance(r, bool) or r < 1
+        ):
+            _fail(spec_name, f"{label}: field 'rounds' must be an "
+                             f"int >= 1, got {r!r}")
     if kind in _SERVE_LOAD and raw["duration_s"] <= 0:
         _fail(spec_name, f"{label}: field 'duration_s' must be > 0 "
                          f"for load kind '{kind}'")
@@ -497,13 +530,15 @@ def build_schedule(spec: ScenarioSpec) -> ScenarioSchedule:
                 )
         elif ep.kind == "churn":
             actions.append(Action(ep.start_s, ep.name, "churn_start"))
+        elif ep.kind == "population":
+            actions.append(Action(ep.start_s, ep.name, "population_start"))
         elif ep.kind == "publish":
             actions.append(Action(ep.start_s, ep.name, "publish"))
     # stable order: time, then a fixed kind priority so start markers
     # precede same-instant work and end markers follow it
     prio = {
-        "episode_start": 0, "churn_start": 1, "publish": 2, "query": 3,
-        "fleet_fit": 3, "episode_end": 4,
+        "episode_start": 0, "churn_start": 1, "population_start": 1,
+        "publish": 2, "query": 3, "fleet_fit": 3, "episode_end": 4,
     }
     actions.sort(key=lambda a: (a.t_s, prio[a.kind], a.episode, a.index))
     return ScenarioSchedule(spec=spec, actions=tuple(actions))
@@ -725,6 +760,62 @@ class ScenarioRunner:
 
         return threading.Thread(target=work, daemon=True), holder
 
+    def _population_thread(self, ep: Episode, metrics):
+        """One population episode's background cohort-sampled ingest:
+        ClientChaosPlan + population_fit — the ISSUE 16 surfaces,
+        reused verbatim. The verdict judges it purely from
+        ``summary()["population"]`` (rounds closed, rejects attributed)
+        plus the holder's recovery angle. Returns (thread, holder)."""
+        from distributed_eigenspaces_tpu.ops.linalg import (
+            principal_angles_degrees,
+        )
+        from distributed_eigenspaces_tpu.runtime.population import (
+            population_fit,
+        )
+        from distributed_eigenspaces_tpu.utils.faults import (
+            ClientChaosPlan,
+        )
+
+        cfg0 = _scenario_cfg(self.spec)
+        cfg = cfg0.replace(
+            population=int(ep.params["population"]),
+            cohort_size=int(ep.params["cohort_size"]),
+            min_participation_frac=float(
+                ep.params.get("min_participation_frac", 0.5)
+            ),
+            max_poison_frac=float(
+                ep.params.get("max_poison_frac", 0.08)
+            ),
+        )
+        plan = ClientChaosPlan(
+            dropout_frac=float(ep.params.get("dropout_frac", 0.0)),
+            poison_frac=float(ep.params.get("poison_frac", 0.0)),
+            poison_scale=3.0,
+        )
+        rounds = int(ep.params.get("rounds", 4))
+        holder: dict = {}
+
+        def work():
+            try:
+                w, info, _sup = population_fit(
+                    cfg, plan=plan, rounds=rounds, metrics=metrics,
+                    seed=self.spec.seed,
+                )
+                q, _ = np.linalg.qr(np.asarray(w))
+                holder["angle_deg"] = float(
+                    np.max(
+                        principal_angles_degrees(
+                            q[:, : cfg.k], info["planted"]
+                        )
+                    )
+                )
+                holder["rounds"] = info["rounds"]
+                holder["rejects"] = info["rejects"]
+            except Exception as e:  # surfaced in the verdict's gates
+                holder["error"] = f"{type(e).__name__}: {e}"
+
+        return threading.Thread(target=work, daemon=True), holder
+
     # -- replay --------------------------------------------------------------
 
     def run(self) -> tuple[dict, bool]:
@@ -865,6 +956,13 @@ class ScenarioRunner:
                 th, holder = self._churn_thread(ep, spectrum, metrics)
                 churn_threads[ep.name] = th
                 churn_holders[ep.name] = holder
+        population_threads: dict[str, threading.Thread] = {}
+        population_holders: dict[str, dict] = {}
+        for ep in spec.episodes:
+            if ep.kind == "population":
+                th, holder = self._population_thread(ep, metrics)
+                population_threads[ep.name] = th
+                population_holders[ep.name] = holder
 
         pending: list = []
         fleet_pending: list = []
@@ -970,6 +1068,8 @@ class ScenarioRunner:
                         )
                 elif action.kind == "churn_start":
                     churn_threads[ep.name].start()
+                elif action.kind == "population_start":
+                    population_threads[ep.name].start()
 
             # drain: resolve every accepted ticket (the no-hang gate).
             # A DeadlineExceeded here is the server's queue-deadline
@@ -1006,6 +1106,14 @@ class ScenarioRunner:
                         lineage={"producer": f"scenario:{name}"},
                     )
                     self.publishes += 1
+            for name, th in population_threads.items():
+                if not th.is_alive() and not th.ident:
+                    continue  # never started (spec ended early)
+                th.join(timeout=120.0)
+                if th.is_alive():
+                    population_holders[name]["error"] = (
+                        "population fit did not finish in 120s"
+                    )
             if drift is not None:
                 drift.join_refresh(timeout=60.0)
         finally:
@@ -1026,7 +1134,7 @@ class ScenarioRunner:
                 shutil.rmtree(registry_dir, ignore_errors=True)
 
         summary = metrics.summary()
-        verdict = self._verdict(summary, churn_holders)
+        verdict = self._verdict(summary, churn_holders, population_holders)
         if self.trace_out:
             tracer.export_chrome_trace(self.trace_out)
             verdict["trace_out"] = self.trace_out
@@ -1039,7 +1147,10 @@ class ScenarioRunner:
 
     # -- verdict -------------------------------------------------------------
 
-    def _verdict(self, summary: dict, churn_holders: dict) -> dict:
+    def _verdict(
+        self, summary: dict, churn_holders: dict,
+        population_holders: dict | None = None,
+    ) -> dict:
         """The judged record: every numeric field below comes from
         ``summary()`` — the runner's submit/resolve counters appear
         under 'replay' and feed the GATES only."""
@@ -1049,6 +1160,8 @@ class ScenarioRunner:
         replication = summary.get("replication") or {}
         fleet = summary.get("fleet") or {}
         membership = summary.get("membership") or {}
+        population = summary.get("population") or {}
+        population_holders = population_holders or {}
         slo = summary.get("slo") or {}
 
         gates: dict[str, bool] = {
@@ -1072,6 +1185,24 @@ class ScenarioRunner:
                 gates[f"{ep.name}_fit_completed"] = (
                     "error" not in holder and membership.get("rounds", 0) > 0
                 )
+            elif ep.kind == "population":
+                # judged from summary()["population"]: the episode's
+                # cohort rounds all closed into telemetry, and every
+                # injected poisoner landed in rejects_by_reason (the
+                # attribution trail, not just the holder's say-so)
+                holder = population_holders.get(ep.name, {})
+                gates[f"{ep.name}_rounds_closed"] = (
+                    "error" not in holder
+                    and population.get("rounds", 0)
+                    >= int(ep.params.get("rounds", 4))
+                )
+                if ep.params.get("poison_frac"):
+                    gates[f"{ep.name}_rejects_attributed"] = (
+                        sum(
+                            (population.get("rejects_by_reason") or {})
+                            .values()
+                        ) > 0
+                    )
             elif ep.kind == "publish":
                 gates[f"{ep.name}_version_live"] = (
                     len(serving.get("versions_served") or ()) >= 2
@@ -1129,6 +1260,13 @@ class ScenarioRunner:
                 name: {k: v for k, v in holder.items() if k != "w"}
                 for name, holder in churn_holders.items()
             },
+            "population": {
+                k: population.get(k)
+                for k in ("rounds", "stale_folds", "participation_hist",
+                          "rejects_by_reason", "by_kind")
+                if k in population
+            },
+            "population_fits": dict(population_holders),
             "replay": {
                 "submitted": self.submitted,
                 "shed_at_submit": self.shed_at_submit,

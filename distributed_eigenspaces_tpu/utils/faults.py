@@ -156,6 +156,76 @@ class ChurnPlan:
         return float(self.slow.get(slot, 0.0))
 
 
+@dataclasses.dataclass(frozen=True)
+class ClientChaosPlan:
+    """Deterministic population-chaos schedule for the SAMPLED-COHORT
+    ingest tier (ISSUE 16), consumed by ``runtime/population.py``
+    (1-based absolute rounds, resume-safe like :class:`ChaosPlan`).
+
+    Client ROLES are assigned by population id range (deterministic,
+    seed-independent): ids ``[0, P·nan_frac)`` are NaN submitters, the
+    next ``P·poison_frac`` are colluding poisoners, the next
+    ``P·straggler_frac`` are persistent stragglers; everyone else is
+    honest. Uniform cohort sampling makes contiguous ranges equivalent
+    to any other deterministic assignment.
+
+    ``dropout_frac``: baseline i.i.d. per-sampled-client dropout
+    probability per round — a dropped client contributes NOTHING (the
+    participation-fraction deadline absorbs it; no detection lag, no
+    placeholder).
+    ``dropout_waves``: ``{round: frac}`` — rounds where the dropout
+    probability SPIKES (a correlated outage wave). A wave deep enough
+    to push arrivals below ``cfg.min_participation_frac`` triggers the
+    participation-collapse arc (bounded wait → resume) under test.
+    ``straggler_frac``: fraction of the population that is persistently
+    SLOW: their contributions always miss the round deadline and fold
+    one-step-stale into the NEXT round (the PR 2/PR 12 rule) — a
+    steady one-round lag, never a stall.
+    ``nan_frac``: fraction of the population whose submissions are NaN
+    — the loud-corruption class the gauntlet's non-finite screen must
+    quarantine with client id + reason.
+    ``poison_frac``: fraction of the population that is Byzantine and
+    COLLUDING: every poisoner submits the SAME sign-flipped adversarial
+    basis (orthogonal to the planted one), scaled by ``poison_scale``.
+    ``poison_scale``: norm multiplier on poison submissions. ``> 1``
+    breaks near-orthonormality, so the gauntlet rejects it at the door
+    (the attribution path); ``== 1`` stays exactly orthonormal and
+    slips the gauntlet, so the norm-clipped trimmed mean + affinity
+    screen must stop the steering (the robust-statistics path). The
+    bench runs both.
+    """
+
+    dropout_frac: float = 0.0
+    dropout_waves: dict[int, float] = dataclasses.field(
+        default_factory=dict
+    )
+    straggler_frac: float = 0.0
+    nan_frac: float = 0.0
+    poison_frac: float = 0.0
+    poison_scale: float = 1.0
+
+    def __post_init__(self):
+        for name in ("dropout_frac", "straggler_frac", "nan_frac",
+                     "poison_frac"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(
+                    f"{name} must be a fraction in [0, 1], got {v!r}"
+                )
+        for rnd, frac in self.dropout_waves.items():
+            if not 0.0 <= frac <= 1.0:
+                raise ValueError(
+                    f"dropout_waves[{rnd}] must be a fraction in "
+                    f"[0, 1], got {frac!r}"
+                )
+
+    def dropout_at(self, rnd: int) -> float:
+        """Effective dropout probability for round ``rnd``: a scheduled
+        wave overrides the baseline (one-off wins over persistent — the
+        :class:`ChurnPlan.delay` rule)."""
+        return float(self.dropout_waves.get(rnd, self.dropout_frac))
+
+
 @dataclasses.dataclass
 class ServeChaosPlan:
     """Deterministic fault schedule for the SERVE tier (ISSUE 7 — the

@@ -136,6 +136,13 @@ class MetricsLogger:
         self.replication_records = RingLog(
             retention, self._evict_replication
         )
+        #: population-ingest events (runtime/population.py
+        #: PopulationIngest): cohort round closes, client quarantines
+        #: by reason, participation collapses/restores, trimmed-merge
+        #: stats — surfaced by :meth:`summary` under "population"
+        self.population_records = RingLog(
+            retention, self._evict_population
+        )
         #: compile-lifecycle counters (utils/compile_cache.py
         #: CompileCache), attached via :meth:`attach_compile` —
         #: surfaced by :meth:`summary` under "compile"
@@ -192,6 +199,16 @@ class MetricsLogger:
             "count": 0, "by_kind": {}, "installs": 0, "stale": 0,
             "fenced": 0, "failovers": 0, "recovery_ms": [],
             "lag_hist": Histogram(),
+        }
+        # population-ingest eviction aggregates (ISSUE 16): event
+        # counts by kind, cohort-round outcomes (participation decile
+        # histogram, one-step-stale folds), quarantines by rejection
+        # reason, and the running trim-fraction mean — so
+        # summary()["population"] covers the whole run after eviction
+        self._population_agg: dict = {
+            "count": 0, "by_kind": {}, "rounds": 0, "stale_folds": 0,
+            "participation_hist": {}, "rejects_by_reason": {},
+            "trim_frac_sum": 0.0, "trim_frac_n": 0,
         }
 
     @staticmethod
@@ -377,6 +394,18 @@ class MetricsLogger:
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
 
+    def population(self, event: dict) -> None:
+        """Record one structured population-ingest event (a cohort
+        round close, a client quarantine with id + reason, a
+        participation collapse/restore, or a hardened-merge stat —
+        ``runtime/population.py``). Rides the same JSON stream as step
+        records, tagged ``"population"``."""
+        rec = {"population": event.get("kind", "unknown"), **event}
+        _stamp(rec)
+        self.population_records.append(rec)
+        if self.stream is not None:
+            print(json.dumps(rec), file=self.stream, flush=True)
+
     def fault(self, event: dict) -> None:
         """Record one structured fault event (a supervisor detection /
         recovery action). Events ride the same JSON stream as step
@@ -452,6 +481,39 @@ class MetricsLogger:
         if arrived is not None:
             key = str(int(arrived))
             t["arrival_hist"][key] = t["arrival_hist"].get(key, 0) + 1
+
+    def _evict_population(self, rec: dict) -> None:
+        agg = self._population_agg
+        agg["count"] += 1
+        kind = rec.get("population", "unknown")
+        agg["by_kind"][kind] = agg["by_kind"].get(kind, 0) + 1
+        self._fold_population(agg, rec)
+
+    @staticmethod
+    def _fold_population(agg: dict, rec: dict) -> None:
+        """One population-ingest record into the aggregate: cohort
+        rounds bucket participation into a decile histogram (the
+        membership arrival-hist rule, normalized because cohorts are
+        sampled, not slotted), quarantines tally by rejection reason,
+        merge stats feed the running trim-fraction mean."""
+        kind = rec.get("population", "unknown")
+        if kind == "round_closed":
+            agg["rounds"] += 1
+            agg["stale_folds"] += int(rec.get("stale") or 0)
+            p = rec.get("participation")
+            if p is not None:
+                key = f"{int(float(p) * 10) / 10:.1f}"
+                hist = agg["participation_hist"]
+                hist[key] = hist.get(key, 0) + 1
+        elif kind == "quarantine_client":
+            reason = rec.get("reason", "unknown")
+            rej = agg["rejects_by_reason"]
+            rej[reason] = rej.get(reason, 0) + 1
+        elif kind == "merge":
+            tf = rec.get("trim_frac")
+            if tf is not None:
+                agg["trim_frac_sum"] += float(tf)
+                agg["trim_frac_n"] += 1
 
     def _evict_replication(self, rec: dict) -> None:
         agg = self._replication_agg
@@ -643,6 +705,8 @@ class MetricsLogger:
             out["merge"] = self._merge_summary()
         if self.replication_records or self._replication_agg["count"]:
             out["replication"] = self._replication_summary()
+        if self.population_records or self._population_agg["count"]:
+            out["population"] = self._population_summary()
         if self.serve_records or self._serve_agg["events"]:
             out["serving"] = self._serving_summary()
         if self.fleet_records or self._fleet_agg["events"]:
@@ -850,6 +914,45 @@ class MetricsLogger:
         }
         if self.merge_records.evicted:
             out["events_evicted"] = self.merge_records.evicted
+        return out
+
+    def _population_summary(self) -> dict:
+        """The ``summary()["population"]`` section (ISSUE 16): event
+        counts by kind, cohort-round outcomes (rounds, one-step-stale
+        folds, per-round participation decile histogram), quarantines
+        by rejection reason (the attribution ledger's roll-up), the
+        mean trimmed-merge trim fraction, and the retained event
+        window. Evictions are folded in (the membership-section rule),
+        so the counts cover the whole run."""
+        agg = self._population_agg
+        folded = {
+            "by_kind": dict(agg["by_kind"]),
+            "rounds": agg["rounds"],
+            "stale_folds": agg["stale_folds"],
+            "participation_hist": dict(agg["participation_hist"]),
+            "rejects_by_reason": dict(agg["rejects_by_reason"]),
+            "trim_frac_sum": agg["trim_frac_sum"],
+            "trim_frac_n": agg["trim_frac_n"],
+        }
+        for r in self.population_records:
+            kind = r.get("population", "unknown")
+            folded["by_kind"][kind] = folded["by_kind"].get(kind, 0) + 1
+            self._fold_population(folded, r)
+        out: dict = {
+            "events": agg["count"] + len(self.population_records),
+            "by_kind": folded["by_kind"],
+            "rounds": folded["rounds"],
+            "stale_folds": folded["stale_folds"],
+            "participation_hist": folded["participation_hist"],
+            "rejects_by_reason": folded["rejects_by_reason"],
+            "recent": list(self.population_records),
+        }
+        if folded["trim_frac_n"]:
+            out["mean_trim_frac"] = round(
+                folded["trim_frac_sum"] / folded["trim_frac_n"], 4
+            )
+        if self.population_records.evicted:
+            out["events_evicted"] = self.population_records.evicted
         return out
 
     def _replication_summary(self) -> dict:

@@ -108,7 +108,9 @@ sys.path.insert(
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--mode", choices=["fit", "serve", "churn", "replica"],
+    p.add_argument("--mode",
+                   choices=["fit", "serve", "churn", "replica",
+                            "population"],
                    default="fit",
                    help="fit: the write-path recovery contract "
                    "(supervisor kill/quarantine/resume); serve: the "
@@ -834,6 +836,221 @@ def churn_chaos(args) -> int:
     return 0 if report["ok"] else 1
 
 
+def population_chaos(args) -> int:
+    """``--mode population``: the population-ingest chaos suite
+    (ISSUE 16). In-process, deterministic; the gated CI variant with
+    the 100k-client A/B lives in ``bench.py --population``.
+
+    1. **Cohort rounds under a deterministic clock**: the round
+       protocol (sample -> deadline arrivals -> gauntlet -> stack),
+       the participation-fraction deadline raising a loud
+       ``ParticipationLost`` whose table view speaks the QuorumLost
+       vocabulary, and the bounded wait CONSUMING outage-wave rounds
+       (plus the timeout path) — all on an injected clock, zero real
+       sleeps.
+
+    2. **Trimmed-merge steering bound**: with the colluding fraction
+       at most the trim fraction alpha, every coordinate of the
+       trimmed mean stays inside the HONEST min/max envelope (the
+       provable bound docs/ROBUSTNESS.md states) while the plain mean
+       provably leaves it, and the hardened merge lands within a
+       degree of the honest-only merge while the naive mean is
+       steered an order of magnitude further.
+
+    3. **Quarantine attribution**: every gauntlet reject lands in the
+       fault ledger as a ``quarantine_client`` event naming client id
+       + reason from the closed vocabulary, NaN submitters are
+       attributed to exactly the NaN id range, and ledger counts
+       equal the run's reject totals.
+    """
+    import jax.numpy as jnp
+
+    from distributed_eigenspaces_tpu.config import PCAConfig
+    from distributed_eigenspaces_tpu.ops.linalg import (
+        principal_angles_degrees,
+    )
+    from distributed_eigenspaces_tpu.parallel.clients import (
+        REJECT_REASONS,
+        hardened_merge_body,
+        trimmed_mean_factors,
+    )
+    from distributed_eigenspaces_tpu.runtime.population import (
+        ParticipationLost,
+        PopulationIngest,
+        population_fit,
+    )
+    from distributed_eigenspaces_tpu.utils.faults import ClientChaosPlan
+
+    checks: dict[str, bool] = {}
+
+    # -- 1. cohort rounds + participation deadline, deterministic clock --
+    cfg = PCAConfig(
+        dim=32, k=3, num_workers=4, rows_per_worker=8, num_steps=4,
+        backend="local", heartbeat_timeout_ms=100.0,
+        population=4000, cohort_size=64,
+        min_participation_frac=0.5, max_poison_frac=0.1,
+    )
+    t = [0.0]
+    sleeps: list[float] = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        t[0] += s
+
+    plan = ClientChaosPlan(
+        dropout_frac=0.2, dropout_waves={2: 0.95, 3: 0.95},
+        nan_frac=0.02, poison_frac=0.05, poison_scale=3.0,
+        straggler_frac=0.05,
+    )
+    ing = PopulationIngest(
+        cfg, plan=plan, clock=lambda: t[0], sleep=fake_sleep,
+    )
+    t1, stack1, mask1, rej1 = ing.run_round()
+    checks["round_closes_on_participation"] = (
+        t1 == 1
+        and float(mask1.sum()) / cfg.cohort_size
+        >= cfg.min_participation_frac
+        and stack1.shape == (cfg.cohort_size, cfg.dim, cfg.k)
+    )
+    checks["gauntlet_rejects_by_reason"] = (
+        rej1.get("nonfinite", 0) >= 1
+        and rej1.get("not_orthonormal", 0) >= 1
+        and set(rej1) <= set(REJECT_REASONS)
+    )
+    try:
+        ing.run_round()
+        lost = None
+    except ParticipationLost as pl:
+        lost = pl
+    checks["participation_lost_loud"] = (
+        lost is not None
+        and lost.step == 2
+        and lost.frac < cfg.min_participation_frac
+        and lost.table.num_workers == cfg.cohort_size
+        and lost.table.min_quorum_frac == cfg.min_participation_frac
+    )
+    # the wave covers round 3 too: the bounded wait must consume it
+    restored = lost.table.wait_for_quorum(5.0, poll_s=0.05)
+    checks["wait_consumes_wave_rounds"] = (
+        restored is True and ing.round == 3 and len(sleeps) == 1
+    )
+    t3, _, _, _ = ing.run_round()
+    checks["resume_at_next_round_boundary"] = t3 == 4
+    # timeout path: a wave the wait cannot outlast, deterministic clock
+    ing2 = PopulationIngest(
+        cfg,
+        plan=ClientChaosPlan(
+            dropout_frac=0.2,
+            dropout_waves={r: 0.95 for r in range(2, 200)},
+        ),
+        clock=lambda: t[0], sleep=fake_sleep,
+    )
+    ing2.run_round()
+    try:
+        ing2.run_round()
+    except ParticipationLost as pl2:
+        checks["wait_timeout_bounded"] = (
+            pl2.table.wait_for_quorum(0.5, poll_s=0.05) is False
+        )
+
+    # -- 2. the trimmed-merge steering bound -----------------------------
+    d, k, honest_n, poison_n = 32, 3, 36, 4  # 10% colluders == alpha
+    rng = np.random.default_rng(7)
+    q, _ = np.linalg.qr(rng.standard_normal((d, 2 * k)))
+    planted, adv = q[:, :k], q[:, k: 2 * k]
+    honest = []
+    for i in range(honest_n):
+        w, r = np.linalg.qr(
+            planted + 0.02 * rng.standard_normal((d, k))
+        )
+        honest.append(w * np.sign(np.diag(r))[None, :])
+    stack = np.asarray(
+        honest + [-adv] * poison_n, np.float32
+    )
+    mask = np.ones(len(stack), np.float32)
+    alpha = poison_n / len(stack)
+    trimmed = np.asarray(
+        trimmed_mean_factors(
+            jnp.asarray(stack), jnp.asarray(mask), alpha
+        )
+    )
+    hon = stack[:honest_n]
+    env_lo, env_hi = hon.min(axis=0), hon.max(axis=0)
+    eps = 1e-6
+    checks["trimmed_mean_inside_honest_envelope"] = bool(
+        ((trimmed >= env_lo - eps) & (trimmed <= env_hi + eps)).all()
+    )
+    plain = stack.mean(axis=0)
+    checks["plain_mean_leaves_envelope"] = bool(
+        ((plain < env_lo - eps) | (plain > env_hi + eps)).any()
+    )
+    planted_j = jnp.asarray(planted, jnp.float32)
+    v_base, _, _ = hardened_merge_body(
+        jnp.asarray(np.asarray(honest, np.float32)),
+        jnp.ones(honest_n, jnp.float32), k=k, alpha=alpha,
+    )
+    ang_base = float(principal_angles_degrees(v_base, planted_j).max())
+    v_hard, keep, _ = hardened_merge_body(
+        jnp.asarray(stack), jnp.asarray(mask), k=k, alpha=alpha,
+    )
+    qn, _ = np.linalg.qr(plain)
+    ang_hard = float(principal_angles_degrees(v_hard, planted_j).max())
+    ang_naive = float(
+        principal_angles_degrees(
+            jnp.asarray(qn[:, :k], jnp.float32), planted_j
+        ).max()
+    )
+    # the colluders must cost the hardened merge (almost) nothing
+    # relative to an honest-only merge, while steering the naive mean
+    # several times further off the planted subspace
+    checks["steering_bound_holds"] = (
+        ang_hard <= ang_base + 0.5 and ang_naive >= 3.0 * ang_hard
+    )
+    checks["screen_names_colluders"] = bool(
+        (np.asarray(keep)[honest_n:] == 0).all()
+    )
+
+    # -- 3. quarantine attribution ---------------------------------------
+    plan3 = ClientChaosPlan(
+        dropout_frac=0.2, nan_frac=0.03, poison_frac=0.05,
+        poison_scale=3.0,
+    )
+    _, info, sup = population_fit(cfg, plan=plan3, rounds=3)
+    quarantines = [
+        e for e in sup.ledger.events if e["kind"] == "quarantine_client"
+    ]
+    nan_hi = int(round(cfg.population * plan3.nan_frac))
+    poison_hi = nan_hi + int(round(cfg.population * plan3.poison_frac))
+    valid_reasons = set(REJECT_REASONS) | {"screened"}
+    checks["every_reject_attributed"] = (
+        len(quarantines) == sum(info["rejects"].values())
+        and len(quarantines) > 0
+        and all(
+            "client" in e and e.get("reason") in valid_reasons
+            for e in quarantines
+        )
+    )
+    checks["nan_ids_attributed_nonfinite"] = all(
+        0 <= e["client"] < nan_hi
+        for e in quarantines if e["reason"] == "nonfinite"
+    ) and any(e["reason"] == "nonfinite" for e in quarantines)
+    checks["poison_ids_attributed_not_orthonormal"] = all(
+        nan_hi <= e["client"] < poison_hi
+        for e in quarantines if e["reason"] == "not_orthonormal"
+    ) and any(e["reason"] == "not_orthonormal" for e in quarantines)
+
+    report = {
+        "mode": "population",
+        "hardened_angle_deg": round(ang_hard, 4),
+        "naive_angle_deg": round(ang_naive, 4),
+        "rejects_by_reason": info["rejects"],
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if os.environ.get("JAX_PLATFORMS"):
@@ -846,6 +1063,8 @@ def main(argv=None) -> int:
         return churn_chaos(args)
     if args.mode == "replica":
         return replica_chaos(args)
+    if args.mode == "population":
+        return population_chaos(args)
     import jax
 
     from distributed_eigenspaces_tpu.config import PCAConfig

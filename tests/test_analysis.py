@@ -45,6 +45,7 @@ def test_matrix_covers_every_contract_kind(devices):
         for n in (
             "scan_solo", "feature_scan", "fleet_b8", "serve_project",
             "tree_fit", "dist_merge", "dist_serve_project",
+            "population_reduce",
         )
     }
     assert kinds == set(contracts.CONTRACTS)

@@ -1,0 +1,547 @@
+"""Population-scale ingest (ISSUE 16): the validation gauntlet, the
+Byzantine-hardened merge and its trimmed-mean steering bound, sampled
+cohort rounds with the participation-fraction deadline, dropout/late/
+poison chaos attribution into the fault ledger, population telemetry —
+plus the satellite regression: a MembershipTable rejoin during the
+quorum-lost bounded wait is admitted at the next round boundary with a
+bumped generation."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.parallel.clients import (
+    REJECT_REASONS,
+    _align_signs,
+    clip_factor_norms,
+    hardened_merge_body,
+    make_population_merge,
+    naive_mean_basis,
+    population_topology,
+    trimmed_mean_factors,
+    validate_contribution,
+)
+from distributed_eigenspaces_tpu.runtime.membership import (
+    MembershipTable,
+    QuorumLost,
+)
+from distributed_eigenspaces_tpu.runtime.population import (
+    ParticipationLost,
+    PopulationIngest,
+    population_fit,
+)
+from distributed_eigenspaces_tpu.runtime.supervisor import SupervisorError
+from distributed_eigenspaces_tpu.utils.faults import ClientChaosPlan
+from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+D, K = 24, 3
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=D, k=K, num_workers=4, rows_per_worker=8, num_steps=4,
+        backend="local", heartbeat_timeout_ms=100.0,
+        population=2000, cohort_size=48,
+        min_participation_frac=0.5, max_poison_frac=0.1,
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+def _orthonormal(rng, d=D, k=K):
+    q, _ = np.linalg.qr(rng.standard_normal((d, k)))
+    return np.asarray(q, np.float32)
+
+
+def _honest_stack(rng, planted, n, noise=0.02):
+    out = []
+    for _ in range(n):
+        w, r = np.linalg.qr(
+            planted + noise * rng.standard_normal(planted.shape)
+        )
+        out.append(w * np.sign(np.diag(r))[None, :])
+    return np.asarray(out, np.float32)
+
+
+# -- the validation gauntlet --------------------------------------------------
+
+
+class TestGauntlet:
+    def test_valid_passes(self):
+        rng = np.random.default_rng(0)
+        assert validate_contribution(_orthonormal(rng), D, K) is None
+
+    def test_bad_shape(self):
+        rng = np.random.default_rng(0)
+        w = _orthonormal(rng, D, K + 1)
+        assert validate_contribution(w, D, K) == "bad_shape"
+
+    def test_bad_dtype(self):
+        w = np.zeros((D, K), dtype=np.int32)
+        assert validate_contribution(w, D, K) == "bad_dtype"
+
+    def test_nonfinite(self):
+        rng = np.random.default_rng(0)
+        w = _orthonormal(rng)
+        w[3, 1] = np.nan
+        assert validate_contribution(w, D, K) == "nonfinite"
+
+    def test_scaled_poison_not_orthonormal(self):
+        rng = np.random.default_rng(0)
+        assert (
+            validate_contribution(3.0 * _orthonormal(rng), D, K)
+            == "not_orthonormal"
+        )
+
+    def test_reason_vocabulary_closed(self):
+        assert set(REJECT_REASONS) == {
+            "bad_shape", "bad_dtype", "nonfinite", "not_orthonormal",
+        }
+
+
+# -- clip / sign-align / trimmed mean ----------------------------------------
+
+
+class TestRobustPrimitives:
+    def test_clip_bounds_frobenius_norms(self):
+        rng = np.random.default_rng(1)
+        stack = jnp.asarray(
+            np.stack([
+                _orthonormal(rng),
+                np.asarray(10.0 * _orthonormal(rng), np.float32),
+            ])
+        )
+        clipped = np.asarray(clip_factor_norms(stack, clip_mult=1.0))
+        bound = np.sqrt(K) * (1.0 + 1e-4)
+        norms = np.linalg.norm(clipped, axis=(1, 2))
+        assert (norms <= bound).all()
+        # an in-bound factor is untouched
+        np.testing.assert_allclose(
+            clipped[0], np.asarray(stack[0]), atol=1e-6
+        )
+
+    def test_align_signs_undoes_column_flips(self):
+        rng = np.random.default_rng(2)
+        base = _orthonormal(rng)
+        flipped = base * np.asarray([-1.0, 1.0, -1.0], np.float32)
+        stack = jnp.asarray(np.stack([base, base, flipped]))
+        mask = jnp.ones(3, jnp.float32)
+        aligned = np.asarray(_align_signs(stack, mask))
+        # after alignment every member agrees column-wise up to noise
+        spread = np.abs(aligned - aligned.mean(axis=0)).max()
+        assert spread < 1e-5
+
+    def test_trimmed_mean_inside_honest_envelope(self):
+        """The steering bound: <= alpha-fraction colluders land in the
+        trimmed tails, so every trimmed coordinate is a convex
+        combination of HONEST values. The plain mean has no such
+        bound."""
+        rng = np.random.default_rng(3)
+        q, _ = np.linalg.qr(rng.standard_normal((D, 2 * K)))
+        planted, adv = q[:, :K], q[:, K: 2 * K]
+        honest = _honest_stack(rng, planted, 36)
+        stack = np.concatenate(
+            [honest, np.repeat(-adv[None].astype(np.float32), 4, 0)]
+        )
+        mask = np.ones(len(stack), np.float32)
+        alpha = 4 / len(stack)
+        trimmed = np.asarray(
+            trimmed_mean_factors(
+                jnp.asarray(stack), jnp.asarray(mask), alpha
+            )
+        )
+        lo, hi = honest.min(axis=0), honest.max(axis=0)
+        assert ((trimmed >= lo - 1e-6) & (trimmed <= hi + 1e-6)).all()
+        plain = stack.mean(axis=0)
+        assert ((plain < lo - 1e-6) | (plain > hi + 1e-6)).any()
+
+    def test_trimmed_mean_ignores_masked_slots(self):
+        rng = np.random.default_rng(4)
+        base = _orthonormal(rng)
+        junk = np.full((D, K), 50.0, np.float32)
+        stack = jnp.asarray(np.stack([base, base, junk]))
+        mask = jnp.asarray([1.0, 1.0, 0.0], jnp.float32)
+        out = np.asarray(trimmed_mean_factors(stack, mask, 0.0))
+        np.testing.assert_allclose(out, base, atol=1e-6)
+
+
+# -- the hardened merge -------------------------------------------------------
+
+
+class TestHardenedMerge:
+    def test_screens_orthonormal_colluders(self):
+        rng = np.random.default_rng(5)
+        q, _ = np.linalg.qr(rng.standard_normal((D, 2 * K)))
+        planted, adv = q[:, :K], q[:, K: 2 * K]
+        honest = _honest_stack(rng, planted, 36)
+        stack = np.concatenate(
+            [honest, np.repeat(-adv[None].astype(np.float32), 4, 0)]
+        )
+        mask = np.ones(len(stack), np.float32)
+        v, keep, stats = hardened_merge_body(
+            jnp.asarray(stack), jnp.asarray(mask), k=K, alpha=0.1,
+        )
+        assert (np.asarray(keep)[36:] == 0).all()
+        # hardened lands near the planted basis; the naive mean is
+        # steered several times further
+        from distributed_eigenspaces_tpu.ops.linalg import (
+            principal_angles_degrees,
+        )
+
+        p = jnp.asarray(planted, jnp.float32)
+        ang_h = float(principal_angles_degrees(v, p).max())
+        naive = naive_mean_basis(
+            jnp.asarray(stack), jnp.asarray(mask), K
+        )
+        ang_n = float(principal_angles_degrees(naive, p).max())
+        assert ang_h < 2.0 and ang_n > 2.0 * ang_h
+
+    def test_jitted_merge_matches_body(self):
+        cfg = _cfg()
+        rng = np.random.default_rng(6)
+        planted = _orthonormal(rng)
+        stack = _honest_stack(rng, planted, cfg.cohort_size)
+        mask = np.ones(cfg.cohort_size, np.float32)
+        merge = make_population_merge(cfg)
+        v1, keep1, _ = merge(jnp.asarray(stack), jnp.asarray(mask))
+        v2, keep2, _ = hardened_merge_body(
+            jnp.asarray(stack), jnp.asarray(mask), k=cfg.k,
+            alpha=cfg.max_poison_frac,
+        )
+        # jit fuses the reduction differently: f32-close, not bitwise
+        np.testing.assert_allclose(
+            np.asarray(v1), np.asarray(v2), atol=1e-3
+        )
+        np.testing.assert_array_equal(
+            np.asarray(keep1), np.asarray(keep2)
+        )
+
+
+# -- sampled cohort rounds ----------------------------------------------------
+
+
+def _clocked_ingest(cfg, plan, **kw):
+    t = [0.0]
+    sleeps: list[float] = []
+
+    def sleep(s):
+        sleeps.append(s)
+        t[0] += s
+
+    ing = PopulationIngest(
+        cfg, plan=plan, clock=lambda: t[0], sleep=sleep, **kw
+    )
+    return ing, t, sleeps
+
+
+class TestCohortRounds:
+    def test_round_closes_and_attributes_rejects(self):
+        cfg = _cfg()
+        plan = ClientChaosPlan(
+            dropout_frac=0.1, nan_frac=0.02, poison_frac=0.05,
+            poison_scale=3.0,
+        )
+        ing, _, _ = _clocked_ingest(cfg, plan)
+        t, stack, mask, rejected = ing.run_round()
+        assert t == 1 and stack.shape == (cfg.cohort_size, D, K)
+        assert rejected.get("nonfinite", 0) >= 1
+        assert rejected.get("not_orthonormal", 0) >= 1
+        quarantined = [
+            e for e in ing.events if e["kind"] == "quarantine_client"
+        ]
+        assert len(quarantined) == sum(rejected.values())
+        assert all(
+            e["reason"] in REJECT_REASONS and e["client"] >= 0
+            for e in quarantined
+        )
+
+    def test_deterministic_under_seed(self):
+        cfg = _cfg()
+        plan = ClientChaosPlan(dropout_frac=0.2)
+        a, _, _ = _clocked_ingest(cfg, plan, seed=11)
+        b, _, _ = _clocked_ingest(cfg, plan, seed=11)
+        _, sa, ma, _ = a.run_round()
+        _, sb, mb, _ = b.run_round()
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
+        np.testing.assert_array_equal(np.asarray(ma), np.asarray(mb))
+
+    def test_participation_lost_view_speaks_quorum(self):
+        cfg = _cfg()
+        plan = ClientChaosPlan(dropout_frac=0.1, dropout_waves={2: 0.95})
+        ing, _, _ = _clocked_ingest(cfg, plan)
+        ing.run_round()
+        with pytest.raises(ParticipationLost) as ei:
+            ing.run_round()
+        pl = ei.value
+        assert isinstance(pl, QuorumLost)  # the PR 8 ladder catches it
+        assert pl.step == 2
+        assert pl.frac < cfg.min_participation_frac
+        view = pl.table
+        assert view.num_workers == cfg.cohort_size
+        assert view.min_quorum_frac == cfg.min_participation_frac
+        assert view.live_count() < cfg.cohort_size
+        counts = view.state_counts()
+        assert set(counts) == {"arrived", "absent"}
+
+    def test_wait_consumes_wave_rounds_then_restores(self):
+        cfg = _cfg()
+        plan = ClientChaosPlan(
+            dropout_frac=0.1, dropout_waves={2: 0.95, 3: 0.95},
+        )
+        ing, _, sleeps = _clocked_ingest(cfg, plan)
+        ing.run_round()
+        with pytest.raises(ParticipationLost) as ei:
+            ing.run_round()
+        assert ei.value.table.wait_for_quorum(5.0, poll_s=0.05) is True
+        # round 3 was inside the wave: the wait consumed it
+        assert ing.round == 3 and len(sleeps) == 1
+        t, _, _, _ = ing.run_round()
+        assert t == 4
+
+    def test_wait_times_out_bounded(self):
+        cfg = _cfg()
+        plan = ClientChaosPlan(
+            dropout_frac=0.1,
+            dropout_waves={r: 0.95 for r in range(2, 100)},
+        )
+        ing, t, _ = _clocked_ingest(cfg, plan)
+        ing.run_round()
+        with pytest.raises(ParticipationLost) as ei:
+            ing.run_round()
+        t0 = t[0]
+        assert ei.value.table.wait_for_quorum(0.5, poll_s=0.05) is False
+        assert t[0] - t0 <= 0.5 + 0.05
+
+    def test_late_folds_one_step_stale(self):
+        cfg = _cfg()
+        plan = ClientChaosPlan(dropout_frac=0.3, straggler_frac=0.2)
+        ing, _, _ = _clocked_ingest(cfg, plan)
+        ing.run_round()
+        assert ing.late_pending > 0
+        ing.run_round()
+        closed = [
+            e for e in ing.events if e["kind"] == "round_closed"
+        ]
+        assert closed[1]["stale"] > 0  # round 1's stragglers folded
+
+    def test_late_overflow_dropped_loudly(self):
+        cfg = _cfg()
+        plan = ClientChaosPlan(straggler_frac=0.2)
+        ing, _, _ = _clocked_ingest(cfg, plan)
+        ing.run_round()
+        pending = ing.late_pending
+        assert pending > 0
+        # collapse the straggler id range: round 2 runs fault-free, so
+        # every slot arrives and there is no free slot for round 1's
+        # stragglers — all dropped, each loudly
+        ing._straggler_hi = ing._poison_hi
+        ing.run_round()
+        dropped = [
+            e for e in ing.events if e["kind"] == "late_dropped"
+        ]
+        assert len(dropped) == pending
+        assert all(e["client"] >= 0 for e in dropped)
+
+
+# -- population_fit end to end ------------------------------------------------
+
+
+class TestPopulationFit:
+    def test_hardened_recovers_resumes_and_attributes(self):
+        cfg = _cfg()
+        plan = ClientChaosPlan(
+            dropout_frac=0.2, dropout_waves={3: 0.9},
+            nan_frac=0.02, poison_frac=0.05, poison_scale=3.0,
+        )
+        metrics = MetricsLogger(stream=None)
+        metrics.start()
+        w, info, sup = population_fit(
+            cfg, plan=plan, rounds=5, metrics=metrics,
+            participation_wait_s=5.0, seed=3,
+        )
+        from distributed_eigenspaces_tpu.ops.linalg import (
+            principal_angles_degrees,
+        )
+
+        q, _ = np.linalg.qr(np.asarray(w))
+        ang = float(
+            np.max(
+                principal_angles_degrees(
+                    jnp.asarray(q[:, :K], jnp.float32),
+                    jnp.asarray(info["planted"], jnp.float32),
+                )
+            )
+        )
+        assert ang < 5.0
+        assert info["rounds"] == 5 and info["resumes"] >= 1
+        ledger = [
+            e for e in sup.ledger.events
+            if e["kind"] == "quarantine_client"
+        ]
+        assert len(ledger) == sum(info["rejects"].values()) > 0
+        assert all(
+            "client" in e and e["reason"] in
+            set(REJECT_REASONS) | {"screened"}
+            for e in ledger
+        )
+        pop = metrics.summary()["population"]
+        assert pop["rounds"] == 5
+        assert sum(pop["rejects_by_reason"].values()) > 0
+        assert pop["participation_hist"]
+        assert pop["by_kind"]["round_closed"] == 5
+
+    def test_naive_mean_is_steered(self):
+        cfg = _cfg()
+        plan = ClientChaosPlan(
+            dropout_frac=0.2, poison_frac=0.08, poison_scale=1.0,
+        )
+        seed = 3
+        w_h, info_h, _ = population_fit(
+            cfg, plan=plan, rounds=4, hardened=True, seed=seed,
+        )
+        w_n, info_n, _ = population_fit(
+            cfg, plan=plan, rounds=4, hardened=False, seed=seed,
+        )
+        from distributed_eigenspaces_tpu.ops.linalg import (
+            principal_angles_degrees,
+        )
+
+        def angle(w, planted):
+            q, _ = np.linalg.qr(np.asarray(w))
+            return float(
+                np.max(
+                    principal_angles_degrees(
+                        jnp.asarray(q[:, :K], jnp.float32),
+                        jnp.asarray(planted, jnp.float32),
+                    )
+                )
+            )
+
+        ang_h = angle(w_h, info_h["planted"])
+        ang_n = angle(w_n, info_n["planted"])
+        assert ang_n > 2.0 * ang_h
+
+    def test_exhausted_resumes_raise_supervisor_error(self):
+        cfg = _cfg()
+        plan = ClientChaosPlan(
+            dropout_frac=0.1,
+            dropout_waves={r: 0.95 for r in range(2, 100)},
+        )
+        with pytest.raises(SupervisorError):
+            population_fit(
+                cfg, plan=plan, rounds=4, max_resumes=1,
+                participation_wait_s=0.05,
+            )
+
+    def test_population_required(self):
+        with pytest.raises(ValueError, match="population"):
+            PopulationIngest(_cfg(population=None))
+
+
+# -- topology + config validation --------------------------------------------
+
+
+class TestTopologyAndConfig:
+    def test_population_topology_resolves_against_cohort(self):
+        cfg = _cfg(cohort_size=8, merge_topology=(("chip", 4), ("host", 2)))
+        topo = population_topology(cfg)
+        assert tuple(f for _, f in topo.tiers) == (4, 2)
+
+    def test_population_topology_must_cover_cohort(self):
+        cfg = _cfg(cohort_size=48, merge_topology=(("chip", 4), ("host", 2)))
+        with pytest.raises(ValueError, match="cohort_size"):
+            population_topology(cfg)
+
+    def test_cohort_must_not_exceed_population(self):
+        with pytest.raises(ValueError, match="cohort_size"):
+            _cfg(population=10, cohort_size=11)
+
+    def test_max_poison_frac_below_half(self):
+        with pytest.raises(ValueError, match="max_poison_frac"):
+            _cfg(max_poison_frac=0.5)
+
+    def test_min_participation_frac_in_range(self):
+        with pytest.raises(ValueError, match="min_participation_frac"):
+            _cfg(min_participation_frac=0.0)
+
+
+# -- scenario episode kind ----------------------------------------------------
+
+
+class TestScenarioEpisode:
+    def _spec(self, **ep_kw):
+        ep = dict(
+            name="pop", kind="population", start_s=0.0, duration_s=1.0,
+            population=2000, cohort_size=48,
+        )
+        ep.update(ep_kw)
+        return {
+            "name": "s", "seed": 1, "config": {},
+            "episodes": [ep],
+        }
+
+    def test_valid_spec_schedules_population_start(self):
+        from distributed_eigenspaces_tpu.runtime.scenario import (
+            build_schedule,
+            load_spec,
+        )
+
+        sched = build_schedule(load_spec(self._spec(rounds=3)))
+        assert "population_start" in [a.kind for a in sched.actions]
+
+    def test_validation_names_episode_and_field(self):
+        from distributed_eigenspaces_tpu.runtime.scenario import load_spec
+
+        with pytest.raises(ValueError, match="'pop'.*cohort_size"):
+            load_spec(self._spec(cohort_size=99999))
+        with pytest.raises(ValueError, match="'pop'.*poison_frac"):
+            load_spec(self._spec(poison_frac=1.5))
+        bad = self._spec()
+        del bad["episodes"][0]["population"]
+        with pytest.raises(ValueError, match="'pop'.*population"):
+            load_spec(bad)
+
+
+# -- satellite regression: rejoin during the quorum-lost bounded wait ---------
+
+
+class TestRejoinDuringQuorumWait:
+    def test_rejoin_admitted_next_round_with_bumped_generation(self):
+        t = [0.0]
+        polls = [0]
+
+        def sleep(s):
+            t[0] += s
+            polls[0] += 1
+            # the crashed workers come back DURING the bounded wait
+            if polls[0] == 2:
+                table.join(1)
+                table.join(2)
+
+        table = MembershipTable(
+            4, heartbeat_timeout_ms=100.0, suspect_grace_ms=100.0,
+            min_quorum_frac=0.75, clock=lambda: t[0], sleep=sleep,
+        )
+        for s in range(4):
+            table.heartbeat(s)
+        assert table.begin_round(1).sum() == 4
+        gen_before = (table.generation(1), table.generation(2))
+        # slots 1 and 2 crash: leases lapse through suspect -> dead
+        for _ in range(3):
+            t[0] += 0.15
+            table.heartbeat(0)
+            table.heartbeat(3)
+            table.sweep()
+        assert table.state(1) == "dead" and table.state(2) == "dead"
+        with pytest.raises(QuorumLost):
+            table.begin_round(2)
+        # the bounded wait admits the mid-wait rejoin (the wait IS the
+        # round boundary) and quorum returns
+        assert table.wait_for_quorum(5.0, poll_s=0.05) is True
+        mask = table.begin_round(3)
+        assert mask.sum() == 4
+        assert table.generation(1) == gen_before[0] + 1
+        assert table.generation(2) == gen_before[1] + 1
+        assert table.state(1) == "live" and table.state(2) == "live"
