@@ -122,6 +122,17 @@ class ProgramContract:
     #: None = no sharding contract (checked programs without one are
     #: skipped with a named reason, never passed vacuously)
     sharding: ShardingContract | None = None
+    #: Pallas kernel tile budget (ISSUE 17): ceiling in ELEMENTS on
+    #: every block ref the kernel jaxpr touches — inputs, outputs, and
+    #: scratch alike. A kernel whose index map pins a full (rows, d)
+    #: operand as one block is legal Pallas and still runs; only this
+    #: bound catches that it silently stopped tiling. None = no Pallas
+    #: contract (pallas_call eqns in such programs are not audited)
+    max_block_elems: Callable[[ProgramParams], int] | None = None
+    #: a Pallas-contract program must actually contain pallas_call
+    #: eqns — guards against the audit passing vacuously on a build
+    #: that fell back to the XLA twin
+    require_pallas: bool = False
 
 
 def _factor_stack(p: ProgramParams) -> int:
@@ -441,6 +452,26 @@ CONTRACTS: dict[str, ProgramContract] = {
             replicated_axis_floor=lambda p: p.d,
         ),
     ),
+    "serve_pallas": ProgramContract(
+        name="serve_pallas",
+        description=(
+            "fused serve / solver Pallas kernels (ISSUE 17): the "
+            "quantized dequant->project family and the fused "
+            "matvec+Gram sweep — ZERO collectives, factor-only "
+            "memory, and every kernel block ref (inputs, outputs, "
+            "scratch) bounded by the VMEM tile budget; a kernel that "
+            "maps the full (rows, d) operand into one block has "
+            "silently stopped tiling"
+        ),
+        allowed_collectives=frozenset(),
+        memory_policy="factor_only",
+        dense_dim=lambda p: p.d,
+        # 131072 f32 elems = 512 KiB per block ref — the serve tile
+        # targets (256 rows x 512 d) at their ceiling; a full-operand
+        # block at the kernel-audit shapes (256 x 1024) is 2x over
+        max_block_elems=lambda p: 131072,
+        require_pallas=True,
+    ),
     "population_merge": ProgramContract(
         name="population_merge",
         description=(
@@ -716,6 +747,106 @@ def check_consts(
     }
 
 
+def _iter_pallas_eqns(closed_jaxpr):
+    """Every ``pallas_call`` eqn, recursively through sub-jaxprs
+    (scan/while/cond bodies, pjit calls). Yields the eqn itself — its
+    ``params['jaxpr']`` is the kernel jaxpr whose invars are the block
+    refs (in/out blocks followed by scratch refs)."""
+    seen: set[int] = set()
+
+    def _sub_jaxprs(param):
+        out = []
+        stack = [param]
+        while stack:
+            p = stack.pop()
+            if hasattr(p, "jaxpr") and hasattr(p.jaxpr, "eqns"):
+                out.append(p.jaxpr)
+            elif hasattr(p, "eqns"):
+                out.append(p)
+            elif isinstance(p, (tuple, list)):
+                stack.extend(p)
+        return out
+
+    def walk(jaxpr):
+        if id(jaxpr) in seen:
+            return
+        seen.add(id(jaxpr))
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "pallas_call":
+                yield eqn
+                continue  # the kernel jaxpr's refs are audited per-eqn
+            for p in eqn.params.values():
+                for sub in _sub_jaxprs(p):
+                    yield from walk(sub)
+
+    inner = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    yield from walk(inner)
+
+
+def check_pallas(
+    contract: ProgramContract,
+    params: ProgramParams,
+    closed_jaxpr,
+    *,
+    program: str,
+) -> tuple[list[Violation], dict]:
+    """Pass 4 (ISSUE 17): the Pallas tile budget. For every
+    ``pallas_call`` eqn, bound the element count of EVERY kernel-jaxpr
+    invar ref — in/out blocks and scratch uniformly — by
+    ``max_block_elems``. The op-kind and dense-buffer passes cannot see
+    this failure mode: a kernel whose index map pins the whole operand
+    as one block compiles, runs, and produces exact answers — it just
+    streams the full array through VMEM every grid step."""
+    out: list[Violation] = []
+    metrics: dict = {"n_pallas_calls": 0, "max_block_elems_seen": 0}
+    if contract.max_block_elems is None:
+        metrics["policy"] = "unchecked"
+        return out, metrics
+    bound = contract.max_block_elems(params)
+    metrics["block_bound_elems"] = bound
+    n_calls = 0
+    worst = 0
+    for eqn in _iter_pallas_eqns(closed_jaxpr):
+        n_calls += 1
+        kernel = eqn.params.get("jaxpr")
+        kernel = getattr(kernel, "jaxpr", kernel)
+        name = eqn.params.get("name_and_src_info", None)
+        kname = getattr(name, "name", None) or str(
+            name or "pallas_call"
+        ).split(" ")[0]
+        for i, var in enumerate(getattr(kernel, "invars", ())):
+            shape = tuple(getattr(var.aval, "shape", ()) or ())
+            elems = math.prod(shape) if shape else 1
+            worst = max(worst, elems)
+            if elems > bound:
+                out.append(Violation(
+                    program=program,
+                    rule="pallas-block",
+                    message=(
+                        f"kernel block ref #{i} holds {list(shape)} = "
+                        f"{elems} elems, over the tile budget {bound} "
+                        "— the grid spec maps (nearly) the whole "
+                        "operand into one block, so the kernel "
+                        "streams the full array through VMEM every "
+                        f"step (contract {contract.name!r})"
+                    ),
+                    location=f"pallas_call {kname!r}",
+                ))
+    metrics["n_pallas_calls"] = n_calls
+    metrics["max_block_elems_seen"] = worst
+    if contract.require_pallas and n_calls == 0:
+        out.append(Violation(
+            program=program,
+            rule="pallas-presence",
+            message=(
+                "program contains no pallas_call at all — the tile "
+                "audit would pass vacuously (did the build fall back "
+                f"to the XLA twin?) (contract {contract.name!r})"
+            ),
+        ))
+    return out, metrics
+
+
 def check_program(built) -> tuple[list[Violation], dict]:
     """All static passes over one :class:`~.programs.BuiltProgram`:
     collectives + memory + baked constants + declared shardings +
@@ -745,6 +876,10 @@ def check_program(built) -> tuple[list[Violation], dict]:
         contract, params, jaxpr, program=built.name
     )
     violations += v
+    v, pallas = check_pallas(
+        contract, params, jaxpr, program=built.name
+    )
+    violations += v
     v, shard = _sh.check_built(built, contract)
     violations += v
     v, costs = costmodel.check_built(built)
@@ -755,6 +890,7 @@ def check_program(built) -> tuple[list[Violation], dict]:
         "collectives": col,
         "memory": mem,
         "consts": const,
+        "pallas": pallas,
         "shardings": shard,
         "costs": costs,
     }

@@ -307,6 +307,51 @@ def _mutant_population_payload() -> list[contracts.Violation]:
     return viols
 
 
+def _mutant_pallas_full_block() -> list[contracts.Violation]:
+    """The tiling regression ISSUE 17's kernel gate exists for: a
+    'tiled' Pallas kernel whose index map pins the FULL (rows, d)
+    operand as ONE block. Legal Pallas — it compiles, runs, and is
+    bit-exact — but every grid step streams the whole operand through
+    VMEM, so only the per-ref tile budget can catch it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    d, rows, k = 1024, 256, 8
+
+    def kernel(x_ref, v_ref, o_ref):
+        o_ref[:] = jax.lax.dot_general(
+            x_ref[:], v_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    def project(x, v):
+        return pl.pallas_call(
+            kernel,
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec((rows, d), lambda i: (0, 0)),
+                pl.BlockSpec((d, k), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((rows, k), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, k), jnp.float32),
+            interpret=True,
+        )(x, v)
+
+    jitted = jax.jit(project)
+    args = (
+        jax.ShapeDtypeStruct((rows, d), jnp.float32),
+        jax.ShapeDtypeStruct((d, k), jnp.float32),
+    )
+    contract = contracts.CONTRACTS["serve_pallas"]
+    params = contracts.ProgramParams(d=d, k=k, rows=rows)
+    viols, _ = contracts.check_pallas(
+        contract, params, jitted.trace(*args).jaxpr,
+        program="mutant_pallas_full_block",
+    )
+    return viols
+
+
 _FIXTURE_BLOCKING = '''
 import threading, time
 class Worker:
@@ -379,6 +424,9 @@ MUTATIONS: dict[str, tuple[str, Callable[[], list]]] = {
     ),
     "population_payload": (
         "collective-payload", _mutant_population_payload
+    ),
+    "pallas_full_block": (
+        "pallas-block", _mutant_pallas_full_block
     ),
     "blocking_under_lock": ("blocking-under-lock", _ast_mutant(
         _FIXTURE_BLOCKING, ast_lints.lint_concurrency_source

@@ -27,6 +27,14 @@ _D, _K, _M, _N, _T = 64, 2, 4, 8, 3
 _FEAT_D = 128
 _FLEET_B = 8
 _SERVE_ROWS = 16
+# Pallas kernel-audit shapes (ISSUE 17): LARGE enough that a
+# full-operand block is distinguishable from a tile (at the serve
+# matrix's d=64 every legal block IS the full array, so the tile
+# budget could never fire) — and explicit sub-maximal blocks so the
+# legit programs sit far under the 131072-elem budget the mutant's
+# full (rows, d) block (262144 elems) trips
+_PALLAS_D, _PALLAS_ROWS, _PALLAS_K, _PALLAS_F = 1024, 256, 8, 32
+_PALLAS_BR, _PALLAS_BD = 64, 128
 
 
 def require_mesh_devices(n: int = 8) -> None:
@@ -463,6 +471,59 @@ def _serve_program(name: str, kind: str, *, sharded: bool):
     return build
 
 
+def _pallas_program(name: str, kind: str):
+    """Fused serve / solver Pallas kernels (ISSUE 17), audited at the
+    kernel shapes above. ``interpret=True`` so the audit compiles on
+    the CPU rig — the traced ``pallas_call`` eqn carries the SAME
+    kernel jaxpr and block refs the TPU lowering would, which is all
+    the tile-budget pass reads."""
+
+    def build() -> BuiltProgram:
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_eigenspaces_tpu.ops import pallas_gram as pg
+
+        require_mesh_devices()
+        d, rows = _PALLAS_D, _PALLAS_ROWS
+        k, f = _PALLAS_K, _PALLAS_F
+        br, bd = _PALLAS_BR, _PALLAS_BD
+        if kind == "project_bf16":
+            fn = jax.jit(lambda x, v: pg.serve_project_pallas(
+                x, v, block_rows=br, block_d=bd, interpret=True,
+            ))
+            args = (
+                jax.ShapeDtypeStruct((rows, d), jnp.float32),
+                jax.ShapeDtypeStruct((d, k), jnp.float32),
+            )
+        elif kind == "project_i8":
+            fn = jax.jit(lambda x, q, s: pg.serve_project_i8_pallas(
+                x, q, s, block_rows=br, block_d=bd, interpret=True,
+            ))
+            args = (
+                jax.ShapeDtypeStruct((rows, d), jnp.float32),
+                jax.ShapeDtypeStruct((d, k), jnp.int8),
+                jax.ShapeDtypeStruct((1, k), jnp.float32),
+            )
+        else:  # matvec_gram: the fused solver inner sweep
+            fn = jax.jit(lambda c, v: pg.matvec_gram_pallas(
+                c, v, block_d=bd, interpret=True,
+            ))
+            args = (
+                jax.ShapeDtypeStruct((d, f), jnp.float32),
+                jax.ShapeDtypeStruct((d, k), jnp.float32),
+            )
+        return BuiltProgram(
+            name=name, contract="serve_pallas",
+            params=ProgramParams(
+                d=d, k=k, rows=rows, sketch_width=f,
+            ),
+            jitted=fn, args=args,
+        )
+
+    return build
+
+
 #: name -> zero-arg builder. The ORDER is the report order.
 PROGRAMS: dict[str, Callable[[], BuiltProgram]] = {
     # solo scan family x pipeline x merge_interval
@@ -513,6 +574,16 @@ PROGRAMS: dict[str, Callable[[], BuiltProgram]] = {
     ),
     "dist_serve_residual": _dist_serve_program(
         "dist_serve_residual", "residual"
+    ),
+    # fused serve / solver Pallas kernels (ISSUE 17)
+    "pallas_serve_project_bf16": _pallas_program(
+        "pallas_serve_project_bf16", "project_bf16"
+    ),
+    "pallas_serve_project_i8": _pallas_program(
+        "pallas_serve_project_i8", "project_i8"
+    ),
+    "pallas_matvec_gram": _pallas_program(
+        "pallas_matvec_gram", "matvec_gram"
     ),
 }
 

@@ -99,6 +99,25 @@ def build_parser() -> argparse.ArgumentParser:
                    "clean ServerOverloaded (unset = unbounded); with "
                    "--slo-p99-ms also drops requests that blew the SLO "
                    "before compute")
+    p.add_argument("--serve-continuous", action="store_true",
+                   help="continuous batching for --mode serve "
+                   "(PCAConfig.serve_continuous): admit requests into "
+                   "the NEXT in-flight batch — a dispatch lane never "
+                   "idles while work is queued, batch assembly is "
+                   "round-robin-fair over tenant ids, and the "
+                   "admit-to-dispatch tail collapses at sub-saturation "
+                   "rates (bench.py --wirespeed measures the win); "
+                   "unset keeps bucket-full-or-deadline dispatch "
+                   "byte-identical to the previous path")
+    p.add_argument("--serve-dtype", default="float32",
+                   choices=("float32", "bfloat16", "int8"),
+                   help="serve-kernel precision family for --mode "
+                   "serve (PCAConfig.serve_dtype): float32 is the "
+                   "exact bit-for-bit path; bfloat16/int8 run the "
+                   "fused quantized projection kernels (Pallas on "
+                   "TPU, a one-jit XLA twin on CPU; basis stays an "
+                   "operand so hot swaps still recompile nothing), "
+                   "angle-gated <= 0.2 deg vs fp32 at construction")
     p.add_argument("--breaker-threshold", type=int, default=None,
                    help="per-signature circuit breaker "
                    "(PCAConfig.serve_breaker_threshold): consecutive "
@@ -1614,6 +1633,8 @@ def main(argv=None) -> int:
             publisher_lease_ms=args.publisher_lease_ms,
             serve_queue_depth=args.serve_queue_depth,
             serve_breaker_threshold=args.breaker_threshold,
+            serve_continuous=args.serve_continuous,
+            serve_dtype=args.serve_dtype,
         )
         return _serve_cli(args, cfg, data, truth)
 

@@ -180,6 +180,35 @@ class PCAConfig:
         read path). ``0`` dispatches every query immediately
         (one-query-per-dispatch — the A/B baseline ``bench.py --serve``
         measures against).
+      serve_continuous: continuous batching for the admission queues
+        (CLI ``--serve-continuous``): instead of holding a micro-batch
+        until it is FULL or its oldest request has waited
+        ``serve_flush_s``, a request is admitted into the *next
+        in-flight batch* — whenever a dispatch lane has budget, the
+        queue assembles whatever is pending (up to the bucket size)
+        and dispatches immediately, so a lane never idles while work
+        is queued and the admit-to-dispatch tail collapses at
+        sub-saturation arrival rates (``bench.py --wirespeed`` is the
+        before/after instrument). Batch assembly draws round-robin
+        over tenant ids (``submit(..., tenant=...)``) so one flooding
+        tenant cannot starve the others — per-tenant fairness rides
+        ON TOP of the existing shed/breaker/deadline machinery, which
+        is unchanged. ``False`` (default) keeps bucket-full-or-deadline
+        dispatch BYTE-IDENTICAL to the previous path (pinned in tests).
+      serve_dtype: serve-kernel precision family for the
+        ``TransformEngine`` hot path (CLI ``--serve-dtype``):
+        ``"float32"`` (default) is the exact path — bit-for-bit against
+        the direct ``x @ V``. ``"bfloat16"`` runs a fused cast→project
+        kernel (Pallas on TPU, an equivalent one-jit XLA twin on CPU)
+        with fp32 accumulation; ``"int8"`` additionally quantizes the
+        BASIS per-column (symmetric absmax, the ``data/stream.py``
+        quantizer discipline, scale returned and re-applied in-kernel)
+        and fuses dequant into the projection. Both lowered paths keep
+        the basis an OPERAND (hot-swap still recompiles nothing) and
+        are angle-gated against fp32 at construction
+        (``TransformEngine.self_check``, 0.2° budget) — bases are
+        near-orthonormal so the quantization error is boundable, and
+        the gate makes the bound a runtime guarantee.
       serve_keep_versions: how many published basis versions the
         ``serving/registry.py EigenbasisRegistry`` retains (append-only
         store, GC keeps the newest N; ``latest()`` never dangles).
@@ -390,6 +419,8 @@ class PCAConfig:
     fleet_flush_s: float = 0.1
     serve_bucket_size: int = 8
     serve_flush_s: float = 0.02
+    serve_continuous: bool = False
+    serve_dtype: str = "float32"
     serve_keep_versions: int = 4
     registry_dir: str | None = None
     serve_queue_depth: int | None = None
@@ -520,6 +551,18 @@ class PCAConfig:
         if self.serve_flush_s < 0:
             raise ValueError(
                 f"serve_flush_s must be >= 0, got {self.serve_flush_s}"
+            )
+        if not isinstance(self.serve_continuous, bool):
+            raise ValueError(
+                f"serve_continuous must be a bool, got "
+                f"{self.serve_continuous!r}"
+            )
+        if self.serve_dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"unknown serve_dtype: {self.serve_dtype!r} "
+                "(float32/bfloat16/int8 — the serve-kernel precision "
+                "family, angle-gated vs fp32; see docs/ARCHITECTURE.md "
+                "'Wire-speed read path')"
             )
         if not isinstance(self.serve_keep_versions, int) or isinstance(
             self.serve_keep_versions, bool

@@ -106,6 +106,301 @@ def gram_pallas(
     return out
 
 
+# -- serve-side kernel family (ISSUE 17) -------------------------------------
+#
+# The read path's hot op is a skinny projection x (rows, d) @ v (d, k)
+# with k tiny: the kernels below tile rows x d through VMEM with the
+# (rows_blk, k) fp32 accumulator resident (the gram kernel's discipline,
+# transposed to the serve shape), cast operands to the MXU dtype
+# in-kernel, and fuse the int8 basis dequant into the projection — one
+# pass over x, the output written exactly once. All variants take the
+# basis as an OPERAND (the hot-swap contract of serving/transform.py is
+# preserved: publishing a new version changes an argument, not a
+# program). `interpret=True` runs them on CPU for tests/analysis; the
+# CPU serve path itself uses the XLA twins in TransformEngine (interpret
+# mode is a correctness tool, not a fast path).
+
+
+def _serve_project_kernel(x_ref, v_ref, out_ref, *, mxu_dtype):
+    """Grid (rows_blk, d_blk): out += cast(x_blk) @ cast(v_blk), fp32
+    accumulate. The d axis is innermost, so each (rows, k) output tile
+    stays resident in VMEM across all of its d-blocks."""
+
+    @pl.when(pl.program_id(1) == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += jax.lax.dot_general(
+        x_ref[:].astype(mxu_dtype),
+        v_ref[:].astype(mxu_dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _serve_project_i8_kernel(x_ref, v_ref, scale_ref, out_ref):
+    """Fused dequant->project: the basis block arrives int8 and widens
+    to bf16 ON the MXU input (int8 magnitudes <= 127 are exact in
+    bf16), the per-column scale is applied ONCE at the last d-block —
+    z = (x @ v_i8) * scale, never a dequantized (d, k) fp32 basis in
+    memory."""
+
+    @pl.when(pl.program_id(1) == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += jax.lax.dot_general(
+        x_ref[:].astype(jnp.bfloat16),
+        v_ref[:].astype(jnp.bfloat16),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(pl.program_id(1) == pl.num_programs(1) - 1)
+    def _scale():
+        out_ref[:] = out_ref[:] * scale_ref[:]
+
+
+def quantize_basis_i8(v, *, eps: float = 1e-12):
+    """Per-COLUMN symmetric int8 quantization of a ``(d, k)`` basis:
+    ``(v_i8, scale)`` with ``scale (1, k)`` fp32 such that
+    ``v ~= v_i8 * scale``. Unlike the fit path's
+    ``data.stream.quantize_block_i8`` (one global scale, DROPPED — it
+    cancels in the eigenvectors), serving must return the scale: the
+    projection ``z = (x @ v_i8) * scale`` is an answer, not an
+    intermediate that re-orthonormalizes. An all-zero column quantizes
+    to zeros with zero scale (exact). Traces inside jit — the basis
+    stays a program OPERAND, so a hot-swap re-quantizes in-program
+    instead of recompiling."""
+    v = jnp.asarray(v, jnp.float32)
+    absmax = jnp.max(jnp.abs(v), axis=0, keepdims=True)
+    scale = absmax / 127.0
+    q = jnp.clip(
+        jnp.round(v / jnp.maximum(scale, eps)), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def serve_project_pallas(
+    x: jax.Array,
+    v: jax.Array,
+    *,
+    block_rows: int,
+    block_d: int,
+    mxu_dtype=jnp.bfloat16,
+    interpret: bool = False,
+) -> jax.Array:
+    """``(rows, d) @ (d, k) -> (rows, k)`` fused cast->project with fp32
+    accumulation. Callers pick legal blocks via :func:`_pick_block`
+    (``serve_blocks``) and fall back to the XLA twin otherwise."""
+    rows, d = x.shape
+    k = v.shape[-1]
+    if rows % block_rows or d % block_d:
+        raise ValueError(
+            f"shape ({rows}, {d}) not divisible by blocks "
+            f"({block_rows}, {block_d})"
+        )
+    grid = (rows // block_rows, d // block_d)
+    return pl.pallas_call(
+        partial(_serve_project_kernel, mxu_dtype=mxu_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (block_rows, block_d),
+                lambda r, db: (r, db),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_d, k),
+                lambda r, db: (db, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_rows, k),
+            lambda r, db: (r, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows, k), jnp.float32),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x, v)
+
+
+def serve_project_i8_pallas(
+    x: jax.Array,
+    v_i8: jax.Array,
+    scale: jax.Array,
+    *,
+    block_rows: int,
+    block_d: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """``z = (x @ v_i8) * scale`` with the dequant fused into the
+    projection (see :func:`_serve_project_i8_kernel`); ``scale`` is the
+    ``(1, k)`` per-column scale from :func:`quantize_basis_i8`."""
+    rows, d = x.shape
+    k = v_i8.shape[-1]
+    if rows % block_rows or d % block_d:
+        raise ValueError(
+            f"shape ({rows}, {d}) not divisible by blocks "
+            f"({block_rows}, {block_d})"
+        )
+    grid = (rows // block_rows, d // block_d)
+    return pl.pallas_call(
+        _serve_project_i8_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (block_rows, block_d),
+                lambda r, db: (r, db),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_d, k),
+                lambda r, db: (db, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, k),
+                lambda r, db: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_rows, k),
+            lambda r, db: (r, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows, k), jnp.float32),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x, v_i8, scale)
+
+
+def _matvec_gram_kernel(c_ref, v_ref, w_ref, g_ref, y_ref):
+    """Fused distributed-solver inner sweep for a local factor operator
+    ``C (d, f)``: two passes over the d axis in ONE kernel launch —
+
+    - pass 0 accumulates ``y = C^T v`` (f, k) into VMEM scratch,
+    - pass 1 writes ``w = C y`` block-by-block AND accumulates the
+      CholeskyQR Gram ``g = w^T w`` (k, k) alongside,
+
+    so the matvec and the first Gram CholeskyQR2 needs cost one launch
+    and one extra pass over C instead of three separate dispatches. The
+    only resident state is (f + k) x k — never anything d-wide."""
+    p = pl.program_id(0)
+    db = pl.program_id(1)
+
+    @pl.when((p == 0) & (db == 0))
+    def _zero_y():
+        y_ref[:] = jnp.zeros_like(y_ref)
+
+    @pl.when(p == 0)
+    def _pass0():
+        y_ref[:] += jax.lax.dot_general(
+            c_ref[:],
+            v_ref[:],
+            dimension_numbers=(((0,), (0,)), ((), ())),  # C_blk^T v_blk
+            preferred_element_type=jnp.float32,
+        )
+        # the out block is visited this pass too: define it (pass 1
+        # overwrites with the real value)
+        w_ref[:] = jnp.zeros_like(w_ref)
+
+    @pl.when(p == 1)
+    def _pass1():
+        wb = jax.lax.dot_general(
+            c_ref[:],
+            y_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),  # C_blk @ y
+            preferred_element_type=jnp.float32,
+        )
+        w_ref[:] = wb
+
+        @pl.when(db == 0)
+        def _zero_g():
+            g_ref[:] = jnp.zeros_like(g_ref)
+
+        g_ref[:] += jax.lax.dot_general(
+            wb,
+            wb,
+            dimension_numbers=(((0,), (0,)), ((), ())),  # w_blk^T w_blk
+            preferred_element_type=jnp.float32,
+        )
+
+
+def matvec_gram_pallas(
+    c: jax.Array,
+    v: jax.Array,
+    *,
+    block_d: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """``(w, g) = (C (C^T v), w^T w)`` for a local factor operator ``C
+    (d, f)`` and block ``v (d, k)`` — the distributed solver's inner
+    matvec fused with the Gram its CholeskyQR2 consumes first. Grid
+    ``(2, d // block_d)``; the f x k partial product lives in VMEM
+    scratch between the passes."""
+    d, f = c.shape
+    k = v.shape[-1]
+    if d % block_d:
+        raise ValueError(f"d={d} not divisible by block_d={block_d}")
+    return pl.pallas_call(
+        _matvec_gram_kernel,
+        grid=(2, d // block_d),
+        in_specs=[
+            pl.BlockSpec(
+                (block_d, f),
+                lambda p, db: (db, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_d, k),
+                lambda p, db: (db, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (block_d, k),
+                lambda p, db: (db, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (k, k),
+                lambda p, db: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d, k), jnp.float32),
+            jax.ShapeDtypeStruct((k, k), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((f, k), jnp.float32)],
+        compiler_params=_CompilerParams(
+            # both axes sequential: pass 1 must see pass 0's scratch
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(c, v)
+
+
+def serve_blocks(rows: int, d: int, dtype=jnp.bfloat16):
+    """Legal (block_rows, block_d) for the serve projection kernels, or
+    ``(None, None)`` when no legal tiling exists (callers fall back to
+    the XLA twin). Same legality rules as :func:`gram_auto`: the
+    sublane align is dtype-dependent, the lane axis needs 128 or the
+    full dim."""
+    br = _pick_block(rows, 256, (8 * 4) // jnp.dtype(dtype).itemsize)
+    bd = _pick_block(d, 512, 128)
+    return br, bd
+
+
 def _pick_block(total: int, target: int, align: int) -> int | None:
     """Largest LEGAL block size for one dimension: the Mosaic lowering
     requires each block dim to be a multiple of its tile alignment (8 for

@@ -69,10 +69,10 @@ def _psum_if(x, axis_name):
     return jax.lax.psum(x, axis_name) if axis_name else x
 
 
-def _chol_qr(v, axis_name, eps=1e-7):
-    """One CholeskyQR pass on row-sharded ``v (..., d_local, k)``."""
-    g = jnp.einsum("...dk,...dl->...kl", v, v, precision=HP)
-    g = _psum_if(g, axis_name)
+def _chol_apply(v, g, eps=1e-7):
+    """Finish one CholeskyQR pass from a PRECOMPUTED (already reduced)
+    Gram ``g = v^T v`` — the half the fused matvec+Gram kernel
+    (``ops.pallas_gram.matvec_gram_pallas``) leaves to do."""
     k = g.shape[-1]
     g = g + eps * jnp.trace(g, axis1=-2, axis2=-1)[..., None, None] * jnp.eye(
         k, dtype=g.dtype
@@ -82,6 +82,13 @@ def _chol_qr(v, axis_name, eps=1e-7):
     return jax.lax.linalg.triangular_solve(
         r, v, left_side=False, lower=True, transpose_a=True
     )
+
+
+def _chol_qr(v, axis_name, eps=1e-7):
+    """One CholeskyQR pass on row-sharded ``v (..., d_local, k)``."""
+    g = jnp.einsum("...dk,...dl->...kl", v, v, precision=HP)
+    g = _psum_if(g, axis_name)
+    return _chol_apply(v, g, eps)
 
 
 def chol_qr2(v, axis_name=None):

@@ -789,6 +789,7 @@ class FleetServer:
             breaker_threshold=getattr(
                 cfg, "serve_breaker_threshold", None
             ),
+            continuous=getattr(cfg, "serve_continuous", False),
         )
         self._fit_cache: dict = {}
         self._thread = threading.Thread(
@@ -802,10 +803,12 @@ class FleetServer:
     # -- client API ----------------------------------------------------------
 
     def submit(self, problem, *, cfg: PCAConfig | None = None,
-               worker_masks=None):
+               worker_masks=None, tenant=None):
         """Admit one fit request; returns its
         :class:`~..runtime.scheduler.FleetTicket` (``.result()`` blocks
-        for the tenant's ``(d, k)`` components)."""
+        for the tenant's ``(d, k)`` components). ``tenant`` is the
+        continuous-batching fairness key (``cfg.serve_continuous``):
+        batch assembly round-robins over tenant ids."""
         cfg = self.cfg if cfg is None else cfg
         sig = (fleet_signature(cfg), repr(cfg))
         from distributed_eigenspaces_tpu.runtime.scheduler import (
@@ -823,6 +826,7 @@ class FleetServer:
                 _FleetRequest(
                     cfg, problem, worker_masks, t_submit=t0, trace_id=tid
                 ),
+                tenant=tenant,
             )
         except QueueClosed as e:
             from distributed_eigenspaces_tpu.serving.server import (

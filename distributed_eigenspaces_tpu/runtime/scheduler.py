@@ -353,9 +353,14 @@ class FleetTicket:
     """One admitted fit request: resolves to its per-tenant result (or
     the dispatch error) once the bucket it rode in has executed."""
 
-    def __init__(self, signature, payload: Any):
+    def __init__(self, signature, payload: Any, tenant: Any = None):
         self.signature = signature
         self.payload = payload
+        #: fairness key (continuous batching): batch assembly draws
+        #: round-robin over tenant ids, so one flooding tenant cannot
+        #: starve the others out of a batch. None = anonymous (all
+        #: anonymous tickets share one fairness slot).
+        self.tenant = tenant
         #: admission stamp (``time.perf_counter``) — the telemetry
         #: layer's queue-wait anchor: dispatch lanes subtract it to
         #: decompose request latency (docs/OBSERVABILITY.md)
@@ -433,6 +438,32 @@ class ShapeBucketQueue:
     determinism call :meth:`flush_expired` with an explicit ``now``
     instead (the timer is harmless alongside — flushing is idempotent
     under the lock).
+
+    **Continuous batching** (``continuous=True``, ISSUE 17): instead of
+    holding a bucket until it is FULL or its deadline expires, a request
+    is admitted into the *next in-flight batch*. The admission state
+    machine per signature:
+
+    - a dispatch lane with free budget (``serve(num_lanes=...)`` sets
+      the budget) dispatches the pending pool IMMEDIATELY on submit —
+      at sub-saturation rates a request never waits a flush window;
+    - while every lane is busy, submissions POOL; the moment a batch
+      completes, the freed lane assembles the next batch from the pool
+      (up to ``bucket_size`` tickets) and dispatches it — a lane never
+      idles while work is queued;
+    - batch assembly draws ROUND-ROBIN over tenant ids
+      (``submit(..., tenant=...)``) with a rotating start cursor, so an
+      adversarial single-tenant flood gets at most its fair share of
+      each batch while other tenants keep landing;
+    - the deadline timer is retained as a liveness BACKSTOP: a pooled
+      request's worst case is one flush window, exactly the old path's
+      bound (and ``flush_deadline == 0`` still dispatches every submit
+      immediately).
+
+    The shed/breaker/close machinery is unchanged and layered identically
+    in both modes; with ``continuous=False`` (default) the dispatch
+    behavior is byte-identical to the bucket-full-or-deadline path
+    (pinned in tests/test_scheduler.py).
     """
 
     def __init__(
@@ -450,6 +481,7 @@ class ShapeBucketQueue:
         breaker_threshold: int | None = None,
         breaker_cooldown_s: float = 1.0,
         on_event: Callable[[str, dict], None] | None = None,
+        continuous: bool = False,
     ):
         if bucket_size < 1:
             raise ValueError(f"bucket_size must be >= 1: {bucket_size}")
@@ -494,6 +526,14 @@ class ShapeBucketQueue:
         self._lock = threading.Condition()
         self._buckets: dict[Any, list[FleetTicket]] = {}
         self._deadlines: dict[Any, float] = {}
+        #: continuous-batching state (all untouched when continuous is
+        #: False): the in-flight batch budget tracks dispatch lanes —
+        #: serve() sets it to num_lanes — and the RR cursor rotates the
+        #: tenant a batch assembly starts from, per signature
+        self.continuous = continuous
+        self._lane_budget = 1
+        self._inflight_batches = 0
+        self._rr: dict[Any, int] = {}
         self._closed = False
         self._timer: threading.Thread | None = None
         if start_timer and flush_deadline > 0:
@@ -555,10 +595,14 @@ class ShapeBucketQueue:
 
     # -- admission -----------------------------------------------------------
 
-    def submit(self, signature: Any, payload: Any) -> FleetTicket:
+    def submit(
+        self, signature: Any, payload: Any, *, tenant: Any = None
+    ) -> FleetTicket:
         """Admit one request; returns its ticket. A full bucket
         dispatches immediately; ``flush_deadline == 0`` dispatches every
-        submission immediately (padded solo serving).
+        submission immediately (padded solo serving). In continuous mode
+        the request instead joins the next in-flight batch (see the
+        class docstring); ``tenant`` is its fairness key.
 
         Resilience gates (both opt-in, both REJECT-NEWEST): a signature
         whose circuit breaker is open fast-fails with
@@ -589,7 +633,7 @@ class ShapeBucketQueue:
                 f"{snap.get('retry_in_s', 0.0)}s",
                 br,
             )
-        ticket = FleetTicket(signature, payload)
+        ticket = FleetTicket(signature, payload, tenant=tenant)
         with self._lock:
             if self._closed:
                 raise QueueClosed("submit on a closed ShapeBucketQueue")
@@ -617,7 +661,17 @@ class ShapeBucketQueue:
                     time.monotonic() + self.flush_deadline
                 )
             pending.append(ticket)
-            if (
+            if self.continuous:
+                # dispatch into a free lane immediately; while every
+                # lane is busy, POOL (the completion hook assembles the
+                # next batch) — except flush_deadline == 0, which keeps
+                # its dispatch-every-submit contract
+                if (
+                    self._inflight_batches < self._lane_budget
+                    or self.flush_deadline == 0
+                ):
+                    self._flush_locked(signature)
+            elif (
                 len(pending) >= self.bucket_size
                 or self.flush_deadline == 0
             ):
@@ -635,45 +689,129 @@ class ShapeBucketQueue:
 
     def flush_expired(self, now: float | None = None) -> int:
         """Dispatch every bucket whose oldest request has waited past
-        the deadline; returns how many buckets flushed. The timer thread
-        calls this; tests may call it directly with a synthetic ``now``."""
+        the deadline; returns how many buckets ACTUALLY dispatched (not
+        how many deadlines looked expired — a sweep racing another flush
+        must not count a bucket twice, ISSUE 17 satellite). The timer
+        thread calls this; tests may call it directly with a synthetic
+        ``now``; repeated calls with the same ``now`` are idempotent."""
         if now is None:
             now = time.monotonic()
         with self._lock:
             expired = [
                 sig for sig, dl in self._deadlines.items() if dl <= now
             ]
-            for sig in expired:
-                self._flush_locked(sig)
-            return len(expired)
+            return sum(
+                1 for sig in expired if self._flush_locked(sig)
+            )
 
     def flush_all(self) -> None:
         """Dispatch every partially-full bucket now (close path)."""
         with self._lock:
-            for sig in list(self._buckets):
-                self._flush_locked(sig)
+            self._drain_locked()
 
     def close(self) -> None:
         """Flush remaining buckets and close the work queue: serve()
         lanes drain what is queued and exit. Idempotent."""
         with self._lock:
             self._closed = True
-            for sig in list(self._buckets):
-                self._flush_locked(sig)
+            self._drain_locked()
             self._lock.notify_all()
         self.wq.close()
 
-    def _flush_locked(self, signature) -> None:
-        tickets = self._buckets.pop(signature, None)
-        self._deadlines.pop(signature, None)
-        if tickets:
-            self.wq.add_task(
-                Bucket(
-                    signature=signature,
-                    tickets=tickets,
-                    t_dispatch=time.perf_counter(),
-                )
+    def _drain_locked(self) -> None:
+        # continuous assembly caps a dispatch at bucket_size, so a
+        # pooled signature may need several flushes to empty
+        for sig in list(self._buckets):
+            while sig in self._buckets:
+                if not self._flush_locked(sig):
+                    break
+
+    def _flush_locked(self, signature) -> bool:
+        """Dispatch one bucket for ``signature``; True when a bucket was
+        actually handed to the work queue (the honest count
+        ``flush_expired`` reports). Continuous mode assembles up to
+        ``bucket_size`` tickets round-robin over tenants and leaves the
+        remainder pooled with a fresh deadline."""
+        if self.continuous:
+            tickets = self._assemble_rr_locked(signature)
+        else:
+            tickets = self._buckets.pop(signature, None)
+            self._deadlines.pop(signature, None)
+        if not tickets:
+            return False
+        self._inflight_batches += 1
+        self.wq.add_task(
+            Bucket(
+                signature=signature,
+                tickets=tickets,
+                t_dispatch=time.perf_counter(),
             )
+        )
+        return True
+
+    def _assemble_rr_locked(self, signature) -> list[FleetTicket] | None:
+        """Continuous-mode batch assembly: up to ``bucket_size`` tickets
+        drawn round-robin over tenant ids (one per tenant per pass,
+        arrival order within a tenant), starting from a rotating
+        per-signature cursor so the same tenant is not always first."""
+        pending = self._buckets.get(signature)
+        if not pending:
+            return None
+        if len(pending) <= self.bucket_size:
+            take = list(pending)
+            del self._buckets[signature]
+            self._deadlines.pop(signature, None)
+            return take
+        by_tenant: dict[Any, list[FleetTicket]] = {}
+        order: list[Any] = []
+        for t in pending:
+            key = t.tenant
+            if key not in by_tenant:
+                by_tenant[key] = []
+                order.append(key)
+            by_tenant[key].append(t)
+        idx = self._rr.get(signature, 0) % len(order)
+        take: list[FleetTicket] = []
+        scanned = 0
+        while len(take) < self.bucket_size and scanned < len(order):
+            q = by_tenant[order[idx % len(order)]]
+            if q:
+                take.append(q.pop(0))
+                scanned = 0
+            else:
+                scanned += 1
+            idx += 1
+        self._rr[signature] = idx % len(order)
+        taken = set(map(id, take))
+        remainder = [t for t in pending if id(t) not in taken]
+        self._buckets[signature] = remainder
+        # the remainder's backstop deadline restarts — worst case one
+        # extra flush window, and the completion hook usually assembles
+        # it far sooner
+        self._deadlines[signature] = (
+            time.monotonic() + self.flush_deadline
+        )
+        return take
+
+    def _batch_completed(self) -> None:
+        """Continuous-mode completion hook (runs on the dispatch lane as
+        each batch finishes): free the lane's budget slot and assemble
+        the next batch(es) from the pooled signatures, oldest deadline
+        first — the lane goes straight back to work."""
+        with self._lock:
+            self._inflight_batches = max(0, self._inflight_batches - 1)
+            while (
+                self._inflight_batches < self._lane_budget
+                and self._buckets
+            ):
+                sig = (
+                    min(self._deadlines, key=self._deadlines.get)
+                    if self._deadlines
+                    else next(iter(self._buckets))
+                )
+                if not self._flush_locked(sig):
+                    break
+            self._lock.notify_all()
 
     def _timer_loop(self) -> None:
         with self._lock:
@@ -706,6 +844,12 @@ class ShapeBucketQueue:
         everything queued has executed. WorkQueue's retry/lease policy
         applies per bucket; a bucket that exhausts its retries fails its
         tickets with the scheduler error instead of hanging them."""
+        if self.continuous:
+            with self._lock:
+                # the in-flight batch budget IS the lane count: one
+                # batch per lane keeps every lane busy with zero
+                # head-of-line queueing inside the work queue
+                self._lane_budget = max(int(num_lanes), 1)
 
         def fold(task_id: int, out) -> None:
             bucket, results = out
@@ -725,16 +869,26 @@ class ShapeBucketQueue:
             # dispatch verdict — it bypasses the breaker.
             br = self.breaker_for(bucket.signature)
             try:
-                out = fit_bucket(bucket)
-            except KillSwitch:
-                raise
-            except Exception as e:
-                if br is not None and br.record_failure(e):
-                    self._emit("breaker", {
-                        "event": "open", "signature": bucket.signature,
-                        "breaker": br.snapshot(),
-                    })
-                raise
+                try:
+                    out = fit_bucket(bucket)
+                except KillSwitch:
+                    raise
+                except Exception as e:
+                    if br is not None and br.record_failure(e):
+                        self._emit("breaker", {
+                            "event": "open",
+                            "signature": bucket.signature,
+                            "breaker": br.snapshot(),
+                        })
+                    raise
+            finally:
+                if self.continuous:
+                    # the lane is free the moment this batch stops
+                    # computing — success, dispatch failure, or lane
+                    # death alike (a re-leased bucket decrements again;
+                    # the budget clamps at zero, so chaos can only
+                    # over-free, never wedge the pool)
+                    self._batch_completed()
             if br is not None and br.state != "closed":
                 self._emit("breaker", {
                     "event": "closed", "signature": bucket.signature,

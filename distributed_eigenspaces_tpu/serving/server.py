@@ -166,6 +166,8 @@ class QueryServer:
         supervise: bool = True,
         max_lane_restarts: int = 3,
         fault_hook=None,
+        continuous: bool | None = None,
+        serve_dtype: str | None = None,
     ):
         live = registry.latest()
         if d is None:
@@ -204,9 +206,23 @@ class QueryServer:
 
             compile_cache = compile_cache_for(cfg)
         self.compile_cache = compile_cache
+        if serve_dtype is None:
+            serve_dtype = (
+                getattr(cfg, "serve_dtype", "float32")
+                if cfg is not None else "float32"
+            )
+        self.serve_dtype = serve_dtype
         self.engine = engine or TransformEngine(
             self.d, self.k, mesh=mesh, cache=compile_cache,
+            serve_dtype=serve_dtype,
         )
+        if self.engine.serve_dtype != "float32":
+            # quantized serve kernels are angle-gated at the door: a
+            # basis family whose quantization error blows the 0.2°
+            # budget must fail construction, not silently serve drifted
+            # projections (ISSUE 17 — the gate that makes the bf16/int8
+            # error bound a runtime guarantee)
+            self.engine.self_check()
         # prewarm: compile the expected row-bucket kernels OFF this
         # thread (runtime/prewarm.py) so the first request of a
         # declared size runs zero compiles. `prewarm` is True (default
@@ -265,6 +281,12 @@ class QueryServer:
             # re-lease for the restarted lane — an infinite lease would
             # hang its waiters forever (the reference's exact bug)
             lease_timeout = 60.0
+        if continuous is None:
+            continuous = (
+                getattr(cfg, "serve_continuous", False)
+                if cfg is not None else False
+            )
+        self.continuous = bool(continuous)
         self.queue = ShapeBucketQueue(
             bucket_size=bucket_size,
             flush_deadline=flush_s,
@@ -275,6 +297,7 @@ class QueryServer:
             breaker_threshold=breaker_threshold,
             breaker_cooldown_s=breaker_cooldown_s,
             on_event=self._queue_event,
+            continuous=self.continuous,
         )
         self._num_lanes = max(num_lanes, 1)
         self._watchdog: LaneWatchdog | None = None
@@ -423,13 +446,16 @@ class QueryServer:
 
     # -- client API ----------------------------------------------------------
 
-    def submit(self, x):
+    def submit(self, x, *, tenant=None):
         """Admit one query; returns its ticket. Width is validated HERE
         (a malformed request must fail its caller at the door, not a
         batch three layers down). Admission failures are the documented
         server-boundary errors: :class:`ServerClosed` after
         ``close()``, :class:`ServerOverloaded` when bounded admission
-        sheds, ``BreakerOpen`` when this signature is fast-failing."""
+        sheds, ``BreakerOpen`` when this signature is fast-failing.
+        ``tenant`` is the continuous-batching fairness key: batch
+        assembly round-robins over tenant ids, so a flooding tenant
+        cannot starve the others (ignored in deadline mode)."""
         arr = np.asarray(x, np.float32)
         if arr.ndim == 1:
             arr = arr[None, :]
@@ -449,6 +475,7 @@ class QueryServer:
             ticket = self.queue.submit(
                 (self.d, self.k),
                 _QueryRequest(x=arr, t_submit=t0, trace_id=tid),
+                tenant=tenant,
             )
         except QueueClosed as e:
             raise ServerClosed(
@@ -706,11 +733,39 @@ class QueryServer:
                         parent=dspan, category="serve",
                     )
         if self.metrics is not None:
+            from distributed_eigenspaces_tpu.serving.transform import (
+                bucket_rows,
+            )
+
+            rows_total = int(sum(r.x.shape[0] for r in reqs))
+            rows_served = int(sum(reqs[i].x.shape[0] for i in good))
+            padded = (
+                bucket_rows(
+                    rows_served,
+                    min_bucket=self.engine.min_bucket,
+                    multiple_of=self.engine._row_multiple,
+                ) - rows_served
+                if rows_served else 0
+            )
             self.metrics.serve({
                 "kind": "batch",
                 "queries": len(reqs),
                 "rejected": len(fails),
-                "rows": int(sum(r.x.shape[0] for r in reqs)),
+                "rows": rows_total,
+                # occupancy attribution (ISSUE 17 satellite): zero-rows
+                # the kernel computed for padding, the kernel-level fill
+                # fraction, and each request's admit→dispatch wait (the
+                # continuous-vs-deadline headline number)
+                "padded_rows": padded,
+                "fill_fraction": (
+                    round(rows_served / (rows_served + padded), 4)
+                    if rows_served else 0.0
+                ),
+                "admit_to_dispatch_s": [
+                    round(
+                        max(0.0, bucket.t_dispatch - r.t_submit), 6
+                    ) for r in reqs
+                ] if bucket.t_dispatch is not None else [],
                 "batch_seconds": round(now - t0, 6),
                 "signature": [self.d, self.k],
                 "compile_misses": (

@@ -103,11 +103,18 @@ class TransformEngine:
     """
 
     def __init__(self, d: int, k: int, *, dtype=jnp.float32, mesh=None,
-                 min_bucket: int = 8, cache=None, basis_spec=None):
+                 min_bucket: int = 8, cache=None, basis_spec=None,
+                 serve_dtype: str = "float32"):
         if not (0 < k <= d):
             raise ValueError(f"need 0 < k <= d, got k={k}, d={d}")
+        if serve_dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"unknown serve_dtype: {serve_dtype!r} "
+                "(float32/bfloat16/int8)"
+            )
         self.d = int(d)
         self.k = int(k)
+        self.serve_dtype = serve_dtype
         self.dtype = jnp.dtype(dtype)
         self.mesh = mesh
         self.min_bucket = min_bucket
@@ -147,8 +154,53 @@ class TransformEngine:
         self.tracer = None
         prec = _precision_for(self.dtype)
 
-        def project(x, v):
+        def project_exact(x, v):
             return jnp.matmul(x, v.astype(x.dtype), precision=prec)
+
+        def project_quant(x, v):
+            # the quantized serve kernels (ISSUE 17): Pallas on TPU
+            # with legal tiles, the equivalent one-jit XLA twin
+            # everywhere else (interpret-mode Pallas is a correctness
+            # tool, not a CPU fast path). Both keep the fp32 basis an
+            # OPERAND — int8 quantizes it IN-program (per-column
+            # symmetric absmax) with the dequant fused into the
+            # matmul, so a hot swap still recompiles nothing.
+            from distributed_eigenspaces_tpu.ops.pallas_gram import (
+                quantize_basis_i8,
+                serve_blocks,
+                serve_project_i8_pallas,
+                serve_project_pallas,
+            )
+
+            rows, dd = x.shape
+            on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+            br, bd = serve_blocks(int(rows), int(dd), x.dtype)
+            if on_tpu and br is not None and bd is not None:
+                if self.serve_dtype == "int8":
+                    q, s = quantize_basis_i8(v)
+                    return serve_project_i8_pallas(
+                        x, q, s, block_rows=br, block_d=bd
+                    )
+                return serve_project_pallas(
+                    x, v, block_rows=br, block_d=bd
+                )
+            xb = x.astype(jnp.bfloat16)
+            if self.serve_dtype == "int8":
+                q, s = quantize_basis_i8(v)
+                z = jnp.matmul(
+                    xb, q.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32,
+                )
+                return z * s
+            return jnp.matmul(
+                xb, v.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+
+        project = (
+            project_exact if self.serve_dtype == "float32"
+            else project_quant
+        )
 
         def reconstruct(z, v):
             return jnp.matmul(z, v.T.astype(z.dtype), precision=prec)
@@ -176,7 +228,11 @@ class TransformEngine:
         # reconstruction is row-local back onto the shards, zero
         # collectives
         def project_sharded(x, v):
-            z = jnp.matmul(x, v.astype(x.dtype), precision=prec)
+            # fused dequant->project->psum: each feature shard projects
+            # against ITS row slice of the basis (quantized modes scale
+            # per shard — dequant lands before the reduce, so the psum
+            # payload stays the k-wide fp32 partial either way)
+            z = project(x, v)
             return lax.psum(z, FEATURE_AXIS)
 
         def residual_sharded(x, z):
@@ -294,6 +350,7 @@ class TransformEngine:
                     None if self.mesh is None
                     else tuple(self.mesh.shape.items()),
                     self.basis_spec,
+                    self.serve_dtype,
                 ),
                 str(self.dtype),
             )
@@ -318,6 +375,81 @@ class TransformEngine:
         tests audit its HLO for collectives; does not bump counters
         beyond a normal cache access."""
         return self._compiled(kind, rows)
+
+    def self_check(
+        self,
+        v=None,
+        *,
+        budget_deg: float = 0.2,
+        rows: int = 64,
+        seed: int = 0,
+    ) -> float:
+        """Per-kernel startup gate (ISSUE 17): project a deterministic
+        query batch through this engine's serve kernel and compare
+        against the exact fp32 matmul. ``serve_dtype='float32'`` must be
+        BIT-exact; the quantized kernels must keep every row's
+        projection within ``budget_deg`` degrees of the exact one.
+        Raises ``ValueError`` on breach; returns the measured worst
+        angle in degrees. ``v=None`` checks against a seeded random
+        orthonormal basis (the construction-time gate); pass the live
+        basis to gate a specific version.
+
+        Probe rows carry DOMINANT in-subspace energy plus moderate
+        orthogonal noise — the PCA serve regime. A near-orthogonal
+        query's tiny projection amplifies kernel rounding by
+        ``||x|| / ||z|| ~ sqrt(d/k)``, which measures the query's
+        conditioning, not the kernel's fidelity; on representative
+        rows the bound is tight and a breach means a broken kernel,
+        not an unlucky probe."""
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        if v is None:
+            q, _ = np.linalg.qr(
+                rng.standard_normal((self.d, self.k))
+            )
+            v = np.asarray(q[:, : self.k], np.float32)
+        else:
+            v = np.asarray(v, np.float32)
+        coeffs = rng.standard_normal((rows, self.k))
+        noise = rng.standard_normal((rows, self.d))
+        noise *= (
+            0.3
+            * np.linalg.norm(coeffs, axis=1, keepdims=True)
+            / np.maximum(
+                np.linalg.norm(noise, axis=1, keepdims=True), 1e-12
+            )
+        )
+        x = np.asarray(coeffs @ v.T + noise, np.float32)
+        z = np.asarray(self.project(x, v))
+        z_ref = np.asarray(jnp.matmul(
+            jnp.asarray(x), jnp.asarray(v),
+            precision=jax.lax.Precision.HIGHEST,
+        ))
+        if self.serve_dtype == "float32":
+            if not np.array_equal(z, z_ref):
+                raise ValueError(
+                    "serve_dtype='float32' self-check failed: the "
+                    "padded bucket projection is not bit-exact against "
+                    "the direct matmul (max abs err "
+                    f"{float(np.abs(z - z_ref).max()):.3e})"
+                )
+            return 0.0
+        num = np.sum(z * z_ref, axis=1)
+        den = (
+            np.linalg.norm(z, axis=1) * np.linalg.norm(z_ref, axis=1)
+        )
+        ok = den > 1e-12
+        cos = np.clip(num[ok] / den[ok], -1.0, 1.0)
+        worst = float(np.degrees(np.arccos(cos)).max()) if ok.any() else 0.0
+        if worst > budget_deg:
+            raise ValueError(
+                f"serve_dtype={self.serve_dtype!r} self-check failed: "
+                f"worst projection angle {worst:.4f} deg exceeds the "
+                f"{budget_deg} deg budget — the quantized kernel is "
+                "mis-projecting (refusing to serve drifted answers)"
+            )
+        return worst
 
     def stats(self) -> dict:
         out = {

@@ -40,6 +40,8 @@ from jax import lax
 
 from distributed_eigenspaces_tpu.ops.linalg import canonicalize_signs
 from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+    _chol_apply,
+    _chol_qr,
     _collective_ops,
     _psum_if,
     _small_eigh_desc,
@@ -59,6 +61,7 @@ __all__ = [
     "dist_rayleigh_ritz",
     "dist_subspace_eig",
     "factor_matvec",
+    "fused_factor_matvec",
     "lowrank_matvec",
     "merged_top_k_distributed",
 ]
@@ -110,6 +113,7 @@ def dist_subspace_eig(
     axis_name: str | None = FEATURE_AXIS,
     v0: jax.Array | None = None,
     oversample: int = 0,
+    matvec_gram=None,
 ):
     """Top-k invariant subspace of a symmetric PSD operator by blocked
     randomized subspace iteration with the rows sharded over
@@ -127,7 +131,20 @@ def dist_subspace_eig(
     (blended with norm-matched noise, the ``worker_subspace_sharded``
     rule, so a zero ``v0`` degrades to the random init).
     ``axis_name=None`` runs the identical schedule unsharded — the
-    root-tier / single-device degenerate."""
+    root-tier / single-device degenerate.
+
+    ``matvec_gram`` (``axis_name=None`` only, e.g.
+    :func:`fused_factor_matvec`) fuses each inner sweep: it returns
+    ``(w, g) = (matvec(v), w^T w)`` in one kernel and the loop
+    finishes CholeskyQR2 from the precomputed Gram — same math, one
+    launch and one fewer pass over the operator per iteration on
+    TPU."""
+    if matvec_gram is not None and axis_name is not None:
+        raise ValueError(
+            "matvec_gram fuses a LOCAL operator with its Gram; the "
+            "sharded inner loop must psum between the matvec and the "
+            "Gram, so fusion only applies with axis_name=None"
+        )
     if key is None:
         key = jax.random.PRNGKey(0)
     if axis_name is not None:
@@ -141,8 +158,18 @@ def dist_subspace_eig(
         v = v.at[:, :k].add(v0)
     v = chol_qr2(v, axis_name)
 
-    def body(_, vi):
-        return chol_qr2(matvec(vi), axis_name)
+    if matvec_gram is None:
+
+        def body(_, vi):
+            return chol_qr2(matvec(vi), axis_name)
+
+    else:
+
+        def body(_, vi):
+            w, g = matvec_gram(vi)
+            # First CholeskyQR pass reuses the fused Gram; the second
+            # recomputes it from the orthogonalised factor (QR2).
+            return _chol_qr(_chol_apply(w, g), axis_name)
 
     v = lax.fori_loop(0, iters, body, v)
     return dist_rayleigh_ritz(v, matvec(v), axis_name)[:, :k]
@@ -166,6 +193,38 @@ def factor_matvec(c: jax.Array, axis_name: str | None = None, alive=None):
         return jnp.where(alive, out, v)
 
     return matvec
+
+
+def fused_factor_matvec(c: jax.Array, *, interpret: bool = False):
+    """``matvec_gram(v) -> (w, g)`` for an UNSHARDED factor operator
+    ``C (d, f)``: the inner-loop matvec ``w = C (C^T v)`` fused with
+    the first Gram ``g = w^T w`` that CholeskyQR2 consumes — on TPU one
+    Pallas launch (``ops.pallas_gram.matvec_gram_pallas``: two passes
+    over C, the f x k partial resident in VMEM scratch, nothing d-wide
+    materialized); elsewhere the identical-math XLA pair. The sharded
+    operator cannot fuse across its cross-shard psum, so this is the
+    local / root-tier fast path — :func:`dist_subspace_eig` takes the
+    result via ``matvec_gram=`` and finishes CholeskyQR2 from ``g``."""
+
+    def matvec_gram(v):
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+        if on_tpu or interpret:
+            from distributed_eigenspaces_tpu.ops.pallas_gram import (
+                _pick_block,
+                matvec_gram_pallas,
+            )
+
+            bd = _pick_block(c.shape[0], 512, 8)
+            if bd is not None:
+                return matvec_gram_pallas(
+                    c, v, block_d=bd, interpret=interpret
+                )
+        y = jnp.matmul(c.T, v, precision=HP)
+        w = jnp.matmul(c, y, precision=HP)
+        g = jnp.einsum("dk,dl->kl", w, w, precision=HP)
+        return w, g
+
+    return matvec_gram
 
 
 def lowrank_matvec(u: jax.Array, s: jax.Array,

@@ -219,12 +219,19 @@ class MetricsLogger:
         return {
             "events": 0, "requests": 0, "rejected": 0, "swaps": 0,
             "occ_sum": 0.0, "occ_n": 0,
+            # batch-occupancy waste ledger (ISSUE 17): padded rows per
+            # signature bucket, mean fill fraction, and the
+            # admit-to-dispatch wait histogram the continuous-batching
+            # claim is judged by
+            "padded_rows": 0, "padded_by_sig": {},
+            "fill_sum": 0.0, "fill_n": 0,
             "compile_misses": 0, "compile_stall_ms": 0.0,
             "by_sig": {}, "t_min": None, "t_max": None,
             "versions": set(),
             "slo_requests": 0, "slo_violations": 0,
             "hist": {
                 "total_s": Histogram(),
+                "admit_to_dispatch_s": Histogram(),
                 **{k: Histogram() for k in DECOMP_KEYS},
             },
         }
@@ -589,6 +596,22 @@ class MetricsLogger:
         if "occupancy" in rec:
             agg["occ_sum"] += rec["occupancy"]
             agg["occ_n"] += 1
+        pad = rec.get("padded_rows", 0)
+        agg["padded_rows"] += pad
+        if pad and "signature" in rec:
+            sig = str(tuple(rec["signature"]))
+            agg["padded_by_sig"][sig] = (
+                agg["padded_by_sig"].get(sig, 0) + pad
+            )
+        ff = rec.get("fill_fraction")
+        if ff is not None:
+            agg["fill_sum"] += float(ff)
+            agg["fill_n"] += 1
+        for a in rec.get("admit_to_dispatch_s") or ():
+            if a is not None:
+                agg["hist"]["admit_to_dispatch_s"].record(
+                    max(float(a), 1e-6)
+                )
         agg["compile_misses"] += rec.get("compile_misses", 0)
         stall = rec.get("compile_stall_ms", 0.0)
         agg["compile_stall_ms"] += stall
@@ -751,6 +774,60 @@ class MetricsLogger:
                 by_sig[sig] = round(by_sig.get(sig, 0.0) + stall, 3)
         if by_sig:
             out["compile_stall_ms_by_signature"] = by_sig
+        return out
+
+    def _occupancy_fields(self, batches: list[dict], agg: dict) -> dict:
+        """Batch-occupancy metrics for the serving section (ISSUE 17):
+        mean fill fraction (served rows / dispatched rows after bucket
+        padding), padded-row waste per signature bucket, and
+        admit-to-dispatch wait p50/p99 — the number continuous batching
+        exists to shrink. Percentiles follow the latency-section rule:
+        exact over the live window, log-bucket histogram estimates once
+        the ring has evicted."""
+        out: dict = {}
+        fills = [
+            r["fill_fraction"] for r in batches if "fill_fraction" in r
+        ]
+        fill_n = agg["fill_n"] + len(fills)
+        if fill_n:
+            out["mean_fill_fraction"] = round(
+                (agg["fill_sum"] + sum(fills)) / fill_n, 4
+            )
+        total_pad = agg["padded_rows"] + sum(
+            r.get("padded_rows", 0) for r in batches
+        )
+        if total_pad:
+            out["padded_rows"] = total_pad
+            by_sig: dict[str, int] = dict(agg["padded_by_sig"])
+            for r in batches:
+                pad = r.get("padded_rows", 0)
+                if pad and "signature" in r:
+                    sig = str(tuple(r["signature"]))
+                    by_sig[sig] = by_sig.get(sig, 0) + pad
+            if by_sig:
+                out["padded_rows_by_signature"] = by_sig
+        admits = [
+            float(a)
+            for r in batches
+            for a in (r.get("admit_to_dispatch_s") or ())
+            if a is not None
+        ]
+        evicted = agg["hist"]["admit_to_dispatch_s"].count > 0
+        if admits and not evicted:
+            ws = sorted(admits)
+            out["admit_to_dispatch_p50_s"] = round(ws[len(ws) // 2], 6)
+            out["admit_to_dispatch_p99_s"] = round(
+                ws[min(len(ws) - 1, int(len(ws) * 0.99))], 6
+            )
+        elif evicted:
+            h = agg["hist"]["admit_to_dispatch_s"].copy()
+            h.record_many(max(a, 1e-6) for a in admits)
+            out["admit_to_dispatch_p50_s"] = round(
+                h.quantile(0.5) or 0.0, 6
+            )
+            out["admit_to_dispatch_p99_s"] = round(
+                h.quantile(0.99) or 0.0, 6
+            )
         return out
 
     def _latency_fields(self, records: list[dict], agg: dict) -> dict:
@@ -1076,6 +1153,7 @@ class MetricsLogger:
                 r["version"] for r in batches if "version" in r
             }
             out["versions_served"] = sorted(versions)
+            out.update(self._occupancy_fields(batches, agg))
             out.update(self._stall_fields(batches, agg))
             out.update(self._latency_fields(batches, agg))
         health = self._health_summary()

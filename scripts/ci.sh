@@ -29,7 +29,14 @@
 #      direct estimator.transform BIT-FOR-BIT and that the swap
 #      recompiled nothing; compared (qps normalized + p99 floor)
 #      against the committed BENCH_SERVE_SMOKE_CPU.json;
-#   5. bench.py --coldstart: the zero-cold-start smoke — subprocess A/B
+#   5. bench.py --wirespeed: the ISSUE-17 read-path A/B — continuous
+#      batching vs deadline dispatch on one saturating multi-tenant
+#      burst with a mid-burst publisher hot-swap, gated on bit-exact /
+#      angle-budget answers, zero-recompile swaps, admit-p99
+#      improvement, and the declared serve SLO; compared against the
+#      committed BENCH_WIRESPEED_SMOKE_CPU.json (same serve_dtype
+#      records only — cross-dtype ratios skip loudly);
+#   6. bench.py --coldstart: the zero-cold-start smoke — subprocess A/B
 #      of first-fit / first-serve wall time with cold vs warm
 #      persistent compile cache (utils/compile_cache.py). The bench
 #      itself asserts the hard gates: results BIT-IDENTICAL
@@ -40,25 +47,25 @@
 #      CPU-tolerant floor (the speedup is dimensionless — rig speed
 #      divides itself out — so the floor only catches amortization
 #      drift, not session jitter);
-#   6. telemetry smoke: a serve burst with --trace-out — validates the
+#   7. telemetry smoke: a serve burst with --trace-out — validates the
 #      emitted Chrome trace-event JSON parses, every served query's
 #      span chain (admit → queue_wait → dispatch → compute → reply)
 #      shares one trace_id, and the bench record's slo section is
 #      populated (docs/OBSERVABILITY.md names the span taxonomy this
 #      stage pins);
-#   7. bench.py --chaos-serve: the read-path resilience smoke (ISSUE
+#   8. bench.py --chaos-serve: the read-path resilience smoke (ISSUE
 #      7) — kill -9 mid-publish + durable-registry restart-recovery
 #      (bit-exact, zero refit), overload load-shed, per-signature
 #      breaker isolation, and serve-lane kill + watchdog restart, all
 #      gated by the bench itself; compared (recovery_ms ratio +
 #      structural bound) against the committed BENCH_CHAOS_SMOKE_CPU;
-#   8. bench.py --chaos-churn: the fit-tier elastic-membership smoke
+#   9. bench.py --chaos-churn: the fit-tier elastic-membership smoke
 #      (ISSUE 8) — 30% worker loss + flapping rejoin + persistent
 #      straggler inside the angle budget with zero deadlocks, quorum
 #      loss loud within 2x heartbeat timeout + checkpoint auto-resume,
 #      all gated by the bench itself; compared (churn_recovery_ms
 #      ratio + structural bound) vs the committed BENCH_CHURN_SMOKE_CPU;
-#   9. bench.py --population: the population-ingest smoke (ISSUE 16) —
+#   10. bench.py --population: the population-ingest smoke (ISSUE 16) —
 #      a 100k-client simulated population sampled 256 per round under
 #      30% dropout + a dropout wave + 5% Byzantine poison: the hardened
 #      pipeline (gauntlet -> norm clip -> trimmed mean -> affinity
@@ -69,7 +76,7 @@
 #      gates recovery-angle drift against the committed
 #      BENCH_POPULATION_SMOKE_CPU.json (old/new ratio + the record's
 #      own angle budget as the structural floor);
-#   10. bench.py --replica: the replicated-registry fleet smoke (ISSUE
+#   11. bench.py --replica: the replicated-registry fleet smoke (ISSUE
 #      14) — a kill -9'd publisher (lease live) fails over to a standby
 #      at epoch+1 within the bounded window with zero duplicate version
 #      ids; the zombie's identity is fenced store-side (LeaseLost) AND
@@ -80,7 +87,7 @@
 #      propagation-p99 drift against the committed
 #      BENCH_REPLICA_SMOKE_CPU.json (old/new ratio + the record's own
 #      staleness bound as the structural floor);
-#   11. bench.py --tree: the hierarchical-merge smoke (ISSUE 12) —
+#   12. bench.py --tree: the hierarchical-merge smoke (ISSUE 12) —
 #      the same planted fit flat vs the chip:4 x host:2 tree: both
 #      inside the angle budget and agreeing with each other, the
 #      tiered program passing its tree_merge contract, and the
@@ -89,7 +96,7 @@
 #      headline win, reported as the payload-reduction ratio); the
 #      compare gates that structural ratio against the committed
 #      BENCH_TREE_SMOKE_CPU.json (same-topology records only);
-#   12. bench.py --dsolve: the distributed-eigensolve crossover smoke
+#   13. bench.py --dsolve: the distributed-eigensolve crossover smoke
 #      (ISSUE 15) — a planted-basis sweep over d where the blocked
 #      subspace iteration (factor matvecs only) must match the dense
 #      eigh merge/extract inside the angle budget at every d AND beat
@@ -99,7 +106,7 @@
 #      sizes; the compare gates the dimensionless extract-speedup
 #      ratio against the committed BENCH_DSOLVE_SMOKE_CPU.json
 #      (same-dims records only — a cross-sweep ratio skips loudly);
-#   13. scripts/scenario.py: the production-shaped scenario replay
+#   14. scripts/scenario.py: the production-shaped scenario replay
 #      (ISSUE 11) — a 3-episode composition (flash crowd + lane kill,
 #      correlated fit-tier churn, mid-burst registry publish) replayed
 #      from scenarios/ci_smoke.json against the full stack, judged
@@ -110,7 +117,7 @@
 #      the committed BENCH_SCENARIO_SMOKE_CPU.json (ratio floors + a
 #      10 s structural recovery bound + a 0.5 absolute attainment
 #      floor, so CPU-rig jitter can't flap CI);
-#   14. scripts/analyze.py --all --costs --shardings --mutation-check:
+#   15. scripts/analyze.py --all --costs --shardings --mutation-check:
 #      the static program-contract gate (ISSUE 10 + 13,
 #      docs/ANALYSIS.md) — every program kind audited against its
 #      declarative contract (collective schedule + payload bounds,
@@ -122,12 +129,12 @@
 #      class is caught. ruff (the dev extra / Dockerfile image) runs
 #      first when on PATH; a missing ruff now SKIPS LOUDLY instead of
 #      silently (DET_CI_REQUIRE_RUFF=1 turns the skip into a failure);
-#   15. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   16. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/15] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/16] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -135,7 +142,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/15] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/16] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -145,7 +152,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/15] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/16] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -160,7 +167,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/15] serve equality + amortization smoke (CPU) =="
+echo "== [4/16] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -175,7 +182,28 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/15] coldstart + prewarm smoke (CPU) =="
+echo "== [5/16] wirespeed smoke: continuous batching + quantized kernels (CPU) =="
+# bench.py --wirespeed asserts the ISSUE-17 read-path gates itself:
+# one saturating multi-tenant burst served twice (deadline dispatch vs
+# continuous batching) with a publisher hot-swap MID-burst in each arm
+# — answers equal to the direct estimator.transform (bit-for-bit at
+# serve_dtype=float32, worst row angle <= 0.2 deg quantized), the swap
+# at zero compile misses, continuous admit-to-dispatch p99 strictly
+# under the deadline arm's, and request p99 under cfg.serve_slo_p99_ms.
+# The record also carries the fp32/bf16/int8 serve-kernel and fused
+# matvec+Gram timing table BASELINE.md cites. The compare gates
+# admit-p99 drift against the committed record (old/new ratio + a
+# structural bound so scheduler-wakeup jitter can't flap CI;
+# cross-serve_dtype records are not comparable and skip loudly).
+if [[ -f BENCH_WIRESPEED_SMOKE_CPU.json ]]; then
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --wirespeed \
+        --compare BENCH_WIRESPEED_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --wirespeed
+fi
+
+echo "== [6/16] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -190,7 +218,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [6/15] telemetry smoke: trace export + span-chain validation =="
+echo "== [7/16] telemetry smoke: trace export + span-chain validation =="
 # A serve burst with --trace-out, then a structural validation of the
 # emitted timeline: the JSON must parse as Chrome trace-event format,
 # every served query's span chain (admit → queue_wait → dispatch →
@@ -235,7 +263,7 @@ print(json.dumps({
 }))
 PY
 
-echo "== [7/15] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
+echo "== [8/16] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
 # bench.py --chaos-serve asserts the read-path resilience gates itself
 # (ISSUE 7): a kill -9'd publisher's store recovers (torn snapshot
 # skipped, checksum corruption quarantined) and the restarted server
@@ -254,7 +282,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve
 fi
 
-echo "== [8/15] chaos-churn smoke: elastic membership under churn (CPU) =="
+echo "== [9/16] chaos-churn smoke: elastic membership under churn (CPU) =="
 # bench.py --chaos-churn asserts the fit-tier elastic-membership gates
 # itself (ISSUE 8): a run with 30% mid-run worker loss, flapping
 # rejoins, and a persistent straggler finishes all steps inside the
@@ -274,7 +302,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-churn
 fi
 
-echo "== [9/15] population ingest smoke: cohorts + Byzantine merge (CPU) =="
+echo "== [10/16] population ingest smoke: cohorts + Byzantine merge (CPU) =="
 # bench.py --population asserts the population-scale ingest gates
 # itself (ISSUE 16): a 100k-client simulated population, cohort 256
 # per round, 30% dropout + a mid-run dropout wave + stragglers + NaN
@@ -299,7 +327,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --population
 fi
 
-echo "== [10/15] replica fleet smoke: lease failover + bounded staleness (CPU) =="
+echo "== [11/16] replica fleet smoke: lease failover + bounded staleness (CPU) =="
 # bench.py --replica asserts the replicated-registry gates itself
 # (ISSUE 14): N replicas warm-recover a kill -9'd publisher's store
 # bit-exact; a standby waits out the live lease and takes over at
@@ -321,7 +349,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --replica
 fi
 
-echo "== [11/15] tree-merge smoke: flat vs tiered tree (CPU) =="
+echo "== [12/16] tree-merge smoke: flat vs tiered tree (CPU) =="
 # bench.py --tree asserts the hierarchical-merge gates itself (ISSUE
 # 12): the same planted fit run flat and through the chip:4 x host:2
 # tree must both land inside the angle budget AND agree with each
@@ -340,7 +368,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --tree
 fi
 
-echo "== [12/15] dsolve crossover smoke: eigh vs distributed solve (CPU) =="
+echo "== [13/16] dsolve crossover smoke: eigh vs distributed solve (CPU) =="
 # bench.py --dsolve asserts the distributed-eigensolve gates itself
 # (ISSUE 15): at every swept d the blocked subspace iteration (factor
 # matvecs + CholeskyQR2 + replicated Rayleigh-Ritz, never a d x d
@@ -362,7 +390,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --dsolve
 fi
 
-echo "== [13/15] scenario replay: production-shaped composition (CPU) =="
+echo "== [14/16] scenario replay: production-shaped composition (CPU) =="
 # scripts/scenario.py replays scenarios/ci_smoke.json — a flash crowd
 # with a mid-crowd lane kill, correlated fit-tier worker churn, and a
 # mid-burst registry publish on one timeline — and judges it purely
@@ -382,7 +410,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --scenario scenarios/ci_smoke.json
 fi
 
-echo "== [14/15] static analysis: contracts + shardings + costs + lints + mutations =="
+echo "== [15/16] static analysis: contracts + shardings + costs + lints + mutations =="
 # scripts/analyze.py compiles (never runs) the whole program matrix and
 # audits each program against its contract — collective schedule,
 # memory policy, baked constants, and (ISSUE 13) the declared
@@ -410,7 +438,7 @@ fi
 JAX_PLATFORMS=cpu python scripts/analyze.py --all --costs --shardings \
     --mutation-check
 
-echo "== [15/15] graft entry + 8-device sharded dryrun =="
+echo "== [16/16] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

@@ -45,7 +45,7 @@ def test_matrix_covers_every_contract_kind(devices):
         for n in (
             "scan_solo", "feature_scan", "fleet_b8", "serve_project",
             "tree_fit", "dist_merge", "dist_serve_project",
-            "population_reduce",
+            "population_reduce", "pallas_serve_project_bf16",
         )
     }
     assert kinds == set(contracts.CONTRACTS)
@@ -203,3 +203,42 @@ def test_analyze_cli_json_key_set(devices, tmp_path):
     assert costs["ok"] and costs["claims_ok"]
     assert costs["drift"] == []
     assert costs["snapshot"]["schema"] == "analysis-costs-v1"
+
+
+# -- ISSUE 17: Pallas serve-kernel audit -------------------------------------
+
+
+@pytest.mark.parametrize("name", [
+    "pallas_serve_project_bf16",
+    "pallas_serve_project_i8",
+    "pallas_matvec_gram",
+])
+def test_pallas_serve_programs_blocks_bounded(devices, name):
+    """The audited serve kernels keep every kernel-ref block under the
+    serve_pallas VMEM budget, and the checker actually SAW pallas
+    calls (require_pallas guards against the audit silently tracing an
+    XLA fallback)."""
+    built = programs.build_program(name)
+    viols, detail = contracts.check_program(built)
+    assert not viols, [v.format() for v in viols]
+    pal = detail["pallas"]
+    assert pal["n_pallas_calls"] >= 1
+    assert pal["max_block_elems_seen"] <= pal["block_bound_elems"]
+    # the serve kernels must never stage a d-wide full operand block
+    p = built.params
+    assert pal["max_block_elems_seen"] < p.rows * p.d
+
+
+def test_pallas_full_block_mutant_caught(devices):
+    """The seeded mutation pin (ISSUE 17 satellite): a pallas_call
+    staging the FULL (rows, d) operand as one block blows the
+    serve_pallas block budget and is named by ref and shape."""
+    from distributed_eigenspaces_tpu.analysis import mutations
+
+    rule, runner = mutations.MUTATIONS["pallas_full_block"]
+    assert rule == "pallas-block"
+    viols = runner()
+    hits = [v for v in viols if v.rule == rule]
+    assert hits, [v.format() for v in viols]
+    v = hits[0]
+    assert "block" in v.message and "elems" in v.message

@@ -82,3 +82,121 @@ def test_gram_pallas_block200_interpret(rng):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(gram(x)), atol=2e-5
     )
+
+
+# -- ISSUE 17: fused serve kernel family (interpret mode on CPU) -------------
+
+
+def test_serve_project_bf16_matches_xla_twin(rng):
+    from distributed_eigenspaces_tpu.ops.pallas_gram import (
+        serve_project_pallas,
+    )
+
+    x = rng.standard_normal((256, 128)).astype(np.float32)
+    v = np.linalg.qr(
+        rng.standard_normal((128, 8))
+    )[0].astype(np.float32)
+    got = np.asarray(serve_project_pallas(
+        jnp.asarray(x), jnp.asarray(v),
+        block_rows=64, block_d=128, interpret=True,
+    ))
+    # the XLA twin the engine falls back to off-TPU: cast x to bf16,
+    # accumulate fp32
+    want = np.asarray(jnp.matmul(
+        jnp.asarray(x).astype(jnp.bfloat16),
+        jnp.asarray(v).astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ))
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_serve_project_i8_matches_quantize_then_matmul(rng):
+    from distributed_eigenspaces_tpu.ops.pallas_gram import (
+        quantize_basis_i8,
+        serve_project_i8_pallas,
+    )
+
+    x = rng.standard_normal((128, 256)).astype(np.float32)
+    v = np.linalg.qr(
+        rng.standard_normal((256, 4))
+    )[0].astype(np.float32)
+    q, scale = quantize_basis_i8(jnp.asarray(v))
+    got = np.asarray(serve_project_i8_pallas(
+        jnp.asarray(x), q, scale,
+        block_rows=64, block_d=128, interpret=True,
+    ))
+    # the kernel feeds the MXU in bf16 (x cast; int8 magnitudes are
+    # exact in bf16), so the twin casts identically
+    want = np.asarray(
+        jnp.matmul(
+            jnp.asarray(x).astype(jnp.bfloat16),
+            q.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        ) * scale
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_quantize_basis_i8_roundtrip_properties(rng):
+    from distributed_eigenspaces_tpu.ops.pallas_gram import (
+        quantize_basis_i8,
+    )
+
+    v = rng.standard_normal((64, 5)).astype(np.float32)
+    v[:, 2] = 0.0  # all-zero column must quantize exactly
+    q, scale = quantize_basis_i8(jnp.asarray(v))
+    q = np.asarray(q)
+    scale = np.asarray(scale)
+    assert q.dtype == np.int8 and scale.shape == (1, 5)
+    assert np.abs(q).max() <= 127
+    assert not q[:, 2].any() and scale[0, 2] == 0.0
+    # per-column symmetric: dequant error bounded by half a step
+    err = np.abs(q * scale - v)
+    assert (err <= 0.5 * np.maximum(scale, 1e-12) + 1e-7).all()
+
+
+def test_matvec_gram_fused_matches_unfused(rng):
+    from distributed_eigenspaces_tpu.ops.pallas_gram import (
+        matvec_gram_pallas,
+    )
+
+    c = rng.standard_normal((256, 32)).astype(np.float32)
+    v = np.linalg.qr(
+        rng.standard_normal((256, 6))
+    )[0].astype(np.float32)
+    w, g = matvec_gram_pallas(
+        jnp.asarray(c), jnp.asarray(v), block_d=64, interpret=True
+    )
+    w, g = np.asarray(w), np.asarray(g)
+    w_ref = c @ (c.T @ v)
+    np.testing.assert_allclose(w, w_ref, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(g, w_ref.T @ w_ref, rtol=1e-4, atol=1e-2)
+    # g really is the Gram of the RETURNED w, as CholeskyQR assumes
+    np.testing.assert_allclose(g, w.T @ w, rtol=1e-5, atol=1e-3)
+
+
+def test_serve_project_rejects_misaligned_blocks(rng):
+    from distributed_eigenspaces_tpu.ops.pallas_gram import (
+        serve_project_pallas,
+    )
+
+    x = jnp.zeros((100, 128), jnp.float32)
+    v = jnp.zeros((128, 4), jnp.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        serve_project_pallas(
+            x, v, block_rows=64, block_d=128, interpret=True
+        )
+
+
+def test_serve_blocks_legality():
+    from distributed_eigenspaces_tpu.ops.pallas_gram import serve_blocks
+
+    br, bd = serve_blocks(256, 1024)
+    assert br is not None and bd is not None
+    assert 256 % br == 0 and 1024 % bd == 0
+    assert bd % 128 == 0 or bd == 1024
+    # full-dim blocks are always legal, even ragged primes
+    assert serve_blocks(7, 13) == (7, 13)
+    # over-target dims with no aligned divisor -> loud (None, None)
+    assert serve_blocks(600, 1300) == (None, None)

@@ -349,3 +349,167 @@ def test_zero_deadline_flushes_every_submit():
     q.serve(fit)
     assert buckets == [1, 1]  # padded solo serving: one bucket each
     assert t1.result(timeout=5) == "a" and t2.result(timeout=5) == "b"
+
+
+# -- continuous batching (ISSUE 17) ------------------------------------------
+
+
+def test_continuous_first_submit_dispatches_then_pools():
+    """The admission state machine: a free lane takes work the moment
+    it arrives; while every lane is busy, requests POOL for the next
+    in-flight batch instead of waiting out a deadline."""
+    q = _bucket_queue(bucket_size=8, flush_deadline=60.0,
+                      start_timer=False, continuous=True)
+    sig = ("s",)
+    first = q.submit(sig, 0)
+    # dispatched immediately: nothing pending, one batch in flight
+    assert sig not in q._buckets
+    assert q._inflight_batches == 1
+    rest = [q.submit(sig, i) for i in range(1, 4)]
+    assert len(q._buckets[sig]) == 3  # pooled behind the busy lane
+    q.close()
+    batches = []
+
+    def fit(bucket):
+        batches.append([t.payload for t in bucket.tickets])
+        return [t.payload for t in bucket.tickets]
+
+    q.serve(fit)
+    assert first.result(timeout=5) == 0
+    assert [t.result(timeout=5) for t in rest] == [1, 2, 3]
+    # the pooled trio rode ONE follow-up batch, not three deadline
+    # flushes — and the lane budget drained back to zero
+    assert batches == [[0], [1, 2, 3]]
+    assert q._inflight_batches == 0
+
+
+def test_continuous_off_position_is_legacy_dispatch():
+    """Off-position identity: without ``continuous`` a partial bucket
+    under a far deadline does NOT dispatch on submit — admission state
+    is exactly the legacy bucket-full-or-deadline machine."""
+    q = _bucket_queue(bucket_size=4, flush_deadline=60.0,
+                      start_timer=False)
+    q.submit(("s",), 0)
+    q.submit(("s",), 1)
+    assert len(q._buckets[("s",)]) == 2
+    assert q._inflight_batches == 0
+    # filling the bucket dispatches, as always
+    q.submit(("s",), 2)
+    q.submit(("s",), 3)
+    assert ("s",) not in q._buckets
+    assert q._inflight_batches == 1
+
+
+def test_continuous_tenant_fairness_under_flood():
+    """Adversarial single-tenant flood: the next assembled batch still
+    carries every waiting tenant (round-robin over tenant ids), so one
+    chatty tenant cannot starve the others."""
+    q = _bucket_queue(bucket_size=4, flush_deadline=60.0,
+                      start_timer=False, continuous=True)
+    sig = ("s",)
+    warm = q.submit(sig, "warm", tenant="A")  # occupies the one lane
+    flood = [q.submit(sig, f"A{i}", tenant="A") for i in range(6)]
+    tb = q.submit(sig, "B0", tenant="B")
+    tc = q.submit(sig, "C0", tenant="C")
+    q.close()
+    batches = []
+
+    def fit(bucket):
+        batches.append([t.tenant for t in bucket.tickets])
+        return [t.payload for t in bucket.tickets]
+
+    q.serve(fit)
+    assert warm.result(timeout=5) == "warm"
+    assert tb.result(timeout=5) == "B0"
+    assert tc.result(timeout=5) == "C0"
+    assert [t.result(timeout=5) for t in flood] == [
+        f"A{i}" for i in range(6)
+    ]
+    assert batches[0] == ["A"]
+    # the follow-up batch is one ticket per waiting tenant per pass:
+    # A, B, C ride together despite A's six queued requests
+    assert batches[1].count("B") == 1 and batches[1].count("C") == 1
+    assert batches[1].count("A") == 2
+
+
+def test_continuous_fairness_preserves_arrival_order_within_tenant():
+    q = _bucket_queue(bucket_size=2, flush_deadline=60.0,
+                      start_timer=False, continuous=True)
+    sig = ("s",)
+    q.submit(sig, "warm", tenant="A")
+    tickets = [q.submit(sig, f"A{i}", tenant="A") for i in range(4)]
+    q.close()
+    order = []
+
+    def fit(bucket):
+        order.extend(t.payload for t in bucket.tickets)
+        return [t.payload for t in bucket.tickets]
+
+    q.serve(fit)
+    assert [t.result(timeout=5) for t in tickets] == [
+        f"A{i}" for i in range(4)
+    ]
+    assert order == ["warm", "A0", "A1", "A2", "A3"]
+
+
+def test_flush_expired_exact_expiry_counts_actual_dispatches():
+    """ISSUE 17 satellite: a deadline that expires EXACTLY at the sweep
+    stamp dispatches once and is counted once — repeated sweeps with
+    the same stamp are idempotent (the count reports actual dispatches,
+    never how many deadlines merely looked expired)."""
+    q = _bucket_queue(bucket_size=8, flush_deadline=10.0,
+                      start_timer=False)
+    q.submit(("s",), 1)
+    dl = q._deadlines[("s",)]
+    assert q.flush_expired(now=dl) == 1
+    assert q.flush_expired(now=dl) == 0
+    assert q.flush_expired(now=dl + 100.0) == 0
+    assert ("s",) not in q._buckets and ("s",) not in q._deadlines
+
+
+def test_flush_expired_continuous_no_phantom_counts():
+    """An immediately-dispatched continuous submit leaves no residual
+    deadline for the sweep to double-count."""
+    q = _bucket_queue(bucket_size=8, flush_deadline=10.0,
+                      start_timer=False, continuous=True)
+    q.submit(("s",), 1)
+    assert q.flush_expired(now=1e18) == 0
+
+
+def test_continuous_zero_deadline_keeps_solo_dispatch_contract():
+    q = _bucket_queue(bucket_size=8, flush_deadline=0.0,
+                      start_timer=False, continuous=True)
+    t1 = q.submit(("s",), "a")
+    t2 = q.submit(("s",), "b")
+    q.close()
+    buckets = []
+
+    def fit(bucket):
+        buckets.append(len(bucket.tickets))
+        return [p.payload for p in bucket.tickets]
+
+    q.serve(fit)
+    assert buckets == [1, 1]
+    assert t1.result(timeout=5) == "a" and t2.result(timeout=5) == "b"
+
+
+def test_continuous_multi_lane_budget_tracks_num_lanes():
+    """serve(num_lanes=N) widens the in-flight budget to N batches, the
+    lanes drain a deep pool concurrently, and the budget returns to
+    zero in flight when the pool empties."""
+    q = _bucket_queue(bucket_size=2, flush_deadline=60.0,
+                      start_timer=False, continuous=True)
+    sig = ("s",)
+    tickets = [q.submit(sig, i, tenant=i % 4) for i in range(16)]
+    q.close()
+
+    def fit(bucket):
+        return [t.payload * 2 for t in bucket.tickets]
+
+    q.serve(fit, num_lanes=3)
+    assert q._lane_budget == 3
+    assert sorted(t.result(timeout=5) for t in tickets) == [
+        i * 2 for i in range(16)
+    ]
+    assert q._inflight_batches == 0
+    assert not q._buckets

@@ -566,3 +566,175 @@ class TestDrift:
         v2 = mon.refresh_now()
         assert calls and v2 is not None
         assert v2.lineage["supervised"] is False
+
+
+# -- ISSUE 17: continuous batching + quantized serve kernels -----------------
+
+
+class TestContinuousServer:
+    def test_continuous_served_equals_direct_bit_for_bit(self, fitted):
+        """Continuous admission changes WHEN batches form, never what
+        they compute: fp32 answers stay bit-equal to est.transform."""
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        qs = _queries(spec, 12, seed0=300)
+        with QueryServer(reg, cfg, continuous=True) as srv:
+            tickets = [
+                srv.submit(q, tenant=f"t{i % 3}")
+                for i, q in enumerate(qs)
+            ]
+            res = [t.result(timeout=60) for t in tickets]
+        for q, r in zip(qs, res):
+            assert np.array_equal(r.z, np.asarray(est.transform(q)))
+
+    def test_off_position_matches_continuous_bitwise(self, fitted):
+        """Flipping serve_continuous moves scheduling, not math: the
+        same queries produce byte-identical projections either way."""
+        cfg, spec, est = fitted
+        qs = _queries(spec, 6, seed0=340)
+        out = {}
+        for flag in (False, True):
+            reg = EigenbasisRegistry()
+            reg.publish_fit(est)
+            with QueryServer(reg, cfg, continuous=flag) as srv:
+                out[flag] = [
+                    srv.submit(q).result(timeout=60).z for q in qs
+                ]
+        for a, b in zip(out[False], out[True]):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    def test_continuous_nan_isolation(self, fitted):
+        """A poisoned row inside a continuously-assembled batch fails
+        only its own ticket; batchmates stay bit-exact."""
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        qs = _queries(spec, 3, seed0=360)
+        bad = qs[1].copy()
+        bad[2, 1] = np.nan
+        with QueryServer(
+            reg, cfg, continuous=True, bucket_size=3, flush_s=10.0
+        ) as srv:
+            t1 = srv.submit(qs[0], tenant="a")
+            tb = srv.submit(bad, tenant="b")
+            t2 = srv.submit(qs[2], tenant="c")
+            r1 = t1.result(timeout=60)
+            r2 = t2.result(timeout=60)
+            with pytest.raises(ValueError, match="non-finite rows"):
+                tb.result(timeout=60)
+        assert np.array_equal(r1.z, np.asarray(est.transform(qs[0])))
+        assert np.array_equal(r2.z, np.asarray(est.transform(qs[2])))
+
+    def test_occupancy_metrics_surface_in_summary(self, fitted):
+        """summary()['serving'] carries the ISSUE-17 batch-occupancy
+        block: fill fraction, padded-row waste per bucket signature,
+        and the admit-to-dispatch latency quantiles."""
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        metrics = MetricsLogger()
+        qs = _queries(spec, 10, rows=3, seed0=380)
+        with QueryServer(
+            reg, cfg, continuous=True, metrics=metrics
+        ) as srv:
+            for t in [srv.submit(q) for q in qs]:
+                t.result(timeout=60)
+        s = metrics.summary()["serving"]
+        assert 0.0 < s["mean_fill_fraction"] <= 1.0
+        assert s["padded_rows"] >= 0
+        assert isinstance(s["padded_rows_by_signature"], dict)
+        assert s["admit_to_dispatch_p50_s"] >= 0.0
+        assert (
+            s["admit_to_dispatch_p99_s"]
+            >= s["admit_to_dispatch_p50_s"]
+        )
+
+    def test_occupancy_survives_ring_eviction(self):
+        """Occupancy aggregates fold into the running block when the
+        event ring evicts, so long-lived servers keep honest totals."""
+        m = MetricsLogger(retention=8)
+        for i in range(64):
+            m.serve({
+                "kind": "batch", "queries": 2, "rows": 8,
+                "batch_seconds": 0.01,
+                "query_latency_s": [0.01, 0.02],
+                "occupancy": 0.5, "version": 1,
+                "signature": (D,), "padded_rows": 3,
+                "fill_fraction": 0.25,
+                "admit_to_dispatch_s": [0.001, 0.004],
+            })
+        s = m.summary()["serving"]
+        assert s["batches"] == 64
+        assert s["padded_rows"] == 64 * 3
+        assert s["padded_rows_by_signature"][str((D,))] == 64 * 3
+        assert abs(s["mean_fill_fraction"] - 0.25) < 1e-6
+        assert s["admit_to_dispatch_p99_s"] > 0.0
+
+
+class TestQuantizedServe:
+    def _worst_angle(self, z, z_ref):
+        z = np.asarray(z, np.float64)
+        z_ref = np.asarray(z_ref, np.float64)
+        num = np.sum(z * z_ref, axis=1)
+        den = np.linalg.norm(z, axis=1) * np.linalg.norm(z_ref, axis=1)
+        ok = den > 1e-12
+        cos = np.clip(num[ok] / den[ok], -1.0, 1.0)
+        return float(np.degrees(np.arccos(cos)).max())
+
+    @pytest.mark.parametrize("dt", ["bfloat16", "int8"])
+    def test_quantized_serve_within_angle_budget(self, fitted, dt):
+        """End-to-end ISSUE-17 gate: quantized serving keeps every
+        row's projection within 0.2 deg of the exact fp32 answer on
+        in-distribution queries."""
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        qs = _queries(spec, 6, seed0=420)
+        with QueryServer(reg, cfg, serve_dtype=dt) as srv:
+            res = [srv.submit(q).result(timeout=60) for q in qs]
+        for q, r in zip(qs, res):
+            exact = np.asarray(est.transform(q))
+            assert r.z.shape == exact.shape
+            assert self._worst_angle(r.z, exact) <= 0.2
+
+    def test_fp32_engine_self_check_is_bit_exact(self):
+        eng = TransformEngine(D, K)
+        assert eng.self_check() == 0.0
+
+    @pytest.mark.parametrize("dt", ["bfloat16", "int8"])
+    def test_quantized_self_check_reports_small_angle(self, dt):
+        eng = TransformEngine(D, K, serve_dtype=dt)
+        worst = eng.self_check()
+        assert 0.0 <= worst <= 0.2
+
+    def test_self_check_breach_refuses_to_serve(self):
+        """An impossible budget trips the startup gate loudly instead
+        of serving drifted projections."""
+        eng = TransformEngine(D, K, serve_dtype="int8")
+        with pytest.raises(ValueError, match="self-check failed"):
+            eng.self_check(budget_deg=1e-9)
+
+    def test_unknown_serve_dtype_rejected(self):
+        with pytest.raises(ValueError, match="serve_dtype"):
+            TransformEngine(D, K, serve_dtype="fp8")
+
+    def test_quantized_hot_swap_uses_new_basis_without_self_check_gap(
+        self, fitted
+    ):
+        """The basis is a runtime operand in the quantized path too:
+        a mid-traffic publish serves the new version immediately."""
+        cfg, spec, est = fitted
+        reg = EigenbasisRegistry()
+        reg.publish_fit(est)
+        with QueryServer(reg, cfg, serve_dtype="bfloat16") as srv:
+            srv.submit(_queries(spec, 1, seed0=460)[0]).result(timeout=60)
+            rng = np.random.default_rng(7)
+            w = np.linalg.qr(
+                rng.standard_normal((D, K))
+            )[0].astype(np.float32)
+            v2 = reg.publish(w, step=99)
+            r = srv.submit(
+                _queries(spec, 1, seed0=461)[0]
+            ).result(timeout=60)
+            assert r.version == v2.version
