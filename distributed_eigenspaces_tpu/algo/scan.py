@@ -38,6 +38,29 @@ from distributed_eigenspaces_tpu.ops.linalg import projector
 from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS, shard_map
 
 
+def _merge_knobs(cfg: PCAConfig) -> dict:
+    """Crossover-merge dispatch knobs for direct :func:`merge_core` call
+    sites (the interval / pipelined scan bodies, which bypass
+    ``make_round_core``): ``dist_iters`` routes the merge through the
+    distributed subspace solver when ``cfg.uses_distributed_solve()``,
+    ``deflate_lanes`` swaps it for the parallel-deflation lanes when
+    ``cfg.uses_deflation_solve()`` (ISSUE 18), and ``dist_tol`` arms the
+    gap-adaptive stop. All ``None`` below the crossover — the traced
+    programs stay byte-identical to the pre-knob builds."""
+    dist_iters = cfg.subspace_iters if cfg.uses_distributed_solve() else None
+    deflate_lanes = (
+        cfg.components_axis_size
+        if (dist_iters is not None and cfg.uses_deflation_solve())
+        else None
+    )
+    dist_tol = cfg.solver_tol if dist_iters is not None else None
+    return {
+        "dist_iters": dist_iters,
+        "deflate_lanes": deflate_lanes,
+        "dist_tol": dist_tol,
+    }
+
+
 def _merge_or_fold_factory(cfg: PCAConfig):
     """ONE definition of the merge-interval round fold, shared by every
     interval-aware body (unmasked/masked scan, pipelined scan,
@@ -58,6 +81,7 @@ def _merge_or_fold_factory(cfg: PCAConfig):
 
     k, s = cfg.k, cfg.merge_interval
     topology = resolve_topology(cfg)
+    knobs = _merge_knobs(cfg)
 
     def update_p(st, p):
         return update_state_projector(
@@ -73,7 +97,7 @@ def _merge_or_fold_factory(cfg: PCAConfig):
             # projectors is associative over the tree, so the fold is
             # exact regardless of topology (only the truncating
             # eigensolve has a tree structure)
-            v = merge_core(vs_, k, mask=mask, topology=topology)
+            v = merge_core(vs_, k, mask=mask, topology=topology, **knobs)
             return v, projector(v)
 
         def fold_only(vs_):
@@ -196,6 +220,7 @@ def _make_interval_fit(cfg: PCAConfig, axis_name, update, gather: bool):
     fold_round = _merge_or_fold_factory(cfg)
     k = cfg.k
     topology = resolve_topology(cfg)
+    knobs = _merge_knobs(cfg)
 
     def body(carry, x):
         st, vp = carry
@@ -212,7 +237,7 @@ def _make_interval_fit(cfg: PCAConfig, axis_name, update, gather: bool):
         def run(state, first_x, scan_body, xs_rest):
             v0_bar = merge_core(
                 solve_cold(first_x, axis_name=axis_name), k,
-                topology=topology,
+                topology=topology, **knobs,
             )
             state = update(state, v0_bar)
             (state, _), v_bars = jax.lax.scan(
@@ -295,7 +320,10 @@ def _make_pipelined_fit(cfg: PCAConfig, axis_name, update, gather: bool):
 
     def run(state, get, T, scan_body, xs_scan):
         # prologue: cold step 1, merged + folded before any pipelining
-        v1 = merge_core(solve_cold(get(0), axis_name=axis_name), k)
+        v1 = merge_core(
+            solve_cold(get(0), axis_name=axis_name), k,
+            **_merge_knobs(cfg),
+        )
         state = update(state, v1)
         if T == 1:
             return state, v1[None]

@@ -89,7 +89,8 @@ def make_warm_solve_core(cfg: PCAConfig):
     )
 
 
-def merge_core(vs, k, mask=None, topology=None, dist_iters=None):
+def merge_core(vs, k, mask=None, topology=None, dist_iters=None,
+               deflate_lanes=None, dist_tol=None):
     """The MERGE half of a round: exact masked low-rank top-k of the
     gathered factors (``merged_top_k_lowrank``), under the profiler
     region the traces name. ``mask`` (full ``(m,)`` {0,1}, replicated)
@@ -105,7 +106,13 @@ def merge_core(vs, k, mask=None, topology=None, dist_iters=None):
     the factor operator iteratively instead of the ``(m*k)^2`` Gram /
     dense-route eigh, and a tiered tree applies it at the ROOT tier
     only (lower tiers' per-group problems are small by
-    construction)."""
+    construction). ``deflate_lanes`` (set when
+    ``cfg.uses_deflation_solve()`` — solver="deflation" above the
+    crossover, ISSUE 18) swaps the crossover merge for the
+    PARALLEL-DEFLATION lanes instead: ``cfg.components_axis_size``
+    concurrent eigenvector lanes on the same factor operator.
+    ``dist_tol`` (``cfg.solver_tol``) arms the gap-adaptive stop on
+    either crossover route."""
     from distributed_eigenspaces_tpu.utils.tracing import named_scope
 
     if topology is not None:
@@ -118,13 +125,23 @@ def merge_core(vs, k, mask=None, topology=None, dist_iters=None):
                 vs, k, topology, mask=mask, root_dist_iters=dist_iters
             )
     if dist_iters is not None:
+        if deflate_lanes is not None:
+            from distributed_eigenspaces_tpu.solvers import (
+                merged_top_k_deflation,
+            )
+
+            with named_scope("det_deflation_merge"):
+                return merged_top_k_deflation(
+                    vs, k, lanes=deflate_lanes, mask=mask,
+                    iters=dist_iters, tol=dist_tol,
+                )
         from distributed_eigenspaces_tpu.solvers import (
             merged_top_k_distributed,
         )
 
         with named_scope("det_dist_merge"):
             return merged_top_k_distributed(
-                vs, k, mask=mask, iters=dist_iters
+                vs, k, mask=mask, iters=dist_iters, tol=dist_tol,
             )
     with named_scope("det_merge"):
         return merged_top_k_lowrank(vs, k, mask=mask)
@@ -185,11 +202,18 @@ def make_round_core(
     solve_core = make_solve_core(cfg, iters=iters, orth=orth)
     k = cfg.k
     dist_iters = cfg.subspace_iters if cfg.uses_distributed_solve() else None
+    deflate_lanes = (
+        cfg.components_axis_size
+        if (dist_iters is not None and cfg.uses_deflation_solve())
+        else None
+    )
+    dist_tol = cfg.solver_tol if dist_iters is not None else None
 
     def round_core(x_blocks, axis_name=None, v0=None, mask=None):
         vs = solve_core(x_blocks, axis_name=axis_name, v0=v0)
         return merge_core(
-            vs, k, mask=mask, topology=topology, dist_iters=dist_iters
+            vs, k, mask=mask, topology=topology, dist_iters=dist_iters,
+            deflate_lanes=deflate_lanes, dist_tol=dist_tol,
         )
 
     return round_core

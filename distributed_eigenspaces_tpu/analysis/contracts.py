@@ -60,6 +60,10 @@ class ProgramParams:
     n_feature_shards: int = 1
     n_workers_mesh: int = 1
     sketch_width: int = 0
+    #: parallel-deflation lane count (deflation_solve programs only):
+    #: the 'components' mesh-axis size the k eigenvector lanes are
+    #: model-parallel over — lane width is k / components
+    components: int = 1
     #: merge-tree fan-ins leaf->root (tree_merge programs only): the
     #: tier-local Gram psum is (f*k)^2 per tier
     tier_fan_ins: tuple[int, ...] = ()
@@ -139,6 +143,17 @@ def _factor_stack(p: ProgramParams) -> int:
     """The merge's gathered factor stack — the payload ceiling every
     trainer contract quotes: ``m * d_local * max(k, sketch_width)``."""
     return p.m * p.d_local * max(p.k, p.sketch_width)
+
+
+def _deflation_stack(p: ProgramParams) -> int:
+    """Parallel deflation's payload ceiling (ISSUE 18): the largest
+    thing a device may move is the cross-lane gather of its own
+    ``(d_local, k/L)`` panel — gathered size ``d_local * k`` — or the
+    merge's worker factor stack when the operand is an m-wide concat.
+    The deflation corrections themselves are ``(L, kb, kb)`` blocks
+    (k x k class), strictly below both; a lane gathering the full
+    DEFLATED operand over features is d-wide and blows this bound."""
+    return max(_factor_stack(p), p.d_local * p.k)
 
 
 def _tree_bound(p: ProgramParams) -> int:
@@ -388,6 +403,70 @@ CONTRACTS: dict[str, ProgramContract] = {
             ),
             # the d-ceiling rule, same as the sharded trainers: no
             # device may hold an un-sharded full-d buffer
+            replicated_axis_floor=lambda p: p.d,
+        ),
+    ),
+    "deflation_solve": ProgramContract(
+        name="deflation_solve",
+        description=(
+            "parallel-deflation eigensolve (ISSUE 18): k lanes "
+            "model-parallel over the 'components' mesh axis, each "
+            "iterating a (d_local, k/L) block against the factor "
+            "operand with deflation corrections from lower lanes. "
+            "Collectives are the cross-lane gather of one lane panel "
+            "(d_local * k gathered), the (L, kb, kb) correction-"
+            "coefficient psums over 'features', and CholeskyQR2 / "
+            "Rayleigh-Ritz k-wide Grams — corrections ride as k x k "
+            "blocks, never d x d, never an above-floor replicated "
+            "d x k; the result stays a (d_local, k) row shard"
+        ),
+        allowed_collectives=frozenset({"all-gather", "all-reduce"}),
+        max_payload_elems=_deflation_stack,
+        require_collectives=True,
+        memory_policy="factor_only",
+        sharding=ShardingContract(
+            buffers=(
+                DeclaredBuffer(
+                    # THE components-axis witness: the per-lane seed
+                    # blocks enter sharded over ('components',
+                    # 'features') — this is what makes the audit
+                    # non-vacuous on the new axis
+                    "lane seed blocks", "in",
+                    dims=lambda p: (
+                        p.components,
+                        p.d,
+                        p.k // max(p.components, 1),
+                    ),
+                    spec=lambda p: ("components", "features", None),
+                ),
+                DeclaredBuffer(
+                    "row-sharded state factors", "in",
+                    dims=lambda p: (p.d, WILD),
+                    spec=lambda p: ("features", None),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    "replicated spectrum", "in",
+                    dims=lambda p: (p.sketch_width,),
+                    spec=lambda p: (None,),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    "worker factor stack", "in",
+                    dims=lambda p: (p.m, p.d, WILD),
+                    spec=lambda p: ("workers", "features", None),
+                    required=False,
+                ),
+                DeclaredBuffer(
+                    # replicated over 'components' (every lane slot
+                    # computes the identical finish), row-sharded over
+                    # 'features' — the same born-sharded output shape
+                    # class as dist_solve
+                    "sharded eigenbasis", "out",
+                    dims=lambda p: (p.d, WILD),
+                    spec=lambda p: ("features", None),
+                ),
+            ),
             replicated_axis_floor=lambda p: p.d,
         ),
     ),

@@ -224,6 +224,48 @@ def _mutant_dist_dense_gram() -> list[contracts.Violation]:
     return viols
 
 
+def _mutant_deflation_lane_gather() -> list[contracts.Violation]:
+    """The parallel-deflation regression ISSUE 18's gate exists for: a
+    lane that all-gathers the full DEFLATED operand over 'features'
+    (d-wide rows on every device) instead of moving its own
+    (d_local, k/L) panel over 'components'. all-gather is in the
+    deflation_solve contract's allowed set — the PAYLOAD bound (the
+    d_local * k lane gather / factor stack) is what must catch it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_eigenspaces_tpu.parallel.mesh import (
+        FEATURE_AXIS,
+        make_component_mesh,
+        shard_map,
+    )
+
+    mesh = make_component_mesh(4, 2)
+    d, r = 2 * _D, 8
+
+    def lane_sweep(c):  # (d_local, r) deflated operand shard
+        full = jax.lax.all_gather(c, FEATURE_AXIS, axis=0, tiled=True)
+        return jnp.matmul(full.T, full)
+
+    f = jax.jit(shard_map(
+        lane_sweep, mesh=mesh, in_specs=P(FEATURE_AXIS, None),
+        out_specs=P(), check_vma=False,
+    ))
+    hlo = f.lower(
+        jnp.zeros((d, r), jnp.float32)
+    ).compile().as_text()
+    contract = contracts.CONTRACTS["deflation_solve"]
+    params = contracts.ProgramParams(
+        d=d, k=8, m=1, n_feature_shards=2, n_workers_mesh=4,
+        sketch_width=r, components=4,
+    )
+    viols, _ = contracts.check_collectives(
+        contract, params, hlo, program="mutant_deflation_lane_gather"
+    )
+    return viols
+
+
 def _mutant_tree_payload_drift() -> list[contracts.Violation]:
     """A tree tier moving the flat m-wide factor STACK instead of the
     merged (d, k) basis — the op kind (all-reduce) is in the tree
@@ -418,6 +460,9 @@ MUTATIONS: dict[str, tuple[str, Callable[[], list]]] = {
     "replicated_dk": ("silent-replication", _mutant_replicated_dk),
     "dist_dense_gram": (
         "collective-payload", _mutant_dist_dense_gram
+    ),
+    "deflation_lane_gather": (
+        "collective-payload", _mutant_deflation_lane_gather
     ),
     "tree_payload_drift": (
         "cost-bound", _mutant_tree_payload_drift

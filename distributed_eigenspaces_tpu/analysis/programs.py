@@ -356,6 +356,86 @@ def _dist_extract_program(name: str):
     return build
 
 
+def _deflation_merge_program(name: str):
+    """The parallel-deflation solve (ISSUE 18): dist_deflation_eig on
+    the (components, features) mesh — k eigenvector lanes
+    model-parallel over ``components``, each lane iterating its
+    ``(d_local, k/L)`` block against the low-rank state operator with
+    deflation corrections from lower lanes. The deflation_solve
+    contract's subject: the cross-lane panel gather plus k-wide
+    feature psums only; the per-lane seed blocks enter SHARDED over
+    ``('components', 'features')`` so the new axis is audited
+    non-vacuously."""
+
+    _R = 8  # audit state rank (the operator's factor width)
+    _DK = 8  # audit k: 4 lanes x lane width 2
+    _LANES = 4
+
+    def build() -> BuiltProgram:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_eigenspaces_tpu.parallel.mesh import (
+            COMPONENT_AXIS,
+            FEATURE_AXIS,
+            make_component_mesh,
+            shard_map,
+        )
+        from distributed_eigenspaces_tpu.solvers import (
+            dist_deflation_eig,
+        )
+        from distributed_eigenspaces_tpu.solvers.distributed import (
+            lowrank_matvec,
+        )
+
+        require_mesh_devices()
+        mesh = make_component_mesh(_LANES, 2)
+
+        def solve(v0, u, s):
+            return dist_deflation_eig(
+                lowrank_matvec(u, s, FEATURE_AXIS),
+                u.shape[0],
+                _DK,
+                lanes=_LANES,
+                iters=2,
+                v0=v0[0],  # this slot's (d_local, kb) seed block
+            )
+
+        in_specs = (
+            P(COMPONENT_AXIS, FEATURE_AXIS, None),
+            P(FEATURE_AXIS, None),
+            P(),
+        )
+        fit = jax.jit(
+            shard_map(
+                solve, mesh=mesh, in_specs=in_specs,
+                out_specs=P(FEATURE_AXIS, None), check_vma=False,
+            ),
+            in_shardings=tuple(
+                NamedSharding(mesh, s) for s in in_specs
+            ),
+        )
+        args = (
+            jax.ShapeDtypeStruct(
+                (_LANES, _FEAT_D, _DK // _LANES), jnp.float32
+            ),
+            jax.ShapeDtypeStruct((_FEAT_D, _R), jnp.float32),
+            jax.ShapeDtypeStruct((_R,), jnp.float32),
+        )
+        return BuiltProgram(
+            name=name, contract="deflation_solve",
+            params=ProgramParams(
+                d=_FEAT_D, k=_DK, m=1, n_feature_shards=2,
+                n_workers_mesh=_LANES, sketch_width=_R,
+                components=_LANES,
+            ),
+            jitted=fit, args=args,
+        )
+
+    return build
+
+
 def _dist_serve_program(name: str, kind: str):
     """Sharded-basis serving (ISSUE 15): the engine's own lowering at
     ``basis_spec=("features", None)`` — queries shard over (workers,
@@ -566,6 +646,8 @@ PROGRAMS: dict[str, Callable[[], BuiltProgram]] = {
     # distributed eigensolve + sharded-basis serving (ISSUE 15)
     "dist_merge": _dist_merge_program("dist_merge"),
     "dist_extract": _dist_extract_program("dist_extract"),
+    # parallel-deflation eigensolve on the components axis (ISSUE 18)
+    "deflation_merge": _deflation_merge_program("deflation_merge"),
     "dist_serve_project": _dist_serve_program(
         "dist_serve_project", "project"
     ),

@@ -149,16 +149,46 @@ def build_parser() -> argparse.ArgumentParser:
                    default="auto",
                    help="feature_sharded = large-d path: d sharded over a "
                    "second mesh axis, no d x d matrix anywhere")
-    p.add_argument("--solver", choices=["eigh", "subspace", "distributed"],
+    p.add_argument("--solver",
+                   choices=["eigh", "subspace", "distributed", "deflation"],
                    default="eigh",
                    help="distributed = subspace machinery for worker "
                    "solves, plus the sharded factor-operator eigensolve "
                    "(solvers/) for the merge and serving extract whenever "
                    "--dim exceeds --eigh-crossover-d — the path that "
-                   "breaks the d ceiling")
+                   "breaks the d ceiling; deflation = the model-parallel-"
+                   "over-k twin (ISSUE 18): above the crossover the merge/"
+                   "extract run --components concurrent eigenvector lanes, "
+                   "each deflating the lower lanes via k x k correction "
+                   "panels (never a d x d) — the path that breaks the k "
+                   "ceiling")
+    p.add_argument("--components", type=int, default=1,
+                   help="deflation lane parallelism "
+                   "(PCAConfig.components_axis_size): how many ways the "
+                   "k eigenvector lanes split over the 'components' mesh "
+                   "axis (requires --solver deflation; k must divide "
+                   "evenly; 1 = lanes run batched on one device, same "
+                   "schedule, no extra mesh axis)")
+    p.add_argument("--grow-k", type=int, default=None, metavar="K2",
+                   help="elastic k (--mode serve): after publishing the "
+                   "--rank-wide basis, grow it to K2 columns with "
+                   "solvers.grow_basis — the parent lanes are FROZEN "
+                   "(deflated, bit-identical prefix) and only the K2 - "
+                   "rank new directions are fit — and publish the "
+                   "widened basis as a lineage-linked version "
+                   "(grew_from) through the same registry; the burst "
+                   "then serves the grown version")
     p.add_argument("--subspace-iters", type=int, default=16,
                    help="power-iteration count for --solver "
-                   "subspace/distributed")
+                   "subspace/distributed/deflation")
+    p.add_argument("--solver-tol", type=float, default=None,
+                   help="gap-adaptive stopping for the distributed/"
+                   "deflation eigensolves (PCAConfig.solver_tol): stop "
+                   "as soon as the measured subspace residual drops "
+                   "below this tolerance instead of always running "
+                   "--subspace-iters (per-lane convergence counters "
+                   "surface in summary()['solver']); unset keeps the "
+                   "fixed schedule byte-identical")
     p.add_argument("--eigh-crossover-d", type=int, default=4096,
                    help="with --solver distributed: dims ABOVE this run "
                    "the distributed merge/extract eigensolve, dims at or "
@@ -1207,6 +1237,74 @@ def _serve_cli(args, cfg, data, truth) -> int:
         fit_s = time.time() - t0
         version = registry.publish_fit(est, lineage={"producer": "cli"})
 
+    grown = None
+    if args.grow_k is not None:
+        if est is None:
+            # warm restart recovered a committed basis but no fitted
+            # state — there is no covariance operand to deflate against
+            print(
+                "error: --grow-k needs a fresh fit in this process (the "
+                "grow fit deflates the parent lanes against the fitted "
+                "covariance operand; the warm-restarted registry holds "
+                "only the basis) — point --registry-dir elsewhere or "
+                "drop the flag",
+                file=sys.stderr,
+            )
+            return 2
+        import jax
+
+        from distributed_eigenspaces_tpu.solvers.deflation import (
+            grow_basis,
+        )
+
+        if hasattr(est.state, "sigma_tilde"):
+            sig = jnp.asarray(est.state.sigma_tilde, jnp.float32)
+
+            def matvec(v):
+                return jnp.matmul(
+                    sig, v, precision=jax.lax.Precision.HIGHEST
+                )
+        else:
+            # low-rank carry (feature-sharded backend): sigma ~= U S U^T
+            u_f = jnp.asarray(est.state.u, jnp.float32)
+            s_f = jnp.asarray(est.state.s, jnp.float32)
+
+            def matvec(v):
+                return jnp.matmul(
+                    u_f * s_f,
+                    jnp.matmul(
+                        u_f.T, v, precision=jax.lax.Precision.HIGHEST
+                    ),
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+        t0 = time.time()
+        v_g, grow_info = grow_basis(
+            matvec,
+            jnp.asarray(version.v, jnp.float32),
+            args.grow_k,
+            iters=cfg.subspace_iters,
+            tol=cfg.solver_tol,
+            key=jax.random.PRNGKey(7),
+            with_info=True,
+        )
+        grow_s = time.time() - t0
+        version = registry.publish_grown(
+            version, np.asarray(v_g), lineage={"producer": "cli"},
+        )
+        grown = {
+            "grown_version": version.version,
+            "grew_from": version.lineage["grew_from"],
+            "k_from": version.lineage["k_from"],
+            "k_to": version.lineage["k_to"],
+            "grow_seconds": round(grow_s, 3),
+        }
+        # the burst serves the GROWN version: the server's signature
+        # follows k', and the bit-exactness check below compares
+        # against the grown basis directly (est.transform projects
+        # onto the parent's k columns, not k')
+        cfg = cfg.replace(k=args.grow_k)
+        est = None
+
     r = max(1, args.serve_rows)
     n_q = max(1, args.serve_queries)
     n_total = len(data)
@@ -1220,6 +1318,18 @@ def _serve_cli(args, cfg, data, truth) -> int:
         stream=sys.stderr if args.metrics else None,
         retention=cfg.metrics_retention,
     )
+    if grown is not None:
+        # the grow fit's convergence counters ride the solver channel
+        # (summary()["solver"] — per-lane iteration / early-stop
+        # accounting, ISSUE 18)
+        metrics.solver({
+            "kind": "grow",
+            "iters_used": int(grow_info["iters_used"]),
+            "residual": float(grow_info["residual"]),
+            "max_iters": cfg.subspace_iters,
+            **({"tol": cfg.solver_tol}
+               if cfg.solver_tol is not None else {}),
+        })
     if tracer is not None:
         metrics.attach_tracer(tracer)
     from distributed_eigenspaces_tpu.utils.compile_cache import (
@@ -1326,6 +1436,7 @@ def _serve_cli(args, cfg, data, truth) -> int:
             {"registry_quarantined": registry.quarantined}
             if registry.quarantined else {}
         ),
+        **(grown or {}),
         "queries": n_q,
         "rows_per_query": r,
         "includes_compile": True,
@@ -1342,6 +1453,9 @@ def _serve_cli(args, cfg, data, truth) -> int:
         ),
         **(
             {"slo": summary["slo"]} if "slo" in summary else {}
+        ),
+        **(
+            {"solver": summary["solver"]} if "solver" in summary else {}
         ),
         **({"prewarm": prewarm_stats} if prewarm_stats else {}),
         **(
@@ -1416,9 +1530,33 @@ def main(argv=None) -> int:
             "decompose — flag ignored",
             file=sys.stderr,
         )
+    if args.components > 1 and args.solver != "deflation":
+        print(
+            f"error: --components {args.components} requires --solver "
+            "deflation (only the parallel-deflation eigensolve shards "
+            "eigenvector lanes over the 'components' mesh axis)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.grow_k is not None:
+        if args.mode != "serve":
+            print(
+                "error: --grow-k widens a PUBLISHED basis and serves the "
+                "grown version — it only applies to --mode serve",
+                file=sys.stderr,
+            )
+            return 2
+        if args.grow_k <= args.rank:
+            print(
+                f"error: --grow-k {args.grow_k} must exceed --rank "
+                f"{args.rank} (shrinking is a slice of the parent, not "
+                "a grow)",
+                file=sys.stderr,
+            )
+            return 2
     if (
         args.warm_start_iters
-        and args.solver not in ("subspace", "distributed")
+        and args.solver not in ("subspace", "distributed", "deflation")
         and getattr(args, "trainer", None) != "sketch"
     ):
         # an explicit 0 ("disable") is solver-independent; a positive
@@ -1596,6 +1734,8 @@ def main(argv=None) -> int:
         solver=args.solver,
         eigh_crossover_d=args.eigh_crossover_d,
         subspace_iters=args.subspace_iters,
+        solver_tol=args.solver_tol,
+        components_axis_size=args.components,
         orth_method=args.orth_method,
         warm_orth_method=args.warm_orth_method,
         compute_dtype=(

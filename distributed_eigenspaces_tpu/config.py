@@ -50,7 +50,27 @@ class PCAConfig:
         composes (``"distributed"`` is subspace-family, so warm starts
         resolve); a tiered ``merge_topology`` uses the distributed
         solve at the ROOT tier only (lower tiers' per-group problems
-        are small by construction).
+        are small by construction). ``"deflation"`` (ISSUE 18) is the
+        model-parallel-over-k twin: above the crossover the merge /
+        extract run k eigenvector LANES concurrently, each lane
+        deflating the converged lower lanes via k x k correction
+        blocks (arxiv 2502.17615); ``components_axis_size`` shards the
+        lanes over the ``components`` mesh axis.
+      solver_tol: gap-adaptive stopping for the distributed/deflation
+        eigensolves (ISSUE 18 satellite): when set, the blocked
+        iteration stops as soon as the measured subspace residual
+        ``||A V - V (V^T A V)||_F / ||A V||_F`` drops below this
+        tolerance (bounded above by ``subspace_iters``), instead of
+        always running the fixed schedule. ``None`` (default) keeps
+        the fixed-``iters`` programs byte-identical. Per-lane
+        convergence counters surface in ``MetricsLogger.summary()``
+        under ``"solver"``.
+      components_axis_size: lane parallelism of the deflation solve:
+        how many ways the k eigenvector lanes split over the
+        ``components`` mesh axis. 1 (default) runs the lanes batched
+        on one device (no extra mesh axis); > 1 requires
+        ``solver="deflation"``, ``components_axis_size <= k`` and
+        ``k % components_axis_size == 0`` (equal lane widths).
       eigh_crossover_d: the eigh-vs-distributed crossover dimension:
         with ``solver="distributed"``, merge/extract eigensolves run
         the exact eigh-family routes while ``dim <= eigh_crossover_d``
@@ -168,6 +188,13 @@ class PCAConfig:
         batching window. ``0`` flushes every request immediately
         (B-padded solo serving — maximum latency fairness, no
         amortization).
+      fleet_pad_k: heterogeneous-k fleet bucketing (ISSUE 18
+        satellite): when True, admission signatures round k up to the
+        next power of two, so tenants that differ ONLY in k share one
+        padded compiled program — each tenant's basis is sliced back
+        to its own k at extraction, and the padded lanes are
+        attributed per signature in the fleet occupancy metrics.
+        False (default) keeps exact-k signatures.
       serve_bucket_size: query-serving micro-batch capacity
         (``serving/server.py QueryServer``): transform requests
         accumulate until this many are pending, then dispatch as ONE
@@ -402,6 +429,8 @@ class PCAConfig:
     solver: str = "eigh"
     eigh_crossover_d: int = 4096
     subspace_iters: int = 16
+    solver_tol: float | None = None
+    components_axis_size: int = 1
     warm_start_iters: int | None | str = "auto"
     orth_method: str = "cholqr2"
     warm_orth_method: str | None = None
@@ -417,6 +446,7 @@ class PCAConfig:
     pipeline_merge: bool = False
     fleet_bucket_size: int = 8
     fleet_flush_s: float = 0.1
+    fleet_pad_k: bool = False
     serve_bucket_size: int = 8
     serve_flush_s: float = 0.02
     serve_continuous: bool = False
@@ -451,8 +481,50 @@ class PCAConfig:
             # "tpu" = the north star's name for the mesh backend
             # (BASELINE.json); alias of "shard_map"
             raise ValueError(f"unknown backend: {self.backend!r}")
-        if self.solver not in ("eigh", "subspace", "distributed"):
+        if self.solver not in ("eigh", "subspace", "distributed",
+                               "deflation"):
             raise ValueError(f"unknown solver: {self.solver!r}")
+        if self.solver_tol is not None and (
+            not isinstance(self.solver_tol, (int, float))
+            or isinstance(self.solver_tol, bool)
+            or not 0.0 < self.solver_tol < 1.0
+        ):
+            raise ValueError(
+                f"solver_tol must be a residual tolerance in (0, 1) or "
+                f"None, got {self.solver_tol!r} (the gap-adaptive stop "
+                "for the distributed/deflation eigensolves; None keeps "
+                "the fixed subspace_iters schedule)"
+            )
+        if not isinstance(self.components_axis_size, int) or isinstance(
+            self.components_axis_size, bool
+        ) or self.components_axis_size < 1:
+            raise ValueError(
+                f"components_axis_size must be an int >= 1, got "
+                f"{self.components_axis_size!r}"
+            )
+        if self.components_axis_size > 1:
+            if self.solver != "deflation":
+                raise ValueError(
+                    f"components_axis_size={self.components_axis_size} "
+                    f"requires solver='deflation' (got "
+                    f"{self.solver!r}): only the parallel-deflation "
+                    "eigensolve shards eigenvector lanes over the "
+                    "'components' mesh axis"
+                )
+            if self.components_axis_size > self.k:
+                raise ValueError(
+                    f"components_axis_size="
+                    f"{self.components_axis_size} exceeds k={self.k}: "
+                    "each deflation lane owns at least one eigenvector "
+                    "column"
+                )
+            if self.k % self.components_axis_size:
+                raise ValueError(
+                    f"k={self.k} must divide evenly into "
+                    f"components_axis_size={self.components_axis_size} "
+                    "lanes (equal lane widths keep the correction "
+                    "blocks k x k and the mesh layout static)"
+                )
         if not isinstance(self.eigh_crossover_d, int) or isinstance(
             self.eigh_crossover_d, bool
         ) or self.eigh_crossover_d < 1:
@@ -540,6 +612,13 @@ class PCAConfig:
         if self.fleet_flush_s < 0:
             raise ValueError(
                 f"fleet_flush_s must be >= 0, got {self.fleet_flush_s}"
+            )
+        if not isinstance(self.fleet_pad_k, bool):
+            raise ValueError(
+                f"fleet_pad_k must be a bool, got {self.fleet_pad_k!r} "
+                "(heterogeneous-k fleet bucketing: pad k to the next "
+                "power of two so tenants with different k share one "
+                "compiled program, padded lanes masked inactive)"
             )
         if not isinstance(self.serve_bucket_size, int) or isinstance(
             self.serve_bucket_size, bool
@@ -772,7 +851,7 @@ class PCAConfig:
         to ``None`` there. The sketch trainer resolves separately (warm
         by construction, solver-independent — see
         ``make_feature_sharded_sketch_fit``)."""
-        if self.solver not in ("subspace", "distributed"):
+        if self.solver not in ("subspace", "distributed", "deflation"):
             return None
         if self.warm_start_iters == "auto":
             return 2
@@ -785,17 +864,31 @@ class PCAConfig:
         ``"subspace"`` — ONE definition for every cfg->component
         boundary (worker pools, solve cores, dense extraction) so the
         dispatch cannot drift."""
-        return "subspace" if self.solver == "distributed" else self.solver
+        if self.solver in ("distributed", "deflation"):
+            return "subspace"
+        return self.solver
 
     def uses_distributed_solve(self) -> bool:
         """True when the MERGE solve and SERVING extract must run the
         distributed eigensolve (``solvers/``): ``solver="distributed"``
-        AND ``dim`` above the configured crossover. Below the crossover
-        the exact eigh-family routes run unchanged — the crossover
-        policy in ONE place (trainers, serving, topology root tier all
-        ask here)."""
+        (or its model-parallel twin ``"deflation"``) AND ``dim`` above
+        the configured crossover. Below the crossover the exact
+        eigh-family routes run unchanged — the crossover policy in ONE
+        place (trainers, serving, topology root tier all ask here)."""
         return (
-            self.solver == "distributed"
+            self.solver in ("distributed", "deflation")
+            and self.dim > self.eigh_crossover_d
+        )
+
+    def uses_deflation_solve(self) -> bool:
+        """True when the crossover merge/extract runs the
+        PARALLEL-DEFLATION lanes (``solvers/deflation.py``) instead of
+        the single-block distributed iteration: ``solver="deflation"``
+        above the crossover. ``components_axis_size`` sets the lane
+        count (1 = the lanes run batched on one device — same
+        schedule, no components mesh axis needed)."""
+        return (
+            self.solver == "deflation"
             and self.dim > self.eigh_crossover_d
         )
 

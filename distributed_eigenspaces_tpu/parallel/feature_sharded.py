@@ -405,6 +405,12 @@ def _make_step_core(cfg: PCAConfig, *, collectives: str, key):
     s_int = cfg.merge_interval
     _, gather_c = _collective_ops(collectives)
     dist_iters = cfg.subspace_iters if cfg.uses_distributed_solve() else None
+    deflate_lanes = (
+        cfg.components_axis_size
+        if (dist_iters is not None and cfg.uses_deflation_solve())
+        else None
+    )
+    dist_tol = cfg.solver_tol if dist_iters is not None else None
 
     def step_core(st, x, step_iters, mask=None):
         # warm-start worker solves from the running estimate's top-k (zero
@@ -420,7 +426,22 @@ def _make_step_core(cfg: PCAConfig, *, collectives: str, key):
         w, keep = weights(st.step)
 
         def merge_round(st_, vws_):
-            if dist_iters is not None:
+            if deflate_lanes is not None:
+                # crossover route, deflation flavor
+                # (cfg.uses_deflation_solve()): the same factor
+                # operand solved by cfg.components_axis_size
+                # parallel-deflation lanes (ISSUE 18)
+                from distributed_eigenspaces_tpu.solvers import (
+                    dist_merged_top_k_deflation,
+                )
+
+                with jax.named_scope("det_deflation_merge"):
+                    v_bar = dist_merged_top_k_deflation(
+                        vws_, k, lanes=deflate_lanes, mask=mask,
+                        iters=dist_iters, tol=dist_tol, key=key,
+                        collectives=collectives, v0=st_.u[:, :k],
+                    )
+            elif dist_iters is not None:
                 # crossover route (cfg.uses_distributed_solve()): the
                 # factor-operator subspace solve — no (m*k)^2 Gram, no
                 # dense dispatch; warm-started from the running
@@ -433,7 +454,7 @@ def _make_step_core(cfg: PCAConfig, *, collectives: str, key):
                     v_bar = dist_merged_top_k(
                         vws_, k, mask=mask, iters=dist_iters,
                         key=key, collectives=collectives,
-                        v0=st_.u[:, :k],
+                        v0=st_.u[:, :k], tol=dist_tol,
                     )
             else:
                 with jax.named_scope("det_merge"):

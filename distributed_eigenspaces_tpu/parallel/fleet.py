@@ -77,6 +77,7 @@ __all__ = [
     "fit_fleet",
     "init_fleet_states",
     "make_fleet_fit",
+    "padded_fleet_cfg",
     "stage_fleet",
 ]
 
@@ -90,6 +91,36 @@ def fleet_signature(cfg: PCAConfig) -> tuple:
         cfg.dim, cfg.k, cfg.num_workers, cfg.rows_per_worker,
         cfg.num_steps,
     )
+
+
+def padded_fleet_cfg(cfg: PCAConfig) -> PCAConfig:
+    """Heterogeneous-k admission (ISSUE 18): the config a
+    ``cfg.fleet_pad_k`` request actually compiles/buckets under — ``k``
+    padded UP to the next power of two (kept a multiple of
+    ``components_axis_size`` so the deflation lane split survives,
+    capped at ``dim``), every other knob untouched. Tenants whose k
+    differs only within one padded width share ONE program; the padded
+    lanes are fitted and sliced off at extraction (inactive product
+    surface), and the dispatch metrics attribute them per signature
+    (``summary()["fleet"]["padded_lanes_by_signature"]``). Returns
+    ``cfg`` itself when padding would not change k or cannot produce a
+    valid config."""
+    k = cfg.k
+    k_pad = 1
+    while k_pad < k:
+        k_pad *= 2
+    lanes = cfg.components_axis_size
+    if k_pad % lanes:
+        k_pad = -(-k_pad // lanes) * lanes
+    k_pad = min(k_pad, cfg.dim)
+    if k_pad <= k:
+        return cfg
+    try:
+        return dataclasses.replace(cfg, k=k_pad)
+    except ValueError:
+        # a knob elsewhere pins k (loud config validation) — serve the
+        # exact shape rather than guessing a different pad
+        return cfg
 
 
 def _tree_where(pred, new, old):
@@ -714,6 +745,12 @@ class _FleetRequest:
     cfg: PCAConfig
     problem: Any
     worker_masks: Any = None
+    #: the k-padded config this request buckets/compiles under when
+    #: ``cfg.fleet_pad_k`` admitted it into a shared-width bucket
+    #: (ISSUE 18); None = exact-shape admission. The tenant's OWN cfg
+    #: (above) still drives result extraction — its first ``cfg.k``
+    #: padded-program columns.
+    pad_cfg: PCAConfig | None = None
     #: admission stamp + correlation id for the request's span chain
     #: (admit → queue_wait → dispatch → compute, utils/telemetry.py);
     #: trace context rides the payload to the dispatch lane
@@ -810,7 +847,17 @@ class FleetServer:
         continuous-batching fairness key (``cfg.serve_continuous``):
         batch assembly round-robins over tenant ids."""
         cfg = self.cfg if cfg is None else cfg
-        sig = (fleet_signature(cfg), repr(cfg))
+        # heterogeneous-k bucketing (ISSUE 18): k is BUCKETABLE when
+        # cfg.fleet_pad_k — the bucket keys on the k-padded config, so
+        # tenants whose k differs only within one padded width share
+        # one compiled program (their own cfg still slices the result)
+        pad_cfg = None
+        if getattr(cfg, "fleet_pad_k", False):
+            padded = padded_fleet_cfg(cfg)
+            if padded is not cfg:
+                pad_cfg = padded
+        bucket_cfg = pad_cfg if pad_cfg is not None else cfg
+        sig = (fleet_signature(bucket_cfg), repr(bucket_cfg))
         from distributed_eigenspaces_tpu.runtime.scheduler import (
             QueueClosed,
             QueueFull,
@@ -824,7 +871,8 @@ class FleetServer:
             ticket = self.queue.submit(
                 sig,
                 _FleetRequest(
-                    cfg, problem, worker_masks, t_submit=t0, trace_id=tid
+                    cfg, problem, worker_masks, t_submit=t0,
+                    trace_id=tid, pad_cfg=pad_cfg,
                 ),
                 tenant=tenant,
             )
@@ -861,7 +909,9 @@ class FleetServer:
         ticket's payload carries the config the compile needs)."""
         with self.queue._lock:
             return [
-                tickets[0].payload.cfg
+                # prewarm the cfg the bucket will actually COMPILE —
+                # the k-padded one for fleet_pad_k admissions
+                tickets[0].payload.pad_cfg or tickets[0].payload.cfg
                 for tickets in self.queue._buckets.values()
                 if tickets
             ]
@@ -940,7 +990,12 @@ class FleetServer:
         tr = tracer_of(self.metrics)
         t0 = time.perf_counter()
         reqs = [t.payload for t in bucket.tickets]
-        cfg = reqs[0].cfg
+        # fit at the bucket's compiled shape: the k-padded config for
+        # fleet_pad_k admissions (every request in the bucket padded to
+        # the same width — the bucket keyed on it), the tenant cfg
+        # otherwise
+        cfg = reqs[0].pad_cfg or reqs[0].cfg
+        padded_lanes = sum(cfg.k - r.cfg.k for r in reqs)
         masks = (
             [r.worker_masks for r in reqs]
             if any(r.worker_masks is not None for r in reqs) else None
@@ -1022,5 +1077,15 @@ class FleetServer:
                 ],
                 "compute_s": round(compute_s, 6),
                 "dispatch_s": round(now - t0, 6),
+                # heterogeneous-k occupancy waste (ISSUE 18): lanes
+                # fitted only because a tenant's k padded up to the
+                # shared bucket width, attributed by signature
+                "padded_lanes": padded_lanes,
             })
-        return [result.components[i] for i in range(len(reqs))]
+        # extraction slices each tenant's OWN k columns off the padded
+        # program's output (descending eigenvalue order, so the first
+        # k_i columns ARE the tenant's top-k)
+        return [
+            result.components[i][:, : reqs[i].cfg.k]
+            for i in range(len(reqs))
+        ]

@@ -20,6 +20,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 WORKER_AXIS = "workers"
 FEATURE_AXIS = "features"
+#: model parallelism over k (ISSUE 18): eigenvector LANES of the
+#: parallel-deflation solve shard over this axis, composing with
+#: ``features`` (rows) exactly as ``workers`` composes with it
+COMPONENT_AXIS = "components"
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -74,6 +78,37 @@ def make_mesh(
         )
     grid = np.asarray(devices[:need]).reshape(num_workers, num_feature_shards)
     return Mesh(grid, (WORKER_AXIS, FEATURE_AXIS))
+
+
+def make_component_mesh(
+    num_components: int,
+    num_feature_shards: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """Build a ``(components, features)`` mesh for the
+    parallel-deflation eigensolve (ISSUE 18): eigenvector lanes over
+    ``components``, rows (the d dimension) over ``features``. Same
+    loud-rejection discipline as :func:`make_mesh` — the product must
+    fit the device count exactly, never silently wrapped."""
+    if devices is None:
+        devices = jax.devices()
+    n_dev = len(devices)
+    if num_components < 1 or num_feature_shards < 1:
+        raise ValueError(
+            f"component mesh axes must be >= 1, got "
+            f"components={num_components}, features={num_feature_shards}"
+        )
+    need = num_components * num_feature_shards
+    if need > n_dev:
+        raise ValueError(
+            f"component mesh {num_components}x{num_feature_shards} needs "
+            f"{need} devices, have {n_dev}"
+        )
+    grid = np.asarray(devices[:need]).reshape(
+        num_components, num_feature_shards
+    )
+    return Mesh(grid, (COMPONENT_AXIS, FEATURE_AXIS))
 
 
 def largest_divisor_leq(m: int, cap: int) -> int:

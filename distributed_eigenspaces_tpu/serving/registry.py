@@ -748,6 +748,81 @@ class EigenbasisRegistry:
             lineage=lin,
         )
 
+    def publish_grown(
+        self,
+        parent: "BasisVersion | int",
+        v_grown,
+        *,
+        sigma_tilde=None,
+        step: int | None = None,
+        explained_variance: Mapping[str, float] | None = None,
+        lineage: Mapping[str, Any] | None = None,
+        spec=None,
+        num_shards: int | None = None,
+        prefix_atol: float = 1e-5,
+    ) -> BasisVersion:
+        """Publish an ELASTIC-K widening of a retained version (ISSUE
+        18): ``v_grown (d, k')`` with ``k' > parent k``, produced by
+        ``solvers.grow_basis`` against the parent — the first k columns
+        must match the parent within ``prefix_atol`` (the grow fit
+        freezes the parent lane; a drifted prefix means the caller grew
+        against some OTHER basis, and serving it under this lineage
+        would lie to every replica that trusts ``grew_from``).
+
+        Lineage is the product surface replicas and restarts key on:
+        ``{"producer": "grow_basis", "grew_from": <parent version>,
+        "k_from": k, "k_to": k'}``, merged under any caller-provided
+        entries. The grown version is otherwise an ordinary publish —
+        durable-first, lease-fenced, GC'd by the same retention window
+        (``grew_from`` keeps naming the parent id after the parent
+        itself is GC'd — lineage is provenance, not a liveness ref)."""
+        if not hasattr(parent, "v"):
+            parent = self.get(int(parent))
+        parr = np.asarray(parent.v)
+        if isinstance(v_grown, (list, tuple)):
+            garr = np.concatenate(
+                [np.asarray(p) for p in v_grown], axis=0
+            )
+        else:
+            garr = np.asarray(v_grown)
+        if garr.ndim != 2 or garr.shape[0] != parr.shape[0]:
+            raise ValueError(
+                f"grown basis must be (d={parr.shape[0]}, k'), got "
+                f"shape {garr.shape}"
+            )
+        k0, k1 = parr.shape[1], garr.shape[1]
+        if not k1 > k0:
+            raise ValueError(
+                f"publish_grown needs k' > parent k, got k'={k1} vs "
+                f"parent k={k0} (version {parent.version}; shrinking "
+                "is a slice of the parent, not a new version)"
+            )
+        if not np.allclose(garr[:, :k0], parr, atol=prefix_atol):
+            drift = float(np.abs(garr[:, :k0] - parr).max())
+            raise ValueError(
+                f"grown basis prefix drifts from parent version "
+                f"{parent.version} (max abs diff {drift:.3e} > "
+                f"prefix_atol {prefix_atol:g}): grow_basis freezes the "
+                "parent lane, so a drifted prefix means this was grown "
+                "against a different basis — refusing the lineage link"
+            )
+        lin = {
+            "producer": "grow_basis",
+            "grew_from": int(parent.version),
+            "k_from": int(k0),
+            "k_to": int(k1),
+        }
+        lin.update(lineage or {})
+        return self.publish(
+            v_grown,
+            sigma_tilde=sigma_tilde,
+            step=int(parent.step if step is None else step),
+            explained_variance=explained_variance,
+            lineage=lin,
+            spec=spec,
+            num_shards=num_shards,
+        )
+
     # -- read side -----------------------------------------------------------
 
     def latest(self) -> BasisVersion | None:

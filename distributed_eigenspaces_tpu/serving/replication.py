@@ -387,6 +387,11 @@ class ReplicaRegistry:
         # committed hours ago is not a staleness breach)
         self._catching_up = True
         self.installs = 0
+        #: installs whose lineage carries ``grew_from`` — elastic-k
+        #: widenings tailed off the store (ISSUE 18); the health
+        #: snapshot surfaces the count so a fleet dashboard can tell
+        #: grown hot-swaps from full refits
+        self.grown_installs = 0
         self.fenced: list[int] = []
         self.torn_pending: set[int] = set()
         self.retired_mid_tail = 0
@@ -542,6 +547,9 @@ class ReplicaRegistry:
             self._install_locked(bv, epoch)
         self._seen.add(version)
         self.installs += 1
+        grew_from = bv.lineage.get("grew_from")
+        if grew_from is not None:
+            self.grown_installs += 1
         stale = lag_ms is not None and lag_ms > self.staleness_ms
         if lag_ms is not None:
             self.last_lag_ms = lag_ms
@@ -549,6 +557,7 @@ class ReplicaRegistry:
         self._event(
             "install", replica=self.name, version=version,
             epoch=epoch, lag_ms=lag_ms, stale=stale,
+            grew_from=grew_from,
         )
         if stale:
             self.stale_installs += 1
@@ -635,6 +644,7 @@ class ReplicaRegistry:
             "alive": bool(wd is not None and wd.alive),
             "restarts": 0 if wd is None else wd.restarts,
             "installs": self.installs,
+            "grown_installs": self.grown_installs,
             "latest": (
                 None if self._latest is None else self._latest.version
             ),

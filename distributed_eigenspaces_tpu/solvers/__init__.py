@@ -16,6 +16,14 @@ paths below it (equivalence angle-gated in tests and
 ``bench.py --dsolve``).
 """
 
+from distributed_eigenspaces_tpu.solvers.deflation import (
+    deflation_eig,
+    dist_deflation_eig,
+    dist_merged_top_k_deflation,
+    grow_basis,
+    grow_directions,
+    merged_top_k_deflation,
+)
 from distributed_eigenspaces_tpu.solvers.distributed import (
     dist_canonicalize_signs,
     dist_extract_top_k,
@@ -25,15 +33,23 @@ from distributed_eigenspaces_tpu.solvers.distributed import (
     factor_matvec,
     lowrank_matvec,
     merged_top_k_distributed,
+    subspace_residual,
 )
 
 __all__ = [
+    "deflation_eig",
     "dist_canonicalize_signs",
+    "dist_deflation_eig",
     "dist_extract_top_k",
     "dist_merged_top_k",
+    "dist_merged_top_k_deflation",
     "dist_rayleigh_ritz",
     "dist_subspace_eig",
     "factor_matvec",
+    "grow_basis",
+    "grow_directions",
     "lowrank_matvec",
+    "merged_top_k_deflation",
     "merged_top_k_distributed",
+    "subspace_residual",
 ]

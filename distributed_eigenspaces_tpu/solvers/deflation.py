@@ -1,0 +1,528 @@
+"""Parallel-deflation eigensolve: model parallelism over k (ISSUE 18).
+
+The last unparallelized loop in the system — component work inside one
+eigensolve — becomes a mesh axis, after the parallel-deflation scheme
+of *Provable Model-Parallel Distributed PCA with Parallel Deflation*
+(arxiv 2502.17615): the k eigenvector columns split into L equal-width
+LANES that iterate **concurrently**, each lane running blocked power /
+subspace iteration against the same matvec operand while receiving
+deflation corrections from the lanes below it. Lane 0 converges to the
+leading block exactly as plain subspace iteration would; lane ``l``
+iterates on the operator deflated by the *current* (still-moving)
+estimates of lanes ``j < l`` — the paper's point is that this coupled
+concurrent schedule still converges, so k-wide solves stop paying the
+sequential-k critical path.
+
+Wire discipline (the PR 13/15 sharding contracts, unchanged):
+
+- corrections are exchanged as **k x k blocks** — lane ``l`` receives
+  the kb x kb coefficient panels ``V_j^T (A V_l)`` (kb = k / L), never
+  a d x d, never an above-floor replicated d x k;
+- the only d-proportional collective is the **(d_local, kb) lane
+  gather** over the ``components`` axis (feature-sharded rows, so no
+  device ever holds an un-sharded full-d buffer);
+- orthonormalization and the finishing Rayleigh–Ritz reuse the
+  distributed solver's CholeskyQR2 / ``dist_rayleigh_ritz`` /
+  sign-canonicalization verbatim — ONE definition of the numerics.
+
+Two implementations of the same schedule:
+
+- :func:`deflation_eig` — lanes BATCHED on one device (a ``(L,
+  d_local, kb)`` stack), rows optionally sharded over ``features``.
+  This is the dispatch route for ``cfg.solver="deflation"`` merges /
+  extracts (``components_axis_size`` sets L) and the reference the
+  mesh version is gated against.
+- :func:`dist_deflation_eig` — lanes SHARDED over the ``components``
+  mesh axis (``parallel/mesh.make_component_mesh``), one lane per mesh
+  slot, composing with ``features`` row sharding. Audited by the
+  ``deflation_solve`` contract (``analysis/contracts.py``).
+
+On top of the lanes, **elastic k** (:func:`grow_directions` /
+:func:`grow_basis`): widening a published basis k -> k' deflates
+against the frozen parent — a single always-converged lane — and fits
+only the k' - k new directions, so a tenant widening its basis never
+pays a full refit. The serving tier publishes the result as a
+lineage-linked version (``EigenbasisRegistry.publish_grown``).
+
+Everything traces inside any caller's ``jit``/``shard_map``; all
+solves are deterministic given ``key``. ``tol`` arms the same
+gap-adaptive stop as :func:`~.distributed.dist_subspace_eig`, with
+PER-LANE residuals and iteration counters (``with_info=True``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_eigenspaces_tpu.parallel.feature_sharded import (
+    _collective_ops,
+    _psum_if,
+    chol_qr2,
+)
+from distributed_eigenspaces_tpu.parallel.mesh import (
+    COMPONENT_AXIS,
+    FEATURE_AXIS,
+)
+from distributed_eigenspaces_tpu.solvers.distributed import (
+    HP,
+    _scaled_factor_concat,
+    dist_rayleigh_ritz,
+    factor_matvec,
+)
+
+__all__ = [
+    "deflation_eig",
+    "dist_deflation_eig",
+    "dist_merged_top_k_deflation",
+    "grow_basis",
+    "grow_directions",
+    "merged_top_k_deflation",
+]
+
+
+def _lane_widths(k: int, lanes: int) -> int:
+    """Validated equal lane width kb = k / lanes (loud, static)."""
+    if not isinstance(lanes, int) or lanes < 1:
+        raise ValueError(f"lanes must be an int >= 1, got {lanes!r}")
+    if lanes > k:
+        raise ValueError(
+            f"lanes={lanes} exceeds k={k}: each deflation lane owns at "
+            "least one eigenvector column"
+        )
+    if k % lanes:
+        raise ValueError(
+            f"k={k} must split into {lanes} equal-width lanes "
+            "(equal widths keep the correction blocks k x k and the "
+            "lane layout static)"
+        )
+    return k // lanes
+
+
+def _lanes_to_flat(vs: jax.Array) -> jax.Array:
+    """``(L, d_local, kb) -> (d_local, L*kb)`` with lane ``l`` owning
+    columns ``[l*kb, (l+1)*kb)`` — eigenvalue-descending lane order."""
+    return jnp.transpose(vs, (1, 0, 2)).reshape(vs.shape[1], -1)
+
+
+def _flat_to_lanes(v: jax.Array, lanes: int) -> jax.Array:
+    """Inverse of :func:`_lanes_to_flat`."""
+    d, k = v.shape
+    return jnp.transpose(v.reshape(d, lanes, k // lanes), (1, 0, 2))
+
+
+def _lane_residuals(vs, ws, axis_name):
+    """Per-lane relative invariance residual ``||W_l - V_l (V_l^T
+    W_l)||_F / ||W_l||_F`` for lane stacks ``(L, d_local, kb)`` —
+    kb x kb + scalar psums only. A dead lane (zero ``W_l``, the
+    all-masked merge's guard) reads as converged (residual 0)."""
+    s = jnp.einsum("ldb,ldc->lbc", vs, ws, precision=HP)
+    s = _psum_if(s, axis_name)
+    r = ws - jnp.einsum("ldb,lbc->ldc", vs, s, precision=HP)
+    rn = _psum_if(jnp.sum(r * r, axis=(1, 2)), axis_name)
+    wn = _psum_if(jnp.sum(ws * ws, axis=(1, 2)), axis_name)
+    return jnp.sqrt(rn) / jnp.sqrt(jnp.maximum(wn, 1e-30))
+
+
+def deflation_eig(
+    matvec,
+    d_local: int,
+    k: int,
+    *,
+    lanes: int,
+    iters: int = 16,
+    tol: float | None = None,
+    key: jax.Array | None = None,
+    axis_name: str | None = None,
+    v0: jax.Array | None = None,
+    with_info: bool = False,
+):
+    """Top-k invariant subspace by PARALLEL DEFLATION with the L lanes
+    batched on-device: a ``(L, d_local, kb)`` lane stack iterates
+    concurrently, lane ``l`` deflating the current estimates of lanes
+    ``j < l`` each sweep via kb x kb correction panels.
+
+    Per iteration, for every lane at once: one matvec (columns are
+    independent, so all lanes ride ONE operator application), the
+    strictly-lower-triangular correction ``W_l -= sum_{j<l} V_j
+    (V_j^T W_l)`` (one ``(L, L, kb, kb)`` einsum, reduced over
+    ``axis_name`` with a k x k-class psum), and a per-lane CholeskyQR2.
+    The finish assembles the lanes into ``(d_local, k)``, re-runs
+    CholeskyQR2 across lanes (cross-lane drift from still-moving lower
+    lanes is second-order, but free to remove), and applies the shared
+    Rayleigh–Ritz + sign canonicalization — so the output contract is
+    exactly :func:`~.distributed.dist_subspace_eig`'s: descending
+    eigenvalue order, globally canonical signs, a ``(d_local, k)`` row
+    shard.
+
+    ``tol`` arms the PER-LANE gap-adaptive stop: a lane whose measured
+    residual drops below ``tol`` freezes (its blocks stop updating —
+    converged lower lanes keep feeding corrections from their frozen
+    values, the deflation semantics), and the loop ends when every
+    lane froze or at ``iters``. ``with_info=True`` returns ``(v,
+    info)`` with per-lane ``iters_used`` / ``residual`` vectors — the
+    convergence counters ``MetricsLogger.summary()`` surfaces."""
+    kb = _lane_widths(k, lanes)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if axis_name is not None:
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    v = jax.random.normal(key, (d_local, k), jnp.float32)
+    if v0 is not None:
+        d_total = _psum_if(jnp.asarray(d_local, jnp.float32), axis_name)
+        v = (1e-3 * lax.rsqrt(d_total)) * v
+        v = v.at[:, : v0.shape[1]].add(v0)
+    # cross-lane orthonormal start (one full-width CholeskyQR2), then
+    # split into the lane stack
+    vs = _flat_to_lanes(chol_qr2(v, axis_name), lanes)
+    lower = (
+        jnp.arange(lanes)[:, None] < jnp.arange(lanes)[None, :]
+    ).astype(jnp.float32)[:, :, None, None]  # strict: j < l
+
+    def sweep(vs, active):
+        # ONE matvec application covers every lane (column-independent)
+        ws = _flat_to_lanes(matvec(_lanes_to_flat(vs)), lanes)
+        # deflation corrections: kb x kb panels V_j^T W_l, j < l
+        coef = jnp.einsum("jdb,ldc->jlbc", vs, ws, precision=HP)
+        coef = _psum_if(coef, axis_name) * lower
+        ws = ws - jnp.einsum("jdb,jlbc->ldc", vs, coef, precision=HP)
+        res = _lane_residuals(vs, ws, axis_name)
+        vn = chol_qr2(ws, axis_name)  # batched per-lane QR
+        gate = active[:, None, None]
+        return jnp.where(gate > 0, vn, vs), res
+
+    if tol is None:
+        ones = jnp.ones((lanes,), jnp.float32)
+        vs = lax.fori_loop(
+            0, iters, lambda _, s: sweep(s, ones)[0], vs
+        )
+        iters_used = jnp.full((lanes,), iters, jnp.int32)
+        res = jnp.full((lanes,), jnp.nan, jnp.float32)
+    else:
+
+        def cond(carry):
+            _, i, res, _ = carry
+            return jnp.logical_and(i < iters, jnp.any(res > tol))
+
+        def body(carry):
+            vs, i, res, used = carry
+            active = (res > tol).astype(jnp.float32)
+            vs, res = sweep(vs, active)
+            used = used + (active > 0).astype(jnp.int32)
+            return vs, i + 1, res, used
+
+        vs, _, res, iters_used = lax.while_loop(
+            cond,
+            body,
+            (
+                vs,
+                jnp.asarray(0, jnp.int32),
+                jnp.full((lanes,), jnp.inf, jnp.float32),
+                jnp.zeros((lanes,), jnp.int32),
+            ),
+        )
+    flat = chol_qr2(_lanes_to_flat(vs), axis_name)
+    out = dist_rayleigh_ritz(flat, matvec(flat), axis_name)[:, :k]
+    if with_info:
+        return out, {
+            "iters_used": iters_used, "residual": res,
+            "lanes": lanes, "lane_width": kb,
+        }
+    return out
+
+
+def dist_deflation_eig(
+    matvec,
+    d_local: int,
+    k: int,
+    *,
+    lanes: int,
+    iters: int = 16,
+    tol: float | None = None,
+    key: jax.Array | None = None,
+    lane_axis: str = COMPONENT_AXIS,
+    axis_name: str | None = FEATURE_AXIS,
+    v0: jax.Array | None = None,
+    with_info: bool = False,
+):
+    """:func:`deflation_eig` with the lanes SHARDED over the
+    ``components`` mesh axis — call inside ``shard_map`` over a
+    ``(components, features)`` mesh (``make_component_mesh``), one
+    lane of width kb = k / lanes per components slot. ``lanes`` must
+    equal the mesh's ``components`` axis size (static — it sizes the
+    lane blocks).
+
+    The collective schedule per iteration, per device:
+
+    - ONE ``all_gather`` of the own ``(d_local, kb)`` lane block over
+      ``components`` — the (d, k)-class lane gather (feature-sharded
+      rows: never an above-floor replicated d x k);
+    - the kb x kb correction panels ``V_j^T (A V_l)`` reduced over
+      ``features`` (one ``(L, kb, kb)`` psum — the k x k correction
+      blocks);
+    - CholeskyQR2's two kb x kb Gram psums over ``features``.
+
+    The finish gathers the lanes once more, re-orthonormalizes across
+    lanes, and runs the shared Rayleigh–Ritz — every components slot
+    computes the identical ``(d_local, k)`` result (replicated over
+    ``components``, row-sharded over ``features``).
+
+    ``tol`` freezes THIS lane once its residual clears the bar while
+    lower lanes keep feeding corrections; the loop runs until every
+    lane froze (a scalar all-lanes reduce over ``components``) or
+    ``iters``. ``with_info=True`` returns this lane's own counter —
+    gather over ``lane_axis`` outside for the per-lane vector.
+
+    ``v0`` warm-starts THIS lane from a ``(d_local, kb)`` seed block
+    (e.g. the matching columns of a published basis on a hot swap) —
+    it enters through CholeskyQR2, so any full-rank block is legal."""
+    kb = _lane_widths(k, lanes)
+    my = lax.axis_index(lane_axis)
+    if v0 is not None:
+        v = chol_qr2(v0.astype(jnp.float32), axis_name)
+    else:
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        if axis_name is not None:
+            key = jax.random.fold_in(key, lax.axis_index(axis_name))
+        key = jax.random.fold_in(key, my)
+        v = chol_qr2(
+            jax.random.normal(key, (d_local, kb), jnp.float32),
+            axis_name,
+        )
+    jlt = jnp.arange(lanes)  # lane indices, for the j < my mask
+
+    def sweep(v, active):
+        vs = lax.all_gather(v, lane_axis)  # (L, d_local, kb)
+        w = matvec(v)  # (d_local, kb)
+        coef = jnp.einsum("jdb,dc->jbc", vs, w, precision=HP)
+        coef = _psum_if(coef, axis_name)
+        coef = coef * (jlt < my).astype(coef.dtype)[:, None, None]
+        w = w - jnp.einsum("jdb,jbc->dc", vs, coef, precision=HP)
+        # this lane's residual (kb-wide + scalar psums over features)
+        s = jnp.matmul(v.T, w, precision=HP)
+        s = _psum_if(s, axis_name)
+        r = w - jnp.matmul(v, s, precision=HP)
+        rn = _psum_if(jnp.sum(r * r), axis_name)
+        wn = _psum_if(jnp.sum(w * w), axis_name)
+        res = jnp.sqrt(rn) / jnp.sqrt(jnp.maximum(wn, 1e-30))
+        vn = chol_qr2(w, axis_name)
+        return jnp.where(active > 0, vn, v), res
+
+    if tol is None:
+        one = jnp.asarray(1.0, jnp.float32)
+        v = lax.fori_loop(0, iters, lambda _, s: sweep(s, one)[0], v)
+        iters_used = jnp.asarray(iters, jnp.int32)
+        res = jnp.asarray(jnp.nan, jnp.float32)
+    else:
+
+        def cond(carry):
+            _, i, _, _, worst = carry
+            # the carried all-lanes max keeps the collective out of
+            # the while cond (body-side pmax over components)
+            return jnp.logical_and(i < iters, worst > tol)
+
+        def body(carry):
+            v, i, res, used, _ = carry
+            active = (res > tol).astype(jnp.float32)
+            v, res = sweep(v, active)
+            used = used + (active > 0).astype(jnp.int32)
+            worst = lax.pmax(res, lane_axis)
+            return v, i + 1, res, used, worst
+
+        v, _, res, iters_used, _ = lax.while_loop(
+            cond,
+            body,
+            (
+                v,
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(jnp.inf, jnp.float32),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(jnp.inf, jnp.float32),
+            ),
+        )
+    vs = lax.all_gather(v, lane_axis)  # the finishing lane gather
+    flat = chol_qr2(_lanes_to_flat(vs), axis_name)
+    out = dist_rayleigh_ritz(flat, matvec(flat), axis_name)[:, :k]
+    if with_info:
+        return out, {"iters_used": iters_used, "residual": res,
+                     "lanes": lanes, "lane_width": kb}
+    return out
+
+
+def merged_top_k_deflation(
+    v_stack: jax.Array,
+    k: int,
+    *,
+    lanes: int,
+    mask: jax.Array | None = None,
+    iters: int = 16,
+    tol: float | None = None,
+    key: jax.Array | None = None,
+    v0: jax.Array | None = None,
+):
+    """The MERGE solve on the deflation route: top-k of the (masked)
+    mean worker projector from a full ``(m, d, k_f)`` factor stack, by
+    parallel-deflation lanes on the factor operator ``C C^T`` — the
+    ``cfg.solver="deflation"`` twin of
+    :func:`~.distributed.merged_top_k_distributed` (same operand, same
+    guard semantics: an all-masked round returns exact zeros). ``v0``
+    warm-starts the lane stack from the previous merged basis."""
+    m = v_stack.shape[0]
+    if mask is None:
+        w = jnp.ones((m,), jnp.float32)
+    else:
+        w = mask.astype(jnp.float32)
+    alive = jnp.sum(w) > 0
+    cc = _scaled_factor_concat(v_stack, w)
+    mv = factor_matvec(cc, None, alive=alive)
+    v = deflation_eig(
+        mv, v_stack.shape[1], k, lanes=lanes, iters=iters, tol=tol,
+        key=key, axis_name=None, v0=v0,
+    )
+    return v * alive.astype(v.dtype)
+
+
+def dist_merged_top_k_deflation(
+    v_workers: jax.Array,
+    k: int,
+    *,
+    lanes: int,
+    mask: jax.Array | None = None,
+    iters: int = 16,
+    tol: float | None = None,
+    key: jax.Array | None = None,
+    collectives: str = "xla",
+    v0: jax.Array | None = None,
+):
+    """The deflation merge inside ``shard_map`` over the ``(workers,
+    features)`` mesh — the ``cfg.solver="deflation"`` twin of
+    :func:`~.distributed.dist_merged_top_k`: same worker-axis factor
+    gather and masked factor operand, but the crossover eigensolve runs
+    the parallel-deflation lanes (batched per device, rows sharded over
+    ``features``) instead of plain subspace iteration. ``v0`` row shard
+    warm-starts the lane stack; an all-masked round returns exact
+    zeros."""
+    _, gather_c = _collective_ops(collectives)
+    from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
+
+    c = gather_c(v_workers, WORKER_AXIS)  # (m_total, d_local, kf)
+    m_total = c.shape[0]
+    if mask is None:
+        w = jnp.ones((m_total,), jnp.float32)
+    else:
+        w = gather_c(mask, WORKER_AXIS).astype(jnp.float32)
+    alive = jnp.sum(w) > 0
+    cc = _scaled_factor_concat(c, w)
+    mv = factor_matvec(cc, FEATURE_AXIS, alive=alive)
+    v = deflation_eig(
+        mv, c.shape[1], k, lanes=lanes, iters=iters, tol=tol, key=key,
+        axis_name=FEATURE_AXIS, v0=v0,
+    )
+    return v * alive.astype(v.dtype)
+
+
+def grow_directions(
+    matvec,
+    v_parent: jax.Array,
+    k_new: int,
+    *,
+    iters: int = 16,
+    tol: float | None = None,
+    key: jax.Array | None = None,
+    axis_name: str | None = None,
+    with_info: bool = False,
+):
+    """Elastic k, the solve half: fit ``k_new`` directions ORTHOGONAL
+    to a frozen parent basis ``v_parent (d_local, k0)`` — deflated
+    subspace iteration where the parent is a single permanently-
+    converged lane: every sweep applies ``W -= V_p (V_p^T W)`` (a
+    k0 x k_new correction block, reduced over ``axis_name``) before
+    the CholeskyQR2, so the new block converges to eigenpairs
+    ``k0+1 .. k0+k_new`` of the operator without ever re-fitting the
+    parent's span. Finish: Rayleigh–Ritz of the new block alone
+    (deflated operator), descending order, canonical signs."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if axis_name is not None:
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    d_local = v_parent.shape[0]
+    v = jax.random.normal(key, (d_local, k_new), jnp.float32)
+
+    def deflate(w):
+        coef = jnp.matmul(v_parent.T, w, precision=HP)
+        coef = _psum_if(coef, axis_name)
+        return w - jnp.matmul(v_parent, coef, precision=HP)
+
+    v = chol_qr2(deflate(v), axis_name)
+
+    def sweep(vi):
+        w = deflate(matvec(vi))
+        return w, chol_qr2(w, axis_name)
+
+    if tol is None:
+        v = lax.fori_loop(0, iters, lambda _, vi: sweep(vi)[1], v)
+        iters_used = jnp.asarray(iters, jnp.int32)
+        res = jnp.asarray(jnp.nan, jnp.float32)
+    else:
+        from distributed_eigenspaces_tpu.solvers.distributed import (
+            subspace_residual,
+        )
+
+        def cond(carry):
+            _, i, res = carry
+            return jnp.logical_and(i < iters, res > tol)
+
+        def body(carry):
+            vi, i, _ = carry
+            w, vn = sweep(vi)
+            return vn, i + 1, subspace_residual(vi, w, axis_name)
+
+        v, iters_used, res = lax.while_loop(
+            cond, body, (v, jnp.asarray(0, jnp.int32),
+                         jnp.asarray(jnp.inf, jnp.float32))
+        )
+    out = dist_rayleigh_ritz(v, deflate(matvec(v)), axis_name)
+    if with_info:
+        return out, {"iters_used": iters_used, "residual": res}
+    return out
+
+
+def grow_basis(
+    matvec,
+    v_parent: jax.Array,
+    k_prime: int,
+    *,
+    iters: int = 16,
+    tol: float | None = None,
+    key: jax.Array | None = None,
+    axis_name: str | None = None,
+    with_info: bool = False,
+):
+    """Elastic k end-to-end on the solver side: widen a converged
+    parent basis ``(d_local, k0)`` to ``(d_local, k_prime)`` by
+    fitting ONLY the ``k_prime - k0`` new directions
+    (:func:`grow_directions`) and concatenating — the first k0 columns
+    of the result ARE the parent, bit-identical, so a serving tier
+    that validated the parent needs to validate only the suffix. The
+    fit cost is ``O((k' - k))`` matvec columns per sweep vs a full
+    refit's ``O(k')`` — the elastic-k product claim ``bench.py
+    --deflate`` measures. Publish the result through
+    ``EigenbasisRegistry.publish_grown`` to get the lineage-linked
+    version the replication fleet tails."""
+    k0 = v_parent.shape[1]
+    if not k0 < k_prime:
+        raise ValueError(
+            f"grow_basis needs k_prime > parent k, got k_prime="
+            f"{k_prime} vs parent k={k0} (shrinking is a slice, not a "
+            "fit)"
+        )
+    new = grow_directions(
+        matvec, v_parent, k_prime - k0, iters=iters, tol=tol, key=key,
+        axis_name=axis_name, with_info=with_info,
+    )
+    if with_info:
+        new, info = new
+        return jnp.concatenate([v_parent, new], axis=1), info
+    return jnp.concatenate([v_parent, new], axis=1)

@@ -64,6 +64,7 @@ __all__ = [
     "fused_factor_matvec",
     "lowrank_matvec",
     "merged_top_k_distributed",
+    "subspace_residual",
 ]
 
 
@@ -114,6 +115,8 @@ def dist_subspace_eig(
     v0: jax.Array | None = None,
     oversample: int = 0,
     matvec_gram=None,
+    tol: float | None = None,
+    with_info: bool = False,
 ):
     """Top-k invariant subspace of a symmetric PSD operator by blocked
     randomized subspace iteration with the rows sharded over
@@ -138,7 +141,17 @@ def dist_subspace_eig(
     ``(w, g) = (matvec(v), w^T w)`` in one kernel and the loop
     finishes CholeskyQR2 from the precomputed Gram — same math, one
     launch and one fewer pass over the operator per iteration on
-    TPU."""
+    TPU.
+
+    ``tol`` (ISSUE 18 satellite) arms the gap-adaptive stop: the loop
+    measures the subspace residual ``||W - V (V^T W)||_F / ||W||_F``
+    (``W = A V``, one extra k' x k' psum + two scalar psums per
+    iteration — never anything d-wide) and stops as soon as it drops
+    below ``tol``, still bounded above by ``iters``. ``tol=None``
+    compiles the exact fixed-``iters`` ``fori_loop`` program,
+    byte-identical to the pre-knob build. ``with_info=True`` returns
+    ``(v, info)`` with ``info = {"iters_used", "residual"}`` (traced
+    scalars) so callers can surface convergence counters."""
     if matvec_gram is not None and axis_name is not None:
         raise ValueError(
             "matvec_gram fuses a LOCAL operator with its Gram; the "
@@ -160,19 +173,59 @@ def dist_subspace_eig(
 
     if matvec_gram is None:
 
-        def body(_, vi):
-            return chol_qr2(matvec(vi), axis_name)
+        def sweep(vi):
+            w = matvec(vi)
+            return w, chol_qr2(w, axis_name)
 
     else:
 
-        def body(_, vi):
+        def sweep(vi):
             w, g = matvec_gram(vi)
             # First CholeskyQR pass reuses the fused Gram; the second
             # recomputes it from the orthogonalised factor (QR2).
-            return _chol_qr(_chol_apply(w, g), axis_name)
+            return w, _chol_qr(_chol_apply(w, g), axis_name)
 
-    v = lax.fori_loop(0, iters, body, v)
-    return dist_rayleigh_ritz(v, matvec(v), axis_name)[:, :k]
+    if tol is None:
+        v = lax.fori_loop(0, iters, lambda _, vi: sweep(vi)[1], v)
+        iters_used = jnp.asarray(iters, jnp.int32)
+        res = jnp.asarray(jnp.nan, jnp.float32)
+    else:
+
+        def cond(carry):
+            _, i, res = carry
+            return jnp.logical_and(i < iters, res > tol)
+
+        def body(carry):
+            vi, i, _ = carry
+            w, vn = sweep(vi)
+            res = subspace_residual(vi, w, axis_name)
+            return vn, i + 1, res
+
+        v, iters_used, res = lax.while_loop(
+            cond, body, (v, jnp.asarray(0, jnp.int32),
+                         jnp.asarray(jnp.inf, jnp.float32))
+        )
+    out = dist_rayleigh_ritz(v, matvec(v), axis_name)[:, :k]
+    if with_info:
+        return out, {"iters_used": iters_used, "residual": res}
+    return out
+
+
+def subspace_residual(v: jax.Array, w: jax.Array,
+                      axis_name: str | None = None) -> jax.Array:
+    """Relative invariance residual of an orthonormal row-sharded block
+    ``v (d_local, k')`` given ``w = A @ v``: ``||W - V (V^T W)||_F /
+    ||W||_F`` — the measured quantity the gap-adaptive stop compares to
+    ``tol``. Payloads: one k' x k' psum + two scalar psums; nothing
+    d-wide. Zero ``w`` (the all-masked merge's dead operator) yields
+    residual 0, so a dead solve stops immediately instead of spinning
+    to the iteration cap."""
+    s = jnp.matmul(v.T, w, precision=HP)
+    s = _psum_if(s, axis_name)
+    r = w - jnp.matmul(v, s, precision=HP)
+    rn = _psum_if(jnp.sum(r * r), axis_name)
+    wn = _psum_if(jnp.sum(w * w), axis_name)
+    return jnp.sqrt(rn) / jnp.sqrt(jnp.maximum(wn, 1e-30))
 
 
 def factor_matvec(c: jax.Array, axis_name: str | None = None, alive=None):
@@ -270,6 +323,7 @@ def dist_merged_top_k(
     collectives: str = "xla",
     v0: jax.Array | None = None,
     oversample: int | None = None,
+    tol: float | None = None,
 ):
     """The distributed MERGE solve, inside ``shard_map`` over the
     ``(workers, features)`` mesh: exact-operator top-k of the masked
@@ -301,7 +355,7 @@ def dist_merged_top_k(
     mv = factor_matvec(cc, FEATURE_AXIS, alive=alive)
     v = dist_subspace_eig(
         mv, d_local, k, iters=iters, key=key,
-        axis_name=FEATURE_AXIS, v0=v0, oversample=oversample,
+        axis_name=FEATURE_AXIS, v0=v0, oversample=oversample, tol=tol,
     )
     return v * alive.astype(v.dtype)
 
@@ -315,6 +369,7 @@ def merged_top_k_distributed(
     key: jax.Array | None = None,
     v0: jax.Array | None = None,
     oversample: int | None = None,
+    tol: float | None = None,
 ):
     """Unsharded / root-tier variant of the distributed merge solve:
     top-k of the (masked) mean of projectors from a full ``(m, d, k)``
@@ -337,7 +392,7 @@ def merged_top_k_distributed(
     mv = factor_matvec(cc, None, alive=alive)
     v = dist_subspace_eig(
         mv, v_stack.shape[1], k, iters=iters, key=key,
-        axis_name=None, v0=v0, oversample=oversample,
+        axis_name=None, v0=v0, oversample=oversample, tol=tol,
     )
     return v * alive.astype(v.dtype)
 

@@ -143,6 +143,11 @@ class MetricsLogger:
         self.population_records = RingLog(
             retention, self._evict_population
         )
+        #: eigensolver convergence events (solvers/ deflation lanes and
+        #: gap-adaptive subspace stops, ISSUE 18): per-solve
+        #: ``iters_used`` / residuals, per-lane — surfaced by
+        #: :meth:`summary` under "solver"
+        self.solver_records = RingLog(retention, self._evict_solver)
         #: compile-lifecycle counters (utils/compile_cache.py
         #: CompileCache), attached via :meth:`attach_compile` —
         #: surfaced by :meth:`summary` under "compile"
@@ -210,6 +215,13 @@ class MetricsLogger:
             "participation_hist": {}, "rejects_by_reason": {},
             "trim_frac_sum": 0.0, "trim_frac_n": 0,
         }
+        # solver-convergence eviction aggregates (ISSUE 18): solve
+        # counts by kind plus PER-LANE iteration totals (sum/max,
+        # early-stop count) — so summary()["solver"] covers the whole
+        # run after ring-buffer eviction
+        self._solver_agg: dict = {
+            "count": 0, "by_kind": {}, "by_lane": {},
+        }
 
     @staticmethod
     def _fresh_dispatch_agg() -> dict:
@@ -224,6 +236,10 @@ class MetricsLogger:
             # admit-to-dispatch wait histogram the continuous-batching
             # claim is judged by
             "padded_rows": 0, "padded_by_sig": {},
+            # heterogeneous-k bucketing waste (ISSUE 18): eigenvector
+            # lanes fitted only because a tenant's k was padded up to
+            # the shared bucket width, attributed by signature
+            "padded_lanes": 0, "padded_lanes_by_sig": {},
             "fill_sum": 0.0, "fill_n": 0,
             "compile_misses": 0, "compile_stall_ms": 0.0,
             "by_sig": {}, "t_min": None, "t_max": None,
@@ -413,6 +429,19 @@ class MetricsLogger:
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
 
+    def solver(self, event: dict) -> None:
+        """Record one structured eigensolver-convergence event
+        (``kind="deflation"``: per-lane ``iters_used`` / ``residual``
+        vectors from a gap-adaptive deflation solve, plus the armed
+        ``tol`` and ``max_iters``; ``kind="subspace"``: the scalar
+        equivalents from :func:`~..solvers.dist_subspace_eig`). Rides
+        the same JSON stream as step records, tagged ``"solver"``."""
+        rec = {"solver": event.get("kind", "unknown"), **event}
+        _stamp(rec)
+        self.solver_records.append(rec)
+        if self.stream is not None:
+            print(json.dumps(rec), file=self.stream, flush=True)
+
     def fault(self, event: dict) -> None:
         """Record one structured fault event (a supervisor detection /
         recovery action). Events ride the same JSON stream as step
@@ -522,6 +551,74 @@ class MetricsLogger:
                 agg["trim_frac_sum"] += float(tf)
                 agg["trim_frac_n"] += 1
 
+    def _evict_solver(self, rec: dict) -> None:
+        agg = self._solver_agg
+        agg["count"] += 1
+        kind = rec.get("solver", "unknown")
+        agg["by_kind"][kind] = agg["by_kind"].get(kind, 0) + 1
+        self._fold_solver(agg, rec)
+
+    @staticmethod
+    def _fold_solver(agg: dict, rec: dict) -> None:
+        """One solver-convergence record into the aggregate: per-lane
+        iteration totals (sum / max / solve count) plus how often the
+        lane stopped EARLY (``iters_used < max_iters`` — the
+        gap-adaptive win the counters exist to show). Scalar
+        ``iters_used`` folds as a single lane 0."""
+        used = rec.get("iters_used")
+        if used is None:
+            return
+        if not isinstance(used, (list, tuple)):
+            used = [used]
+        max_iters = rec.get("max_iters")
+        by_lane = agg["by_lane"]
+        for lane, n in enumerate(used):
+            st = by_lane.setdefault(
+                lane,
+                {"solves": 0, "iters_sum": 0, "iters_max": 0,
+                 "early_stops": 0},
+            )
+            n = int(n)
+            st["solves"] += 1
+            st["iters_sum"] += n
+            st["iters_max"] = max(st["iters_max"], n)
+            if max_iters is not None and n < int(max_iters):
+                st["early_stops"] += 1
+
+    def _solver_summary(self) -> dict:
+        """Per-lane convergence counters (ISSUE 18): for each deflation
+        lane, solve count, mean/max iterations, and the early-stop
+        count the gap-adaptive criterion earned — live window + evicted
+        aggregate."""
+        agg = {
+            "count": self._solver_agg["count"],
+            "by_kind": dict(self._solver_agg["by_kind"]),
+            "by_lane": {
+                lane: dict(st)
+                for lane, st in self._solver_agg["by_lane"].items()
+            },
+        }
+        for r in self.solver_records:
+            agg["count"] += 1
+            kind = r.get("solver", "unknown")
+            agg["by_kind"][kind] = agg["by_kind"].get(kind, 0) + 1
+            self._fold_solver(agg, r)
+        out: dict = {
+            "solves": agg["count"], "by_kind": agg["by_kind"],
+        }
+        lanes = {}
+        for lane in sorted(agg["by_lane"]):
+            st = agg["by_lane"][lane]
+            lanes[str(lane)] = {
+                "solves": st["solves"],
+                "mean_iters": round(st["iters_sum"] / st["solves"], 2),
+                "max_iters": st["iters_max"],
+                "early_stops": st["early_stops"],
+            }
+        if lanes:
+            out["by_lane"] = lanes
+        return out
+
     def _evict_replication(self, rec: dict) -> None:
         agg = self._replication_agg
         agg["count"] += 1
@@ -602,6 +699,13 @@ class MetricsLogger:
             sig = str(tuple(rec["signature"]))
             agg["padded_by_sig"][sig] = (
                 agg["padded_by_sig"].get(sig, 0) + pad
+            )
+        lpad = rec.get("padded_lanes", 0)
+        agg["padded_lanes"] += lpad
+        if lpad and "signature" in rec:
+            sig = str(tuple(rec["signature"]))
+            agg["padded_lanes_by_sig"][sig] = (
+                agg["padded_lanes_by_sig"].get(sig, 0) + lpad
             )
         ff = rec.get("fill_fraction")
         if ff is not None:
@@ -730,6 +834,8 @@ class MetricsLogger:
             out["replication"] = self._replication_summary()
         if self.population_records or self._population_agg["count"]:
             out["population"] = self._population_summary()
+        if self.solver_records or self._solver_agg["count"]:
+            out["solver"] = self._solver_summary()
         if self.serve_records or self._serve_agg["events"]:
             out["serving"] = self._serving_summary()
         if self.fleet_records or self._fleet_agg["events"]:
@@ -806,6 +912,19 @@ class MetricsLogger:
                     by_sig[sig] = by_sig.get(sig, 0) + pad
             if by_sig:
                 out["padded_rows_by_signature"] = by_sig
+        total_lpad = agg["padded_lanes"] + sum(
+            r.get("padded_lanes", 0) for r in batches
+        )
+        if total_lpad:
+            out["padded_lanes"] = total_lpad
+            by_sig_l: dict[str, int] = dict(agg["padded_lanes_by_sig"])
+            for r in batches:
+                lpad = r.get("padded_lanes", 0)
+                if lpad and "signature" in r:
+                    sig = str(tuple(r["signature"]))
+                    by_sig_l[sig] = by_sig_l.get(sig, 0) + lpad
+            if by_sig_l:
+                out["padded_lanes_by_signature"] = by_sig_l
         admits = [
             float(a)
             for r in batches
@@ -1106,6 +1225,9 @@ class MetricsLogger:
                 out["mean_occupancy"] = round(
                     (agg["occ_sum"] + sum(occ)) / occ_n, 4
                 )
+            # occupancy-waste ledger (ISSUE 18: heterogeneous-k
+            # bucketing surfaces padded_lanes[_by_signature] here)
+            out.update(self._occupancy_fields(buckets, agg))
             out.update(self._stall_fields(buckets, agg))
             out.update(self._latency_fields(buckets, agg))
         if self.fleet_records.evicted:

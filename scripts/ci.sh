@@ -106,7 +106,21 @@
 #      sizes; the compare gates the dimensionless extract-speedup
 #      ratio against the committed BENCH_DSOLVE_SMOKE_CPU.json
 #      (same-dims records only — a cross-sweep ratio skips loudly);
-#   14. scripts/scenario.py: the production-shaped scenario replay
+#   14. bench.py --deflate: the parallel-deflation smoke (ISSUE 18) —
+#      a warm-start matched-sweep-budget A/B where the fused
+#      parallel-deflation eigensolve (all k lanes per sweep, kxk
+#      deflation panels) must beat the sequential per-lane deflation
+#      loop outright, every lane must land inside the 0.5 deg angle
+#      budget vs dense eigh in BOTH the cold tol-stopped and warm
+#      fixed-budget regimes (the cold staircase iteration counts are
+#      recorded as telemetry, not gated — single-device cold parallel
+#      pays the staircase in full-width sweeps), elastic grow_basis
+#      must beat a full refit with a bit-identical parent prefix, and
+#      the deflation_solve contract audit must bound every collective
+#      payload (mesh-too-small rigs skip LOUDLY); the compare gates
+#      the warm speedup ratio against the committed
+#      BENCH_DEFLATE_SMOKE_CPU.json (same (d,k,lanes) records only);
+#   15. scripts/scenario.py: the production-shaped scenario replay
 #      (ISSUE 11) — a 3-episode composition (flash crowd + lane kill,
 #      correlated fit-tier churn, mid-burst registry publish) replayed
 #      from scenarios/ci_smoke.json against the full stack, judged
@@ -117,7 +131,7 @@
 #      the committed BENCH_SCENARIO_SMOKE_CPU.json (ratio floors + a
 #      10 s structural recovery bound + a 0.5 absolute attainment
 #      floor, so CPU-rig jitter can't flap CI);
-#   15. scripts/analyze.py --all --costs --shardings --mutation-check:
+#   16. scripts/analyze.py --all --costs --shardings --mutation-check:
 #      the static program-contract gate (ISSUE 10 + 13,
 #      docs/ANALYSIS.md) — every program kind audited against its
 #      declarative contract (collective schedule + payload bounds,
@@ -129,12 +143,12 @@
 #      class is caught. ruff (the dev extra / Dockerfile image) runs
 #      first when on PATH; a missing ruff now SKIPS LOUDLY instead of
 #      silently (DET_CI_REQUIRE_RUFF=1 turns the skip into a failure);
-#   16. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   17. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/16] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/17] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -142,7 +156,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/16] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/17] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -152,7 +166,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/16] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/17] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -167,7 +181,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/16] serve equality + amortization smoke (CPU) =="
+echo "== [4/17] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -182,7 +196,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/16] wirespeed smoke: continuous batching + quantized kernels (CPU) =="
+echo "== [5/17] wirespeed smoke: continuous batching + quantized kernels (CPU) =="
 # bench.py --wirespeed asserts the ISSUE-17 read-path gates itself:
 # one saturating multi-tenant burst served twice (deadline dispatch vs
 # continuous batching) with a publisher hot-swap MID-burst in each arm
@@ -203,7 +217,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --wirespeed
 fi
 
-echo "== [6/16] coldstart + prewarm smoke (CPU) =="
+echo "== [6/17] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -218,7 +232,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [7/16] telemetry smoke: trace export + span-chain validation =="
+echo "== [7/17] telemetry smoke: trace export + span-chain validation =="
 # A serve burst with --trace-out, then a structural validation of the
 # emitted timeline: the JSON must parse as Chrome trace-event format,
 # every served query's span chain (admit → queue_wait → dispatch →
@@ -263,7 +277,7 @@ print(json.dumps({
 }))
 PY
 
-echo "== [8/16] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
+echo "== [8/17] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
 # bench.py --chaos-serve asserts the read-path resilience gates itself
 # (ISSUE 7): a kill -9'd publisher's store recovers (torn snapshot
 # skipped, checksum corruption quarantined) and the restarted server
@@ -282,7 +296,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve
 fi
 
-echo "== [9/16] chaos-churn smoke: elastic membership under churn (CPU) =="
+echo "== [9/17] chaos-churn smoke: elastic membership under churn (CPU) =="
 # bench.py --chaos-churn asserts the fit-tier elastic-membership gates
 # itself (ISSUE 8): a run with 30% mid-run worker loss, flapping
 # rejoins, and a persistent straggler finishes all steps inside the
@@ -302,7 +316,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-churn
 fi
 
-echo "== [10/16] population ingest smoke: cohorts + Byzantine merge (CPU) =="
+echo "== [10/17] population ingest smoke: cohorts + Byzantine merge (CPU) =="
 # bench.py --population asserts the population-scale ingest gates
 # itself (ISSUE 16): a 100k-client simulated population, cohort 256
 # per round, 30% dropout + a mid-run dropout wave + stragglers + NaN
@@ -327,7 +341,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --population
 fi
 
-echo "== [11/16] replica fleet smoke: lease failover + bounded staleness (CPU) =="
+echo "== [11/17] replica fleet smoke: lease failover + bounded staleness (CPU) =="
 # bench.py --replica asserts the replicated-registry gates itself
 # (ISSUE 14): N replicas warm-recover a kill -9'd publisher's store
 # bit-exact; a standby waits out the live lease and takes over at
@@ -349,7 +363,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --replica
 fi
 
-echo "== [12/16] tree-merge smoke: flat vs tiered tree (CPU) =="
+echo "== [12/17] tree-merge smoke: flat vs tiered tree (CPU) =="
 # bench.py --tree asserts the hierarchical-merge gates itself (ISSUE
 # 12): the same planted fit run flat and through the chip:4 x host:2
 # tree must both land inside the angle budget AND agree with each
@@ -368,7 +382,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --tree
 fi
 
-echo "== [13/16] dsolve crossover smoke: eigh vs distributed solve (CPU) =="
+echo "== [13/17] dsolve crossover smoke: eigh vs distributed solve (CPU) =="
 # bench.py --dsolve asserts the distributed-eigensolve gates itself
 # (ISSUE 15): at every swept d the blocked subspace iteration (factor
 # matvecs + CholeskyQR2 + replicated Rayleigh-Ritz, never a d x d
@@ -390,7 +404,32 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --dsolve
 fi
 
-echo "== [14/16] scenario replay: production-shaped composition (CPU) =="
+echo "== [14/17] deflate smoke: parallel deflation + elastic k (CPU) =="
+# bench.py --deflate asserts the parallel-deflation gates itself
+# (ISSUE 18): on a warm start with a MATCHED fixed per-lane sweep
+# budget the fused parallel solve (all k lanes advanced per sweep,
+# deflation corrections as k x k panels — never d x d) must beat the
+# sequential per-lane deflation loop outright; every lane must land
+# inside the 0.5 deg per-lane angle budget vs dense eigh in both the
+# cold tol-stopped and warm fixed-budget regimes (cold iteration
+# counts record the deflation staircase as telemetry — lane l cannot
+# converge before lanes < l — and are deliberately not timed gates on
+# a single device); elastic grow_basis(k -> k') must beat the full
+# refit with the parent prefix bit-identical; and the deflation_solve
+# contract audit must bound every collective payload to lane-block
+# sizes (a rig that cannot build the components mesh skips LOUDLY).
+# The compare gates the warm speedup ratio against the committed
+# record (same (d, k, lanes) records only — cross-shape ratios skip
+# loudly).
+if [[ -f BENCH_DEFLATE_SMOKE_CPU.json ]]; then
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --deflate \
+        --compare BENCH_DEFLATE_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --deflate
+fi
+
+echo "== [15/17] scenario replay: production-shaped composition (CPU) =="
 # scripts/scenario.py replays scenarios/ci_smoke.json — a flash crowd
 # with a mid-crowd lane kill, correlated fit-tier worker churn, and a
 # mid-burst registry publish on one timeline — and judges it purely
@@ -410,7 +449,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --scenario scenarios/ci_smoke.json
 fi
 
-echo "== [15/16] static analysis: contracts + shardings + costs + lints + mutations =="
+echo "== [16/17] static analysis: contracts + shardings + costs + lints + mutations =="
 # scripts/analyze.py compiles (never runs) the whole program matrix and
 # audits each program against its contract — collective schedule,
 # memory policy, baked constants, and (ISSUE 13) the declared
@@ -438,7 +477,7 @@ fi
 JAX_PLATFORMS=cpu python scripts/analyze.py --all --costs --shardings \
     --mutation-check
 
-echo "== [16/16] graft entry + 8-device sharded dryrun =="
+echo "== [17/17] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

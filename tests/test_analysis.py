@@ -46,6 +46,7 @@ def test_matrix_covers_every_contract_kind(devices):
             "scan_solo", "feature_scan", "fleet_b8", "serve_project",
             "tree_fit", "dist_merge", "dist_serve_project",
             "population_reduce", "pallas_serve_project_bf16",
+            "deflation_merge",
         )
     }
     assert kinds == set(contracts.CONTRACTS)

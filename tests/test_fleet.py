@@ -436,3 +436,64 @@ def test_cli_fleet_mode_runs():
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["mode"] == "fleet" and out["tenants"] == 3
     assert out["principal_angle_deg_max"] < 2.0
+
+
+# -- heterogeneous-k bucketing (ISSUE 18) ------------------------------------
+
+
+def test_padded_fleet_cfg_widths():
+    """k pads to the next pow2, stays a multiple of the deflation lane
+    count, caps at dim — and padding that would not change k returns
+    the SAME config object (no spurious bucket split)."""
+    from distributed_eigenspaces_tpu.parallel.fleet import padded_fleet_cfg
+
+    assert padded_fleet_cfg(_cfg(k=5)).k == 8
+    assert padded_fleet_cfg(_cfg(k=7)).k == 8
+    # deflation lanes: pow2 pad 8 is not a multiple of 3 lanes -> 9
+    lane_cfg = _cfg(
+        k=6, solver="deflation", components_axis_size=3,
+    )
+    assert padded_fleet_cfg(lane_cfg).k == 9
+    # cap at dim: dim=6, k=5 -> pow2 8 caps to 6
+    assert padded_fleet_cfg(_cfg(dim=6, k=5, num_workers=1,
+                                 rows_per_worker=8)).k == 6
+    # already padded -> identity, not an equal copy
+    c8 = _cfg(k=8)
+    assert padded_fleet_cfg(c8) is c8
+
+
+def test_fleet_hetero_k_shares_bucket_and_slices(spec):
+    """Two tenants with k=5 and k=7 under ``fleet_pad_k`` land in ONE
+    k=8 bucket (one compiled program), each gets a result sliced to
+    its OWN k, and the dispatch metrics attribute the 4 padded lanes
+    ((8-5)+(8-7)) to the padded signature."""
+    from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+    base = dict(fleet_pad_k=True, fleet_bucket_size=2)
+    cfg5, cfg7 = _cfg(k=5, **base), _cfg(k=7, **base)
+    probs = [_problem(spec, 0), _problem(spec, 1)]
+    metrics = MetricsLogger()
+    with FleetServer(cfg5, mesh=None, metrics=metrics) as srv:
+        t5 = srv.submit(probs[0], cfg=cfg5)
+        t7 = srv.submit(probs[1], cfg=cfg7)
+        w5 = t5.result(timeout=300)
+        w7 = t7.result(timeout=300)
+    assert w5.shape == (D, 5) and w7.shape == (D, 7)
+    # the shared program is the padded-width fit: slicing its result
+    # to each tenant's k is exact
+    cfg8 = _cfg(k=8, **base)
+    assert fleet_signature(cfg8) == (D, 8, M, N, T)
+    ref = fit_fleet(cfg8, probs, mesh=None)
+    np.testing.assert_allclose(
+        w5, ref.components[0][:, :5], rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        w7, ref.components[1][:, :7], rtol=1e-5, atol=1e-6
+    )
+    # the planted top-K still leads each tenant's sliced basis
+    assert _angle(w5[:, :K], spec.top_k(K)) < 1.0
+    assert _angle(w7[:, :K], spec.top_k(K)) < 1.0
+    fleet = metrics.summary()["fleet"]
+    assert fleet["padded_lanes"] == 4
+    by_sig = fleet["padded_lanes_by_signature"]
+    assert by_sig == {str((D, 8, M, N, T)): 4}

@@ -547,3 +547,87 @@ class TestGCReaderRace:
         assert rep.versions() == [3, 4]
         with pytest.raises(VersionRetired, match="'r0'"):
             rep.get(1)
+
+
+# -- elastic-k lineage through replication (ISSUE 18) ------------------------
+
+
+def _grown_from(parent, k1, seed=9):
+    """Widen ``parent`` to k1 columns keeping the prefix bit-exact."""
+    rng = np.random.default_rng(seed)
+    d, k0 = parent.shape
+    extra = rng.standard_normal((d, k1 - k0)).astype(np.float32)
+    extra -= parent @ (parent.T @ extra)
+    extra = np.linalg.qr(extra)[0].astype(np.float32)
+    return np.concatenate([parent, extra], axis=1)
+
+
+class TestGrownReplication:
+    def test_grown_version_tails_with_lineage(self, tmp_path):
+        """A replica that tails a grown publish counts it in
+        ``grown_installs`` and serves the widened basis with the
+        lineage intact — elastic k is a product surface, so the
+        follower fleet must see WHY a version widened, not just that
+        it did."""
+        td = str(tmp_path / "reg")
+        reg = EigenbasisRegistry(registry_dir=td)
+        parent = _basis(seed=3)
+        grown = _grown_from(parent, K + 2)
+        bv0 = reg.publish(parent)
+        rep = ReplicaRegistry(td, name="r0", start=False)
+        rep._poll_once()
+        assert rep.grown_installs == 0
+        bv1 = reg.publish_grown(bv0, grown)
+        rep._poll_once()
+        assert rep.grown_installs == 1
+        lv = rep.latest()
+        assert lv.version == bv1.version
+        assert lv.lineage["grew_from"] == bv0.version
+        assert lv.lineage["k_from"] == K
+        assert lv.lineage["k_to"] == K + 2
+        np.testing.assert_array_equal(
+            np.asarray(lv.v)[:, :K], parent
+        )
+        health = rep.health()
+        assert health["grown_installs"] == 1
+
+    def test_grown_install_event_names_parent(self, tmp_path):
+        """The replica's install event stream carries ``grew_from`` so
+        an operator can trace a width change from any follower."""
+        td = str(tmp_path / "reg")
+        reg = EigenbasisRegistry(registry_dir=td)
+        parent = _basis(seed=4)
+        bv0 = reg.publish(parent)
+        bv1 = reg.publish_grown(bv0, _grown_from(parent, K + 1))
+        metrics = MetricsLogger()
+        rep = ReplicaRegistry(
+            td, name="r0", start=False, metrics=metrics
+        )
+        rep._poll_once()
+        grown_events = [
+            r for r in list(metrics.replication_records)
+            if r.get("kind") == "install"
+            and r.get("grew_from") is not None
+        ]
+        assert len(grown_events) == 1
+        assert grown_events[0]["grew_from"] == bv0.version
+        assert grown_events[0]["version"] == bv1.version
+
+    def test_lineage_outlives_parent_on_replica(self, tmp_path):
+        """GC retires the parent everywhere, but the grown version a
+        replica serves still names it: provenance is append-only even
+        when liveness is not."""
+        td = str(tmp_path / "reg")
+        reg = EigenbasisRegistry(keep=2, registry_dir=td)
+        parent = _basis(seed=5)
+        bv0 = reg.publish(parent)
+        bv1 = reg.publish_grown(bv0, _grown_from(parent, K + 2))
+        reg.publish(_basis(seed=6))
+        reg.publish(_basis(seed=7))
+        rep = ReplicaRegistry(td, name="r0", keep=2, start=False)
+        rep._poll_once()
+        assert rep.versions() == [3, 4]
+        with pytest.raises(VersionRetired, match="'r0'"):
+            rep.get(bv1.version)
+        with pytest.raises(VersionRetired):
+            reg.get(bv0.version)

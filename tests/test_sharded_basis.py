@@ -325,3 +325,120 @@ class TestShardedServing:
             TransformEngine(
                 33, 2, mesh=mesh, basis_spec=(FEATURE_AXIS, None)
             )
+
+
+# -- elastic-k lineage (ISSUE 18) --------------------------------------------
+
+
+def _grown_pair(seed=0, d=D, k0=K, k1=K + 2, parts=2):
+    """A parent basis and its widened child sharing the exact prefix
+    (what ``solvers.grow_basis`` produces), both as row shards."""
+    rng = np.random.default_rng(seed)
+    full = np.linalg.qr(
+        rng.standard_normal((d, k1))
+    )[0].astype(np.float32)
+    parent, grown = full[:, :k0], full
+    rows = d // parts
+    split = lambda v: [  # noqa: E731
+        v[i * rows:(i + 1) * rows] for i in range(parts)
+    ]
+    return split(parent), split(grown), parent, grown
+
+
+class TestElasticKLineage:
+    def test_grown_sharded_roundtrip_keeps_lineage(self, tmp_path):
+        """publish_grown on SHARDED payloads: lineage + prefix survive
+        the durable roundtrip — a fresh registry (the checkpoint-
+        restore path) recovers the grown version with ``grew_from``
+        intact and the first k0 columns bit-equal to the parent."""
+        td = str(tmp_path / "reg")
+        pp, gp, parent, grown = _grown_pair()
+        reg = EigenbasisRegistry(registry_dir=td)
+        bv0 = reg.publish(pp, spec=("features", None))
+        bv1 = reg.publish_grown(
+            bv0, gp, spec=("features", None),
+            lineage={"tenant": "t7"},
+        )
+        assert bv1.lineage["grew_from"] == bv0.version
+        assert bv1.lineage["k_from"] == K
+        assert bv1.lineage["k_to"] == K + 2
+        assert bv1.lineage["producer"] == "grow_basis"
+        assert bv1.lineage["tenant"] == "t7"  # caller entries merge
+        reg2 = EigenbasisRegistry(registry_dir=td)
+        lv = reg2.latest()
+        assert lv.version == bv1.version
+        assert lv.lineage["grew_from"] == bv0.version
+        assert lv.spec == ("features", None)
+        np.testing.assert_array_equal(
+            np.asarray(lv.v)[:, :K], parent
+        )
+        np.testing.assert_array_equal(np.asarray(lv.v), grown)
+
+    def test_grown_prefix_drift_refused_loudly(self, tmp_path):
+        """A grown payload whose prefix drifts from the parent was
+        grown against some OTHER basis — the lineage link is refused,
+        nothing is published."""
+        reg = EigenbasisRegistry(
+            registry_dir=str(tmp_path / "reg")
+        )
+        _, _, parent, grown = _grown_pair()
+        bv0 = reg.publish(parent)
+        bad = grown.copy()
+        bad[:, 0] += 1e-2
+        with pytest.raises(ValueError, match="prefix drifts"):
+            reg.publish_grown(bv0, bad)
+        with pytest.raises(ValueError, match="k' > parent k"):
+            reg.publish_grown(bv0, parent)
+        assert reg.latest().version == bv0.version
+
+    def test_lineage_survives_parent_gc(self, tmp_path):
+        """``grew_from`` is provenance, not a liveness ref: after the
+        parent is GC'd out of the retention window the grown version
+        still serves, still NAMES the retired parent id, and the
+        parent itself answers VersionRetired."""
+        td = str(tmp_path / "reg")
+        reg = EigenbasisRegistry(keep=2, registry_dir=td)
+        _, _, parent, grown = _grown_pair()
+        bv0 = reg.publish(parent)
+        bv1 = reg.publish_grown(bv0, grown)
+        # two more publishes push the parent (and then the grown
+        # version's predecessor) out of keep=2
+        reg.publish(_shards(seed=5)[1])
+        reg.publish(_shards(seed=6)[1])
+        assert bv1.lineage["grew_from"] == bv0.version
+        from distributed_eigenspaces_tpu.serving.registry import (
+            VersionRetired,
+        )
+
+        with pytest.raises(VersionRetired):
+            reg.get(bv0.version)
+        # cold recovery of the survivors keeps the grown lineage
+        reg2 = EigenbasisRegistry(keep=2, registry_dir=td)
+        assert reg2.latest().version == 4
+
+    def test_torn_grown_shard_quarantines_whole_version(self, tmp_path):
+        """One rotted shard in a GROWN version quarantines the whole
+        version with the id burned — the parent keeps serving, and
+        the tenant re-grows under a NEW id instead of a half-corrupt
+        widened basis riding a valid lineage."""
+        td = str(tmp_path / "reg")
+        pp, gp, parent, grown = _grown_pair()
+        reg = EigenbasisRegistry(registry_dir=td)
+        bv0 = reg.publish(pp, spec=("features", None))
+        bv1 = reg.publish_grown(bv0, gp, spec=("features", None))
+        (shard_file,) = glob.glob(
+            os.path.join(td, f"v{bv1.version:08d}", "basis.shard01.npz")
+        )
+        with open(shard_file, "r+b") as f:
+            f.truncate(16)
+        reg2 = EigenbasisRegistry(registry_dir=td)
+        # the parent survives; the grown version is quarantined loudly
+        assert reg2.latest().version == bv0.version
+        assert len(reg2.quarantined) == 1
+        assert glob.glob(os.path.join(td, "v*.quarantined"))
+        # the burned id is never reused: the re-grow advances past it
+        bv2 = reg2.publish_grown(
+            reg2.latest(), gp, spec=("features", None)
+        )
+        assert bv2.version > bv1.version
+        assert bv2.lineage["grew_from"] == bv0.version
