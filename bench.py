@@ -3079,6 +3079,145 @@ def measure_scenario(spec_path: str, trace_out: str | None = None):
     return run_scenario(spec_path, trace_out=trace_out)
 
 
+#: the seeded-bad-plan arm's rollout: a flush deadline of 2 s against a
+#: 150 ms SLO parks every sub-bucket batch far past the objective — a
+#: plan the autoscaler MUST roll back once the judged window's burn
+#: worsens (the --controller gate that proves the rollback arc works)
+_CONTROLLER_BAD_PLAN = {
+    "schema": "plan-v1",
+    "plan_id": "plan-seeded-bad",
+    "chosen": {"config_overrides": {"serve_flush_s": 2.0}},
+}
+
+#: lineage every recorded knob decision must carry (version-style
+#: provenance — ISSUE 19's "every action published like a version")
+_CONTROLLER_LINEAGE = {
+    "action": ("knob", "trigger", "from", "to"),
+    "rollback": ("knob", "trigger", "from", "to"),
+    "commit": ("knob", "trigger", "to"),
+}
+
+
+def _controller_trail(verdict: dict) -> list[dict]:
+    return list((verdict.get("controller") or {}).get("events") or [])
+
+
+def _controller_lineage_ok(trail: list[dict]) -> bool:
+    """Every knob decision carries its full lineage: the named fields
+    for its kind, plus plan_id and seq (plan_id may be None — the key
+    itself must be present)."""
+    for ev in trail:
+        fields = _CONTROLLER_LINEAGE.get(ev.get("kind"))
+        if fields is None:
+            continue
+        if "plan_id" not in ev or "seq" not in ev:
+            return False
+        if any(f not in ev for f in fields):
+            return False
+    return True
+
+
+def measure_controller(spec_path: str = "scenarios/controller_day.json"):
+    """``--controller``: the self-tuning control-plane A/B (ISSUE 19).
+
+    Three replays of the SAME scenario spec (the controller is a
+    runner parameter, never a spec field — both judged arms see one
+    workload):
+
+    - **off**: the baseline the autoscaler must not lose to;
+    - **on**: the autoscaler lane attached, no plan — pure reactive
+      mitigation through the existing elastic surfaces;
+    - **bad-plan**: the autoscaler rolling out a SEEDED harmful plan
+      (``serve_flush_s=2.0`` against a 150 ms SLO) — the observe/
+      rollback arc must restore the knob automatically.
+
+    Judged purely from each replay's ``summary()`` verdict: overall +
+    per-episode SLO attainment, and the ``summary()["controller"]``
+    audit trail. Hard gates (the ok flag): on-arm attainment >= the
+    off arm's, every recorded decision lineage-stamped
+    ({trigger, knob, from, to, plan_id, seq}), and the bad-plan arm
+    fired at least one burn_worsened rollback of the seeded knob."""
+    import jax
+
+    from distributed_eigenspaces_tpu.runtime.scenario import (
+        load_spec,
+        run_scenario,
+    )
+
+    spec = load_spec(spec_path)
+    off_v, off_ok = run_scenario(spec, controller=False)
+    on_v, on_ok = run_scenario(spec, controller=True)
+    bad_v, _bad_ok = run_scenario(
+        spec, controller=True, plan=_CONTROLLER_BAD_PLAN
+    )
+
+    att_off, att_on = off_v.get("value"), on_v.get("value")
+    on_trail = _controller_trail(on_v)
+    bad_trail = _controller_trail(bad_v)
+    bad_id = _CONTROLLER_BAD_PLAN["plan_id"]
+    rollbacks = [
+        ev for ev in bad_trail
+        if ev.get("kind") == "rollback" and ev.get("plan_id") == bad_id
+    ]
+
+    def _ep_att(v):
+        return {
+            name: (ep.get("slo") or {}).get("attainment")
+            for name, ep in (v.get("episodes") or {}).items()
+        }
+
+    gates = {
+        # the scenario harness's own hard gates, both judged arms
+        "off_arm_ok": bool(off_ok),
+        "on_arm_ok": bool(on_ok),
+        # the headline claim: turning the controller ON never loses
+        "on_attainment_ge_off": bool(
+            att_off is not None and att_on is not None
+            and att_on >= att_off
+        ),
+        # every decision across BOTH controller arms is auditable
+        "actions_lineage_stamped": bool(
+            _controller_lineage_ok(on_trail)
+            and _controller_lineage_ok(bad_trail)
+        ),
+        # the seeded bad plan rolled itself back, stamped with its id
+        "bad_plan_rolled_back": bool(rollbacks),
+    }
+    result = {
+        "metric": "pca_controller_ab",
+        "scenario": spec.name,
+        "seed": spec.seed,
+        # the headline value: on-over-off attainment (>= 1 when the
+        # controller pays its way); dimensionless — both arms share
+        # one rig and session
+        "value": (
+            round(att_on / max(att_off, 1e-9), 4)
+            if att_off is not None and att_on is not None else None
+        ),
+        "unit": "slo_attainment_ratio",
+        "attainment_off": att_off,
+        "attainment_on": att_on,
+        "p99_ms_off": (off_v.get("slo") or {}).get(
+            "serve", {}).get("p99_ms"),
+        "p99_ms_on": (on_v.get("slo") or {}).get(
+            "serve", {}).get("p99_ms"),
+        "episodes_off": _ep_att(off_v),
+        "episodes_on": _ep_att(on_v),
+        "controller_on": on_v.get("controller"),
+        "controller_bad_plan": bad_v.get("controller"),
+        "bad_plan": _CONTROLLER_BAD_PLAN,
+        "bad_plan_rollbacks": rollbacks,
+        "device": str(jax.devices()[0]),
+        "gates": gates,
+    }
+    ok = all(gates.values())
+    if not ok:
+        result["controller_fail"] = sorted(
+            g for g, passed in gates.items() if not passed
+        )
+    return result, ok
+
+
 def _coldstart_cfg(cache_dir):
     """The coldstart A/B's FIXED shape signature: a dense subspace-solver
     scan fit (pipeline_merge on — the heaviest-compiling steady-state
@@ -3332,6 +3471,7 @@ def main():
         if i + 1 >= len(args) or args[i + 1].startswith("--"):
             print("usage: bench.py [--steploop] [--fleet [B]] [--serve] "
                   "[--wirespeed] [--coldstart] [--scenario [SPEC]] "
+                  "[--controller [SPEC]] "
                   "[--profile-dir DIR] [--compare BENCH_rNN.json]",
                   file=sys.stderr)
             return 2
@@ -3505,6 +3645,25 @@ def main():
     # measurement itself
     if "--deflate" in args:
         result, ok = measure_deflate()
+        print(json.dumps(result))
+        if not ok:
+            return 1
+        if compare_path is not None:
+            return compare_reports(compare_path, result, compare_threshold)
+        return 0
+
+    # --controller [SPEC]: the self-tuning control-plane A/B (ISSUE
+    # 19) — three replays of one spec (controller off / on / seeded
+    # bad plan), judged purely by summary() telemetry; hard gates:
+    # on-arm attainment >= off, every decision lineage-stamped, bad
+    # plan rolled back; --compare gates on-arm attainment vs a
+    # committed BENCH_CONTROLLER record
+    if "--controller" in args:
+        i = args.index("--controller")
+        spec_path = "scenarios/controller_day.json"
+        if i + 1 < len(args) and not args[i + 1].startswith("--"):
+            spec_path = args[i + 1]
+        result, ok = measure_controller(spec_path)
         print(json.dumps(result))
         if not ok:
             return 1
@@ -4194,6 +4353,61 @@ def compare_reports(old_path: str, result: dict,
         verdict["regression"] = regression
         print(json.dumps(verdict), file=sys.stderr)
         return 1 if regression else 0
+
+    if "pca_controller_ab" in (old_metric, new_metric):
+        # controller A/B records are comparable only when both runs
+        # replayed the SAME spec: the attainment a controller can buy
+        # is a property of the workload's episode shapes, so a
+        # cross-scenario ratio would be a unit error and skips loudly
+        # (either direction — old record from another spec, or a new
+        # run pointed at one)
+        if old.get("scenario") != result.get("scenario"):
+            print(
+                json.dumps({
+                    "compare": "skipped",
+                    "reason": (
+                        f"scenario mismatch: {old.get('scenario')!r} "
+                        f"vs {result.get('scenario')!r} (controller "
+                        "records replay different specs)"
+                    ),
+                }),
+                file=sys.stderr,
+            )
+            return 0
+        a_old = old.get("attainment_on")
+        a_new = result.get("attainment_on")
+        if a_old is None or a_new is None:
+            print(
+                json.dumps({"compare": "skipped",
+                            "reason": "missing on-arm attainment"}),
+                file=sys.stderr,
+            )
+            return 0
+        att_floor = float(
+            _os.environ.get("DET_CONTROLLER_ATTAINMENT_FLOOR") or 0.5
+        )
+        ratio = a_new / max(a_old, 1e-9)
+        verdict = {
+            "compare": old_path,
+            "scenario": result.get("scenario"),
+            "attainment_on_old": a_old,
+            "attainment_on_new": a_new,
+            "ab_ratio_old": old.get("value"),
+            "ab_ratio_new": result.get("value"),
+            "normalized_ratio": round(ratio, 3),
+            "threshold": threshold,
+            "attainment_floor": att_floor,
+            # the bench itself already failed on the hard gates
+            # (on >= off, lineage, bad-plan rollback); the compare
+            # catches the softer drift — a controller that still
+            # "wins" the A/B but attains far less than the committed
+            # record. Like the scenario compare, a regression needs
+            # the ratio drop AND an absolute-floor breach, so CPU-rig
+            # timing jitter cannot flap CI.
+            "regression": bool(ratio < threshold and a_new < att_floor),
+        }
+        print(json.dumps(verdict), file=sys.stderr)
+        return 1 if verdict["regression"] else 0
 
     if "coldstart_speedup" in old or "coldstart_speedup" in result:
         # coldstart records carry a dimensionless speedup (warm/cold of

@@ -448,6 +448,41 @@ def _ast_mutant(fixture: str, linter) -> Callable[[], list]:
     return run
 
 
+def _mutant_plan_infeasible() -> list:
+    """A hand-built ``plan-v1`` whose predicted host-tier merge budget
+    (640 ms/round) exceeds the workload's declared round deadline
+    (50 ms): a planner that accepted this plan would schedule a merge
+    that can never close its rounds. The planner's self-check must
+    refuse it loudly (ISSUE 19 — the ``plan_infeasible_accepted``
+    witness)."""
+    from distributed_eigenspaces_tpu.analysis import planner
+
+    plan = {
+        "schema": planner.PLAN_SCHEMA,
+        "plan_id": "plan-seeded-infeasible",
+        "workload": {
+            "d": 1024, "k": 8, "m": 16, "n": 64,
+            "qps": 100.0, "slo_p99_ms": 200.0,
+            "round_deadline_ms": 50.0,
+        },
+        "chosen": {
+            "config_overrides": {"merge_interval": 1},
+            "predicted": {
+                "fit_tiers": {
+                    "host": {
+                        "fan_in": 2,
+                        "wire_bytes_per_round": 8_000_000_000,
+                        "modeled_ms_per_round": 640.0,
+                        "assumed_gb_per_sec": 12.5,
+                    },
+                },
+                "serve": {"predicted_p99_ms": 120.0},
+            },
+        },
+    }
+    return planner.self_check(plan)
+
+
 #: mutation name -> (expected rule, runner). Every violation class the
 #: analyzer claims to catch has exactly one seeded witness here.
 MUTATIONS: dict[str, tuple[str, Callable[[], list]]] = {
@@ -472,6 +507,9 @@ MUTATIONS: dict[str, tuple[str, Callable[[], list]]] = {
     ),
     "pallas_full_block": (
         "pallas-block", _mutant_pallas_full_block
+    ),
+    "plan_infeasible_accepted": (
+        "plan-infeasible", _mutant_plan_infeasible
     ),
     "blocking_under_lock": ("blocking-under-lock", _ast_mutant(
         _FIXTURE_BLOCKING, ast_lints.lint_concurrency_source

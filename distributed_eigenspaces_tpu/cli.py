@@ -298,6 +298,18 @@ def build_parser() -> argparse.ArgumentParser:
                    "mark worker solve / gather / merge / state update)")
     p.add_argument("--save", default=None,
                    help="write the final (d, k) subspace to this .npy")
+    p.add_argument("--plan", default=None, metavar="PATH",
+                   help="apply a plan-v1 artifact (analysis/planner.py; "
+                   "generated by scripts/analyze.py --plan "
+                   "--write-plan): the plan's self-check runs first — "
+                   "any violation (tier budget over deadline, "
+                   "predicted p99 over SLO, invalid overrides) rejects "
+                   "the run loudly — then its declared workload shape "
+                   "(--workers/--rank/--dim/--rows-per-worker/"
+                   "--slo-p99-ms) and chosen config_overrides (merge "
+                   "topology/interval/pipeline, replicas, serve "
+                   "bucket/flush/continuous) are applied before the "
+                   "run; PCAConfig.plan_path records the provenance")
     sup = p.add_argument_group(
         "supervision",
         "self-healing runs (runtime/supervisor.py): corrupt input "
@@ -1499,6 +1511,51 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    if args.plan:
+        from .analysis import planner
+
+        plan = planner.load_plan(args.plan)
+        if plan is None:
+            print(f"error: --plan {args.plan}: no such plan artifact",
+                  file=sys.stderr)
+            return 2
+        viols = planner.self_check(plan)
+        if viols:
+            # a plan that fails its own audit never runs: the planner
+            # refused it at emit time, so one arriving here is stale
+            # (records re-committed under it) or hand-edited
+            for v in viols:
+                print(f"error: --plan {args.plan}: {v.format()}",
+                      file=sys.stderr)
+            return 2
+        workload = plan.get("workload") or {}
+        for field, attr in (
+            ("m", "workers"), ("k", "rank"), ("d", "dim"),
+            ("n", "rows_per_worker"), ("slo_p99_ms", "slo_p99_ms"),
+        ):
+            if workload.get(field) is not None:
+                setattr(args, attr, workload[field])
+        over = (plan.get("chosen") or {}).get("config_overrides") or {}
+        for knob, attr in (
+            ("merge_interval", "merge_interval"),
+            ("pipeline_merge", "pipeline_merge"),
+            ("replicas", "replicas"),
+            ("serve_bucket_size", "serve_bucket"),
+            ("serve_continuous", "serve_continuous"),
+            ("serve_flush_s", "serve_flush_s"),
+        ):
+            if knob in over:
+                setattr(args, attr, over[knob])
+        if over.get("merge_topology"):
+            args.merge_topology = ",".join(
+                f"{name}:{fan}" for name, fan in over["merge_topology"]
+            )
+        print(
+            f"note: --plan {args.plan}: applied {plan['plan_id']} "
+            f"({', '.join(sorted(over))})",
+            file=sys.stderr,
+        )
+
     if args.data == "synthetic":
         # --data synthetic sizes its sample by --steps, and checkpoint
         # resume re-runs with a LARGER --steps: the resumed run must see
@@ -1761,6 +1818,7 @@ def main(argv=None) -> int:
         cohort_size=args.cohort_size,
         min_participation_frac=args.min_participation_frac,
         max_poison_frac=args.max_poison_frac,
+        plan_path=args.plan,
     )
 
     if args.mode == "serve":

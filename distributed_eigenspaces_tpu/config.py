@@ -416,6 +416,28 @@ class PCAConfig:
         fraction on each side) and the bench's poison arm. Must lie in
         [0, 0.5) — trimming both tails past half the cohort leaves
         nothing to average.
+      controller_window_s: observation window, in seconds, for the
+        online autoscaler (``runtime/controller.py``). Each window the
+        controller reads ``metrics.summary()`` (SLO burn fast/slow,
+        queue depth, occupancy fill, shed counts), applies AT MOST one
+        knob change through an existing elastic surface (bucket size,
+        flush deadline, ``serve_continuous``), then holds for one full
+        window to observe before acting again; an action whose burn
+        WORSENS within that observation window is rolled back loudly.
+        ``None`` (default) disables the controller entirely — dispatch
+        is byte-identical to a pre-controller build.
+      controller_max_actions: hard budget on autoscaler actions per run
+        (rollbacks included). The controller freezes — loudly, via a
+        ``budget_exhausted`` decision record — once the budget is
+        spent; a runaway oscillation therefore self-limits instead of
+        thrashing the queue. Must be an int >= 1.
+      plan_path: path to a ``plan-v1`` JSON artifact emitted by the
+        offline planner (``analysis/planner.py``; CLI ``--plan``,
+        ``scripts/analyze.py --plan``). The artifact carries the chosen
+        config overrides plus the predicted per-tier budgets that
+        justified them; consumers apply the overrides and stamp the
+        plan id into controller action lineage. ``None`` (default)
+        means no plan — every knob keeps its hand-picked value.
       seed: PRNG seed for initialization (subspace solver, synthetic data).
     """
 
@@ -470,6 +492,9 @@ class PCAConfig:
     cohort_size: int = 256
     min_participation_frac: float = 0.5
     max_poison_frac: float = 0.05
+    controller_window_s: float | None = None
+    controller_max_actions: int = 8
+    plan_path: str | None = None
     seed: int = 0
 
     def __post_init__(self):
@@ -831,6 +856,31 @@ class PCAConfig:
                 f"max_poison_frac must be a fraction in [0, 0.5), got "
                 f"{self.max_poison_frac!r} (trimming both α-tails past "
                 "half the cohort leaves nothing to average)"
+            )
+        if self.controller_window_s is not None and (
+            not isinstance(self.controller_window_s, (int, float))
+            or isinstance(self.controller_window_s, bool)
+            or self.controller_window_s <= 0
+        ):
+            raise ValueError(
+                f"controller_window_s must be a positive duration in "
+                f"seconds or None, got {self.controller_window_s!r} "
+                "(None disables the online autoscaler)"
+            )
+        if not isinstance(self.controller_max_actions, int) or isinstance(
+            self.controller_max_actions, bool
+        ) or self.controller_max_actions < 1:
+            raise ValueError(
+                f"controller_max_actions must be an int >= 1, got "
+                f"{self.controller_max_actions!r} (to disable the "
+                "controller set controller_window_s=None instead)"
+            )
+        if self.plan_path is not None and (
+            not isinstance(self.plan_path, str) or not self.plan_path
+        ):
+            raise ValueError(
+                f"plan_path must be a non-empty path string or None, "
+                f"got {self.plan_path!r}"
             )
         if self.remainder not in ("drop", "pad", "error"):
             raise ValueError(f"unknown remainder policy: {self.remainder!r}")

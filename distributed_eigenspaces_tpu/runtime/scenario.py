@@ -569,9 +569,19 @@ class ScenarioRunner:
     computes the pure-telemetry verdict. Construct once, ``run()``
     once."""
 
-    def __init__(self, spec: ScenarioSpec, *, trace_out: str | None = None):
+    def __init__(
+        self, spec: ScenarioSpec, *, trace_out: str | None = None,
+        controller: bool = False, plan: dict | None = None,
+    ):
         self.spec = spec
         self.trace_out = trace_out
+        #: controller on/off is a RUNNER parameter, not a spec field —
+        #: the A/B bench replays the SAME spec both ways (the spec's
+        #: ``config`` block may still tune ``controller_window_s``)
+        self.controller = controller
+        #: optional plan-v1 dict whose serve overrides the controller
+        #: rolls out (one knob per window, observe + rollback)
+        self.plan = plan
         self.schedule = build_schedule(spec)
         # runner bookkeeping — feeds the hard gates only, never the
         # judged telemetry fields
@@ -978,6 +988,21 @@ class ScenarioRunner:
             # past every episode)
             lease_timeout=0.3,
         )
+        controller = None
+        if self.controller:
+            from distributed_eigenspaces_tpu.runtime.controller import (
+                Controller,
+            )
+
+            ctl_cfg = (
+                cfg if cfg.controller_window_s is not None
+                # default window: a few control decisions fit inside a
+                # CPU-rig replay horizon (specs override via config)
+                else cfg.replace(controller_window_s=0.2)
+            )
+            controller = Controller(
+                server, metrics, ctl_cfg, plan=self.plan
+            ).start()
         try:
             t_base = time.perf_counter()
             for action in self.schedule.actions:
@@ -1121,6 +1146,10 @@ class ScenarioRunner:
             # span records what actually ran)
             for h in handles.values():
                 h.close()
+            if controller is not None:
+                # stop the control lane BEFORE the server: a knob write
+                # racing close() would act on a draining queue
+                controller.close()
             if fleet is not None:
                 fleet.close()
             server.close()
@@ -1282,13 +1311,23 @@ class ScenarioRunner:
             },
             "gates": gates,
         }
+        if "controller" in summary:
+            # the control plane's audit trail rides the verdict
+            # verbatim — every decision with lineage + evidence
+            verdict["controller"] = summary["controller"]
         return verdict
 
 
 def run_scenario(
-    source: Any, *, trace_out: str | None = None
+    source: Any, *, trace_out: str | None = None,
+    controller: bool = False, plan: dict | None = None,
 ) -> tuple[dict, bool]:
     """Load (or accept) a spec, replay it, return ``(verdict, ok)`` —
-    the one-call form bench.py and scripts/scenario.py share."""
+    the one-call form bench.py and scripts/scenario.py share.
+    ``controller=True`` runs the same replay with the autoscaler lane
+    attached (ISSUE 19's A/B arm); ``plan`` hands it a ``plan-v1``
+    dict to roll out."""
     spec = source if isinstance(source, ScenarioSpec) else load_spec(source)
-    return ScenarioRunner(spec, trace_out=trace_out).run()
+    return ScenarioRunner(
+        spec, trace_out=trace_out, controller=controller, plan=plan
+    ).run()

@@ -794,14 +794,20 @@ class ShapeBucketQueue:
         return take
 
     def _batch_completed(self) -> None:
-        """Continuous-mode completion hook (runs on the dispatch lane as
-        each batch finishes): free the lane's budget slot and assemble
-        the next batch(es) from the pooled signatures, oldest deadline
-        first — the lane goes straight back to work."""
+        """Batch-completion hook (runs on the dispatch lane as each
+        batch finishes): free the lane's budget slot and — in
+        continuous mode — assemble the next batch(es) from the pooled
+        signatures, oldest deadline first, so the lane goes straight
+        back to work. The decrement runs in BOTH modes: ``continuous``
+        is a live knob (the controller flips it mid-run), and an
+        inflight ledger that only ever counts down while the knob is on
+        wedges the pool behind phantom in-flight batches the moment the
+        knob flips."""
         with self._lock:
             self._inflight_batches = max(0, self._inflight_batches - 1)
             while (
-                self._inflight_batches < self._lane_budget
+                self.continuous
+                and self._inflight_batches < self._lane_budget
                 and self._buckets
             ):
                 sig = (
@@ -844,12 +850,14 @@ class ShapeBucketQueue:
         everything queued has executed. WorkQueue's retry/lease policy
         applies per bucket; a bucket that exhausts its retries fails its
         tickets with the scheduler error instead of hanging them."""
-        if self.continuous:
-            with self._lock:
-                # the in-flight batch budget IS the lane count: one
-                # batch per lane keeps every lane busy with zero
-                # head-of-line queueing inside the work queue
-                self._lane_budget = max(int(num_lanes), 1)
+        with self._lock:
+            # the in-flight batch budget IS the lane count: one batch
+            # per lane keeps every lane busy with zero head-of-line
+            # queueing inside the work queue. Set unconditionally —
+            # ``continuous`` is a live knob, and a run that starts in
+            # deadline mode must still have the right budget when the
+            # controller flips it on
+            self._lane_budget = max(int(num_lanes), 1)
 
         def fold(task_id: int, out) -> None:
             bucket, results = out
@@ -882,13 +890,14 @@ class ShapeBucketQueue:
                         })
                     raise
             finally:
-                if self.continuous:
-                    # the lane is free the moment this batch stops
-                    # computing — success, dispatch failure, or lane
-                    # death alike (a re-leased bucket decrements again;
-                    # the budget clamps at zero, so chaos can only
-                    # over-free, never wedge the pool)
-                    self._batch_completed()
+                # the lane is free the moment this batch stops
+                # computing — success, dispatch failure, or lane
+                # death alike (a re-leased bucket decrements again;
+                # the budget clamps at zero, so chaos can only
+                # over-free, never wedge the pool). Unconditional:
+                # every _flush_locked counted this batch in, whatever
+                # mode the live knob is in by the time it completes.
+                self._batch_completed()
             if br is not None and br.state != "closed":
                 self._emit("breaker", {
                     "event": "closed", "signature": bucket.signature,

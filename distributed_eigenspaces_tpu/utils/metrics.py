@@ -148,6 +148,14 @@ class MetricsLogger:
         #: ``iters_used`` / residuals, per-lane — surfaced by
         #: :meth:`summary` under "solver"
         self.solver_records = RingLog(retention, self._evict_solver)
+        #: control-plane decisions (runtime/controller.py Controller,
+        #: ISSUE 19): every autoscaler action / rollback / freeze with
+        #: the lineage ``{trigger, knob, from, to, plan_id}`` and the
+        #: telemetry evidence that triggered it — surfaced by
+        #: :meth:`summary` under "controller"
+        self.controller_records = RingLog(
+            retention, self._evict_controller
+        )
         #: compile-lifecycle counters (utils/compile_cache.py
         #: CompileCache), attached via :meth:`attach_compile` —
         #: surfaced by :meth:`summary` under "compile"
@@ -221,6 +229,12 @@ class MetricsLogger:
         # run after ring-buffer eviction
         self._solver_agg: dict = {
             "count": 0, "by_kind": {}, "by_lane": {},
+        }
+        # control-plane eviction aggregates (ISSUE 19): decision counts
+        # by kind plus per-knob action/rollback counters — so
+        # summary()["controller"] covers the whole run after eviction
+        self._controller_agg: dict = {
+            "count": 0, "by_kind": {}, "by_knob": {}, "rollbacks": 0,
         }
 
     @staticmethod
@@ -442,6 +456,20 @@ class MetricsLogger:
         if self.stream is not None:
             print(json.dumps(rec), file=self.stream, flush=True)
 
+    def controller(self, event: dict) -> None:
+        """Record one structured control-plane decision
+        (``runtime/controller.py``): an autoscaler ``action`` /
+        ``rollback`` with the full lineage ``{trigger, knob, from, to,
+        plan_id}`` and the triggering telemetry evidence, a
+        ``budget_exhausted`` freeze, or a lifecycle ``start``/``stop``.
+        Rides the same JSON stream as step records, tagged
+        ``"controller"``."""
+        rec = {"controller": event.get("kind", "unknown"), **event}
+        _stamp(rec)
+        self.controller_records.append(rec)
+        if self.stream is not None:
+            print(json.dumps(rec), file=self.stream, flush=True)
+
     def fault(self, event: dict) -> None:
         """Record one structured fault event (a supervisor detection /
         recovery action). Events ride the same JSON stream as step
@@ -584,6 +612,57 @@ class MetricsLogger:
             st["iters_max"] = max(st["iters_max"], n)
             if max_iters is not None and n < int(max_iters):
                 st["early_stops"] += 1
+
+    def _evict_controller(self, rec: dict) -> None:
+        agg = self._controller_agg
+        agg["count"] += 1
+        kind = rec.get("controller", "unknown")
+        agg["by_kind"][kind] = agg["by_kind"].get(kind, 0) + 1
+        self._fold_controller(agg, rec)
+
+    @staticmethod
+    def _fold_controller(agg: dict, rec: dict) -> None:
+        """One control-plane decision into the aggregate: per-knob
+        action counts plus the rollback total — the numbers the
+        A/B gates read even after the decision records themselves
+        evicted."""
+        kind = rec.get("controller")
+        if kind in ("action", "rollback"):
+            knob = rec.get("knob", "unknown")
+            agg["by_knob"][knob] = agg["by_knob"].get(knob, 0) + 1
+        if kind == "rollback":
+            agg["rollbacks"] += 1
+
+    def _controller_summary(self) -> dict:
+        """The ``summary()["controller"]`` section (ISSUE 19): every
+        retained control-plane decision verbatim — lineage ``{trigger,
+        knob, from, to, plan_id}`` plus the telemetry evidence that
+        triggered it — with counts by kind / by knob and the rollback
+        total covering the whole run (evictions folded)."""
+        agg = {
+            "count": self._controller_agg["count"],
+            "by_kind": dict(self._controller_agg["by_kind"]),
+            "by_knob": dict(self._controller_agg["by_knob"]),
+            "rollbacks": self._controller_agg["rollbacks"],
+        }
+        for r in self.controller_records:
+            agg["count"] += 1
+            kind = r.get("controller", "unknown")
+            agg["by_kind"][kind] = agg["by_kind"].get(kind, 0) + 1
+            self._fold_controller(agg, r)
+        out: dict = {
+            "decisions": agg["count"],
+            "by_kind": agg["by_kind"],
+            "rollbacks": agg["rollbacks"],
+            # the events list holds the RETAINED window; evicted
+            # decisions survive in the counters above
+            "events": list(self.controller_records),
+        }
+        if agg["by_knob"]:
+            out["by_knob"] = agg["by_knob"]
+        if self.controller_records.evicted:
+            out["events_evicted"] = self.controller_records.evicted
+        return out
 
     def _solver_summary(self) -> dict:
         """Per-lane convergence counters (ISSUE 18): for each deflation
@@ -836,6 +915,8 @@ class MetricsLogger:
             out["population"] = self._population_summary()
         if self.solver_records or self._solver_agg["count"]:
             out["solver"] = self._solver_summary()
+        if self.controller_records or self._controller_agg["count"]:
+            out["controller"] = self._controller_summary()
         if self.serve_records or self._serve_agg["events"]:
             out["serving"] = self._serving_summary()
         if self.fleet_records or self._fleet_agg["events"]:

@@ -23,6 +23,8 @@ Usage:
     python scripts/analyze.py --all [--costs] [--shardings] \
         [--mutation-check] [--json OUT]
     python scripts/analyze.py --all --costs --write-costs   # commit
+    python scripts/analyze.py --plan                        # planner smoke
+    python scripts/analyze.py --plan --write-plan           # commit
     python scripts/analyze.py --programs scan_solo,fleet_b8
     python scripts/analyze.py --lints-only
     python scripts/analyze.py --list
@@ -30,6 +32,14 @@ Usage:
 ``--costs`` regenerates the analytic snapshot and diff-gates it
 against the committed ``ANALYSIS_COSTS.json`` (regeneration on clean
 HEAD is a no-op; intentional changes re-commit via ``--write-costs``).
+
+``--plan`` replans the default declared workload (``analysis/
+planner.py``), diff-gates the artifact against the committed
+``ANALYSIS_PLAN.json``, and runs the model-vs-measured drift check
+against the records currently committed: a ``warn`` row (>= 2x) is
+printed loudly, a ``fail`` row (>= 5x) fails the stage — the
+cost-model loop's CI teeth. Intentional changes (new calibration
+records, planner changes) re-commit via ``--write-plan``.
 
 Exit code 0 iff every audited program honors its contract, the lints
 are clean, the snapshot has no drift, and (with ``--mutation-check``)
@@ -98,6 +108,13 @@ def main(argv=None) -> int:
     ap.add_argument("--write-costs", action="store_true",
                     help="write the regenerated snapshot to "
                          "ANALYSIS_COSTS.json (with --costs)")
+    ap.add_argument("--plan", action="store_true",
+                    help="replan the default workload, diff-gate it "
+                         "against the committed ANALYSIS_PLAN.json, "
+                         "and drift-check model vs measured records")
+    ap.add_argument("--write-plan", action="store_true",
+                    help="write the regenerated plan to "
+                         "ANALYSIS_PLAN.json (with --plan)")
     ap.add_argument("--json", default=None, metavar="OUT",
                     help="write the machine-readable report here")
     args = ap.parse_args(argv)
@@ -117,40 +134,43 @@ def main(argv=None) -> int:
             print(f"  {key}: {c.description}")
         return 0
 
-    if not (args.all or args.programs or args.lints_only):
-        ap.error("pick one of --all / --programs / --lints-only / --list")
+    run_audit = args.all or args.programs or args.lints_only
+    if not (run_audit or args.plan or args.write_plan):
+        ap.error("pick one of --all / --programs / --lints-only / "
+                 "--plan / --list")
 
     t0 = time.time()
     out: dict = {"schema": report_mod.SCHEMA}
     failures = 0
 
-    if args.lints_only:
-        rep = report_mod.run_analysis([], lints=True)
-    else:
-        subset = (
-            [s for s in args.programs.split(",") if s]
-            if args.programs else None
-        )
-        rep = report_mod.run_analysis(subset, lints=not args.programs)
-    out["analysis"] = rep
-    failures += rep["n_violations"]
+    if run_audit:
+        if args.lints_only:
+            rep = report_mod.run_analysis([], lints=True)
+        else:
+            subset = (
+                [s for s in args.programs.split(",") if s]
+                if args.programs else None
+            )
+            rep = report_mod.run_analysis(subset, lints=not args.programs)
+        out["analysis"] = rep
+        failures += rep["n_violations"]
 
-    print(f"programs audited: {len(rep['programs'])}")
-    _print_program_rows(rep)
-    for key, entry in rep["lints"].items():
-        n = len(entry["violations"])
-        print(f"  lint:{key:21s} {'ok' if entry['ok'] else 'FAIL'}"
-              f"   violations={n}")
-    for name, entry in rep["programs"].items():
-        for v in entry["violations"]:
-            print(f"    VIOLATION {v['program']}: {v['rule']}: "
-                  f"{v['message']} [{v['location']}]")
-    for key, entry in rep["lints"].items():
-        for v in entry["violations"]:
-            print(f"    VIOLATION {v['program']}: {v['rule']}: "
-                  f"{v['message']} [{v['location']}]")
+        print(f"programs audited: {len(rep['programs'])}")
+        _print_program_rows(rep)
+        for key, entry in rep["lints"].items():
+            n = len(entry["violations"])
+            print(f"  lint:{key:21s} {'ok' if entry['ok'] else 'FAIL'}"
+                  f"   violations={n}")
+        for name, entry in rep["programs"].items():
+            for v in entry["violations"]:
+                print(f"    VIOLATION {v['program']}: {v['rule']}: "
+                      f"{v['message']} [{v['location']}]")
+        for key, entry in rep["lints"].items():
+            for v in entry["violations"]:
+                print(f"    VIOLATION {v['program']}: {v['rule']}: "
+                      f"{v['message']} [{v['location']}]")
 
-    if args.shardings:
+    if args.shardings and run_audit:
         out["shardings"] = {
             name: entry.get("shardings", {})
             for name, entry in rep["programs"].items()
@@ -225,6 +245,76 @@ def main(argv=None) -> int:
             print(f"    VIOLATION {v.program}: {v.rule}: "
                   f"{v.message} [{v.location}]")
             failures += 1
+
+    if args.plan or args.write_plan:
+        from distributed_eigenspaces_tpu.analysis import planner
+        from distributed_eigenspaces_tpu.analysis.report import (
+            _violations_json,
+        )
+
+        plan_entry: dict = {}
+        try:
+            plan = planner.make_plan()
+        except planner.PlanInfeasible as e:
+            # the committed default workload must stay plannable — an
+            # infeasible default is a calibration or model regression
+            print(f"plan: INFEASIBLE: {e}")
+            plan_entry = {"ok": False, "infeasible": str(e)}
+            out["plan"] = plan_entry
+            plan = None
+            failures += 1
+        if plan is not None:
+            if args.write_plan:
+                path = planner.plan_file_path()
+                with open(path, "w", encoding="utf-8") as f:
+                    json.dump(plan, f, indent=2, sort_keys=True)
+                    f.write("\n")
+                print(f"plan -> {path}")
+            plan_drift = planner.check_plan(plan, planner.load_plan())
+            rows = planner.drift_check(plan)
+            n_warn = sum(1 for r in rows if r["status"] == "warn")
+            n_fail = sum(
+                1 for r in rows if r["status"] in ("fail", "missing")
+            )
+            plan_entry = {
+                "schema": plan["schema"],
+                "plan_id": plan["plan_id"],
+                "chosen": plan["chosen"]["config_overrides"],
+                "predicted": plan["chosen"]["predicted"],
+                "drift": _violations_json(plan_drift),
+                "model_vs_measured": rows,
+                "ok": not plan_drift and n_fail == 0,
+            }
+            out["plan"] = plan_entry
+            ch = plan["chosen"]
+            print(f"plan: {plan['plan_id']} "
+                  f"({plan['candidates_considered']} candidates, "
+                  f"{sum(plan['rejected'].values())} rejected)")
+            for knob, val in sorted(
+                ch["config_overrides"].items()
+            ):
+                print(f"  {knob:22s} = {val}")
+            pred = ch["predicted"]
+            print(f"  predicted serve p99 = "
+                  f"{pred['serve']['predicted_p99_ms']} ms "
+                  f"(SLO {plan['workload']['slo_p99_ms']} ms), "
+                  f"fit {pred['fit_ms_per_step']} ms/step")
+            print("model vs measured (warn >= "
+                  f"{planner.DRIFT_WARN_RATIO}x, fail >= "
+                  f"{planner.DRIFT_FAIL_RATIO}x):")
+            for r in rows:
+                print(f"  {r['anchor']:26s} {r['status']:7s} "
+                      f"predicted={r.get('predicted')} "
+                      f"measured={r.get('measured')} "
+                      f"ratio={r.get('ratio', '-')}")
+            for v in plan_drift:
+                print(f"    VIOLATION {v.program}: {v.rule}: "
+                      f"{v.message} [{v.location}]")
+            failures += len(plan_drift) + n_fail
+            if n_warn:
+                print(f"  plan drift: {n_warn} anchor(s) in the warn "
+                      "band — re-record the bench or revisit the "
+                      "model before they hit the fail threshold")
 
     if args.mutation_check:
         mut = report_mod.run_mutation_report()

@@ -131,7 +131,19 @@
 #      the committed BENCH_SCENARIO_SMOKE_CPU.json (ratio floors + a
 #      10 s structural recovery bound + a 0.5 absolute attainment
 #      floor, so CPU-rig jitter can't flap CI);
-#   16. scripts/analyze.py --all --costs --shardings --mutation-check:
+#   16. bench.py --controller: the self-tuning control-plane A/B
+#      (ISSUE 19) — three replays of scenarios/controller_day.json
+#      (controller off / on / seeded bad plan), judged purely from
+#      summary() telemetry: the on arm's SLO attainment must meet or
+#      beat the off arm's, every autoscaler decision must carry its
+#      version-style lineage ({trigger, knob, from, to, plan_id,
+#      seq} + evidence), and the seeded harmful plan must roll itself
+#      back on worsened burn. The compare gates on-arm attainment
+#      drift against the committed BENCH_CONTROLLER_SMOKE_CPU.json
+#      (ratio floor + 0.5 absolute attainment floor, override with
+#      DET_CONTROLLER_ATTAINMENT_FLOOR; cross-scenario records skip
+#      loudly both directions);
+#   17. scripts/analyze.py --all --costs --shardings --mutation-check:
 #      the static program-contract gate (ISSUE 10 + 13,
 #      docs/ANALYSIS.md) — every program kind audited against its
 #      declarative contract (collective schedule + payload bounds,
@@ -143,12 +155,21 @@
 #      class is caught. ruff (the dev extra / Dockerfile image) runs
 #      first when on PATH; a missing ruff now SKIPS LOUDLY instead of
 #      silently (DET_CI_REQUIRE_RUFF=1 turns the skip into a failure);
-#   17. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   18. scripts/analyze.py --plan: the planner smoke (ISSUE 19) —
+#      replans the default declared workload from the committed
+#      calibration records (wirespeed / serve / coldstart smokes +
+#      EXP_PIPELINE_CPU.json), diff-gates the artifact against the
+#      committed ANALYSIS_PLAN.json (any drift names the field and
+#      both values; intentional changes re-commit via --write-plan),
+#      and runs the model-vs-measured drift check: a >= 2x anchor
+#      ratio warns loudly, >= 5x fails the stage — the cost-model
+#      loop's teeth;
+#   19. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/17] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/19] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -156,7 +177,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/17] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/19] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -166,7 +187,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/17] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/19] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -181,7 +202,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/17] serve equality + amortization smoke (CPU) =="
+echo "== [4/19] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -196,7 +217,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/17] wirespeed smoke: continuous batching + quantized kernels (CPU) =="
+echo "== [5/19] wirespeed smoke: continuous batching + quantized kernels (CPU) =="
 # bench.py --wirespeed asserts the ISSUE-17 read-path gates itself:
 # one saturating multi-tenant burst served twice (deadline dispatch vs
 # continuous batching) with a publisher hot-swap MID-burst in each arm
@@ -217,7 +238,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --wirespeed
 fi
 
-echo "== [6/17] coldstart + prewarm smoke (CPU) =="
+echo "== [6/19] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -232,7 +253,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [7/17] telemetry smoke: trace export + span-chain validation =="
+echo "== [7/19] telemetry smoke: trace export + span-chain validation =="
 # A serve burst with --trace-out, then a structural validation of the
 # emitted timeline: the JSON must parse as Chrome trace-event format,
 # every served query's span chain (admit → queue_wait → dispatch →
@@ -277,7 +298,7 @@ print(json.dumps({
 }))
 PY
 
-echo "== [8/17] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
+echo "== [8/19] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
 # bench.py --chaos-serve asserts the read-path resilience gates itself
 # (ISSUE 7): a kill -9'd publisher's store recovers (torn snapshot
 # skipped, checksum corruption quarantined) and the restarted server
@@ -296,7 +317,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve
 fi
 
-echo "== [9/17] chaos-churn smoke: elastic membership under churn (CPU) =="
+echo "== [9/19] chaos-churn smoke: elastic membership under churn (CPU) =="
 # bench.py --chaos-churn asserts the fit-tier elastic-membership gates
 # itself (ISSUE 8): a run with 30% mid-run worker loss, flapping
 # rejoins, and a persistent straggler finishes all steps inside the
@@ -316,7 +337,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-churn
 fi
 
-echo "== [10/17] population ingest smoke: cohorts + Byzantine merge (CPU) =="
+echo "== [10/19] population ingest smoke: cohorts + Byzantine merge (CPU) =="
 # bench.py --population asserts the population-scale ingest gates
 # itself (ISSUE 16): a 100k-client simulated population, cohort 256
 # per round, 30% dropout + a mid-run dropout wave + stragglers + NaN
@@ -341,7 +362,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --population
 fi
 
-echo "== [11/17] replica fleet smoke: lease failover + bounded staleness (CPU) =="
+echo "== [11/19] replica fleet smoke: lease failover + bounded staleness (CPU) =="
 # bench.py --replica asserts the replicated-registry gates itself
 # (ISSUE 14): N replicas warm-recover a kill -9'd publisher's store
 # bit-exact; a standby waits out the live lease and takes over at
@@ -363,7 +384,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --replica
 fi
 
-echo "== [12/17] tree-merge smoke: flat vs tiered tree (CPU) =="
+echo "== [12/19] tree-merge smoke: flat vs tiered tree (CPU) =="
 # bench.py --tree asserts the hierarchical-merge gates itself (ISSUE
 # 12): the same planted fit run flat and through the chip:4 x host:2
 # tree must both land inside the angle budget AND agree with each
@@ -382,7 +403,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --tree
 fi
 
-echo "== [13/17] dsolve crossover smoke: eigh vs distributed solve (CPU) =="
+echo "== [13/19] dsolve crossover smoke: eigh vs distributed solve (CPU) =="
 # bench.py --dsolve asserts the distributed-eigensolve gates itself
 # (ISSUE 15): at every swept d the blocked subspace iteration (factor
 # matvecs + CholeskyQR2 + replicated Rayleigh-Ritz, never a d x d
@@ -404,7 +425,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --dsolve
 fi
 
-echo "== [14/17] deflate smoke: parallel deflation + elastic k (CPU) =="
+echo "== [14/19] deflate smoke: parallel deflation + elastic k (CPU) =="
 # bench.py --deflate asserts the parallel-deflation gates itself
 # (ISSUE 18): on a warm start with a MATCHED fixed per-lane sweep
 # budget the fused parallel solve (all k lanes advanced per sweep,
@@ -429,7 +450,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --deflate
 fi
 
-echo "== [15/17] scenario replay: production-shaped composition (CPU) =="
+echo "== [15/19] scenario replay: production-shaped composition (CPU) =="
 # scripts/scenario.py replays scenarios/ci_smoke.json — a flash crowd
 # with a mid-crowd lane kill, correlated fit-tier worker churn, and a
 # mid-burst registry publish on one timeline — and judges it purely
@@ -449,7 +470,27 @@ else
     JAX_PLATFORMS=cpu python bench.py --scenario scenarios/ci_smoke.json
 fi
 
-echo "== [16/17] static analysis: contracts + shardings + costs + lints + mutations =="
+echo "== [16/19] controller A/B: self-tuning control plane (CPU) =="
+# bench.py --controller asserts the ISSUE-19 control-plane gates
+# itself: three replays of scenarios/controller_day.json — controller
+# off (baseline), on (autoscaler lane acting through the live queue's
+# elastic surfaces), and on with a SEEDED harmful plan. The on arm's
+# attainment must meet or beat the off arm's, every decision must be
+# lineage-stamped ({trigger, knob, from, to, plan_id, seq} +
+# triggering evidence) on summary()["controller"], and the bad plan
+# must roll itself back when the judged window's burn worsens. The
+# compare gates on-arm attainment against the committed record (ratio
+# + 0.5 absolute floor, DET_CONTROLLER_ATTAINMENT_FLOOR overrides;
+# cross-scenario records skip loudly).
+if [[ -f BENCH_CONTROLLER_SMOKE_CPU.json ]]; then
+    JAX_PLATFORMS=cpu python bench.py --controller \
+        --compare BENCH_CONTROLLER_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    JAX_PLATFORMS=cpu python bench.py --controller
+fi
+
+echo "== [17/19] static analysis: contracts + shardings + costs + lints + mutations =="
 # scripts/analyze.py compiles (never runs) the whole program matrix and
 # audits each program against its contract — collective schedule,
 # memory policy, baked constants, and (ISSUE 13) the declared
@@ -477,7 +518,20 @@ fi
 JAX_PLATFORMS=cpu python scripts/analyze.py --all --costs --shardings \
     --mutation-check
 
-echo "== [17/17] graft entry + 8-device sharded dryrun =="
+echo "== [18/19] planner smoke: plan diff-gate + model-vs-measured drift =="
+# scripts/analyze.py --plan replans the default declared workload from
+# the calibration records committed in THIS tree (wirespeed / serve /
+# coldstart smokes + the EXP_PIPELINE_CPU.json schedule grid) and
+# diff-gates the artifact against the committed ANALYSIS_PLAN.json —
+# a calibration record or planner change that moves the chosen config
+# or its predicted budgets fails here and re-commits deliberately via
+# --write-plan. The model-vs-measured drift check then prices the
+# plan's stored anchors against the live records: >= 2x warns loudly,
+# >= 5x fails — the planner's predictions stay tethered to what the
+# benches actually measured.
+JAX_PLATFORMS=cpu python scripts/analyze.py --plan
+
+echo "== [19/19] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

@@ -1,0 +1,324 @@
+"""Control plane, online half (runtime/controller.py): the autoscaler
+state machine driven deterministically via tick(), the new config
+knobs' loud validation, and the controller-off inertness guarantee
+(ISSUE 19).
+"""
+
+import inspect
+
+import pytest
+
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.runtime.controller import (
+    SURFACE_KNOBS,
+    Controller,
+)
+from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+
+# -- fakes: a live queue surface + a scriptable telemetry feed ---------------
+
+
+class _FakeQueue:
+    def __init__(self, continuous=False, bucket_size=8,
+                 flush_deadline=0.3):
+        self.continuous = continuous
+        self.bucket_size = bucket_size
+        self.flush_deadline = flush_deadline
+        self.flush_all_calls = 0
+
+    def flush_all(self):
+        self.flush_all_calls += 1
+
+
+class _FakeServer:
+    def __init__(self, queue):
+        self.queue = queue
+
+
+class _FakeMetrics:
+    """Records controller decisions; summary() replays whatever SLO
+    snapshot the test scripted last via feed()."""
+
+    def __init__(self):
+        self.events = []
+        self._slo = {"burn": {"fast": 0.0, "slow": 0.0},
+                     "attainment": 1.0, "requests": 0, "violations": 0,
+                     "p99_ms": 1.0}
+
+    def feed(self, *, requests, violations, burn_fast=0.0):
+        self._slo = {
+            "burn": {"fast": burn_fast, "slow": burn_fast},
+            "attainment": 1.0 - (violations / max(requests, 1)),
+            "requests": requests, "violations": violations,
+            "p99_ms": 5.0,
+        }
+
+    def controller(self, event):
+        self.events.append(dict(event))
+
+    def summary(self):
+        return {
+            "slo": {"serve": dict(self._slo)},
+            "serving": {"mean_occupancy": 0.5,
+                        "health": {"sheds": {}}},
+        }
+
+
+def _cfg(**kw):
+    base = dict(dim=16, k=4, controller_window_s=0.25)
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+def _controller(queue=None, metrics=None, plan=None, **cfg_kw):
+    q = queue if queue is not None else _FakeQueue()
+    m = metrics if metrics is not None else _FakeMetrics()
+    c = Controller(_FakeServer(q), m, _cfg(**cfg_kw), plan=plan)
+    return c, q, m
+
+
+def _kinds(metrics):
+    return [e["kind"] for e in metrics.events]
+
+
+# -- config knobs: loud validation (satellite 4) -----------------------------
+
+
+@pytest.mark.parametrize("bad", [0, -1.0, True, "fast"])
+def test_controller_window_s_invalid_rejected(bad):
+    with pytest.raises(ValueError, match="controller_window_s"):
+        PCAConfig(dim=16, k=4, controller_window_s=bad)
+
+
+@pytest.mark.parametrize("bad", [0, -2, True, 1.5])
+def test_controller_max_actions_invalid_rejected(bad):
+    with pytest.raises(ValueError, match="controller_max_actions"):
+        PCAConfig(dim=16, k=4, controller_max_actions=bad)
+
+
+@pytest.mark.parametrize("bad", ["", 7])
+def test_plan_path_invalid_rejected(bad):
+    with pytest.raises(ValueError, match="plan_path"):
+        PCAConfig(dim=16, k=4, plan_path=bad)
+
+
+def test_new_knobs_valid_values_accepted():
+    cfg = PCAConfig(dim=16, k=4, controller_window_s=0.5,
+                    controller_max_actions=3, plan_path="plan.json")
+    assert cfg.controller_window_s == 0.5
+    assert cfg.controller_max_actions == 3
+    assert cfg.plan_path == "plan.json"
+    # the defaults: control plane OFF
+    off = PCAConfig(dim=16, k=4)
+    assert off.controller_window_s is None
+    assert off.plan_path is None
+
+
+def test_controller_requires_window():
+    # window None means OFF — constructing a lane anyway is a bug
+    with pytest.raises(ValueError, match="controller_window_s"):
+        Controller(_FakeServer(_FakeQueue()), _FakeMetrics(),
+                   PCAConfig(dim=16, k=4))
+
+
+# -- controller-off: dispatch path untouched ---------------------------------
+
+
+def test_controller_off_summary_has_no_section():
+    # no decisions recorded -> summary() must not grow a "controller"
+    # section (the off-arm verdict stays byte-compatible with pre-PR-19)
+    m = MetricsLogger()
+    assert "controller" not in m.summary()
+
+
+def test_scenario_controller_defaults_off():
+    from distributed_eigenspaces_tpu.runtime.scenario import run_scenario
+
+    params = inspect.signature(run_scenario).parameters
+    assert params["controller"].default is False
+    assert params["plan"].default is None
+
+
+# -- the state machine, tick by tick -----------------------------------------
+
+
+def test_burn_breach_flips_continuous_and_drains_backlog():
+    c, q, m = _controller()
+    m.feed(requests=100, violations=5, burn_fast=2.0)
+    c.tick()
+    assert q.continuous is True
+    assert q.flush_all_calls == 1  # the old regime's backlog drains NOW
+    [act] = m.events
+    assert act["kind"] == "action"
+    assert act["knob"] == "serve_continuous"
+    assert act["trigger"] == "burn_breach"
+    assert act["from"] is False and act["to"] is True
+    # full lineage: seq + plan_id (None without a plan) + evidence
+    assert act["seq"] == 1 and act["plan_id"] is None
+    assert act["evidence"]["requests"] == 100
+
+
+def test_hold_commits_when_burn_recovers():
+    c, q, m = _controller()
+    m.feed(requests=100, violations=5, burn_fast=2.0)
+    c.tick()  # action
+    m.feed(requests=150, violations=5)
+    c.tick()  # settle window: backlog drains, no decision
+    m.feed(requests=250, violations=5)
+    c.tick()  # judge: 100 new requests, 0 new violations -> burn 0
+    assert _kinds(m) == ["action", "commit"]
+    commit = m.events[-1]
+    assert commit["trigger"] == "hold_elapsed"
+    assert commit["evidence"]["window_burn_after"] == 0.0
+    assert q.continuous is True  # the knob sticks
+
+
+def test_hold_rolls_back_when_burn_worsens():
+    c, q, m = _controller()
+    m.feed(requests=100, violations=5, burn_fast=1.5)
+    c.tick()  # action: continuous on
+    m.feed(requests=110, violations=6)
+    c.tick()  # settle
+    m.feed(requests=120, violations=16)  # 10/10 violate post-action
+    c.tick()  # judge: window burn 100x budget -> worse
+    assert _kinds(m) == ["action", "rollback"]
+    rb = m.events[-1]
+    assert rb["trigger"] == "burn_worsened"
+    assert rb["knob"] == "serve_continuous"
+    assert rb["to"] is False
+    ev = rb["evidence"]
+    assert ev["window_burn_after"] > ev.get("window_burn_before", 0.0)
+    assert q.continuous is False  # restored
+
+
+def test_judge_window_stretches_until_traffic_resolves():
+    # a knob bad enough to stall resolutions entirely must NOT commit
+    # unjudged — the hold stretches until a request lands
+    c, q, m = _controller()
+    m.feed(requests=100, violations=5, burn_fast=2.0)
+    c.tick()  # action
+    m.feed(requests=130, violations=5)
+    c.tick()  # settle
+    c.tick()  # judge with ZERO new resolutions -> keep holding
+    c.tick()  # still nothing
+    assert _kinds(m) == ["action"]
+    m.feed(requests=180, violations=5)
+    c.tick()  # traffic finally resolved -> judged now
+    assert _kinds(m) == ["action", "commit"]
+
+
+def test_plan_rollout_one_knob_per_window_with_lineage():
+    plan = {"plan_id": "plan-test-1234",
+            "chosen": {"config_overrides": {
+                "serve_continuous": True, "serve_flush_s": 0.05,
+                "serve_bucket_size": 8,  # == live value: no-op
+            }}}
+    c, q, m = _controller(plan=plan)
+    m.feed(requests=10, violations=0)
+    c.tick()
+    assert q.continuous is True and q.flush_deadline == 0.3
+    m.feed(requests=20, violations=0)
+    c.tick()  # settle
+    m.feed(requests=30, violations=0)
+    c.tick()  # commit knob 1
+    m.feed(requests=40, violations=0)
+    c.tick()  # roll out knob 2
+    assert q.flush_deadline == 0.05
+    actions = [e for e in m.events if e["kind"] == "action"]
+    assert [a["knob"] for a in actions] == [
+        "serve_continuous", "serve_flush_s"]
+    assert all(a["trigger"] == "plan_rollout" for a in actions)
+    assert all(a["plan_id"] == "plan-test-1234" for a in m.events)
+
+
+def test_mitigation_priority_and_floors():
+    # continuous already on, flush above floor -> halve flush first
+    c, q, m = _controller(queue=_FakeQueue(continuous=True))
+    m.feed(requests=100, violations=50, burn_fast=5.0)
+    c.tick()
+    assert m.events[-1]["knob"] == "serve_flush_s"
+    assert q.flush_deadline == pytest.approx(0.15)
+
+    # all surfaces at their floor -> ONE loud no_surface, never spam
+    qq = _FakeQueue(continuous=True, bucket_size=2,
+                    flush_deadline=0.005)
+    c2, _, m2 = _controller(queue=qq)
+    m2.feed(requests=100, violations=50, burn_fast=5.0)
+    c2.tick()
+    m2.feed(requests=200, violations=100, burn_fast=5.0)
+    c2.tick()
+    assert _kinds(m2) == ["no_surface"]
+    assert qq.bucket_size == 2 and qq.flush_deadline == 0.005
+
+
+def test_bucket_size_is_last_resort():
+    # continuous on + flush at floor -> only then shrink buckets
+    qq = _FakeQueue(continuous=True, bucket_size=8,
+                    flush_deadline=0.005)
+    c, _, m = _controller(queue=qq)
+    m.feed(requests=100, violations=50, burn_fast=5.0)
+    c.tick()
+    assert m.events[-1]["knob"] == "serve_bucket_size"
+    assert qq.bucket_size == 4
+    assert SURFACE_KNOBS[-1] == "serve_bucket_size"
+
+
+def test_budget_exhaustion_freezes_loudly():
+    c, q, m = _controller(controller_max_actions=1)
+    m.feed(requests=100, violations=50, burn_fast=5.0)
+    c.tick()  # action 1 = the whole budget
+    m.feed(requests=150, violations=50)
+    c.tick()  # settle
+    m.feed(requests=250, violations=50)
+    c.tick()  # judge -> commit, then freeze
+    assert _kinds(m) == ["action", "commit", "budget_exhausted"]
+    frozen = m.events[-1]
+    assert frozen["spent"] == 1 and frozen["budget"] == 1
+    m.feed(requests=400, violations=200, burn_fast=9.0)
+    c.tick()  # FROZEN: breach ignored, no thrash
+    assert len(m.events) == 3
+
+
+def test_rollback_runs_even_with_budget_spent():
+    # safety inversion: the restore is never gated on budget
+    c, q, m = _controller(controller_max_actions=1)
+    m.feed(requests=100, violations=5, burn_fast=1.5)
+    c.tick()  # action spends the whole budget
+    m.feed(requests=110, violations=6)
+    c.tick()  # settle
+    m.feed(requests=120, violations=16)
+    c.tick()  # judge: worsened -> rollback despite spent budget
+    assert "rollback" in _kinds(m)
+    assert q.continuous is False
+    assert "budget_exhausted" in _kinds(m)
+
+
+def test_summary_controller_section_aggregates_decisions():
+    m = MetricsLogger()
+    q = _FakeQueue()
+    c = Controller(_FakeServer(q), m, _cfg())
+    # drive one real decision through the real Metrics channel
+    c._record("action", knob="serve_continuous", trigger="burn_breach",
+              **{"from": False, "to": True}, evidence={})
+    c._record("rollback", knob="serve_continuous",
+              trigger="burn_worsened",
+              **{"from": True, "to": False}, evidence={})
+    summ = m.summary()["controller"]
+    assert summ["decisions"] == 2
+    assert summ["rollbacks"] == 1
+    assert summ["by_kind"] == {"action": 1, "rollback": 1}
+    assert [e["controller"] for e in summ["events"]] == [
+        "action", "rollback"]
+
+
+def test_lifecycle_start_close_records_bracketing_events():
+    c, q, m = _controller()
+    with c:
+        pass
+    kinds = _kinds(m)
+    assert kinds[0] == "start" and kinds[-1] == "stop"
+    start = m.events[0]
+    assert start["window_s"] == 0.25 and start["budget"] == 8
+    stop = m.events[-1]
+    assert set(stop["knobs"]) == set(SURFACE_KNOBS)

@@ -1,0 +1,193 @@
+"""Control plane, offline half (analysis/planner.py): workload
+validation, plan feasibility + the PlanInfeasible refusals, the plan's
+own self-check, the committed-artifact diff gate, and the
+model-vs-measured drift bands (ISSUE 19).
+"""
+
+import copy
+
+import pytest
+
+from distributed_eigenspaces_tpu.analysis import planner
+
+
+#: a small workload every test can plan on CPU in milliseconds
+SMALL = {
+    "name": "test-small", "d": 64, "k": 2, "m": 8, "n": 16,
+    "qps": 20.0, "fleet": 1, "slo_p99_ms": 500.0,
+    "round_deadline_ms": 250.0,
+}
+
+
+@pytest.fixture(scope="module")
+def small_plan():
+    return planner.make_plan(SMALL)
+
+
+# -- workload validation -----------------------------------------------------
+
+
+def test_validate_workload_fills_defaults():
+    spec = planner.validate_workload(SMALL)
+    assert spec["d"] == 64 and spec["fleet"] == 1
+    # unspecified fields come from DEFAULT_WORKLOAD
+    assert spec["rows_per_query"] == planner.DEFAULT_WORKLOAD[
+        "rows_per_query"]
+
+
+@pytest.mark.parametrize("mutate,match", [
+    ({"d": 0}, "d must be"),
+    ({"k": 128}, "k <= d"),
+    ({"qps": -1.0}, "qps must be"),
+    ({"slo_p99_ms": True}, "slo_p99_ms must be"),
+    ({"bogus_field": 1}, "unknown workload field"),
+])
+def test_validate_workload_rejects_loudly(mutate, match):
+    spec = dict(SMALL)
+    spec.update(mutate)
+    with pytest.raises(ValueError, match=match):
+        planner.validate_workload(spec)
+
+
+# -- make_plan: choose or refuse ---------------------------------------------
+
+
+def test_make_plan_small_workload_feasible(small_plan):
+    plan = small_plan
+    assert plan["schema"] == planner.PLAN_SCHEMA
+    assert plan["plan_id"].startswith("plan-")
+    assert plan["candidates_considered"] > 0
+    over = plan["chosen"]["config_overrides"]
+    # every override names a real config surface
+    assert set(over) == {
+        "merge_topology", "pipeline_merge", "merge_interval",
+        "serve_bucket_size", "serve_flush_s", "serve_continuous",
+        "replicas",
+    }
+    pred = plan["chosen"]["predicted"]
+    assert pred["serve"]["predicted_p99_ms"] <= SMALL["slo_p99_ms"]
+    for tier in pred["fit_tiers"].values():
+        assert tier["modeled_ms_per_round"] <= SMALL["round_deadline_ms"]
+    # an emitted plan never fails its own audit
+    assert planner.self_check(plan) == []
+
+
+def test_make_plan_is_deterministic(small_plan):
+    again = planner.make_plan(SMALL)
+    assert again["plan_id"] == small_plan["plan_id"]
+    assert again["chosen"] == small_plan["chosen"]
+
+
+def test_make_plan_refuses_undividable_fleet():
+    spec = dict(SMALL, fleet=3)  # 8 workers never pack onto 3 hosts
+    with pytest.raises(planner.PlanInfeasible, match="m % fleet"):
+        planner.make_plan(spec)
+
+
+def test_make_plan_refuses_impossible_slo():
+    spec = dict(SMALL, slo_p99_ms=0.0001, round_deadline_ms=0.0001)
+    with pytest.raises(planner.PlanInfeasible) as ei:
+        planner.make_plan(spec)
+    # the refusal carries the rejection histogram, not a bare "no"
+    assert "rejections" in str(ei.value)
+
+
+# -- self_check: the audit any plan-v1 dict must survive ---------------------
+
+
+def test_self_check_catches_tier_over_deadline(small_plan):
+    plan = copy.deepcopy(small_plan)
+    tiers = plan["chosen"]["predicted"]["fit_tiers"]
+    next(iter(tiers.values()))["modeled_ms_per_round"] = 1e6
+    viols = planner.self_check(plan)
+    assert any(v.rule == "plan-infeasible" for v in viols)
+    assert any("round deadline" in v.message for v in viols)
+
+
+def test_self_check_catches_p99_over_slo(small_plan):
+    plan = copy.deepcopy(small_plan)
+    plan["chosen"]["predicted"]["serve"]["predicted_p99_ms"] = 1e9
+    viols = planner.self_check(plan)
+    assert any(v.rule == "plan-infeasible" for v in viols)
+
+
+def test_self_check_catches_unbuildable_overrides(small_plan):
+    plan = copy.deepcopy(small_plan)
+    plan["chosen"]["config_overrides"]["serve_bucket_size"] = -5
+    viols = planner.self_check(plan)
+    assert any(v.rule == "plan-infeasible" for v in viols)
+
+
+def test_self_check_rejects_wrong_schema(small_plan):
+    plan = copy.deepcopy(small_plan)
+    plan["schema"] = "plan-v0"
+    viols = planner.self_check(plan)
+    assert viols and all(v.rule == "plan-infeasible" for v in viols)
+
+
+# -- check_plan: the committed-artifact diff gate ----------------------------
+
+
+def test_check_plan_clean_when_identical(small_plan):
+    assert planner.check_plan(small_plan,
+                              copy.deepcopy(small_plan)) == []
+
+
+def test_check_plan_flags_drifted_field(small_plan):
+    committed = copy.deepcopy(small_plan)
+    committed["plan_id"] = "plan-stale-000000"
+    viols = planner.check_plan(small_plan, committed)
+    assert any(v.rule == "plan-drift" and v.location == "plan_id"
+               for v in viols)
+
+
+def test_check_plan_missing_committed_artifact(small_plan):
+    viols = planner.check_plan(small_plan, None)
+    assert len(viols) == 1
+    assert "no committed" in viols[0].message
+
+
+# -- drift_check: model vs measured, the CI bands ----------------------------
+
+
+def _plan_with_anchor(value):
+    return {
+        "schema": planner.PLAN_SCHEMA,
+        "drift_anchors": {
+            "serve_admit_p99_ms": {
+                "predicted": value, "source": "test"},
+        },
+    }
+
+
+def test_drift_check_bands(tmp_path, small_plan):
+    # anchors were stamped from the committed records -> ratio 1.0
+    rows = planner.drift_check(small_plan)
+    assert rows, "committed smokes should anchor at least one term"
+    assert all(r["status"] == "ok" for r in rows)
+    # against an EMPTY root every record is gone -> loud missing rows
+    rows = planner.drift_check(small_plan, root=str(tmp_path))
+    assert rows and all(r["status"] == "missing" for r in rows)
+
+
+def test_drift_check_warn_and_fail_ratios(small_plan):
+    measured = small_plan["drift_anchors"]["serve_admit_p99_ms"][
+        "predicted"]
+    [row] = planner.drift_check(_plan_with_anchor(measured * 3.0))
+    assert row["status"] == "warn"  # 3x: past warn (2x), short of fail
+    [row] = planner.drift_check(_plan_with_anchor(measured * 10.0))
+    assert row["status"] == "fail"  # 10x: past the 5x fail band
+    # the ratio is symmetric: a 10x UNDER-prediction fails too
+    [row] = planner.drift_check(_plan_with_anchor(measured / 10.0))
+    assert row["status"] == "fail"
+
+
+def test_committed_plan_artifact_current():
+    """The repo's ANALYSIS_PLAN.json must match what the planner
+    regenerates from the committed calibration — the same gate
+    scripts/ci.sh applies."""
+    committed = planner.load_plan()
+    assert committed is not None, "ANALYSIS_PLAN.json must be committed"
+    current = planner.make_plan()
+    assert planner.check_plan(current, committed) == []
+    assert planner.self_check(committed) == []

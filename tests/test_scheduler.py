@@ -513,3 +513,33 @@ def test_continuous_multi_lane_budget_tracks_num_lanes():
     ]
     assert q._inflight_batches == 0
     assert not q._buckets
+
+
+def test_inflight_ledger_survives_continuous_flip():
+    """``continuous`` is a LIVE knob (the autoscaler flips it mid-run):
+    the inflight ledger must balance in deadline mode too, or the flip
+    inherits phantom in-flight batches and the pool wedges — every
+    later sub-bucket submit waits for a timer that already fired."""
+    q = _bucket_queue(bucket_size=2, flush_deadline=60.0,
+                      start_timer=False, continuous=False)
+    t = threading.Thread(
+        target=q.serve,
+        args=(lambda b: [p.payload for p in b.tickets],),
+        kwargs={"num_lanes": 1},
+    )
+    t.start()
+    # deadline mode: full buckets dispatch + complete; the ledger must
+    # return to zero each time, not count up monotonically
+    for _ in range(3):
+        tks = [q.submit(("s",), i) for i in range(2)]
+        assert [tk.result(timeout=30) for tk in tks] == [0, 1]
+    assert q._inflight_batches == 0
+    # flip the knob mid-run, controller-style: a lone sub-bucket submit
+    # must dispatch immediately under the lane budget, with no close()
+    # and no deadline anywhere near
+    q.continuous = True
+    tk = q.submit(("s",), 7)
+    assert tk.result(timeout=30) == 7
+    q.close()
+    t.join(timeout=30)
+    assert not t.is_alive()
