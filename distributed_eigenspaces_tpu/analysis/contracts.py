@@ -35,6 +35,7 @@ validates the premise loudly rather than trusting the matrix author.
 from __future__ import annotations
 
 import math
+import re
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -71,6 +72,13 @@ class ProgramParams:
     #: sharding contract requires the tree's inputs sharded over and
     #: the cost model attributes per-tier wire bytes to
     tier_axes: tuple[str, ...] = ()
+    #: per-tier WIRE dtype declarations leaf->root (ISSUE 20), aligned
+    #: with ``tier_axes``: which codec each tier's data-moving
+    #: collectives must carry on the wire ("fp32" / "bf16" / "int8").
+    #: Empty = no wire policy declared — the dtype rule is skipped.
+    #: Reductions (psum) are exempt by design: accumulation is fp32
+    #: even on compressed tiers
+    tier_wire_dtypes: tuple[str, ...] = ()
 
     @property
     def d_local(self) -> int:
@@ -596,6 +604,116 @@ CONTRACTS: dict[str, ProgramContract] = {
 
 # -- checkers ----------------------------------------------------------------
 
+#: the collective op kinds that MOVE data (and so carry a wire codec);
+#: reductions (all-reduce) are exempt — accumulation stays fp32 even on
+#: compressed tiers (int8 has no closed addition)
+_DATA_MOVERS = frozenset({"all-gather", "all-to-all"})
+
+
+def _check_wire_dtypes(
+    params: ProgramParams,
+    ops,
+    contract: ProgramContract,
+    *,
+    program: str,
+) -> list[Violation]:
+    """Rule ``collective-wire-dtype`` (ISSUE 20): the declared per-tier
+    wire policy against the partitioned HLO's actual payload dtypes.
+
+    Positive half: every tier declared non-fp32 must have at least one
+    data-moving collective carrying that codec's HLO dtype token (bf16
+    / s8) on a replica group of the tier's fan-in — a policy the
+    program silently ignored is a compression that never happened.
+
+    Negative half: an f32 data-mover above the ``d_local * kf / 2``
+    elements floor whose replica-group size matches ONLY tiers declared
+    compressed is a full-width payload on a wire the policy narrowed
+    (the ``wire_dtype_drift`` mutant). The floor keeps the masked-
+    weight gathers and int8 fp32 scale sidecars — both tiny and f32 by
+    design — out of scope; ambiguous group sizes (a fan shared by an
+    fp32 tier) are left alone rather than guessed at.
+
+    bf16 caveat: backends without native bf16 collectives (the CPU
+    audit rig) run float-normalization, which rewrites the bf16
+    collective as an f32 one fed by the encode/decode convert pair —
+    values are still bf16-rounded, only the emulation's local bytes
+    widen. Both halves therefore accept an f32 mover whose operand
+    list carries a ``convert`` as the normalized bf16 spelling; on
+    TPU the collective stays bf16 and the check is exact. int8 has no
+    such escape — s8 movers must appear verbatim everywhere.
+    """
+    from distributed_eigenspaces_tpu.analysis.costmodel import (
+        parse_replica_groups,
+    )
+    from distributed_eigenspaces_tpu.parallel.wire import WIRE_HLO_TOKEN
+
+    out: list[Violation] = []
+    tiers = list(zip(
+        params.tier_axes, params.tier_fan_ins, params.tier_wire_dtypes
+    ))
+    kf = max(params.k, params.sketch_width, 1)
+    floor = params.d_local * kf // 2
+    movers = []
+    for o in ops:
+        if o.op not in _DATA_MOVERS:
+            continue
+        groups = parse_replica_groups(o.line)
+        gsize = len(groups[0]) if groups else None
+        movers.append((o, gsize))
+
+    def _bf16_normalized(o) -> bool:
+        m = re.search(r"all-(?:gather|to-all)\(([^)]*)\)", o.line)
+        return bool(m and "convert" in m.group(1))
+
+    for axis, fan, dtype in tiers:
+        if dtype == "fp32":
+            continue
+        token = WIRE_HLO_TOKEN[dtype]
+        hit = any(
+            (gsize is None or gsize == fan) and (
+                o.dtype == token
+                or (dtype == "bf16" and o.dtype == "f32"
+                    and _bf16_normalized(o))
+            )
+            for o, gsize in movers
+        )
+        if not hit:
+            out.append(Violation(
+                program=program,
+                rule="collective-wire-dtype",
+                message=(
+                    f"tier {axis!r} (fan-in {fan}) declares wire dtype "
+                    f"{dtype!r} but no data-moving collective carries "
+                    f"{token} on a group of {fan} — the declared "
+                    "compression never reaches the wire "
+                    f"(contract {contract.name!r})"
+                ),
+                location=f"tier_wire_dtypes[{axis!r}]={dtype!r}",
+            ))
+    for o, gsize in movers:
+        if o.dtype != "f32" or o.elems <= floor or gsize is None:
+            continue
+        matched = [t for t in tiers if t[1] == gsize]
+        if any(t[2] == "bf16" for t in matched) and _bf16_normalized(o):
+            continue
+        if matched and all(t[2] != "fp32" for t in matched):
+            names = ", ".join(
+                f"{t[0]}={t[2]}" for t in matched
+            )
+            out.append(Violation(
+                program=program,
+                rule="collective-wire-dtype",
+                message=(
+                    f"{o.op} moves {o.elems} f32 elems on a group of "
+                    f"{gsize}, but every tier with that fan-in is "
+                    f"declared compressed ({names}) — a full-width "
+                    "fp32 payload is riding a wire the policy "
+                    f"narrowed (contract {contract.name!r})"
+                ),
+                location=o.line.strip(),
+            ))
+    return out
+
 
 def check_collectives(
     contract: ProgramContract,
@@ -654,6 +772,10 @@ def check_collectives(
                 "audit would pass vacuously (was the program actually "
                 f"partitioned?) (contract {contract.name!r})"
             ),
+        ))
+    if params.tier_wire_dtypes:
+        out.extend(_check_wire_dtypes(
+            params, ops, contract, program=program
         ))
     return out, metrics
 

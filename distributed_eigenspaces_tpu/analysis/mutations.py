@@ -483,6 +483,50 @@ def _mutant_plan_infeasible() -> list:
     return planner.self_check(plan)
 
 
+def _mutant_wire_dtype_drift() -> list[contracts.Violation]:
+    """A tiered merge whose tiers are DECLARED int8 on the wire but
+    whose basis gather ships full-width fp32 (ISSUE 20): the codec was
+    dropped — or never wired in — and the compression the policy
+    promises silently never happens. Both halves of the
+    ``collective-wire-dtype`` rule must fire: no s8 data-mover exists
+    for the declared tiers, and a wide f32 gather rides a replica
+    group that only compressed tiers own."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_eigenspaces_tpu.parallel.mesh import shard_map
+    from distributed_eigenspaces_tpu.parallel.topology import (
+        MergeTopology,
+        make_tiered_mesh,
+    )
+
+    topo = MergeTopology((("chip", 2), ("host", 2)))
+    mesh = make_tiered_mesh(topo)
+
+    def drifted_round(v):  # (d/2, k) -> fp32 gather on an int8 tier
+        return jax.lax.all_gather(v, "chip", axis=0, tiled=True)
+
+    f = jax.jit(shard_map(
+        drifted_round, mesh=mesh,
+        in_specs=P("chip"), out_specs=P(),
+        check_vma=False,
+    ))
+    hlo = f.lower(
+        jnp.zeros((_D // 2, 2), jnp.float32)
+    ).compile().as_text()
+    contract = contracts.CONTRACTS["tree_merge"]
+    params = contracts.ProgramParams(
+        d=_D, k=2, m=4, n=8,
+        tier_fan_ins=topo.fan_ins, tier_axes=topo.names,
+        tier_wire_dtypes=("int8", "int8"),
+    )
+    viols, _ = contracts.check_collectives(
+        contract, params, hlo, program="mutant_wire_dtype_drift"
+    )
+    return viols
+
+
 #: mutation name -> (expected rule, runner). Every violation class the
 #: analyzer claims to catch has exactly one seeded witness here.
 MUTATIONS: dict[str, tuple[str, Callable[[], list]]] = {
@@ -510,6 +554,9 @@ MUTATIONS: dict[str, tuple[str, Callable[[], list]]] = {
     ),
     "plan_infeasible_accepted": (
         "plan-infeasible", _mutant_plan_infeasible
+    ),
+    "wire_dtype_drift": (
+        "collective-wire-dtype", _mutant_wire_dtype_drift
     ),
     "blocking_under_lock": ("blocking-under-lock", _ast_mutant(
         _FIXTURE_BLOCKING, ast_lints.lint_concurrency_source

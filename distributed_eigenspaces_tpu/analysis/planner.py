@@ -297,10 +297,21 @@ def _tier_splits(m: int, fleet: int) -> list[tuple | None]:
     return splits
 
 
+#: the wire policies the planner enumerates for tiered merges
+#: (ISSUE 20): uncompressed, and the host (DCN) tier narrowed to each
+#: codec. Chip-tier compression is not enumerated — ICI is never the
+#: binding constraint in the priced workloads, so it would only grow
+#: the candidate set without changing any choice.
+_WIRE_POLICY_CANDIDATES: tuple[dict | None, ...] = (
+    None, {"host": "bf16"}, {"host": "int8"},
+)
+
+
 def enumerate_candidates(spec: dict, calib: dict) -> list[dict]:
     """The candidate configs, elastic surfaces only: tier splits x
-    measured schedule arms x serve bucket/flush/continuous x replica
-    counts (powers of two up to the fleet)."""
+    host-tier wire dtype x measured schedule arms x serve
+    bucket/flush/continuous x replica counts (powers of two up to the
+    fleet)."""
     replicas = []
     r = 1
     while r <= spec["fleet"]:
@@ -308,23 +319,28 @@ def enumerate_candidates(spec: dict, calib: dict) -> list[dict]:
         r *= 2
     cands = []
     for topo in _tier_splits(spec["m"], spec["fleet"]):
-        for pipe, interval, speedup in _schedule_arms(calib):
-            if topo is not None and pipe:
-                continue  # merge_topology rejects pipeline_merge
-            for bucket in _BUCKET_CANDIDATES:
-                for flush_s in _FLUSH_S_CANDIDATES:
-                    for cont in (False, True):
-                        for n_rep in replicas:
-                            cands.append({
-                                "merge_topology": topo,
-                                "pipeline_merge": pipe,
-                                "merge_interval": interval,
-                                "schedule_speedup": speedup,
-                                "serve_bucket_size": bucket,
-                                "serve_flush_s": flush_s,
-                                "serve_continuous": cont,
-                                "replicas": n_rep,
-                            })
+        # flat merges have no tiers to compress (config refuses the
+        # combination for the same reason)
+        wire_opts = _WIRE_POLICY_CANDIDATES if topo else (None,)
+        for wire in wire_opts:
+            for pipe, interval, speedup in _schedule_arms(calib):
+                if topo is not None and pipe:
+                    continue  # merge_topology rejects pipeline_merge
+                for bucket in _BUCKET_CANDIDATES:
+                    for flush_s in _FLUSH_S_CANDIDATES:
+                        for cont in (False, True):
+                            for n_rep in replicas:
+                                cands.append({
+                                    "merge_topology": topo,
+                                    "merge_wire_dtype": wire,
+                                    "pipeline_merge": pipe,
+                                    "merge_interval": interval,
+                                    "schedule_speedup": speedup,
+                                    "serve_bucket_size": bucket,
+                                    "serve_flush_s": flush_s,
+                                    "serve_continuous": cont,
+                                    "replicas": n_rep,
+                                })
     return cands
 
 
@@ -354,12 +370,23 @@ def _fit_tiers(cand: dict, spec: dict) -> dict:
             "modeled_ms_per_round": round(wire / (gbps * 1e9) * 1e3, 4),
         }
     else:
+        from distributed_eigenspaces_tpu.parallel.wire import (
+            WIRE_ITEMSIZE,
+        )
+
+        policy = cand.get("merge_wire_dtype") or {}
         for name, fan in cand["merge_topology"]:
+            # the two data movers ship at the tier's declared codec
+            # width; the Gram psum stays f32 (accumulation is never
+            # compressed) — the same split model_costs commits
+            dtype = policy.get(name, "fp32")
+            ring = costmodel._ring(fan)
             wire = int(
-                costmodel._ring(fan)
-                * (2 * d * k + 2 * (fan * k) ** 2)
-                * itemsize
+                ring * 2 * d * k * WIRE_ITEMSIZE[dtype]
+                + ring * 2 * (fan * k) ** 2 * itemsize
             )
+            if dtype == "int8":
+                wire += int(ring * (fan + 1) * k * itemsize)
             gbps = ici if name == "chip" else dcn
             tiers[name] = {
                 "fan_in": fan,
@@ -369,6 +396,8 @@ def _fit_tiers(cand: dict, spec: dict) -> dict:
                     wire / (gbps * 1e9) * 1e3, 4
                 ),
             }
+            if dtype != "fp32":
+                tiers[name]["wire_dtype"] = dtype
     return tiers
 
 
@@ -513,6 +542,7 @@ def make_plan(
             [list(t) for t in cand["merge_topology"]]
             if cand["merge_topology"] else None
         ),
+        "merge_wire_dtype": cand["merge_wire_dtype"],
         "pipeline_merge": cand["pipeline_merge"],
         "merge_interval": cand["merge_interval"],
         "serve_bucket_size": cand["serve_bucket_size"],

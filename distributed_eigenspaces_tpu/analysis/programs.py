@@ -142,12 +142,17 @@ def _scan_program(name: str, *, masked: bool = False, **cfg_kw):
     return build
 
 
-def _tree_program(name: str, *, masked: bool = False):
+def _tree_program(
+    name: str, *, masked: bool = False, wire: dict | None = None
+):
     """Tiered-mesh tree fit (ISSUE 12): a 2x2 chip/host topology over
     the 8-device rig (4 workers on a ("host", "chip") mesh) — the
     tree_merge contract's subject. The tree's whole point shows in the
     bound: max(d*k, (f*k)^2) = 128 elems here vs the flat factor
-    stack's m*d*k = 512."""
+    stack's m*d*k = 512. ``wire`` (ISSUE 20) compiles the same fit
+    under a ``merge_wire_dtype`` policy — the ``collective-wire-dtype``
+    rule then audits that the declared codecs actually reach the
+    partitioned HLO's data movers."""
 
     def build() -> BuiltProgram:
         import jax.numpy as jnp
@@ -158,9 +163,15 @@ def _tree_program(name: str, *, masked: bool = False):
             make_tiered_mesh,
             resolve_topology,
         )
+        from distributed_eigenspaces_tpu.parallel.wire import (
+            resolve_wire_policy,
+        )
 
         require_mesh_devices()
-        cfg = _cfg(merge_topology=(("chip", 2), ("host", 2)))
+        cfg = _cfg(
+            merge_topology=(("chip", 2), ("host", 2)),
+            merge_wire_dtype=wire,
+        )
         topo = resolve_topology(cfg)
         mesh = make_tiered_mesh(topo)
         fit = _ensure_jit(make_scan_fit(cfg, mesh, masked=masked))
@@ -173,6 +184,7 @@ def _tree_program(name: str, *, masked: bool = False):
             params=ProgramParams(
                 d=_D, k=_K, m=_M, n=_N, T=_T, n_workers_mesh=_M,
                 tier_fan_ins=topo.fan_ins, tier_axes=topo.names,
+                tier_wire_dtypes=resolve_wire_policy(cfg, topo) or (),
             ),
             jitted=fit, args=args,
         )
@@ -622,6 +634,9 @@ PROGRAMS: dict[str, Callable[[], BuiltProgram]] = {
     # tiered-mesh tree merge (ISSUE 12)
     "tree_fit": _tree_program("tree_fit"),
     "tree_fit_masked": _tree_program("tree_fit_masked", masked=True),
+    "tree_fit_wire": _tree_program(
+        "tree_fit_wire", wire={"chip": "bf16", "host": "int8"}
+    ),
     # feature-sharded cores
     "feature_scan": _feature_program("feature_scan", "scan"),
     "feature_sketch": _feature_program("feature_sketch", "sketch"),

@@ -356,6 +356,21 @@ class PCAConfig:
         ``QuorumLost`` is raised per tier, not globally. ``None``
         (default) dispatches to the byte-identical pre-topology flat
         merge programs.
+      merge_wire_dtype: per-tier WIRE precision for the tree merge's
+        data-moving collectives (``parallel/wire.py``, ISSUE 20): a
+        mapping from resolved topology tier names to one of
+        ``{"fp32", "bf16", "int8"}``, e.g. ``{"chip": "fp32", "host":
+        "int8"}`` — the all_to_all factor splits and tier-boundary
+        (d, k) basis all-gathers of each named tier ship in that
+        dtype (int8 is per-column symmetric with an fp32 scale
+        sidecar, PR 17's serve quantizer), while every Gram/psum
+        ACCUMULATION stays fp32 on the wire. Unnamed tiers default to
+        fp32. Per-tier error-feedback residuals carry one step stale
+        so rounding error cannot accumulate across the online loop.
+        Requires ``merge_topology`` (keys are validated against its
+        tier names); does not compose with ``pipeline_merge``. ``None``
+        (default) dispatches to the byte-identical uncompressed
+        programs.
       replicas: serve-tier replica count (``serving/replication.py``;
         CLI ``--replicas``): N in-process ``ReplicaRegistry`` readers
         tail ONE committed ``registry_dir`` — the commit markers are
@@ -485,6 +500,7 @@ class PCAConfig:
     round_deadline_ms: float | None = 250.0
     min_quorum_frac: float = 0.5
     merge_topology: tuple | None = None
+    merge_wire_dtype: Any = None
     replicas: int = 1
     replica_staleness_ms: float = 500.0
     publisher_lease_ms: float = 1000.0
@@ -803,6 +819,63 @@ class PCAConfig:
             # the worker count is final — scenario specs reuse config
             # dicts at different fleet sizes)
             object.__setattr__(self, "merge_topology", tuple(tiers))
+        if self.merge_wire_dtype is not None:
+            wd = self.merge_wire_dtype
+            if isinstance(wd, dict):
+                items = list(wd.items())
+            elif isinstance(wd, (list, tuple)) and all(
+                isinstance(e, (list, tuple)) and len(e) == 2 for e in wd
+            ):
+                items = [(k, v) for k, v in wd]
+            else:
+                raise ValueError(
+                    f"merge_wire_dtype must be a mapping of tier name "
+                    f"-> wire dtype or None, got {wd!r}"
+                )
+            if self.pipeline_merge:
+                raise ValueError(
+                    "merge_wire_dtype does not compose with "
+                    "pipeline_merge=True: the pipelined body overlaps "
+                    "the FLAT merge, which has no tiers to compress"
+                )
+            if self.merge_topology is None:
+                raise ValueError(
+                    "merge_wire_dtype requires merge_topology: the "
+                    "wire policy is per TIER, keyed by the resolved "
+                    "topology's tier names (flat merges have none)"
+                )
+            tier_names = [name for name, _ in self.merge_topology]
+            for name, dtype in items:
+                if not isinstance(name, str) or name not in tier_names:
+                    raise ValueError(
+                        f"merge_wire_dtype key {name!r} names no "
+                        f"merge_topology tier; tiers are {tier_names}"
+                    )
+                if dtype not in ("fp32", "bf16", "int8"):
+                    raise ValueError(
+                        f"merge_wire_dtype tier {name!r} has unknown "
+                        f"wire dtype {dtype!r} (fp32/bf16/int8 — the "
+                        "write-path codec family, error-feedback "
+                        "corrected; see docs/ARCHITECTURE.md 'Wire "
+                        "compression')"
+                    )
+            if len({name for name, _ in items}) != len(items):
+                raise ValueError(
+                    f"merge_wire_dtype tier keys must be unique, got "
+                    f"{[name for name, _ in items]!r}"
+                )
+            # normalize to a tier-ordered tuple of pairs so configs
+            # stay value-comparable (and hashable) regardless of how
+            # the policy was spelled
+            by_name = dict(items)
+            object.__setattr__(
+                self,
+                "merge_wire_dtype",
+                tuple(
+                    (name, by_name[name]) for name in tier_names
+                    if name in by_name
+                ),
+            )
         if not isinstance(self.replicas, int) or isinstance(
             self.replicas, bool
         ) or self.replicas < 1:

@@ -290,7 +290,9 @@ def make_population_merge(cfg, *, screen_tau: float = 0.5):
     return jax.jit(merge)
 
 
-def make_sharded_cohort_reduce(cfg, mesh, *, screen_tau: float = 0.5):
+def make_sharded_cohort_reduce(
+    cfg, mesh, *, screen_tau: float = 0.5, wire_dtype: str | None = None
+):
     """The AUDITED population-merge program (``population_merge``
     contract): the cohort stack arrives sharded over the ``workers``
     mesh axis, ONE all-gather assembles the ``(cohort, d, k)`` stack —
@@ -299,6 +301,14 @@ def make_sharded_cohort_reduce(cfg, mesh, *, screen_tau: float = 0.5):
     hardened merge body runs replicated on the gathered stack.
 
     Returns the jitted program; args are the sharded stack and mask.
+
+    ``wire_dtype`` (default: the ROOT tier of ``cfg.merge_wire_dtype``
+    via :func:`~.wire.root_wire_dtype` — the cohort gather is ONE
+    collective crossing every tier boundary at once, so it rides the
+    slowest wire the policy names) compresses the cohort stack gather
+    through the ``parallel/wire.py`` codecs. One-shot lossy; the
+    participation MASK gather stays fp32 — screening and trim
+    decisions are never made on quantized bits.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -306,14 +316,25 @@ def make_sharded_cohort_reduce(cfg, mesh, *, screen_tau: float = 0.5):
         WORKER_AXIS,
         shard_map,
     )
+    from distributed_eigenspaces_tpu.parallel.wire import (
+        root_wire_dtype,
+        wire_all_gather,
+    )
 
     topo = population_topology(cfg)
     k, alpha = cfg.k, float(cfg.max_poison_frac)
+    if wire_dtype is None:
+        wire_dtype = root_wire_dtype(cfg, topo)
 
     def reduce_shard(stack_shard, mask_shard):
-        stack = jax.lax.all_gather(
-            stack_shard, WORKER_AXIS, axis=0, tiled=True
-        )
+        if wire_dtype == "fp32":
+            stack = jax.lax.all_gather(
+                stack_shard, WORKER_AXIS, axis=0, tiled=True
+            )
+        else:
+            stack = wire_all_gather(
+                stack_shard, WORKER_AXIS, wire_dtype, tiled=True
+            )
         mask = jax.lax.all_gather(
             mask_shard, WORKER_AXIS, axis=0, tiled=True
         )

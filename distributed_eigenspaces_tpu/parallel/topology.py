@@ -58,6 +58,7 @@ from jax import lax
 from jax.sharding import Mesh
 
 from distributed_eigenspaces_tpu.ops.linalg import (
+    _cholqr2,
     canonicalize_signs,
     guarded_inv_sqrt,
     merged_top_k_lowrank,
@@ -65,10 +66,12 @@ from distributed_eigenspaces_tpu.ops.linalg import (
 
 __all__ = [
     "MergeTopology",
+    "init_wire_residuals",
     "make_tiered_mesh",
     "make_tree_scan_fit",
     "resolve_topology",
     "tier_merge_sharded",
+    "tier_merge_sharded_wire",
     "tree_merge_sharded",
     "tree_merge_stacked",
 ]
@@ -298,27 +301,202 @@ def tier_merge_sharded(v, w, k: int, axis: str, fan_in: int):
     return canonicalize_signs(v_new), cnt
 
 
-def tree_merge_sharded(v, w, k: int, topo: MergeTopology):
+def tier_merge_sharded_wire(
+    v, w, k: int, axis: str, fan_in: int, *, dtype: str, residuals
+):
+    """One tier of :func:`tier_merge_sharded` with the tier's two
+    DATA-MOVING collectives — the all_to_all factor split and the
+    tier-boundary basis all_gather — shipped in ``dtype`` through the
+    ``parallel/wire.py`` codecs (ISSUE 20). The count psum and the
+    (f·kf)² Gram psum stay fp32: accumulation is never compressed.
+
+    Payloads are DELTA-coded against ``residuals``, the tier's
+    synchronized error-feedback carry from the previous round: every
+    device tracks the value the codec reconstructed last round
+    (``h_send``/``h_recv`` for the all_to_all in sender/receiver
+    layout, ``h_v`` for the gathered basis — identical across the
+    group by construction, since both sides advance by the SAME
+    decoded delta) and only the round-over-round CHANGE rides the
+    lossy wire. The rounding residual ``x - ĥ`` is therefore folded
+    into the next round's payload one step stale (the PR 2 rule), and
+    once the warm fit converges the quantizer sees shrinking deltas —
+    int8's ~1% relative error applies to ``‖Δ‖``, not ``‖v‖``.
+
+    Two wire-path-only transforms keep the deltas continuous without
+    changing the merged subspace (per-column sign flips of the
+    exchanged factors are absorbed by the Gram eigensolve, and the
+    final :func:`canonicalize_signs` is flip-invariant):
+
+    - the payload is the sign-canonicalized basis, NOT ``v·√(w/cnt)``
+      — the per-child masked-mean weights are applied fp32-exact
+      AFTER the exchange (a ``fan``-scalar gather), so leaf churn
+      flipping ``w`` never spikes the delta;
+    - the Ritz rotation ``uk`` is sign-canonicalized before mapping
+      rows, pinning ``eigh``'s arbitrary per-column signs.
+
+    Returns ``(v_new, cnt, new_residuals, ef_norm)`` where ``ef_norm``
+    is this round's quantization-error Frobenius norm (the telemetry
+    leg of ``summary()["merge"]``'s wire records); fp32 tiers carry
+    ``()`` and report exact zero.
+    """
+    from distributed_eigenspaces_tpu.parallel import wire as _wire
+
+    d, kf = v.shape
+    cnt = lax.psum(w, axis)
+    if dtype == "fp32":
+        c = v * jnp.sqrt(w / jnp.maximum(cnt, 1.0))
+        c = c.reshape(fan_in, d // fan_in, kf)
+        c = lax.all_to_all(c, axis, split_axis=0, concat_axis=0)
+        s = jnp.transpose(c, (1, 0, 2)).reshape(d // fan_in, fan_in * kf)
+        b = lax.psum(
+            jnp.matmul(s.T, s, precision=lax.Precision.HIGHEST), axis
+        )
+        with jax.default_matmul_precision("highest"):
+            ew, u = jnp.linalg.eigh(0.5 * (b + b.T))
+        wk = ew[-k:][::-1]
+        uk = u[:, -k:][:, ::-1]
+        rows = jnp.matmul(s, uk, precision=lax.Precision.HIGHEST)
+        rows = rows * guarded_inv_sqrt(wk)[None, :]
+        v_new = lax.all_gather(rows, axis, axis=0, tiled=True)
+        return (
+            canonicalize_signs(v_new), cnt, residuals,
+            jnp.zeros((), jnp.float32),
+        )
+    h_send, h_recv, h_v = residuals
+    # Procrustes-align the payload to the carry reference: per-child
+    # orthogonal column rotations are absorbed by the Gram eigensolve
+    # (merged span invariant), so within-subspace eigensolver churn —
+    # rotations, sign flips, ordering swaps — never inflates the delta
+    r_send = _wire.procrustes_rotation(jnp.matmul(
+        v.T, h_send.reshape(d, kf), precision=lax.Precision.HIGHEST
+    ))
+    p = jnp.matmul(v, r_send, precision=lax.Precision.HIGHEST)
+    p = p.reshape(fan_in, d // fan_in, kf)
+    delta = p - h_send
+    rt = _wire.wire_roundtrip(delta, dtype)
+    dec = _wire.wire_all_to_all(delta, axis, dtype)
+    h_send = h_send + rt
+    c = h_recv + dec
+    h_recv = c
+    # masked-mean weights applied post-exchange, fp32-exact: slot j of
+    # the exchanged stack is child j's row slice, scaled by child j's
+    # √(w_j/cnt) from a fan-scalar gather that never rides the codec
+    wg = lax.all_gather(w, axis)
+    c = c * jnp.sqrt(wg / jnp.maximum(cnt, 1.0))[:, None, None]
+    s = jnp.transpose(c, (1, 0, 2)).reshape(d // fan_in, fan_in * kf)
+    b = lax.psum(
+        jnp.matmul(s.T, s, precision=lax.Precision.HIGHEST), axis
+    )
+    with jax.default_matmul_precision("highest"):
+        ew, u = jnp.linalg.eigh(0.5 * (b + b.T))
+    wk = ew[-k:][::-1]
+    uk = u[:, -k:][:, ::-1]
+    rows = jnp.matmul(s, uk, precision=lax.Precision.HIGHEST)
+    rows = rows * guarded_inv_sqrt(wk)[None, :]
+    ref = lax.dynamic_slice_in_dim(
+        h_v, lax.axis_index(axis) * (d // fan_in), d // fan_in, axis=0
+    )
+    # align the merged rows to the gathered-basis carry: the (k, k)
+    # alignment Gram is a tiny fp32 psum, so every group member
+    # computes the SAME rotation and the gathered columns stay global
+    r_gather = _wire.procrustes_rotation(lax.psum(jnp.matmul(
+        rows.T, ref, precision=lax.Precision.HIGHEST
+    ), axis))
+    rows = jnp.matmul(rows, r_gather, precision=lax.Precision.HIGHEST)
+    gdelta = rows - ref
+    grt = _wire.wire_roundtrip(gdelta, dtype)
+    v_new = h_v + _wire.wire_all_gather(gdelta, axis, dtype, tiled=True)
+    # restore the fp32 path's orthonormal-columns invariant after the
+    # lossy decode (replicated (k,k) work, no communication): the
+    # quantized basis has column norms off by O(codec eps), which
+    # downstream V·Vᵀ projectors — and the principal-angle metric —
+    # would otherwise amplify
+    v_new = _cholqr2(v_new)
+    h_v = v_new
+    ef_norm = jnp.sqrt(
+        jnp.sum(jnp.square(delta - rt))
+        + jnp.sum(jnp.square(gdelta - grt))
+    )
+    return canonicalize_signs(v_new), cnt, (h_send, h_recv, h_v), ef_norm
+
+
+def init_wire_residuals(
+    topo: MergeTopology, wire, d: int, kf: int, k: int
+):
+    """Zero error-feedback carry matching the per-tier state of
+    :func:`tier_merge_sharded_wire`: ``(h_send, h_recv, h_v)`` — the
+    synchronized codec reconstructions of the all_to_all payload
+    (sender and receiver layouts, ``(f, d/f, cols)``) and of the
+    gathered ``(d, k)`` tier basis. Tier 0's all_to_all moves the
+    solver's ``(d, kf)`` factors; every later tier moves the merged
+    ``(d, k)`` basis. fp32 tiers carry ``()`` — no state, so an
+    all-fp32 policy adds zero pytree leaves to the scan carry."""
+    res = []
+    cols = kf
+    for (_, f), dtype in zip(topo.tiers, wire):
+        if dtype == "fp32":
+            res.append(())
+        else:
+            res.append((
+                jnp.zeros((f, d // f, cols), jnp.float32),
+                jnp.zeros((f, d // f, cols), jnp.float32),
+                jnp.zeros((d, k), jnp.float32),
+            ))
+        cols = k
+    return tuple(res)
+
+
+def tree_merge_sharded(
+    v, w, k: int, topo: MergeTopology, *, wire=None, residuals=None
+):
     """All tiers of the sharded tree, leaf -> root: after the last tier
     the merged ``(d, k)`` basis is replicated across the whole tiered
     mesh (each tier's gather replicates within its groups; the root's
     group IS the mesh). ``v (d, kf)`` / scalar ``w`` are this device's
-    leaf basis and mask weight."""
+    leaf basis and mask weight.
+
+    ``wire`` (a per-tier dtype tuple from
+    :func:`~.wire.resolve_wire_policy`) routes each tier through
+    :func:`tier_merge_sharded_wire` with ``residuals`` as the
+    error-feedback carry, returning ``(v, new_residuals, ef_norms)``
+    with ``ef_norms`` the ``(n_tiers,)`` per-tier quantization-error
+    norms; ``None`` (default) is the byte-identical uncompressed
+    program returning ``v`` alone."""
     from distributed_eigenspaces_tpu.utils.tracing import named_scope
 
-    for name, f in topo.tiers:
+    if wire is None:
+        for name, f in topo.tiers:
+            with named_scope(f"det_tier_merge_{name}"):
+                v, w = tier_merge_sharded(v, w, k, name, f)
+        return v
+    new_res, norms = [], []
+    for (name, f), dtype, res in zip(topo.tiers, wire, residuals):
         with named_scope(f"det_tier_merge_{name}"):
-            v, w = tier_merge_sharded(v, w, k, name, f)
-    return v
+            v, w, res, ef = tier_merge_sharded_wire(
+                v, w, k, name, f, dtype=dtype, residuals=res
+            )
+        new_res.append(res)
+        norms.append(ef)
+    return v, tuple(new_res), jnp.stack(norms)
 
 
-def make_tree_scan_fit(cfg, mesh: Mesh, *, masked: bool = False):
+def make_tree_scan_fit(
+    cfg, mesh: Mesh, *, masked: bool = False, with_wire_stats: bool = False
+):
     """Whole-fit scan trainer on a TIERED mesh: per-device local solves
     (no factor gather at all — the flat path's ``all_gather`` of the
     (m, d, k) stack is exactly what the tree removes) followed by the
     tier-local sharded tree merge each step. Signature matches
     ``make_scan_fit``'s dense entries: ``fit(state, x_steps)`` /
     ``fit(state, x_steps, masks[, membership_masks])``.
+
+    A ``cfg.merge_wire_dtype`` policy routes every tier's data-moving
+    collectives through the ``parallel/wire.py`` codecs with the
+    per-tier error-feedback residuals threaded through the scan carry
+    (one step stale — round ``t``'s rounding error folds into round
+    ``t+1``'s payload). ``with_wire_stats=True`` (active policy only)
+    appends a third output: the per-step ``(T, n_tiers)`` residual
+    norms for ``summary()["merge"]`` wire telemetry.
 
     Scope (rejected loudly, the segmented trainer's discipline):
     ``merge_interval > 1`` and gather staging are flat-merge schedule
@@ -354,6 +532,17 @@ def make_tree_scan_fit(cfg, mesh: Mesh, *, masked: bool = False):
             "single-worker-axis mesh or single device)"
         )
 
+    from distributed_eigenspaces_tpu.parallel.wire import (
+        resolve_wire_policy,
+    )
+
+    wire = resolve_wire_policy(cfg, topo)
+    if with_wire_stats and wire is None:
+        raise ValueError(
+            "with_wire_stats needs an active cfg.merge_wire_dtype "
+            "policy (the stats ARE the error-feedback residual norms)"
+        )
+
     solve_cold = make_solve_core(cfg)
     solve_warm = make_warm_solve_core(cfg)
     warm = solve_warm is not None
@@ -379,61 +568,103 @@ def make_tree_scan_fit(cfg, mesh: Mesh, *, masked: bool = False):
                 )
             return solve_cold(x)
 
+        def merge_step(v_local, w_, res):
+            # one tree merge under the (static) wire policy; ``res``
+            # is the per-tier error-feedback carry (() when off)
+            if wire is None:
+                return tree_merge_sharded(v_local, w_, k, topo), res, None
+            return tree_merge_sharded(
+                v_local, w_, k, topo, wire=wire, residuals=res
+            )
+
+        def res_init():
+            if wire is None:
+                return ()
+            return init_wire_residuals(topo, wire, cfg.dim, k, k)
+
+        def emit(v_bar, norms):
+            if with_wire_stats:
+                return (v_bar, norms)
+            return v_bar
+
         if masked:
 
             def body(carry, xm):
-                st, vp = carry
+                st, vp, res = carry
                 x, mk = xm
                 w = mk[flat_worker_index(topo)]
                 live = jnp.any(vp != 0)
                 vs = local_solve(x, vp, live)
-                v_bar = tree_merge_sharded(vs[0], w, k, topo)
+                v_bar, res, norms = merge_step(vs[0], w, res)
                 # liveness from the MASK row (the masked-body rule:
                 # a live all-zero round must still advance the carry)
                 vp_next = jnp.where(jnp.any(mk != 0), v_bar, vp)
-                return (update(st, v_bar), vp_next), v_bar
+                return (
+                    (update(st, v_bar), vp_next, res),
+                    emit(v_bar, norms),
+                )
 
             def fit(state, x_steps, masks):
                 vp0 = jnp.zeros((cfg.dim, k), jnp.float32)
-                (state, _), v_bars = jax.lax.scan(
-                    body, (state, vp0),
+                (state, _, _), ys = jax.lax.scan(
+                    body, (state, vp0, res_init()),
                     (x_steps, masks.astype(jnp.float32)),
                 )
-                return state, v_bars
+                if with_wire_stats:
+                    v_bars, norms = ys
+                    return state, v_bars, norms
+                return state, ys
 
             return fit
 
         def body(carry, x):
-            st, vp = carry
+            st, vp, res = carry
             vs = local_solve(x, vp, jnp.any(vp != 0) if warm else None)
-            v_bar = tree_merge_sharded(vs[0], jnp.float32(1.0), k, topo)
-            return (update(st, v_bar), v_bar), v_bar
+            v_bar, res, norms = merge_step(vs[0], jnp.float32(1.0), res)
+            return (
+                (update(st, v_bar), v_bar, res), emit(v_bar, norms)
+            )
 
         if warm:
 
             def fit(state, x_steps):
                 # step 1: cold at the full iteration count (seeds the
                 # warm carry — the scan trainer's schedule exactly)
-                v0 = tree_merge_sharded(
-                    solve_cold(x_steps[0])[0], jnp.float32(1.0), k, topo
+                v0, r0, n0 = merge_step(
+                    solve_cold(x_steps[0])[0], jnp.float32(1.0),
+                    res_init(),
                 )
                 state = update(state, v0)
-                (state, _), v_bars = jax.lax.scan(
-                    body, (state, v0), x_steps[1:]
+                (state, _, _), ys = jax.lax.scan(
+                    body, (state, v0, r0), x_steps[1:]
                 )
-                return state, jnp.concatenate([v0[None], v_bars], axis=0)
+                if with_wire_stats:
+                    v_bars, norms = ys
+                    return (
+                        state,
+                        jnp.concatenate([v0[None], v_bars], axis=0),
+                        jnp.concatenate([n0[None], norms], axis=0),
+                    )
+                return state, jnp.concatenate([v0[None], ys], axis=0)
 
             return fit
 
         def fit_cold(state, x_steps):
-            def b(st, x):
+            def b(carry, x):
+                st, res = carry
                 vs = solve_cold(x)
-                v_bar = tree_merge_sharded(
-                    vs[0], jnp.float32(1.0), k, topo
+                v_bar, res, norms = merge_step(
+                    vs[0], jnp.float32(1.0), res
                 )
-                return update(st, v_bar), v_bar
+                return (update(st, v_bar), res), emit(v_bar, norms)
 
-            return jax.lax.scan(b, state, x_steps)
+            (state, _), ys = jax.lax.scan(
+                b, (state, res_init()), x_steps
+            )
+            if with_wire_stats:
+                v_bars, norms = ys
+                return state, v_bars, norms
+            return state, ys
 
         return fit_cold
 
@@ -444,17 +675,18 @@ def make_tree_scan_fit(cfg, mesh: Mesh, *, masked: bool = False):
     # tier axis, root-major — worker l lands on its C-order device
     x_sharding = NamedSharding(mesh, P(None, axis_tuple))
     extra = (P(),) if masked else ()
+    out_extra = (P(),) if with_wire_stats else ()
     inner = shard_map(
         make_fit(),
         mesh=mesh,
         in_specs=(P(), P(None, axis_tuple)) + extra,
-        out_specs=(P(), P()),
+        out_specs=(P(), P()) + out_extra,
         check_vma=False,
     )
     fitted = checked_jit(
         inner,
         in_shardings=(rep, x_sharding) + ((rep,) if masked else ()),
-        out_shardings=(rep, rep),
+        out_shardings=(rep, rep) + ((rep,) if with_wire_stats else ()),
     )
     if not masked:
         return fitted

@@ -1,0 +1,281 @@
+"""Wire-format codecs for write-path collectives (ISSUE 20).
+
+PR 17 quantized the READ path (int8/bf16 fused serve kernels); this
+module is the WRITE-path twin: every merge-time collective payload —
+the tier all_to_all factor splits and (d, k) basis all-gathers of the
+tree merge, the worker factor-stack gathers of the distributed and
+deflation solves, the population cohort gather — can ship bf16 or
+per-column-symmetric int8 on the wire while every Gram / psum
+ACCUMULATION stays fp32 (the arXiv:2112.09017 discipline: narrow
+operands into the exchange, wide accumulation out of it).
+
+Three rules, enforced by construction:
+
+1. **Payloads only.** A codec wraps exactly one data-moving collective
+   (``all_to_all`` / ``all_gather``): quantize immediately before the
+   exchange, dequantize immediately after. Reductions (``psum``) are
+   never compressed — int8 has no closed addition and bf16 psums lose
+   the fp32 accumulator, so the (f·k)² Gram psums stay f32 on the wire
+   by design (the contract rule in ``analysis/contracts.py`` exempts
+   them for the same reason).
+
+2. **Per-tier policy.** ``cfg.merge_wire_dtype`` maps resolved
+   topology tier names to {fp32, bf16, int8}; unnamed tiers default to
+   fp32. ``None`` (the default) dispatches to the byte-identical
+   pre-knob programs — the PR 2/PR 12 off-position discipline.
+
+3. **Error feedback, one step stale.** The int8/bf16 rounding residual
+   of round ``t`` is carried and folded into round ``t+1``'s payload
+   BEFORE quantization (the PR 2 staleness rule: never block the
+   current round on correction state), so quantization error cannot
+   accumulate across the T-step online loop — it is re-presented to
+   the quantizer until it clears the rounding threshold.
+
+The int8 codec reuses PR 17's :func:`~..ops.pallas_gram.
+quantize_basis_i8` machinery (per-column symmetric, absmax/127 scale,
+all-zero columns exact); its fp32 ``(1, k)`` scale rides the exchange
+as a sidecar payload that ``analysis/costmodel`` accounts explicitly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "WIRE_DTYPES",
+    "WIRE_HLO_TOKEN",
+    "WIRE_ITEMSIZE",
+    "error_feedback",
+    "normalize_wire_policy",
+    "procrustes_rotation",
+    "resolve_wire_policy",
+    "root_wire_dtype",
+    "tier_wire_records",
+    "wire_all_gather",
+    "wire_all_to_all",
+    "wire_roundtrip",
+]
+
+#: the closed codec vocabulary — config validation, contracts and the
+#: planner all key on exactly these
+WIRE_DTYPES = ("fp32", "bf16", "int8")
+
+#: bytes per element each codec puts on the wire (the int8 scale
+#: sidecar is accounted separately — see ``costmodel.model_costs``)
+WIRE_ITEMSIZE = {"fp32": 4, "bf16": 2, "int8": 1}
+
+#: codec -> the dtype token its payloads carry in compiled HLO — what
+#: the ``collective-wire-dtype`` contract rule greps for
+WIRE_HLO_TOKEN = {"fp32": "f32", "bf16": "bf16", "int8": "s8"}
+
+
+# ---------------------------------------------------------------------------
+# policy resolution
+# ---------------------------------------------------------------------------
+
+
+def normalize_wire_policy(policy) -> dict[str, str]:
+    """``merge_wire_dtype`` in any accepted spelling (dict or tuple of
+    ``(tier, dtype)`` pairs — the config normal form) -> plain dict."""
+    if isinstance(policy, dict):
+        return {str(k): str(v) for k, v in policy.items()}
+    return {str(k): str(v) for k, v in policy}
+
+
+def resolve_wire_policy(cfg, topo) -> tuple[str, ...] | None:
+    """``cfg.merge_wire_dtype`` -> per-tier dtype tuple aligned with
+    ``topo.tiers`` (leaf -> root), or ``None`` for the byte-identical
+    uncompressed programs. Loud on keys that name no resolved tier —
+    a policy silently ignored is a compression that silently never
+    happens."""
+    policy = getattr(cfg, "merge_wire_dtype", None)
+    if policy is None or topo is None:
+        return None
+    policy = normalize_wire_policy(policy)
+    unknown = set(policy) - set(topo.names)
+    if unknown:
+        raise ValueError(
+            f"merge_wire_dtype keys {sorted(unknown)} name no resolved "
+            f"topology tier; tiers are {list(topo.names)}"
+        )
+    bad = {k: v for k, v in policy.items() if v not in WIRE_DTYPES}
+    if bad:
+        raise ValueError(
+            f"merge_wire_dtype values {bad} not in {WIRE_DTYPES}"
+        )
+    return tuple(policy.get(name, "fp32") for name in topo.names)
+
+
+def root_wire_dtype(cfg, topo) -> str:
+    """The ROOT tier's wire dtype — the policy a single flat gather
+    spanning the whole mesh inherits (the population cohort gather:
+    one collective that crosses every tier boundary at once, so it
+    rides the slowest wire the policy names)."""
+    wire = resolve_wire_policy(cfg, topo)
+    if wire is None:
+        return "fp32"
+    return wire[-1]
+
+
+# ---------------------------------------------------------------------------
+# codecs
+# ---------------------------------------------------------------------------
+
+
+def _quantize_i8(x):
+    """Per-column symmetric int8 of a ``(rows, k)`` panel or a
+    ``(g, rows, k)`` batch of panels (one scale row per batch slot —
+    each sender's scale travels with its payload)."""
+    from distributed_eigenspaces_tpu.ops.pallas_gram import (
+        quantize_basis_i8,
+    )
+
+    if x.ndim == 2:
+        return quantize_basis_i8(x)
+    return jax.vmap(quantize_basis_i8)(x)
+
+
+def procrustes_rotation(m):
+    """Orthogonal ``(k, k)`` rotation ``R`` maximizing ``tr(Rᵀ m)`` —
+    the Procrustes alignment of a basis ``x`` onto a reference
+    (``m = xᵀ·ref``), reflections allowed. Per-child orthogonal column
+    rotations are absorbed by the tier Gram eigensolve (the merged
+    span is invariant), so the delta codec aligns every payload to its
+    carry reference before encoding: within-subspace column churn —
+    eigensolver rotations, sign flips, ordering swaps — never inflates
+    the wire delta. The tiny identity bias pins ``R = I`` exactly when
+    the reference is all-zero (round 0's cold carry)."""
+    k = m.shape[-1]
+    m = m + 1e-6 * jnp.eye(k, dtype=m.dtype)
+    with jax.default_matmul_precision("highest"):
+        u, _, vt = jnp.linalg.svd(m)
+    return jnp.matmul(u, vt, precision=lax.Precision.HIGHEST)
+
+
+def wire_roundtrip(x, dtype: str):
+    """Encode/decode without moving anything: the value the RECEIVERS
+    will reconstruct. The error-feedback residual is ``x - roundtrip``;
+    XLA CSEs the duplicated encode against the collective's own."""
+    if dtype == "fp32":
+        return x
+    if dtype == "bf16":
+        return x.astype(jnp.bfloat16).astype(jnp.float32)
+    if dtype == "int8":
+        q, s = _quantize_i8(x)
+        return q.astype(jnp.float32) * s
+    raise ValueError(f"unknown wire dtype {dtype!r}; one of {WIRE_DTYPES}")
+
+
+def error_feedback(x, residual, dtype: str):
+    """Fold the previous round's rounding residual into this round's
+    payload and return ``(x_adjusted, new_residual)``. fp32 is exact —
+    the residual stays identically zero and the payload untouched."""
+    if dtype == "fp32":
+        return x, residual
+    x = x + residual
+    return x, x - wire_roundtrip(x, dtype)
+
+
+def wire_all_gather(x, axis_name: str, dtype: str, *, tiled: bool = True):
+    """``all_gather`` over ``axis_name`` with the payload in the wire
+    dtype, result fp32. ``x`` is a ``(rows, k)`` panel or a
+    ``(m_local, rows, k)`` stack; gather is on axis 0, tiled or
+    stacked exactly like ``lax.all_gather``."""
+    if dtype == "fp32":
+        return lax.all_gather(x, axis_name, axis=0, tiled=tiled)
+    if dtype == "bf16":
+        # barriers pin the encode to the SEND side and the decode to
+        # the RECEIVE side: converts are elementwise and shape-class
+        # preserving, so XLA freely commutes them through collectives
+        # (convert∘gather == gather∘convert) and the wire silently
+        # carries f32 again — the ``collective-wire-dtype`` contract
+        # rule is what catches that regression.
+        g = lax.optimization_barrier(lax.all_gather(
+            lax.optimization_barrier(x.astype(jnp.bfloat16)),
+            axis_name, axis=0, tiled=tiled,
+        ))
+        return g.astype(jnp.float32)
+    if dtype != "int8":
+        raise ValueError(f"unknown wire dtype {dtype!r}; one of {WIRE_DTYPES}")
+    q, s = _quantize_i8(x)
+    qg = lax.all_gather(q, axis_name, axis=0, tiled=tiled)
+    if not tiled:
+        # qg (g, *x.shape); s (1, k) or (m_local, 1, k) stacks alongside
+        sg = lax.all_gather(s, axis_name, axis=0, tiled=False)
+        return qg.astype(jnp.float32) * sg
+    if x.ndim == 2:
+        # qg (g*rows, k): regroup by sender to apply each sender's scale
+        sg = lax.all_gather(s, axis_name, axis=0, tiled=False)  # (g, 1, k)
+        grp = sg.shape[0]
+        dec = qg.astype(jnp.float32).reshape(grp, x.shape[0], -1) * sg
+        return dec.reshape(qg.shape)
+    # x (m_local, rows, k): tiled gather concatenates senders on axis 0
+    # and so does the (m_local, 1, k) scale stack — rows stay aligned
+    sg = lax.all_gather(s, axis_name, axis=0, tiled=True)
+    return qg.astype(jnp.float32) * sg
+
+
+def wire_all_to_all(c, axis_name: str, dtype: str):
+    """``all_to_all`` of ``c (g, rows, k)`` (split/concat on axis 0)
+    with the payload in the wire dtype, result fp32. Slot ``i`` of the
+    result is peer ``i``'s row block, decoded with PEER ``i``'s scale —
+    the ``(g, 1, k)`` scale sidecar rides its own tiny all_to_all."""
+    if dtype == "fp32":
+        return lax.all_to_all(c, axis_name, split_axis=0, concat_axis=0)
+    if dtype == "bf16":
+        # barriers for the same convert-commuting reason as in
+        # :func:`wire_all_gather` — see the note there
+        g = lax.optimization_barrier(lax.all_to_all(
+            lax.optimization_barrier(c.astype(jnp.bfloat16)),
+            axis_name, split_axis=0, concat_axis=0,
+        ))
+        return g.astype(jnp.float32)
+    if dtype != "int8":
+        raise ValueError(f"unknown wire dtype {dtype!r}; one of {WIRE_DTYPES}")
+    q, s = _quantize_i8(c)  # q (g, rows, k), s (g, 1, k)
+    qx = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    sx = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+    return qx.astype(jnp.float32) * sx
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+
+def tier_wire_records(
+    topo, wire, d: int, kf: int, *, residual_norms=None
+) -> list[dict]:
+    """Per-tier ``{"kind": "wire", ...}`` merge telemetry records for
+    one round under an ACTIVE policy: wire payload bytes (both
+    data-movers + int8 scale sidecars), the compression ratio vs the
+    fp32 program, and the error-feedback residual norm when the caller
+    measured one. Feed to ``MetricsLogger.merge`` — the ``wire`` kind
+    aggregates per tier in ``summary()["merge"]`` with eviction fold.
+    """
+    records = []
+    norms = residual_norms or {}
+    for (name, fan), dtype in zip(topo.tiers, wire):
+        ring = (fan - 1) / fan if fan > 1 else 0.0
+        # the tier's two data-movers: the all_to_all factor split and
+        # the tier-boundary basis gather, d*kf elements each
+        fp32_bytes = 2 * ring * d * kf * WIRE_ITEMSIZE["fp32"]
+        bytes_wire = 2 * ring * d * kf * WIRE_ITEMSIZE[dtype]
+        if dtype == "int8":
+            bytes_wire += ring * (fan + 1) * kf * 4  # scale sidecars
+        rec = {
+            "kind": "wire",
+            "tier": name,
+            "wire_dtype": dtype,
+            "payload_bytes": int(round(bytes_wire)),
+            "fp32_bytes": int(round(fp32_bytes)),
+            "compression_ratio": round(
+                fp32_bytes / max(bytes_wire, 1e-9), 3
+            ),
+        }
+        if name in norms:
+            rec["ef_residual_norm"] = float(norms[name])
+        records.append(rec)
+    return records

@@ -118,11 +118,22 @@ class TierSet:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
     ):
+        from distributed_eigenspaces_tpu.parallel.wire import (
+            resolve_wire_policy,
+        )
+
         self.topo = topo
         self.cfg = cfg
         self.metrics = metrics
         self._clock = clock
         self._sleep = sleep
+        #: per-tier wire dtypes under an active ``merge_wire_dtype``
+        #: policy (ISSUE 20), or None — drives the per-round ``wire``
+        #: merge records and the ``merge:tier`` span attribute
+        self.wire = resolve_wire_policy(cfg, topo)
+        #: tier -> last observed error-feedback residual norm, fed by
+        #: :meth:`note_wire_residuals` from the fit's scanned stats
+        self._wire_norms: dict[str, float] = {}
         self._deadline_s = (
             None if cfg.round_deadline_ms is None
             else cfg.round_deadline_ms / 1e3
@@ -161,6 +172,22 @@ class TierSet:
     def _emit(self, kind: str, **detail) -> None:
         if self.metrics is not None:
             self.metrics.merge({"kind": kind, **detail})
+
+    def note_wire_residuals(self, norms) -> None:
+        """Feed the latest per-tier error-feedback residual norms (the
+        fit's scanned wire stats — ``make_tree_scan_fit(...,
+        with_wire_stats=True)`` — or any tier->norm mapping). They ride
+        the next round's ``wire`` merge records so ``summary()
+        ["merge"]["wire"]`` tracks how much rounding error the one-
+        step-stale carry is re-presenting."""
+        if norms is None:
+            return
+        if not isinstance(norms, dict):
+            norms = dict(zip(
+                self.topo.names, (float(x) for x in norms)
+            ))
+        for name, x in norms.items():
+            self._wire_norms[str(name)] = float(x)
 
     def replay(self, first_step: int) -> None:
         """Rebuild the churn simulation state for a stream resuming at
@@ -202,12 +229,37 @@ class TierSet:
         info: dict[str, dict] = {}
         for stage in range(1, len(self.topo.tiers)):
             name, fan_in = self.topo.tiers[stage]
+            attrs = {"tier": name, "step": int(step)}
+            if self.wire is not None:
+                attrs["wire_dtype"] = self.wire[stage]
             with tracer.span(
-                "merge:tier", category="merge",
-                attrs={"tier": name, "step": int(step)},
+                "merge:tier", category="merge", attrs=attrs,
             ):
                 info[name] = self._tier_round(name, fan_in, step)
+        self._emit_wire_round(step)
         return info
+
+    def _emit_wire_round(self, step: int) -> None:
+        """One ``wire`` merge record per COMPRESSED tier per round
+        (ISSUE 20): the tier's modeled payload bytes on the wire vs
+        the fp32 program, its compression ratio, and — once the fit
+        reported them — the error-feedback residual norm. fp32 tiers
+        emit nothing: their rounds are byte-identical to the pre-knob
+        program and the ledger should say so by silence."""
+        if self.wire is None:
+            return
+        from distributed_eigenspaces_tpu.parallel.wire import (
+            tier_wire_records,
+        )
+
+        for rec in tier_wire_records(
+            self.topo, self.wire, self.cfg.dim, self.cfg.k,
+            residual_norms=self._wire_norms,
+        ):
+            if rec["wire_dtype"] == "fp32":
+                continue
+            del rec["kind"]
+            self._emit("wire", step=step, **rec)
 
     def _tier_round(self, name: str, fan_in: int, step: int) -> dict:
         table = self.tables[name]

@@ -245,6 +245,7 @@ def dist_deflation_eig(
     axis_name: str | None = FEATURE_AXIS,
     v0: jax.Array | None = None,
     with_info: bool = False,
+    wire_dtype: str = "fp32",
 ):
     """:func:`deflation_eig` with the lanes SHARDED over the
     ``components`` mesh axis — call inside ``shard_map`` over a
@@ -276,7 +277,23 @@ def dist_deflation_eig(
 
     ``v0`` warm-starts THIS lane from a ``(d_local, kb)`` seed block
     (e.g. the matching columns of a published basis on a hot swap) —
-    it enters through CholeskyQR2, so any full-rank block is legal."""
+    it enters through CholeskyQR2, so any full-rank block is legal.
+
+    ``wire_dtype`` ships the cross-lane panel gathers — the per-sweep
+    ``(L, d_local, kb)`` lane stack and the finishing gather, the
+    solve's only d-wide payloads — in {fp32, bf16, int8} through the
+    ``parallel/wire.py`` codecs (ISSUE 20). One-shot lossy: the sweep
+    is self-correcting (each iteration re-gathers and CholeskyQR2
+    re-orthonormalizes), and the correction/Gram psums stay fp32."""
+    from distributed_eigenspaces_tpu.parallel.wire import (
+        wire_all_gather,
+    )
+
+    def lane_gather(x):
+        if wire_dtype == "fp32":
+            return lax.all_gather(x, lane_axis)
+        return wire_all_gather(x, lane_axis, wire_dtype, tiled=False)
+
     kb = _lane_widths(k, lanes)
     my = lax.axis_index(lane_axis)
     if v0 is not None:
@@ -294,7 +311,7 @@ def dist_deflation_eig(
     jlt = jnp.arange(lanes)  # lane indices, for the j < my mask
 
     def sweep(v, active):
-        vs = lax.all_gather(v, lane_axis)  # (L, d_local, kb)
+        vs = lane_gather(v)  # (L, d_local, kb)
         w = matvec(v)  # (d_local, kb)
         coef = jnp.einsum("jdb,dc->jbc", vs, w, precision=HP)
         coef = _psum_if(coef, axis_name)
@@ -342,7 +359,7 @@ def dist_deflation_eig(
                 jnp.asarray(jnp.inf, jnp.float32),
             ),
         )
-    vs = lax.all_gather(v, lane_axis)  # the finishing lane gather
+    vs = lane_gather(v)  # the finishing lane gather
     flat = chol_qr2(_lanes_to_flat(vs), axis_name)
     out = dist_rayleigh_ritz(flat, matvec(flat), axis_name)[:, :k]
     if with_info:
@@ -395,6 +412,7 @@ def dist_merged_top_k_deflation(
     key: jax.Array | None = None,
     collectives: str = "xla",
     v0: jax.Array | None = None,
+    wire_dtype: str = "fp32",
 ):
     """The deflation merge inside ``shard_map`` over the ``(workers,
     features)`` mesh — the ``cfg.solver="deflation"`` twin of
@@ -403,11 +421,27 @@ def dist_merged_top_k_deflation(
     the parallel-deflation lanes (batched per device, rows sharded over
     ``features``) instead of plain subspace iteration. ``v0`` row shard
     warm-starts the lane stack; an all-masked round returns exact
-    zeros."""
+    zeros. ``wire_dtype`` compresses the worker factor-stack gather
+    exactly as in ``dist_merged_top_k`` (one-shot lossy; mask gather
+    and psums stay fp32; xla collectives only)."""
     _, gather_c = _collective_ops(collectives)
     from distributed_eigenspaces_tpu.parallel.mesh import WORKER_AXIS
 
-    c = gather_c(v_workers, WORKER_AXIS)  # (m_total, d_local, kf)
+    if wire_dtype != "fp32":
+        if collectives != "xla":
+            raise ValueError(
+                "wire_dtype compression needs collectives='xla' (the "
+                "ring route has no codec path)"
+            )
+        from distributed_eigenspaces_tpu.parallel.wire import (
+            wire_all_gather,
+        )
+
+        c = wire_all_gather(
+            v_workers, WORKER_AXIS, wire_dtype, tiled=True
+        )
+    else:
+        c = gather_c(v_workers, WORKER_AXIS)  # (m_total, d_local, kf)
     m_total = c.shape[0]
     if mask is None:
         w = jnp.ones((m_total,), jnp.float32)

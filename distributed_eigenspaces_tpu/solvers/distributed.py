@@ -324,6 +324,7 @@ def dist_merged_top_k(
     v0: jax.Array | None = None,
     oversample: int | None = None,
     tol: float | None = None,
+    wire_dtype: str = "fp32",
 ):
     """The distributed MERGE solve, inside ``shard_map`` over the
     ``(workers, features)`` mesh: exact-operator top-k of the masked
@@ -339,9 +340,29 @@ def dist_merged_top_k(
     the merge the feature-sharded trainers run. An all-masked round
     returns exact zeros (the exact route's guard semantics). ``v0``
     row shard warm-starts the iteration (the previous merged basis —
-    the same lever the worker solves use)."""
+    the same lever the worker solves use).
+
+    ``wire_dtype`` ships the worker factor-stack gather — the solve's
+    one d-wide payload — in {fp32, bf16, int8} through the
+    ``parallel/wire.py`` codecs (ISSUE 20). One-shot lossy (no carry
+    to delta-code against): the iteration's psums, the mask gather and
+    every k-wide collective stay fp32. xla collectives only."""
     psum_c, gather_c = _collective_ops(collectives)
-    c = gather_c(v_workers, WORKER_AXIS)  # (m_total, d_local, kf)
+    if wire_dtype != "fp32":
+        if collectives != "xla":
+            raise ValueError(
+                "wire_dtype compression needs collectives='xla' (the "
+                "ring route has no codec path)"
+            )
+        from distributed_eigenspaces_tpu.parallel.wire import (
+            wire_all_gather,
+        )
+
+        c = wire_all_gather(
+            v_workers, WORKER_AXIS, wire_dtype, tiled=True
+        )
+    else:
+        c = gather_c(v_workers, WORKER_AXIS)  # (m_total, d_local, kf)
     m_total = c.shape[0]
     d_local = c.shape[1]
     if mask is None:

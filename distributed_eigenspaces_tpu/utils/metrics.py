@@ -201,7 +201,9 @@ class MetricsLogger:
         # counts by kind plus PER-TIER round outcomes (fan-in,
         # deadline closes, stale folds, arrival histogram) — so
         # summary()["merge"] covers the whole run after eviction
-        self._merge_agg: dict = {"count": 0, "by_kind": {}, "tiers": {}}
+        self._merge_agg: dict = {
+            "count": 0, "by_kind": {}, "tiers": {}, "wire": {},
+        }
         # registry-replication eviction aggregates (ISSUE 14): event
         # counts by kind, install/staleness/fencing/failover counters,
         # failover recovery times, and the mergeable propagation-lag
@@ -526,6 +528,34 @@ class MetricsLogger:
         agg["by_kind"][kind] = agg["by_kind"].get(kind, 0) + 1
         if kind == "tier_round":
             self._fold_merge_tier(agg["tiers"], rec)
+        elif kind == "wire":
+            self._fold_merge_wire(agg["wire"], rec)
+
+    @staticmethod
+    def _fold_merge_wire(wire: dict, rec: dict) -> None:
+        """One per-tier wire-compression record (ISSUE 20,
+        ``parallel/wire.tier_wire_records``) into the per-tier wire
+        aggregate: cumulative payload bytes vs the fp32 program, the
+        declared codec + its per-round compression ratio, and the
+        error-feedback residual norm (last seen + running max) — the
+        write-path twin of the serve dtype ledger."""
+        tier = rec.get("tier", "unknown")
+        t = wire.setdefault(tier, {
+            "wire_dtype": rec.get("wire_dtype"), "rounds": 0,
+            "payload_bytes": 0, "fp32_bytes": 0,
+        })
+        t["rounds"] += 1
+        t["wire_dtype"] = rec.get("wire_dtype", t["wire_dtype"])
+        t["payload_bytes"] += int(rec.get("payload_bytes") or 0)
+        t["fp32_bytes"] += int(rec.get("fp32_bytes") or 0)
+        if rec.get("compression_ratio") is not None:
+            t["compression_ratio"] = rec["compression_ratio"]
+        norm = rec.get("ef_residual_norm")
+        if norm is not None:
+            t["ef_residual_norm"] = float(norm)
+            t["ef_residual_norm_max"] = max(
+                float(norm), t.get("ef_residual_norm_max", 0.0)
+            )
 
     @staticmethod
     def _fold_merge_tier(tiers: dict, rec: dict) -> None:
@@ -1169,26 +1199,34 @@ class MetricsLogger:
         """The ``summary()["merge"]`` section (ISSUE 12): hierarchical-
         merge event counts by kind and the PER-TIER round ledger —
         fan-in, rounds, tier-deadline closes, one-step-stale folds, and
-        the per-round arrival histogram — plus the retained event
-        window. Evictions are folded in (the membership-section rule),
-        so a long elastic run's tree stays fully accounted."""
+        the per-round arrival histogram — plus, under an active
+        ``merge_wire_dtype`` policy, the per-tier WIRE ledger (ISSUE
+        20: codec, payload vs fp32 bytes, compression ratio, EF
+        residual norm) and the retained event window. Evictions are
+        folded in (the membership-section rule), so a long elastic
+        run's tree stays fully accounted."""
         agg = self._merge_agg
         by_kind = dict(agg["by_kind"])
         tiers = {
             name: {**t, "arrival_hist": dict(t["arrival_hist"])}
             for name, t in agg["tiers"].items()
         }
+        wire = {name: dict(t) for name, t in agg["wire"].items()}
         for r in self.merge_records:
             kind = r.get("merge", "unknown")
             by_kind[kind] = by_kind.get(kind, 0) + 1
             if kind == "tier_round":
                 self._fold_merge_tier(tiers, r)
+            elif kind == "wire":
+                self._fold_merge_wire(wire, r)
         out: dict = {
             "events": agg["count"] + len(self.merge_records),
             "by_kind": by_kind,
             "tiers": tiers,
             "recent": list(self.merge_records),
         }
+        if wire:
+            out["wire"] = wire
         if self.merge_records.evicted:
             out["events_evicted"] = self.merge_records.evicted
         return out

@@ -120,7 +120,19 @@
 #      payload (mesh-too-small rigs skip LOUDLY); the compare gates
 #      the warm speedup ratio against the committed
 #      BENCH_DEFLATE_SMOKE_CPU.json (same (d,k,lanes) records only);
-#   15. scripts/scenario.py: the production-shaped scenario replay
+#   15. bench.py --wire: the wire-compression smoke (ISSUE 20) — the
+#      same tiered fit (chip:4 x host:2, churn masks on) under three
+#      wire policies (fp32 / bf16-both / int8-host): every arm inside
+#      the planted-truth angle budget, each compressed arm within
+#      0.2 deg of the fp32 arm (error feedback + delta coding doing
+#      their job), the host tier's modeled data-mover bytes reduced
+#      >= 2x (bf16) / >= 3.5x (int8), and BOTH program legs
+#      (tree_fit / tree_fit_wire) passing the collective-wire-dtype
+#      contract — the declared compression provably reaches the wire.
+#      The compare gates the int8 host-tier compression ratio against
+#      the committed BENCH_WIRE_SMOKE_CPU.json (same-topology,
+#      same-policy records only — cross-policy ratios skip loudly);
+#   16. scripts/scenario.py: the production-shaped scenario replay
 #      (ISSUE 11) — a 3-episode composition (flash crowd + lane kill,
 #      correlated fit-tier churn, mid-burst registry publish) replayed
 #      from scenarios/ci_smoke.json against the full stack, judged
@@ -131,7 +143,7 @@
 #      the committed BENCH_SCENARIO_SMOKE_CPU.json (ratio floors + a
 #      10 s structural recovery bound + a 0.5 absolute attainment
 #      floor, so CPU-rig jitter can't flap CI);
-#   16. bench.py --controller: the self-tuning control-plane A/B
+#   17. bench.py --controller: the self-tuning control-plane A/B
 #      (ISSUE 19) — three replays of scenarios/controller_day.json
 #      (controller off / on / seeded bad plan), judged purely from
 #      summary() telemetry: the on arm's SLO attainment must meet or
@@ -143,7 +155,7 @@
 #      (ratio floor + 0.5 absolute attainment floor, override with
 #      DET_CONTROLLER_ATTAINMENT_FLOOR; cross-scenario records skip
 #      loudly both directions);
-#   17. scripts/analyze.py --all --costs --shardings --mutation-check:
+#   18. scripts/analyze.py --all --costs --shardings --mutation-check:
 #      the static program-contract gate (ISSUE 10 + 13,
 #      docs/ANALYSIS.md) — every program kind audited against its
 #      declarative contract (collective schedule + payload bounds,
@@ -155,7 +167,7 @@
 #      class is caught. ruff (the dev extra / Dockerfile image) runs
 #      first when on PATH; a missing ruff now SKIPS LOUDLY instead of
 #      silently (DET_CI_REQUIRE_RUFF=1 turns the skip into a failure);
-#   18. scripts/analyze.py --plan: the planner smoke (ISSUE 19) —
+#   19. scripts/analyze.py --plan: the planner smoke (ISSUE 19) —
 #      replans the default declared workload from the committed
 #      calibration records (wirespeed / serve / coldstart smokes +
 #      EXP_PIPELINE_CPU.json), diff-gates the artifact against the
@@ -164,12 +176,12 @@
 #      and runs the model-vs-measured drift check: a >= 2x anchor
 #      ratio warns loudly, >= 5x fails the stage — the cost-model
 #      loop's teeth;
-#   19. __graft_entry__.py: single-chip entry() compile + the 8-device
+#   20. __graft_entry__.py: single-chip entry() compile + the 8-device
 #      sharded dryrun (tp/dp/sp shardings compile AND execute).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/19] pytest suite (CPU rig, 8 virtual devices) =="
+echo "== [1/20] pytest suite (CPU rig, 8 virtual devices) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" == "--fast" ]]; then
@@ -177,7 +189,7 @@ if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
 
-echo "== [2/19] bench smoke + anchor-normalized compare (CPU) =="
+echo "== [2/20] bench smoke + anchor-normalized compare (CPU) =="
 if [[ -f BENCH_SMOKE_CPU.json ]]; then
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py \
         --compare BENCH_SMOKE_CPU.json \
@@ -187,7 +199,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py
 fi
 
-echo "== [3/19] fleet equivalence + amortization smoke (CPU) =="
+echo "== [3/20] fleet equivalence + amortization smoke (CPU) =="
 # bench.py --fleet asserts the fleet-vs-solo equivalence gate itself
 # (per-tenant accuracy <= 1 deg AND fleet-vs-solo angle gap <= 0.5 deg)
 # and the compare checks the anchor-normalized fits/sec against the
@@ -202,7 +214,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --fleet
 fi
 
-echo "== [4/19] serve equality + amortization smoke (CPU) =="
+echo "== [4/20] serve equality + amortization smoke (CPU) =="
 # bench.py --serve asserts the serving correctness gates itself:
 # every served projection BIT-FOR-BIT equal to the direct
 # estimator.transform result, and the mid-burst basis hot-swap
@@ -217,7 +229,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --serve
 fi
 
-echo "== [5/19] wirespeed smoke: continuous batching + quantized kernels (CPU) =="
+echo "== [5/20] wirespeed smoke: continuous batching + quantized kernels (CPU) =="
 # bench.py --wirespeed asserts the ISSUE-17 read-path gates itself:
 # one saturating multi-tenant burst served twice (deadline dispatch vs
 # continuous batching) with a publisher hot-swap MID-burst in each arm
@@ -238,7 +250,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --wirespeed
 fi
 
-echo "== [6/19] coldstart + prewarm smoke (CPU) =="
+echo "== [6/20] coldstart + prewarm smoke (CPU) =="
 # bench.py --coldstart asserts the zero-cold-start gates itself:
 # cached-vs-fresh results bit-identical, the prewarmed signature's
 # first request at 0 compile misses / 0.0 ms stall, warm first-fit
@@ -253,7 +265,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --coldstart
 fi
 
-echo "== [7/19] telemetry smoke: trace export + span-chain validation =="
+echo "== [7/20] telemetry smoke: trace export + span-chain validation =="
 # A serve burst with --trace-out, then a structural validation of the
 # emitted timeline: the JSON must parse as Chrome trace-event format,
 # every served query's span chain (admit → queue_wait → dispatch →
@@ -298,7 +310,7 @@ print(json.dumps({
 }))
 PY
 
-echo "== [8/19] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
+echo "== [8/20] chaos-serve smoke: durable restart + shed + breaker (CPU) =="
 # bench.py --chaos-serve asserts the read-path resilience gates itself
 # (ISSUE 7): a kill -9'd publisher's store recovers (torn snapshot
 # skipped, checksum corruption quarantined) and the restarted server
@@ -317,7 +329,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-serve
 fi
 
-echo "== [9/19] chaos-churn smoke: elastic membership under churn (CPU) =="
+echo "== [9/20] chaos-churn smoke: elastic membership under churn (CPU) =="
 # bench.py --chaos-churn asserts the fit-tier elastic-membership gates
 # itself (ISSUE 8): a run with 30% mid-run worker loss, flapping
 # rejoins, and a persistent straggler finishes all steps inside the
@@ -337,7 +349,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --chaos-churn
 fi
 
-echo "== [10/19] population ingest smoke: cohorts + Byzantine merge (CPU) =="
+echo "== [10/20] population ingest smoke: cohorts + Byzantine merge (CPU) =="
 # bench.py --population asserts the population-scale ingest gates
 # itself (ISSUE 16): a 100k-client simulated population, cohort 256
 # per round, 30% dropout + a mid-run dropout wave + stragglers + NaN
@@ -362,7 +374,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --population
 fi
 
-echo "== [11/19] replica fleet smoke: lease failover + bounded staleness (CPU) =="
+echo "== [11/20] replica fleet smoke: lease failover + bounded staleness (CPU) =="
 # bench.py --replica asserts the replicated-registry gates itself
 # (ISSUE 14): N replicas warm-recover a kill -9'd publisher's store
 # bit-exact; a standby waits out the live lease and takes over at
@@ -384,7 +396,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --replica
 fi
 
-echo "== [12/19] tree-merge smoke: flat vs tiered tree (CPU) =="
+echo "== [12/20] tree-merge smoke: flat vs tiered tree (CPU) =="
 # bench.py --tree asserts the hierarchical-merge gates itself (ISSUE
 # 12): the same planted fit run flat and through the chip:4 x host:2
 # tree must both land inside the angle budget AND agree with each
@@ -403,7 +415,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --tree
 fi
 
-echo "== [13/19] dsolve crossover smoke: eigh vs distributed solve (CPU) =="
+echo "== [13/20] dsolve crossover smoke: eigh vs distributed solve (CPU) =="
 # bench.py --dsolve asserts the distributed-eigensolve gates itself
 # (ISSUE 15): at every swept d the blocked subspace iteration (factor
 # matvecs + CholeskyQR2 + replicated Rayleigh-Ritz, never a d x d
@@ -425,7 +437,7 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --dsolve
 fi
 
-echo "== [14/19] deflate smoke: parallel deflation + elastic k (CPU) =="
+echo "== [14/20] deflate smoke: parallel deflation + elastic k (CPU) =="
 # bench.py --deflate asserts the parallel-deflation gates itself
 # (ISSUE 18): on a warm start with a MATCHED fixed per-lane sweep
 # budget the fused parallel solve (all k lanes advanced per sweep,
@@ -450,7 +462,30 @@ else
     DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --deflate
 fi
 
-echo "== [15/19] scenario replay: production-shaped composition (CPU) =="
+echo "== [15/20] wire-compression smoke: mixed-precision collectives (CPU) =="
+# bench.py --wire asserts the ISSUE-20 wire-compression gates itself:
+# the same planted tiered fit (chip:4 x host:2, churn masks on) run
+# under fp32, bf16-both-tiers, and int8-host wire policies — every
+# arm inside the planted-truth angle budget, each compressed arm's
+# final basis within 0.2 deg of the fp32 arm (the error-feedback +
+# delta-coding loop gated, not assumed), the host tier's modeled
+# data-mover bytes reduced >= 2x (bf16) / >= 3.5x (int8, fp32 scale
+# sidecars included), and both program legs (tree_fit /
+# tree_fit_wire) passing the collective-wire-dtype contract audit —
+# the declared compression provably reaches the wire as s8 payloads
+# (bf16 accepted in its CPU float-normalized spelling). The compare
+# gates the int8 host-tier compression ratio against the committed
+# record (same-topology, same-policy records only — a cross-policy
+# ratio is a unit error and skips loudly).
+if [[ -f BENCH_WIRE_SMOKE_CPU.json ]]; then
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --wire \
+        --compare BENCH_WIRE_SMOKE_CPU.json \
+        --compare-threshold "${DET_CI_COMPARE_THRESHOLD:-0.5}"
+else
+    DET_BENCH_SMALL=1 JAX_PLATFORMS=cpu python bench.py --wire
+fi
+
+echo "== [16/20] scenario replay: production-shaped composition (CPU) =="
 # scripts/scenario.py replays scenarios/ci_smoke.json — a flash crowd
 # with a mid-crowd lane kill, correlated fit-tier worker churn, and a
 # mid-burst registry publish on one timeline — and judges it purely
@@ -470,7 +505,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --scenario scenarios/ci_smoke.json
 fi
 
-echo "== [16/19] controller A/B: self-tuning control plane (CPU) =="
+echo "== [17/20] controller A/B: self-tuning control plane (CPU) =="
 # bench.py --controller asserts the ISSUE-19 control-plane gates
 # itself: three replays of scenarios/controller_day.json — controller
 # off (baseline), on (autoscaler lane acting through the live queue's
@@ -490,7 +525,7 @@ else
     JAX_PLATFORMS=cpu python bench.py --controller
 fi
 
-echo "== [17/19] static analysis: contracts + shardings + costs + lints + mutations =="
+echo "== [18/20] static analysis: contracts + shardings + costs + lints + mutations =="
 # scripts/analyze.py compiles (never runs) the whole program matrix and
 # audits each program against its contract — collective schedule,
 # memory policy, baked constants, and (ISSUE 13) the declared
@@ -518,7 +553,7 @@ fi
 JAX_PLATFORMS=cpu python scripts/analyze.py --all --costs --shardings \
     --mutation-check
 
-echo "== [18/19] planner smoke: plan diff-gate + model-vs-measured drift =="
+echo "== [19/20] planner smoke: plan diff-gate + model-vs-measured drift =="
 # scripts/analyze.py --plan replans the default declared workload from
 # the calibration records committed in THIS tree (wirespeed / serve /
 # coldstart smokes + the EXP_PIPELINE_CPU.json schedule grid) and
@@ -531,7 +566,7 @@ echo "== [18/19] planner smoke: plan diff-gate + model-vs-measured drift =="
 # benches actually measured.
 JAX_PLATFORMS=cpu python scripts/analyze.py --plan
 
-echo "== [19/19] graft entry + 8-device sharded dryrun =="
+echo "== [20/20] graft entry + 8-device sharded dryrun =="
 python __graft_entry__.py
 
 echo "ci: all green"

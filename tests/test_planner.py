@@ -60,9 +60,9 @@ def test_make_plan_small_workload_feasible(small_plan):
     over = plan["chosen"]["config_overrides"]
     # every override names a real config surface
     assert set(over) == {
-        "merge_topology", "pipeline_merge", "merge_interval",
-        "serve_bucket_size", "serve_flush_s", "serve_continuous",
-        "replicas",
+        "merge_topology", "merge_wire_dtype", "pipeline_merge",
+        "merge_interval", "serve_bucket_size", "serve_flush_s",
+        "serve_continuous", "replicas",
     }
     pred = plan["chosen"]["predicted"]
     assert pred["serve"]["predicted_p99_ms"] <= SMALL["slo_p99_ms"]

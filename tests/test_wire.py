@@ -1,0 +1,653 @@
+"""Wire compression for the write path (ISSUE 20): codec roundtrips +
+error feedback, Procrustes payload alignment, per-tier policy
+resolution (loud on unknown tiers), config validation, the wire
+collectives vs their fp32 twins on the 8-device rig, the tiered fit
+A/B (compressed arms within 0.2 deg of the fp32 arm, fp32 policy
+bitwise identical to the off position), the collective-wire-dtype
+contract rule (positive / negative / CPU-normalized-bf16 halves), the
+seeded wire_dtype_drift mutation, the dtype-aware cost model + planner
+surface, and the summary()["merge"] wire telemetry with eviction fold.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_eigenspaces_tpu.analysis import contracts, costmodel
+from distributed_eigenspaces_tpu.analysis.contracts import ProgramParams
+from distributed_eigenspaces_tpu.analysis.hlo import CollectiveOp
+from distributed_eigenspaces_tpu.config import PCAConfig
+from distributed_eigenspaces_tpu.data.synthetic import planted_spectrum
+from distributed_eigenspaces_tpu.ops.linalg import (
+    principal_angles_degrees,
+)
+from distributed_eigenspaces_tpu.parallel.mesh import shard_map
+from distributed_eigenspaces_tpu.parallel.topology import (
+    MergeTopology,
+    make_tiered_mesh,
+    make_tree_scan_fit,
+    resolve_topology,
+)
+from distributed_eigenspaces_tpu.parallel.wire import (
+    WIRE_DTYPES,
+    WIRE_ITEMSIZE,
+    error_feedback,
+    procrustes_rotation,
+    resolve_wire_policy,
+    root_wire_dtype,
+    tier_wire_records,
+    wire_all_gather,
+    wire_all_to_all,
+    wire_roundtrip,
+)
+from distributed_eigenspaces_tpu.utils.metrics import MetricsLogger
+
+
+def _cfg(**kw):
+    base = dict(
+        dim=16, k=2, num_workers=4, rows_per_worker=8, num_steps=6,
+        backend="local", prefetch_depth=0,
+    )
+    base.update(kw)
+    return PCAConfig(**base)
+
+
+def _panel(rng, rows=12, k=3):
+    return jnp.asarray(rng.standard_normal((rows, k)), jnp.float32)
+
+
+# -- codec roundtrips --------------------------------------------------------
+
+
+class TestCodecs:
+    def test_fp32_roundtrip_is_identity(self, rng):
+        x = _panel(rng)
+        assert wire_roundtrip(x, "fp32") is x
+
+    def test_bf16_roundtrip_is_the_bf16_cast(self, rng):
+        x = _panel(rng)
+        rt = wire_roundtrip(x, "bf16")
+        np.testing.assert_array_equal(
+            np.asarray(rt),
+            np.asarray(x.astype(jnp.bfloat16).astype(jnp.float32)),
+        )
+
+    def test_int8_roundtrip_per_column_symmetric(self, rng):
+        x = np.array(_panel(rng, rows=32, k=4))
+        x[:, 2] = 0.0  # all-zero column must decode exactly
+        rt = np.asarray(wire_roundtrip(jnp.asarray(x), "int8"))
+        scale = np.abs(x).max(axis=0) / 127.0
+        err = np.abs(rt - x)
+        # per-column error bounded by that column's quantization step
+        assert (err <= scale[None, :] + 1e-7).all()
+        np.testing.assert_array_equal(rt[:, 2], 0.0)
+
+    def test_unknown_dtype_raises(self, rng):
+        with pytest.raises(ValueError, match="unknown wire dtype"):
+            wire_roundtrip(_panel(rng), "fp8")
+
+    def test_error_feedback_fp32_exact(self, rng):
+        x = _panel(rng)
+        r0 = jnp.ones_like(x)
+        x_adj, r1 = error_feedback(x, r0, "fp32")
+        assert x_adj is x
+        assert r1 is r0
+
+    def test_error_feedback_carries_rounding_residual(self, rng):
+        x = _panel(rng, rows=16, k=2)
+        r0 = jnp.zeros_like(x)
+        x_adj, r1 = error_feedback(x, r0, "int8")
+        np.testing.assert_array_equal(np.asarray(x_adj), np.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(r1),
+            np.asarray(x_adj - wire_roundtrip(x_adj, "int8")),
+            rtol=0, atol=1e-7,
+        )
+        # next round folds the residual in BEFORE quantizing: the sum
+        # of two decoded rounds re-presents what round one rounded off
+        x_adj2, _ = error_feedback(x, r1, "int8")
+        np.testing.assert_allclose(
+            np.asarray(x_adj2), np.asarray(x + r1), rtol=0, atol=1e-7
+        )
+
+
+class TestProcrustes:
+    def test_aligns_rotated_basis_back(self, rng):
+        k = 4
+        ref, _ = np.linalg.qr(rng.standard_normal((32, k)))
+        theta = 0.7
+        q = np.eye(k, dtype=np.float32)
+        q[:2, :2] = [[np.cos(theta), -np.sin(theta)],
+                     [np.sin(theta), np.cos(theta)]]
+        q[3, 3] = -1.0  # reflections allowed
+        x = (ref @ q).astype(np.float32)
+        r = np.asarray(procrustes_rotation(jnp.asarray(x.T @ ref)))
+        np.testing.assert_allclose(x @ r, ref, atol=1e-4)
+
+    def test_zero_reference_pins_identity(self):
+        m = jnp.zeros((3, 3), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(procrustes_rotation(m)), np.eye(3), atol=1e-5
+        )
+
+
+# -- policy resolution + config validation -----------------------------------
+
+
+class TestPolicy:
+    TOPO = MergeTopology((("chip", 2), ("host", 2)))
+
+    def test_none_policy_resolves_none(self):
+        assert resolve_wire_policy(_cfg(), self.TOPO) is None
+        assert root_wire_dtype(_cfg(), self.TOPO) == "fp32"
+
+    def test_unnamed_tiers_fill_fp32(self):
+        cfg = _cfg(
+            merge_topology=(("chip", 2), ("host", 2)),
+            merge_wire_dtype={"host": "int8"},
+        )
+        assert resolve_wire_policy(cfg, self.TOPO) == ("fp32", "int8")
+        assert root_wire_dtype(cfg, self.TOPO) == "int8"
+
+    def test_unknown_tier_key_raises_loudly(self):
+        class Raw:
+            merge_wire_dtype = {"pod": "int8"}
+
+        with pytest.raises(ValueError, match="name no resolved"):
+            resolve_wire_policy(Raw(), self.TOPO)
+
+    def test_unknown_dtype_raises_loudly(self):
+        class Raw:
+            merge_wire_dtype = {"host": "fp16"}
+
+        with pytest.raises(ValueError, match="not in"):
+            resolve_wire_policy(Raw(), self.TOPO)
+
+    @pytest.mark.parametrize("kw,match", [
+        (dict(merge_wire_dtype="int8"), "must be a mapping"),
+        (dict(merge_wire_dtype={"host": "int8"}),
+         "requires merge_topology"),
+        (dict(merge_wire_dtype={"host": "int8"},
+              merge_topology=(("chip", 2), ("host", 2)),
+              pipeline_merge=True), "pipeline_merge"),
+        (dict(merge_wire_dtype={"pod": "int8"},
+              merge_topology=(("chip", 2), ("host", 2))),
+         "names no"),
+        (dict(merge_wire_dtype={"host": "fp16"},
+              merge_topology=(("chip", 2), ("host", 2))),
+         "unknown.*wire dtype"),
+        (dict(merge_wire_dtype=(("host", "int8"), ("host", "bf16")),
+              merge_topology=(("chip", 2), ("host", 2))),
+         "unique"),
+    ])
+    def test_config_rejects_bad_policies(self, kw, match):
+        with pytest.raises(ValueError, match=match):
+            _cfg(**kw)
+
+    def test_config_normalizes_tier_ordered_pairs(self):
+        cfg = _cfg(
+            merge_topology=(("chip", 2), ("host", 2)),
+            merge_wire_dtype={"host": "int8", "chip": "bf16"},
+        )
+        assert cfg.merge_wire_dtype == (
+            ("chip", "bf16"), ("host", "int8")
+        )
+
+
+# -- wire collectives vs their fp32 twins ------------------------------------
+
+
+def _flat_mesh(devices):
+    return Mesh(np.array(devices).reshape(len(devices)), ("w",))
+
+
+class TestWireCollectives:
+    @pytest.mark.parametrize("dtype", ["bf16", "int8"])
+    def test_all_gather_close_to_fp32(self, devices, dtype, rng):
+        mesh = _flat_mesh(devices)
+        x = jnp.asarray(
+            rng.standard_normal((8 * 4, 3)), jnp.float32
+        )
+
+        def gather(xx):
+            return wire_all_gather(xx, "w", dtype, tiled=True)
+
+        got = shard_map(
+            gather, mesh=mesh, in_specs=P("w"), out_specs=P(),
+            check_vma=False,
+        )(x)
+        assert got.dtype == jnp.float32
+        assert got.shape == x.shape
+        tol = 2e-2 * float(jnp.abs(x).max())
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(x), atol=tol
+        )
+
+    @pytest.mark.parametrize("dtype", ["bf16", "int8"])
+    def test_all_to_all_close_to_fp32(self, devices, dtype, rng):
+        mesh = _flat_mesh(devices)
+        c = jnp.asarray(
+            rng.standard_normal((8, 8, 4, 3)), jnp.float32
+        )
+
+        def exchange(cc):
+            return wire_all_to_all(cc[0], "w", dtype)
+
+        def exchange_fp32(cc):
+            return wire_all_to_all(cc[0], "w", "fp32")
+
+        got = shard_map(
+            exchange, mesh=mesh, in_specs=P("w"), out_specs=P("w"),
+        )(c)
+        want = shard_map(
+            exchange_fp32, mesh=mesh, in_specs=P("w"), out_specs=P("w"),
+        )(c)
+        tol = 2e-2 * float(jnp.abs(c).max())
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=tol
+        )
+
+    def test_unknown_dtype_raises(self, devices, rng):
+        with pytest.raises(ValueError, match="unknown wire dtype"):
+            wire_all_gather(_panel(rng), "w", "fp64")
+        with pytest.raises(ValueError, match="unknown wire dtype"):
+            wire_all_to_all(_panel(rng)[None], "w", "fp64")
+
+
+# -- the tiered fit under a wire policy --------------------------------------
+
+
+def _fit_setup(policy):
+    cfg = _cfg(
+        dim=32, k=2, num_workers=4, rows_per_worker=16, num_steps=6,
+        merge_topology=(("chip", 2), ("host", 2)),
+        merge_wire_dtype=policy,
+    )
+    topo = resolve_topology(cfg)
+    mesh = make_tiered_mesh(topo)
+    spec = planted_spectrum(
+        cfg.dim, k_planted=cfg.k, gap=20.0, noise=0.01, seed=3
+    )
+    rows = cfg.num_steps * cfg.num_workers * cfg.rows_per_worker
+    x = jnp.asarray(
+        np.asarray(spec.sample(jax.random.PRNGKey(4), rows)).reshape(
+            cfg.num_steps, cfg.num_workers, cfg.rows_per_worker, cfg.dim
+        )
+    )
+    return cfg, mesh, spec, x
+
+
+class TestTieredWireFit:
+    def test_fp32_policy_bitwise_identical_to_off(self, devices):
+        """An explicit all-fp32 policy routes through the wire merge's
+        fp32 early-return — same collectives, same order, bitwise the
+        same result as the off position (the PR 2 off-position rule)."""
+        from distributed_eigenspaces_tpu.algo.online import OnlineState
+
+        cfg, mesh, _, x = _fit_setup(None)
+        cfg_fp32 = cfg.replace(
+            merge_wire_dtype={"chip": "fp32", "host": "fp32"}
+        )
+        st0 = OnlineState.initial(cfg.dim)
+        _, vb_off = make_tree_scan_fit(cfg, mesh)(st0, x)
+        _, vb_fp32 = make_tree_scan_fit(cfg_fp32, mesh)(st0, x)
+        np.testing.assert_array_equal(
+            np.asarray(vb_off), np.asarray(vb_fp32)
+        )
+
+    @pytest.mark.parametrize("policy", [
+        {"chip": "bf16", "host": "bf16"},
+        {"host": "int8"},
+    ])
+    def test_compressed_arm_tracks_fp32_arm(self, devices, policy):
+        from distributed_eigenspaces_tpu.algo.online import OnlineState
+
+        cfg, mesh, spec, x = _fit_setup(None)
+        st0 = OnlineState.initial(cfg.dim)
+        _, vb_ref = make_tree_scan_fit(cfg, mesh)(st0, x)
+        _, vb_wire, norms = make_tree_scan_fit(
+            cfg.replace(merge_wire_dtype=policy), mesh,
+            with_wire_stats=True,
+        )(st0, x)
+        gap = float(jnp.max(principal_angles_degrees(
+            vb_wire[-1], vb_ref[-1]
+        )))
+        assert gap <= 0.2, gap
+        # truth accuracy is whatever the fp32 arm achieves at these
+        # tiny shapes — the codec must not degrade it past the gap gate
+        truth_ref = float(jnp.max(principal_angles_degrees(
+            vb_ref[-1], spec.top_k(cfg.k)
+        )))
+        truth = float(jnp.max(principal_angles_degrees(
+            vb_wire[-1], spec.top_k(cfg.k)
+        )))
+        assert truth <= truth_ref + 0.2, (truth, truth_ref)
+        # the EF residual norms ride the scan output, one per tier
+        assert norms.shape == (cfg.num_steps, 2)
+        assert bool(jnp.all(jnp.isfinite(norms)))
+
+    def test_with_wire_stats_needs_active_policy(self, devices):
+        cfg, mesh, _, _ = _fit_setup(None)
+        with pytest.raises(ValueError, match="with_wire_stats"):
+            make_tree_scan_fit(cfg, mesh, with_wire_stats=True)
+
+
+# -- the collective-wire-dtype contract rule ---------------------------------
+
+
+def _op(op, dtype, shape, *, operands="param.1", groups="{0,1},{2,3}"):
+    line = (
+        f"  %x = {dtype}[{','.join(str(s) for s in shape)}] "
+        f"{op}({dtype}({operands})), replica_groups={{{groups}}}"
+    )
+    return CollectiveOp(op=op, dtype=dtype, shape=shape, line=line)
+
+
+def _params(**kw):
+    base = dict(
+        d=64, k=2, m=4,
+        tier_axes=("chip", "host"), tier_fan_ins=(2, 2),
+        tier_wire_dtypes=("fp32", "int8"),
+    )
+    base.update(kw)
+    return ProgramParams(**base)
+
+
+class TestWireDtypeRule:
+    CONTRACT = contracts.CONTRACTS["tree_merge"]
+
+    def _check(self, params, ops):
+        return contracts._check_wire_dtypes(
+            params, ops, self.CONTRACT, program="unit"
+        )
+
+    def test_declared_int8_tier_satisfied_by_s8_mover(self):
+        ops = [
+            _op("all-gather", "s8", (64, 2)),
+            _op("all-reduce", "f32", (4, 4)),
+        ]
+        assert self._check(_params(), ops) == []
+
+    def test_missing_compressed_mover_flagged(self):
+        # psums alone cannot satisfy a declared compression
+        ops = [_op("all-reduce", "f32", (4, 4))]
+        viols = self._check(_params(), ops)
+        assert len(viols) == 1
+        assert viols[0].rule == "collective-wire-dtype"
+        assert "never reaches the wire" in viols[0].message
+
+    def test_fullwidth_f32_mover_on_compressed_tier_flagged(self):
+        # the positive half is satisfied by the s8 gather, but a
+        # full-width f32 mover still rides the narrowed group (distinct
+        # fan-ins so the group size names ONLY the compressed tier —
+        # ambiguous fans are deliberately left alone)
+        ops = [
+            _op("all-gather", "s8", (64, 2)),
+            _op("all-gather", "f32", (64, 2)),
+        ]
+        viols = self._check(_params(tier_fan_ins=(4, 2)), ops)
+        assert len(viols) == 1
+        assert "full-width fp32 payload" in viols[0].message
+
+    def test_small_f32_sidecars_exempt(self):
+        # the int8 scale sidecar and masked-weight gathers sit under
+        # the d_local*k/2 floor — never flagged
+        ops = [
+            _op("all-gather", "s8", (64, 2)),
+            _op("all-gather", "f32", (2, 1, 2)),
+            _op("all-gather", "f32", (2,)),
+        ]
+        assert self._check(_params(), ops) == []
+
+    def test_bf16_accepts_cpu_normalized_spelling(self):
+        # XLA CPU float-normalization rewrites bf16 collectives to f32
+        # fed by fused converts — the rule accepts that spelling for
+        # bf16 tiers (values still bf16-rounded) but never for int8
+        params = _params(
+            tier_fan_ins=(4, 2), tier_wire_dtypes=("fp32", "bf16")
+        )
+        normalized = _op(
+            "all-gather", "f32", (64, 2),
+            operands="f32[32,2] %convert_convert_fusion",
+        )
+        assert self._check(params, [normalized]) == []
+        # a plain f32 mover (no convert in the operand list) does NOT
+        # count — the declared compression never happened
+        plain = _op("all-gather", "f32", (64, 2))
+        viols = self._check(params, [plain])
+        assert len(viols) == 2  # positive half missing + negative hit
+
+    def test_empty_declaration_skips_rule(self):
+        ops = [_op("all-gather", "f32", (64, 2))]
+        assert self._check(_params(tier_wire_dtypes=()), ops) == []
+
+
+def test_wire_dtype_drift_mutant_caught(devices):
+    """The seeded mutation pin (ISSUE 20 satellite): a tier merge that
+    ships its declared-int8 gather as raw f32 is named by the
+    collective-wire-dtype rule."""
+    from distributed_eigenspaces_tpu.analysis import mutations
+
+    rule, runner = mutations.MUTATIONS["wire_dtype_drift"]
+    assert rule == "collective-wire-dtype"
+    viols = runner()
+    hits = [v for v in viols if v.rule == rule]
+    assert hits, [v.format() for v in viols]
+
+
+def test_tree_fit_wire_program_ships_s8(devices):
+    """The registered wire audit program actually puts int8 on the
+    host tier's movers (bf16 rides the CPU-normalized spelling)."""
+    from distributed_eigenspaces_tpu.analysis import programs
+
+    built = programs.build_program("tree_fit_wire")
+    viols, detail = contracts.check_program(built)
+    assert not viols, [v.format() for v in viols]
+    ops = detail["collectives"]["ops"]
+    assert any(k.startswith("all-gather s8") for k in ops), ops
+    assert any(k.startswith("all-to-all s8") for k in ops), ops
+
+
+# -- cost model + planner surface --------------------------------------------
+
+
+class TestWireCosts:
+    def test_model_costs_prices_codec_widths(self):
+        p = _params(tier_wire_dtypes=("bf16", "int8"))
+        out = costmodel.model_costs("tree_merge", p)
+        chip, host = out["chip"], out["host"]
+        assert chip["wire_dtype"] == "bf16"
+        assert host["wire_dtype"] == "int8"
+        assert "scale_sidecar_bytes" in host
+        assert "scale_sidecar_bytes" not in chip
+        # fp32 twin for the byte ratio
+        ref = costmodel.model_costs(
+            "tree_merge", _params(tier_wire_dtypes=("fp32", "fp32"))
+        )
+        assert "wire_dtype" not in ref["host"]
+        assert chip["alltoall_factor_bytes"] * 2 == (
+            ref["chip"]["alltoall_factor_bytes"]
+        )
+        assert host["alltoall_factor_bytes"] * 4 == (
+            ref["host"]["alltoall_factor_bytes"]
+        )
+        # the Gram psum is NEVER compressed
+        assert host["gram_psum_bytes"] == ref["host"]["gram_psum_bytes"]
+
+    def test_projection_meets_reduction_floors(self):
+        proj = costmodel.projections()["wire_compression_large_d"]
+        assert proj["bf16"]["reduction_vs_fp32"] >= 2.0
+        assert proj["int8"]["reduction_vs_fp32"] >= 3.5
+
+    def test_tier_wire_records_ledger(self):
+        topo = MergeTopology((("chip", 2), ("host", 4)))
+        recs = tier_wire_records(
+            topo, ("bf16", "int8"), 64, 2,
+            residual_norms={"host": 0.25},
+        )
+        by_tier = {r["tier"]: r for r in recs}
+        assert by_tier["chip"]["compression_ratio"] == 2.0
+        host = by_tier["host"]
+        assert host["wire_dtype"] == "int8"
+        assert host["ef_residual_norm"] == 0.25
+        # int8 payload = movers at 1 byte + the fp32 scale sidecars
+        ring = 3 / 4
+        assert host["payload_bytes"] == int(round(
+            2 * ring * 64 * 2 * 1 + ring * 5 * 2 * 4
+        ))
+        assert host["fp32_bytes"] == int(round(2 * ring * 64 * 2 * 4))
+
+
+class TestPlannerWireSurface:
+    SPEC = {
+        "name": "wire-test", "d": 4096, "k": 8, "m": 8, "n": 64,
+        "qps": 50.0, "fleet": 2, "slo_p99_ms": 500.0,
+        "round_deadline_ms": 250.0,
+    }
+
+    def test_candidates_enumerate_wire_policies(self):
+        from distributed_eigenspaces_tpu.analysis import planner
+
+        spec = planner.validate_workload(self.SPEC)
+        cands = planner.enumerate_candidates(
+            spec, planner.load_calibration()
+        )
+        tiered = {
+            str(c["merge_wire_dtype"]) for c in cands
+            if c["merge_topology"] is not None
+        }
+        assert tiered == {"None", "{'host': 'bf16'}",
+                          "{'host': 'int8'}"}
+        # flat merges have no tiers to compress
+        assert all(
+            c["merge_wire_dtype"] is None for c in cands
+            if c["merge_topology"] is None
+        )
+
+    def test_fit_tiers_prices_compression(self):
+        from distributed_eigenspaces_tpu.analysis import planner
+
+        spec = planner.validate_workload(self.SPEC)
+        base = {
+            "merge_topology": (("chip", 4), ("host", 2)),
+            "merge_wire_dtype": None,
+        }
+        fp32 = planner._fit_tiers(dict(base), spec)
+        int8 = planner._fit_tiers(
+            dict(base, merge_wire_dtype={"host": "int8"}), spec
+        )
+        assert int8["host"]["wire_dtype"] == "int8"
+        assert "wire_dtype" not in int8["chip"]
+        assert int8["host"]["wire_bytes_per_round"] < (
+            fp32["host"]["wire_bytes_per_round"]
+        )
+        assert int8["host"]["modeled_ms_per_round"] < (
+            fp32["host"]["modeled_ms_per_round"]
+        )
+
+    def test_plan_overrides_carry_wire_policy(self):
+        from distributed_eigenspaces_tpu.analysis import planner
+
+        plan = planner.make_plan(self.SPEC)
+        over = plan["chosen"]["config_overrides"]
+        assert "merge_wire_dtype" in over
+        # at pod-ish d the DCN tier picks a compressed codec
+        if over["merge_topology"] is not None:
+            assert over["merge_wire_dtype"] is not None
+
+
+# -- merge wire telemetry -----------------------------------------------------
+
+
+class TestWireTelemetry:
+    def _records(self, n):
+        return [
+            {
+                "kind": "wire", "step": i, "tier": "host",
+                "wire_dtype": "int8", "payload_bytes": 280,
+                "fp32_bytes": 1024, "compression_ratio": 3.657,
+                "ef_residual_norm": 0.1 * (i + 1),
+            }
+            for i in range(n)
+        ]
+
+    def test_summary_aggregates_per_tier(self):
+        metrics = MetricsLogger()
+        for rec in self._records(3):
+            metrics.merge(rec)
+        wire = metrics.summary()["merge"]["wire"]["host"]
+        assert wire["rounds"] == 3
+        assert wire["wire_dtype"] == "int8"
+        assert wire["payload_bytes"] == 3 * 280
+        assert wire["fp32_bytes"] == 3 * 1024
+        assert wire["compression_ratio"] == 3.657
+        assert wire["ef_residual_norm"] == pytest.approx(0.3)
+        assert wire["ef_residual_norm_max"] == pytest.approx(0.3)
+
+    def test_eviction_folds_not_drops(self):
+        metrics = MetricsLogger(retention=4)
+        for rec in self._records(12):
+            metrics.merge(rec)
+        wire = metrics.summary()["merge"]["wire"]["host"]
+        # 8 evicted + 4 live: the ledger still counts all 12
+        assert wire["rounds"] == 12
+        assert wire["payload_bytes"] == 12 * 280
+        assert wire["ef_residual_norm_max"] == pytest.approx(1.2)
+
+    def test_tierset_emits_wire_rounds(self):
+        from distributed_eigenspaces_tpu.runtime.tiers import TierSet
+
+        cfg = _cfg(
+            merge_topology=(("w", 2), ("host", 2)),
+            merge_wire_dtype={"host": "int8"},
+            heartbeat_timeout_ms=100.0, round_deadline_ms=30.0,
+            min_quorum_frac=0.5,
+        )
+        topo = MergeTopology((("w", 2), ("host", 2)))
+        metrics = MetricsLogger()
+        ts = TierSet(
+            topo, cfg, metrics=metrics, clock=lambda: 0.0,
+            sleep=lambda s: None,
+        )
+        ts.note_wire_residuals({"host": 0.5})
+        ts.begin_round(1)
+        ts.begin_round(2)
+        merge = metrics.summary()["merge"]
+        wire = merge["wire"]
+        # fp32 tiers never enter the ledger; the int8 tier does
+        assert set(wire) == {"host"}
+        assert wire["host"]["rounds"] == 2
+        assert wire["host"]["wire_dtype"] == "int8"
+        assert wire["host"]["ef_residual_norm"] == 0.5
+
+
+# -- solver + cohort wire parameters -----------------------------------------
+
+
+def test_solver_wire_dtype_rejects_non_xla():
+    from distributed_eigenspaces_tpu.solvers.distributed import (
+        dist_merged_top_k,
+    )
+
+    with pytest.raises(ValueError, match="collectives='xla'"):
+        dist_merged_top_k(
+            jnp.zeros((1, 32, 2), jnp.float32), 2,
+            collectives="ring", wire_dtype="int8",
+        )
+
+
+def test_cohort_reduce_inherits_root_wire_dtype():
+    cfg = _cfg(
+        num_workers=4,
+        merge_topology=(("chip", 2), ("host", 2)),
+        merge_wire_dtype={"host": "int8"},
+    )
+    assert root_wire_dtype(cfg, resolve_topology(cfg)) == "int8"
+
+
+def test_wire_vocabulary_is_closed():
+    assert WIRE_DTYPES == ("fp32", "bf16", "int8")
+    assert set(WIRE_ITEMSIZE) == set(WIRE_DTYPES)
